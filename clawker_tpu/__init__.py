@@ -1,0 +1,34 @@
+"""clawker-tpu: a TPU-native agent-sandbox framework.
+
+A ground-up rebuild of the capabilities of schmitthub/clawker (reference at
+/root/reference): run AI coding-agent harnesses inside locked-down containers
+behind a deny-by-default egress firewall, with credential forwarding,
+git-worktree parallel agents, a control-plane daemon, and an observability
+stack -- re-designed so the compute backend is pluggable and Cloud TPU-VM
+workers are the first-class distributed runtime.
+
+Layer map (mirrors reference SURVEY.md section 1, re-architected for Python/C++):
+
+    cli/            host CLI verbs (reference: internal/cmd/*)
+    engine/         runtime-driver seam + Docker Engine API client
+                    (reference: pkg/whail + internal/docker)
+    runtime/        naming/label/PTY middleware (reference: internal/docker)
+    storage/        layered YAML Store (reference: internal/storage)
+    config/         project + settings schemas (reference: internal/config)
+    bundler/        Dockerfile generation (reference: internal/bundler)
+    bundle/         3-tier component resolution (reference: internal/bundle)
+    controlplane/   CP daemon: pubsub, events, registry, dialer, executor
+                    (reference: internal/controlplane + controlplane/*)
+    firewall/       PKI, Envoy/CoreDNS config gen, policy engine, eBPF loader
+                    (reference: controlplane/firewall)
+    agentd/         session protocol client for the C++ in-container PID 1
+                    (reference: clawkerd/)
+    fleet/          TPU-pod worker inventory + placement          (net-new)
+    loop/           autonomous agent-loop scheduler               (net-new)
+    analytics/      JAX fleet-telemetry analytics on TPU          (net-new)
+    monitor/        observability stack templates (reference: internal/monitor)
+    hostproxy/      host side-channel HTTP server (reference: internal/hostproxy)
+    socketbridge/   SSH/GPG agent forwarding mux (reference: internal/socketbridge)
+"""
+
+__version__ = "0.1.0"

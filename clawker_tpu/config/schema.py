@@ -1,0 +1,247 @@
+"""Typed configuration schemas: ``clawker.yaml`` (project) and ``settings.yaml``.
+
+Parity reference: internal/config schemas (SURVEY.md 2.5): project =
+build/agent/workspace/security; settings = logging, host_proxy,
+firewall.enable, monitoring, control_plane ports.  This build adds the
+``runtime`` settings block (driver selection + TPU-pod description) and the
+``loop`` block for the autonomous-loop scheduler -- both net-new per
+BASELINE.json north_star.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field, fields, is_dataclass
+from typing import Any, get_args, get_origin, get_type_hints
+
+
+# --------------------------------------------------------------------------
+# generic dict <-> dataclass plumbing
+# --------------------------------------------------------------------------
+
+def from_dict(cls, data: Any):
+    """Build dataclass ``cls`` from a raw tree, ignoring unknown keys."""
+    if data is None:
+        return cls()
+    if not isinstance(data, dict):
+        raise TypeError(f"{cls.__name__}: expected mapping, got {type(data).__name__}")
+    hints = get_type_hints(cls)
+    kwargs = {}
+    for f in fields(cls):
+        if f.name not in data:
+            continue
+        raw = data[f.name]
+        ft = hints[f.name]
+        kwargs[f.name] = _coerce(ft, raw)
+    return cls(**kwargs)
+
+
+def _coerce(ft, raw):
+    origin = get_origin(ft)
+    if is_dataclass(ft):
+        return from_dict(ft, raw)
+    if origin is list:
+        (elem,) = get_args(ft)
+        if raw is None:
+            return []
+        return [_coerce(elem, r) for r in raw]
+    if origin is dict:
+        _, vt = get_args(ft)
+        if raw is None:
+            return {}
+        return {k: _coerce(vt, v) for k, v in raw.items()}
+    if origin is not None:  # Optional[...] and friends: pass through
+        return raw
+    if ft is float and isinstance(raw, int):
+        return float(raw)
+    return raw
+
+
+def to_dict(obj) -> dict:
+    """Dataclass -> plain tree, dropping values equal to the field default."""
+    out: dict[str, Any] = {}
+    for f in fields(obj):
+        v = getattr(obj, f.name)
+        if f.default is not dataclasses.MISSING and v == f.default:
+            continue
+        if f.default_factory is not dataclasses.MISSING and v == f.default_factory():  # type: ignore[misc]
+            continue
+        if is_dataclass(v):
+            sub = to_dict(v)
+            if sub:
+                out[f.name] = sub
+        elif isinstance(v, list):
+            out[f.name] = [to_dict(i) if is_dataclass(i) else i for i in v]
+        else:
+            out[f.name] = v
+    return out
+
+
+# --------------------------------------------------------------------------
+# project config (clawker.yaml)
+# --------------------------------------------------------------------------
+
+@dataclass
+class EgressRule:
+    """One egress allowance.
+
+    ``dst`` is a domain (exact or ``*.wildcard``), ``proto`` one of
+    http|https|tcp|udp, ``port`` the destination port (0 = protocol default),
+    ``paths`` optional HTTP path prefixes that force MITM inspection
+    (reference: firewall rules store dedupe key ``dst:proto:port``,
+    controlplane/firewall/rules_store.go).
+    """
+
+    dst: str = ""
+    proto: str = "https"
+    port: int = 0
+    paths: list[str] = field(default_factory=list)
+
+    def key(self) -> str:
+        return f"{self.dst}:{self.proto}:{self.effective_port()}"
+
+    def effective_port(self) -> int:
+        if self.port:
+            return self.port
+        return {"https": 443, "http": 80, "udp": 0, "tcp": 0}.get(self.proto, 0)
+
+
+@dataclass
+class BuildConfig:
+    image: str = ""                 # base image override (else stack default)
+    stack: str = ""                 # language stack bundle (python, go, node...)
+    harness: str = "claude"         # agent harness bundle
+    packages: list[str] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+    instructions: list[str] = field(default_factory=list)  # extra Dockerfile lines
+
+
+@dataclass
+class AgentConfig:
+    default: str = "dev"            # default agent name
+    cmd: list[str] = field(default_factory=list)   # override harness CMD
+    env: dict[str, str] = field(default_factory=dict)
+    memory: str = ""                # container memory limit, e.g. "8g"
+    cpus: float = 0.0
+
+
+@dataclass
+class WorkspaceConfig:
+    mode: str = "bind"              # bind | snapshot (reference: internal/workspace)
+    mount_docker_socket: bool = False
+    extra_mounts: list[str] = field(default_factory=list)  # "src:dst[:ro]"
+
+
+@dataclass
+class SecurityConfig:
+    egress: list[EgressRule] = field(default_factory=list)
+    allow_host_proxy: bool = True
+    bypass_firewall: bool = False   # dev-only full bypass
+
+
+@dataclass
+class ProjectConfig:
+    project: str = ""
+    build: BuildConfig = field(default_factory=BuildConfig)
+    agent: AgentConfig = field(default_factory=AgentConfig)
+    workspace: WorkspaceConfig = field(default_factory=WorkspaceConfig)
+    security: SecurityConfig = field(default_factory=SecurityConfig)
+
+    @staticmethod
+    def merge_strategies() -> dict[str, str]:
+        """Dotted-path merge strategies for the layered store (union lists)."""
+        return {
+            "build.packages": "union",
+            "build.instructions": "union",
+            "security.egress": "union",
+            "workspace.extra_mounts": "union",
+        }
+
+
+# --------------------------------------------------------------------------
+# settings (settings.yaml)
+# --------------------------------------------------------------------------
+
+@dataclass
+class LoggingSettings:
+    level: str = "info"
+    file_enabled: bool = True
+    otlp_enabled: bool = False
+
+
+@dataclass
+class HostProxySettings:
+    enable: bool = True
+    port: int = 18374
+
+
+@dataclass
+class FirewallSettings:
+    enable: bool = False
+    default_deny: bool = True
+    dns_upstreams: list[str] = field(default_factory=list)  # default: consts.UPSTREAM_DNS
+
+
+@dataclass
+class MonitoringSettings:
+    enable: bool = False
+    opensearch_port: int = 9200
+    dashboards_port: int = 5601
+    prometheus_port: int = 9090
+    otlp_grpc_port: int = 4317
+
+
+@dataclass
+class ControlPlaneSettings:
+    admin_port: int = 7443
+    agent_port: int = 7444
+    health_port: int = 7080
+    per_worker: bool = True         # tpu_vm: one CP per worker VM + fleet aggregation
+
+
+@dataclass
+class TPUSettings:
+    """TPU-pod runtime description (net-new; BASELINE.json north_star)."""
+
+    pod: str = ""                   # TPU name, e.g. "my-v5e-8"
+    zone: str = ""
+    project: str = ""               # GCP project
+    ssh_user: str = "clawker"
+    ssh_key: str = ""               # path to private key; empty = agent/default
+    workers: list[str] = field(default_factory=list)  # explicit host list override
+    accelerator: str = "v5litepod-8"
+
+
+@dataclass
+class LoopSettings:
+    """Autonomous-loop scheduler defaults (net-new)."""
+
+    parallel: int = 1
+    max_iterations: int = 0         # 0 = unbounded
+    idle_exit_s: float = 300.0
+    placement: str = "spread"       # spread | pack
+
+
+@dataclass
+class RuntimeSettings:
+    driver: str = "local"           # local | tpu_vm | fake
+    docker_host: str = ""           # override local daemon address
+    tpu: TPUSettings = field(default_factory=TPUSettings)
+
+
+@dataclass
+class Settings:
+    logging: LoggingSettings = field(default_factory=LoggingSettings)
+    host_proxy: HostProxySettings = field(default_factory=HostProxySettings)
+    firewall: FirewallSettings = field(default_factory=FirewallSettings)
+    monitoring: MonitoringSettings = field(default_factory=MonitoringSettings)
+    control_plane: ControlPlaneSettings = field(default_factory=ControlPlaneSettings)
+    runtime: RuntimeSettings = field(default_factory=RuntimeSettings)
+    loop: LoopSettings = field(default_factory=LoopSettings)
+
+    @staticmethod
+    def merge_strategies() -> dict[str, str]:
+        return {
+            "firewall.dns_upstreams": "union",
+            "runtime.tpu.workers": "union",
+        }

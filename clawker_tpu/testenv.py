@@ -1,0 +1,77 @@
+"""Isolated test environments (public test support).
+
+Parity reference: internal/testenv -- isolated XDG dirs wired through env
+overrides so tests never touch the real user config (SURVEY.md 4).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import tempfile
+from pathlib import Path
+
+from . import consts
+
+
+class TestEnv(contextlib.AbstractContextManager):
+    """Creates throwaway XDG dirs and points CLAWKER_TPU_*_DIR at them."""
+
+    def __init__(self, base: Path | None = None):
+        self._tmp = None
+        if base is None:
+            self._tmp = tempfile.TemporaryDirectory(prefix="clawker-tpu-test-")
+            base = Path(self._tmp.name)
+        self.base = Path(base)
+        self.config = self.base / "config"
+        self.data = self.base / "data"
+        self.state = self.base / "state"
+        self.cache = self.base / "cache"
+        self._saved: dict[str, str | None] = {}
+
+    def __enter__(self) -> "TestEnv":
+        for p in (self.config, self.data, self.state, self.cache):
+            p.mkdir(parents=True, exist_ok=True)
+        mapping = {
+            consts.ENV_CONFIG_DIR: self.config,
+            consts.ENV_DATA_DIR: self.data,
+            consts.ENV_STATE_DIR: self.state,
+            consts.ENV_CACHE_DIR: self.cache,
+        }
+        for k, v in mapping.items():
+            self._saved[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for k, old in self._saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        if self._tmp is not None:
+            self._tmp.cleanup()
+
+    # convenience writers -------------------------------------------------
+
+    def write_settings(self, text: str) -> Path:
+        p = self.config / consts.SETTINGS_FILE
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+        return p
+
+    def make_project(self, root: Path, text: str, *, form: str = "flat", local: str | None = None) -> Path:
+        root.mkdir(parents=True, exist_ok=True)
+        if form == "dir":
+            d = root / consts.PROJECT_DIR_FORM
+            d.mkdir(exist_ok=True)
+            main = d / "clawker.yaml"
+            main.write_text(text)
+            if local is not None:
+                (d / "clawker.local.yaml").write_text(local)
+        else:
+            main = root / consts.PROJECT_FLAT_FORM
+            main.write_text(text)
+            if local is not None:
+                (root / ".clawker.local.yaml").write_text(local)
+        return main

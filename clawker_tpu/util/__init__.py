@@ -1,0 +1,1 @@
+"""Small leaf utilities: filesystem, XDG paths, text, ids."""

@@ -1,0 +1,33 @@
+"""Stable id / hash helpers."""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+
+
+def short_id(n: int = 12) -> str:
+    """Random hex id (container-id style)."""
+    return secrets.token_hex((n + 1) // 2)[:n]
+
+
+def content_sha(data: bytes) -> str:
+    """Content-derived cache key (reference: controlplane/manager content-SHA
+    CP image tag ``clawker-controlplane:bin-<sha>``)."""
+    return hashlib.sha256(data).hexdigest()[:16]
+
+
+def domain_hash(domain: str) -> int:
+    """64-bit FNV-1a over the lowercase domain.
+
+    Mirrors the kernel-side hashing contract: the DNS plugin writes
+    ``ip -> {domain_hash, ttl}`` into the dns_cache map and the route map is
+    keyed by ``{domain_hash, dst_port}`` (reference: bpf/common.h dns_cache /
+    route_map; internal/dnsbpf bpfmap.go:29-51).  Python and the C eBPF
+    source (native/ebpf) must agree on this exact function.
+    """
+    h = 0xCBF29CE484222325
+    for b in domain.lower().encode("ascii", "ignore"):
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h

@@ -1,0 +1,20 @@
+"""Test bootstrap.
+
+JAX-using tests run on a virtual 8-device CPU mesh; env must be set before
+jax is first imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import pytest
+
+from clawker_tpu.testenv import TestEnv
+
+
+@pytest.fixture()
+def tenv():
+    with TestEnv() as env:
+        yield env

@@ -1,0 +1,96 @@
+"""Config facade tests: schema coercion, settings/project stores, egress
+composition, XDG isolation."""
+
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.config import (
+    EgressRule,
+    ProjectConfig,
+    Settings,
+    load_config,
+    settings_store,
+)
+from clawker_tpu.config.schema import from_dict, to_dict
+from clawker_tpu.util import xdg
+
+
+def test_from_dict_nested_and_unknown_keys():
+    p = from_dict(
+        ProjectConfig,
+        {
+            "project": "demo",
+            "build": {"stack": "python", "packages": ["ripgrep"], "bogus": 1},
+            "security": {"egress": [{"dst": "pypi.org", "proto": "https"}]},
+            "unknown_top": True,
+        },
+    )
+    assert p.project == "demo"
+    assert p.build.stack == "python"
+    assert p.build.packages == ["ripgrep"]
+    assert p.security.egress[0].dst == "pypi.org"
+
+
+def test_to_dict_drops_defaults():
+    p = ProjectConfig(project="demo")
+    d = to_dict(p)
+    assert d == {"project": "demo"}
+
+
+def test_egress_rule_key_and_default_port():
+    r = EgressRule(dst="pypi.org", proto="https")
+    assert r.effective_port() == 443
+    assert r.key() == "pypi.org:https:443"
+
+
+def test_settings_defaults(tenv):
+    s = settings_store().typed()
+    assert isinstance(s, Settings)
+    assert s.firewall.enable is False
+    assert s.runtime.driver == "local"
+    assert s.control_plane.admin_port == 7443
+
+
+def test_settings_file_overrides(tenv):
+    tenv.write_settings("firewall:\n  enable: true\nruntime:\n  driver: tpu_vm\n  tpu:\n    pod: my-v5e\n")
+    s = settings_store().typed()
+    assert s.firewall.enable is True
+    assert s.runtime.driver == "tpu_vm"
+    assert s.runtime.tpu.pod == "my-v5e"
+
+
+def test_xdg_isolation(tenv):
+    assert str(xdg.config_dir()) == str(tenv.config)
+    assert xdg.validate_directories() == []
+
+
+def test_load_config_with_project(tenv, tmp_path):
+    tenv.make_project(
+        tmp_path,
+        "project: demo\nsecurity:\n  egress:\n    - dst: pypi.org\n      proto: https\n",
+    )
+    cfg = load_config(tmp_path)
+    assert cfg.project_name() == "demo"
+    keys = {r.key() for r in cfg.egress_rules()}
+    assert "pypi.org:https:443" in keys
+    # required internal domains always present
+    assert any(r.dst == "api.anthropic.com" for r in cfg.egress_rules())
+
+
+def test_load_config_no_project(tenv, tmp_path):
+    cfg = load_config(tmp_path)
+    assert cfg.project is None
+    with pytest.raises(LookupError):
+        cfg.project_name()
+
+
+def test_project_local_overlay_union(tenv, tmp_path):
+    tenv.make_project(
+        tmp_path,
+        "project: demo\nbuild:\n  packages: [a]\n",
+        local="build:\n  packages: [b]\n",
+    )
+    cfg = load_config(tmp_path)
+    assert cfg.project.build.packages == ["a", "b"]

@@ -1,0 +1,276 @@
+"""Storage engine tests: merge semantics (incl. randomized oracle), layered
+store provenance + routed writes, migrations, discovery.
+
+Modeled on the reference's oracle+golden dual guard for merge correctness
+(SURVEY.md 4, TESTING-REFERENCE.md:880-915).
+"""
+
+import random
+from pathlib import Path
+
+import pytest
+import yaml
+
+from clawker_tpu import consts
+from clawker_tpu.storage import Layer, Store, discover_project_layers, merge_trees
+from clawker_tpu.storage.merge import UNION, get_path
+
+
+# ---------------------------------------------------------------- merge unit
+
+def test_scalar_override_order():
+    merged, prov = merge_trees([{"a": 1}, {"a": 2}, {"a": 3}])
+    assert merged == {"a": 3}
+    assert prov[("a",)] == (2,)
+
+
+def test_absent_key_does_not_mask():
+    merged, _ = merge_trees([{"a": 1, "b": 1}, {"b": 2}])
+    assert merged == {"a": 1, "b": 2}
+
+
+def test_explicit_null_overrides():
+    merged, _ = merge_trees([{"a": 1}, {"a": None}])
+    assert merged == {"a": None}
+
+
+def test_nested_recursive_merge():
+    merged, _ = merge_trees(
+        [{"x": {"p": 1, "q": 1}}, {"x": {"q": 2, "r": 2}}]
+    )
+    assert merged == {"x": {"p": 1, "q": 2, "r": 2}}
+
+
+def test_list_overwrite_default():
+    merged, _ = merge_trees([{"l": [1, 2]}, {"l": [3]}])
+    assert merged == {"l": [3]}
+
+
+def test_list_union_strategy():
+    merged, prov = merge_trees(
+        [{"l": [1, 2]}, {"l": [2, 3]}],
+        {("l",): UNION},
+    )
+    assert merged == {"l": [1, 2, 3]}
+    assert prov[("l",)] == (0, 1)
+
+
+def test_union_of_dicts_dedupes_by_value():
+    a = {"rules": [{"dst": "a.com", "port": 443}]}
+    b = {"rules": [{"dst": "a.com", "port": 443}, {"dst": "b.com", "port": 443}]}
+    merged, _ = merge_trees([a, b], {("rules",): UNION})
+    assert merged["rules"] == [
+        {"dst": "a.com", "port": 443},
+        {"dst": "b.com", "port": 443},
+    ]
+
+
+def test_shape_change_wins():
+    merged, _ = merge_trees([{"a": {"x": 1}}, {"a": "scalar"}])
+    assert merged == {"a": "scalar"}
+
+
+def test_wildcard_strategy():
+    merged, _ = merge_trees(
+        [{"m": {"k1": [1]}}, {"m": {"k1": [2]}}],
+        {("m", "*"): UNION},
+    )
+    assert merged == {"m": {"k1": [1, 2]}}
+
+
+# ------------------------------------------------------------- merge oracle
+
+def _oracle_merge(trees, strategies, path=()):
+    """Independent spec-derived implementation used as the oracle."""
+    present = [t for t in trees if t is not _MISSING]
+    if not present:
+        return _MISSING
+    if all(isinstance(t, dict) for t in present):
+        keys = []
+        for t in present:
+            for k in t:
+                if k not in keys:
+                    keys.append(k)
+        return {
+            k: _oracle_merge(
+                [t[k] if isinstance(t, dict) and k in t else _MISSING for t in trees],
+                strategies,
+                path + (k,),
+            )
+            for k in keys
+        }
+    if all(isinstance(t, list) for t in present) and strategies.get(path) == UNION:
+        out, seen = [], set()
+        for t in present:
+            for item in t:
+                if repr(item) not in seen:
+                    seen.add(repr(item))
+                    out.append(item)
+        return out
+    return present[-1]
+
+
+class _Missing:
+    def __repr__(self):
+        return "<missing>"
+
+
+_MISSING = _Missing()
+
+
+def _rand_tree(rng, depth=0):
+    r = rng.random()
+    if depth >= 3 or r < 0.3:
+        return rng.choice([1, 2, "s", True, None, [1, 2], ["x", "y", "x"]])
+    return {
+        f"k{rng.randint(0, 4)}": _rand_tree(rng, depth + 1)
+        for _ in range(rng.randint(1, 4))
+    }
+
+
+def test_merge_oracle_randomized():
+    rng = random.Random(20260729)
+    for _ in range(300):
+        n = rng.randint(1, 4)
+        trees = [_rand_tree(rng) for _ in range(n)]
+        # random union strategies over some paths that exist
+        strategies = {}
+        for t in trees:
+            if isinstance(t, dict):
+                for k in t:
+                    if rng.random() < 0.3:
+                        strategies[(k,)] = UNION
+        got, _ = merge_trees(trees, strategies)
+        got = {} if got is None else got
+        # whole-layer None means "file absent" in store semantics: the layer
+        # simply does not participate (store.reload filters them out).
+        want = _oracle_merge(
+            [t if t is not None else _MISSING for t in trees], strategies
+        )
+        want = {} if want is _MISSING else want
+        assert got == want, f"trees={trees} strategies={strategies}"
+
+
+# ---------------------------------------------------------------- store
+
+def _mk_store(tmp_path: Path, **kw) -> Store:
+    low = Layer("low", tmp_path / "low.yaml")
+    high = Layer("high", tmp_path / "high.yaml")
+    return Store([low, high], **kw)
+
+
+def test_store_layering_and_provenance(tmp_path):
+    s = _mk_store(tmp_path)
+    s.write_layer("low", {"a": 1, "b": {"c": 1}})
+    s.write_layer("high", {"b": {"c": 2}})
+    assert s.get("a") == 1
+    assert s.get("b.c") == 2
+    assert s.provenance_of("a") == ["low"]
+    assert s.provenance_of("b.c") == ["high"]
+
+
+def test_store_provenance_routed_write(tmp_path):
+    s = _mk_store(tmp_path)
+    s.write_layer("low", {"a": 1})
+    s.write_layer("high", {"b": 2})
+    s.set("a", 10)  # `a` came from low -> write goes to low
+    raw_low = yaml.safe_load((tmp_path / "low.yaml").read_text())
+    assert raw_low["a"] == 10
+    s.set("new.key", "v")  # new key -> highest writable layer
+    raw_high = yaml.safe_load((tmp_path / "high.yaml").read_text())
+    assert raw_high["new"]["key"] == "v"
+
+
+def test_store_readonly_layer_not_routed(tmp_path):
+    low = Layer("low", tmp_path / "low.yaml")
+    ro = Layer("ro", tmp_path / "ro.yaml", writable=False)
+    (tmp_path / "ro.yaml").write_text("a: 5\n")
+    s = Store([low, ro])
+    s.set("a", 9)  # provenance says ro, but ro is read-only -> falls to low
+    assert yaml.safe_load((tmp_path / "low.yaml").read_text())["a"] == 9
+    # effective value still 5: ro overrides low
+    s.reload()
+    assert s.get("a") == 5
+
+
+def test_store_unset(tmp_path):
+    s = _mk_store(tmp_path)
+    s.write_layer("high", {"a": 1})
+    assert s.unset("a") is True
+    s.reload()
+    assert s.get("a") is None
+
+
+def test_store_atomicity_empty_file(tmp_path):
+    (tmp_path / "low.yaml").write_text("")
+    s = _mk_store(tmp_path)
+    assert s.raw() == {}
+
+
+def test_store_rejects_non_mapping(tmp_path):
+    (tmp_path / "low.yaml").write_text("- just\n- a list\n")
+    s = _mk_store(tmp_path)
+    with pytest.raises(ValueError):
+        s.raw()
+
+
+def test_store_migrations(tmp_path):
+    def m2(tree):
+        tree["renamed"] = tree.pop("old", None)
+        return tree
+
+    (tmp_path / "low.yaml").write_text("old: 42\n")
+    s = Store([Layer("low", tmp_path / "low.yaml")], migrations=[(2, m2)], version=2)
+    assert s.get("renamed") == 42
+    assert s.get("old") is None
+    # migration persists on next write
+    s.set("x", 1)
+    raw = yaml.safe_load((tmp_path / "low.yaml").read_text())
+    assert raw["renamed"] == 42 and "old" not in raw and raw["_v"] == 2
+
+
+# ------------------------------------------------------------- discovery
+
+def test_discovery_flat_form(tmp_path):
+    (tmp_path / consts.PROJECT_FLAT_FORM).write_text("project: p\n")
+    d = discover_project_layers(tmp_path)
+    assert d is not None and d.form == "flat" and d.root == tmp_path
+
+
+def test_discovery_dir_form_wins(tmp_path):
+    (tmp_path / consts.PROJECT_FLAT_FORM).write_text("project: flat\n")
+    dd = tmp_path / consts.PROJECT_DIR_FORM
+    dd.mkdir()
+    (dd / "clawker.yaml").write_text("project: dir\n")
+    d = discover_project_layers(tmp_path)
+    assert d is not None and d.form == "dir"
+
+
+def test_discovery_walkup(tmp_path):
+    (tmp_path / consts.PROJECT_FLAT_FORM).write_text("project: p\n")
+    nested = tmp_path / "a" / "b" / "c"
+    nested.mkdir(parents=True)
+    d = discover_project_layers(nested)
+    assert d is not None and d.root == tmp_path
+
+
+def test_discovery_limit(tmp_path):
+    (tmp_path / consts.PROJECT_FLAT_FORM).write_text("project: p\n")
+    cur = tmp_path
+    for i in range(consts.WALKUP_LIMIT + 2):
+        cur = cur / f"d{i}"
+    cur.mkdir(parents=True)
+    assert discover_project_layers(cur) is None
+
+
+def test_discovery_none(tmp_path):
+    assert discover_project_layers(tmp_path) is None
+
+
+def test_local_overlay_merges(tmp_path):
+    (tmp_path / consts.PROJECT_FLAT_FORM).write_text("project: p\nbuild:\n  stack: python\n")
+    (tmp_path / ".clawker.local.yaml").write_text("build:\n  harness: codex\n")
+    d = discover_project_layers(tmp_path)
+    s = Store(d.layers)
+    assert s.get("build.stack") == "python"
+    assert s.get("build.harness") == "codex"
