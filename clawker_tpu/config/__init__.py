@@ -19,6 +19,7 @@ from .schema import (
     LoggingSettings,
     HostProxySettings,
     LoopSettings,
+    RuntimeSettings,
 )
 from .config import Config, load_config, project_store, settings_store
 
@@ -34,6 +35,7 @@ __all__ = [
     "LoopSettings",
     "MonitoringSettings",
     "ProjectConfig",
+    "RuntimeSettings",
     "SecurityConfig",
     "Settings",
     "TPUSettings",

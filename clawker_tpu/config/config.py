@@ -9,11 +9,12 @@ internal rules with project rules (SURVEY.md 2.5).
 from __future__ import annotations
 
 import functools
+import re
 from dataclasses import dataclass
 from pathlib import Path
 
 from .. import consts
-from ..util import xdg
+from ..util import text, xdg
 from ..storage import Layer, Store, discover_project_layers
 from .schema import EgressRule, ProjectConfig, Settings, from_dict
 
@@ -97,9 +98,12 @@ class Config:
 
     def project_name(self) -> str:
         if self.project and self.project.project:
-            return self.project.project
+            return text.validate_name("project", self.project.project)
         if self.project_root is not None:
-            return self.project_root.name.lower().replace(".", "-")
+            # sanitize the directory name into the container-name charset
+            raw = self.project_root.name.lower()
+            name = re.sub(r"[^a-z0-9_-]+", "-", raw).strip("-_") or "project"
+            return text.validate_name("project", name)
         raise LookupError("no project configured here (run `clawker init`)")
 
     def egress_rules(self) -> list[EgressRule]:

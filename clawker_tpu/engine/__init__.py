@@ -1,0 +1,20 @@
+"""Engine layer: the only code that talks to container daemons.
+
+Parity reference: pkg/whail (label-jailed engine over the moby SDK,
+pkg/whail/engine.go:32) + internal/docker middleware.  This build collapses
+the SDK dependency: ``HTTPDockerAPI`` speaks the Docker Engine HTTP API
+directly (unix socket, TCP, or an SSH-forwarded socket on a TPU-VM worker),
+and ``Engine`` enforces the managed-label jail above it.  ``FakeDockerAPI``
+is the in-process test seam (reference: pkg/whail/whailtest FakeAPIClient).
+
+Rule carried over from the reference architecture: all daemon calls go
+through this package (".claude/docs/ARCHITECTURE.md:833 — All Docker SDK
+calls go through pkg/whail").
+"""
+
+from .api import Engine
+from .httpapi import HTTPDockerAPI
+from .fake import FakeDockerAPI, FakeContainer
+from .errors_map import APIError
+
+__all__ = ["Engine", "HTTPDockerAPI", "FakeDockerAPI", "FakeContainer", "APIError"]

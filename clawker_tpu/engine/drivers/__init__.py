@@ -1,0 +1,18 @@
+"""Runtime drivers: where the compute backend becomes pluggable.
+
+This seam is the core TPU-first design decision (SURVEY.md 7: "keep the
+architecture, make the compute backend pluggable").  The reference hard-codes
+one local Docker daemon; here every daemon lives behind a
+:class:`RuntimeDriver` exposing one or more :class:`Worker` endpoints:
+
+* ``local``  -- the laptop's Docker daemon (1 worker)
+* ``tpu_vm`` -- every worker VM of a Cloud TPU pod, each running its own
+  daemon reached over an SSH-forwarded socket (N workers)
+* ``fake``   -- in-process fake daemons for tests (N workers)
+"""
+
+from .base import RuntimeDriver, Worker, get_driver
+from .local import LocalDriver
+from .fakedriver import FakeDriver
+
+__all__ = ["RuntimeDriver", "Worker", "LocalDriver", "FakeDriver", "get_driver"]

@@ -1,0 +1,80 @@
+"""RuntimeDriver seam: pluggable container backends."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
+
+from ...errors import ConfigError, DriverError
+from ..api import Engine
+
+if TYPE_CHECKING:
+    from ...config.schema import Settings
+
+
+@dataclass
+class Worker:
+    """One daemon endpoint (a host that can run agent containers).
+
+    For the local driver there is exactly one.  For ``tpu_vm`` there is one
+    per TPU-VM worker; ``index`` is the TPU worker index (used for
+    topology-aware placement by the loop scheduler) and ``hostname`` the
+    SSH target.
+    """
+
+    id: str
+    index: int = 0
+    hostname: str = "localhost"
+    engine: Engine | None = None
+    meta: dict = field(default_factory=dict)
+
+    def require_engine(self) -> Engine:
+        if self.engine is None:
+            raise DriverError(f"worker {self.id}: engine not connected")
+        return self.engine
+
+
+class RuntimeDriver:
+    """Abstract driver: a named set of workers with engines.
+
+    Subclasses implement :meth:`connect` (build Worker list with live
+    engines) plus any transport-specific provisioning.
+    """
+
+    name = "abstract"
+
+    def connect(self) -> list[Worker]:
+        raise NotImplementedError
+
+    def workers(self) -> list[Worker]:
+        raise NotImplementedError
+
+    def default_worker(self) -> Worker:
+        ws = self.workers()
+        if not ws:
+            raise DriverError(f"driver {self.name}: no workers available")
+        return ws[0]
+
+    def engine(self) -> Engine:
+        """Engine of the default worker (single-daemon callers)."""
+        return self.default_worker().require_engine()
+
+    def close(self) -> None:
+        pass
+
+
+def get_driver(settings: "Settings", *, override: str = "") -> RuntimeDriver:
+    """Driver factory from settings.runtime.driver (or explicit override)."""
+    from .fakedriver import FakeDriver
+    from .local import LocalDriver
+
+    name = override or settings.runtime.driver
+    if name == "local":
+        return LocalDriver(docker_host=settings.runtime.docker_host)
+    if name == "fake":
+        return FakeDriver()
+    if name == "tpu_vm":
+        from .tpu_vm import TPUVMDriver
+
+        return TPUVMDriver(settings.runtime.tpu)
+    raise ConfigError(f"unknown runtime driver {name!r} (expected local|tpu_vm|fake)")

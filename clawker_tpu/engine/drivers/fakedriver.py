@@ -1,0 +1,34 @@
+"""Fake driver: N in-process fake daemons (test seam for multi-worker paths)."""
+
+from __future__ import annotations
+
+from ..api import Engine
+from ..fake import FakeDockerAPI
+from .base import RuntimeDriver, Worker
+
+
+class FakeDriver(RuntimeDriver):
+    name = "fake"
+
+    def __init__(self, n_workers: int = 1):
+        self.apis = [FakeDockerAPI() for _ in range(n_workers)]
+        self._workers = [
+            Worker(
+                id=f"fake-{i}",
+                index=i,
+                hostname=f"fake-worker-{i}",
+                engine=Engine(api),
+            )
+            for i, api in enumerate(self.apis)
+        ]
+
+    def connect(self) -> list[Worker]:
+        return self._workers
+
+    def workers(self) -> list[Worker]:
+        return self._workers
+
+    @property
+    def api(self) -> FakeDockerAPI:
+        """Default worker's fake API (single-worker tests)."""
+        return self.apis[0]

@@ -1,0 +1,43 @@
+"""Cloud TPU-VM runtime driver (skeleton; full transport in fleet/ + ssh).
+
+Provisions and attaches to Docker daemons on every worker VM of a TPU pod
+over SSH (BASELINE.json north_star).  The full implementation lands with the
+fleet subsystem; this module keeps the driver factory importable.
+"""
+
+from __future__ import annotations
+
+from ...config.schema import TPUSettings
+from ...errors import DriverError
+from .base import RuntimeDriver, Worker
+
+
+class TPUVMDriver(RuntimeDriver):
+    name = "tpu_vm"
+
+    def __init__(self, tpu: TPUSettings):
+        self.tpu = tpu
+        self._workers: list[Worker] | None = None
+
+    def connect(self) -> list[Worker]:
+        from ...fleet.inventory import discover_workers
+        from ...fleet.transport import connect_worker_engine
+
+        hosts = discover_workers(self.tpu)
+        if not hosts:
+            raise DriverError(
+                f"tpu_vm: no workers found for pod {self.tpu.pod!r} "
+                "(set runtime.tpu.workers or runtime.tpu.pod in settings.yaml)"
+            )
+        self._workers = []
+        for i, host in enumerate(hosts):
+            engine = connect_worker_engine(self.tpu, host, i)
+            self._workers.append(
+                Worker(id=f"tpu-{i}", index=i, hostname=host, engine=engine)
+            )
+        return self._workers
+
+    def workers(self) -> list[Worker]:
+        if self._workers is None:
+            return self.connect()
+        return self._workers

@@ -1,0 +1,550 @@
+"""Docker Engine HTTP API client (no SDK dependency).
+
+Speaks the daemon's REST API over a pluggable socket factory: local unix
+socket, TCP, or an SSH-forwarded unix socket living on a TPU-VM worker
+(drivers/tpu_vm).  Parity reference: pkg/whail wrapping the moby client
+(engine.go:32); the surface below mirrors the ops inventory in SURVEY.md
+2.3 (25 container ops, image ops incl. build, volume/network ops, events).
+
+Implements the subset of API v1.43 this framework uses.  All methods return
+parsed JSON trees (daemon-shaped); the typed/jailed layer lives above in
+``api.Engine``.
+"""
+
+from __future__ import annotations
+
+import http.client
+import io
+import json
+import socket
+import struct
+import urllib.parse
+from pathlib import Path
+from typing import Any, Callable, Iterator
+
+from ..errors import DriverError
+from .errors_map import raise_for
+
+API_PREFIX = "/v1.43"
+
+SocketFactory = Callable[[], socket.socket]
+
+
+def unix_socket_factory(path: str | Path) -> SocketFactory:
+    def connect() -> socket.socket:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(str(path))
+        return s
+
+    return connect
+
+
+def tcp_socket_factory(host: str, port: int) -> SocketFactory:
+    def connect() -> socket.socket:
+        return socket.create_connection((host, port), timeout=30)
+
+    return connect
+
+
+class _SockConnection(http.client.HTTPConnection):
+    """HTTPConnection over an arbitrary pre-dialed socket."""
+
+    def __init__(self, factory: SocketFactory):
+        super().__init__("localhost")
+        self._factory = factory
+
+    def connect(self) -> None:  # type: ignore[override]
+        self.sock = self._factory()
+
+
+class HijackedStream:
+    """Bidirectional raw stream from a hijacked attach/exec connection.
+
+    ``tty=True`` streams are raw; ``tty=False`` multiplexes stdout/stderr in
+    8-byte-header frames (demux with :meth:`frames`).
+    """
+
+    def __init__(self, sock: socket.socket, resp: http.client.HTTPResponse, tty: bool):
+        self._sock = sock
+        self._resp = resp
+        self.tty = tty
+
+    def write(self, data: bytes) -> None:
+        self._sock.sendall(data)
+
+    def close_write(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+
+    def read(self, n: int = 65536) -> bytes:
+        try:
+            return self._resp.read(n) or b""
+        except (http.client.IncompleteRead, ConnectionResetError):
+            return b""
+
+    def frames(self) -> Iterator[tuple[int, bytes]]:
+        """Yield (stream_fd, payload): 1=stdout, 2=stderr. TTY streams yield
+        everything as fd 1."""
+        if self.tty:
+            while True:
+                chunk = self.read()
+                if not chunk:
+                    return
+                yield 1, chunk
+            return
+        buf = b""
+        while True:
+            while len(buf) < 8:
+                chunk = self.read()
+                if not chunk:
+                    return
+                buf += chunk
+            fd, length = buf[0], struct.unpack(">I", buf[4:8])[0]
+            buf = buf[8:]
+            while len(buf) < length:
+                chunk = self.read()
+                if not chunk:
+                    return
+                buf += chunk
+            yield fd, buf[:length]
+            buf = buf[length:]
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        finally:
+            self._resp.close()
+
+
+class HTTPDockerAPI:
+    """The concrete daemon client.  One instance per daemon endpoint."""
+
+    def __init__(self, factory: SocketFactory, *, api_prefix: str = API_PREFIX):
+        self._factory = factory
+        self._prefix = api_prefix
+
+    # ------------------------------------------------------------ plumbing
+
+    def _url(self, path: str, query: dict[str, Any] | None = None) -> str:
+        url = self._prefix + path
+        if query:
+            q = {}
+            for k, v in query.items():
+                if v is None:
+                    continue
+                if isinstance(v, bool):
+                    v = "true" if v else "false"
+                elif isinstance(v, (dict, list)):
+                    v = json.dumps(v)
+                q[k] = v
+            if q:
+                url += "?" + urllib.parse.urlencode(q)
+        return url
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        *,
+        query: dict[str, Any] | None = None,
+        body: Any = None,
+        raw_body: bytes | None = None,
+        headers: dict[str, str] | None = None,
+    ) -> Any:
+        conn = _SockConnection(self._factory)
+        hdrs = {"Host": "docker"}
+        data: bytes | None = None
+        if raw_body is not None:
+            data = raw_body
+            hdrs["Content-Type"] = "application/x-tar"
+        elif body is not None:
+            data = json.dumps(body).encode()
+            hdrs["Content-Type"] = "application/json"
+        if headers:
+            hdrs.update(headers)
+        try:
+            conn.request(method, self._url(path, query), body=data, headers=hdrs)
+            resp = conn.getresponse()
+            payload = resp.read()
+        except (OSError, http.client.HTTPException) as e:
+            raise DriverError(f"daemon unreachable ({method} {path}): {e}") from e
+        finally:
+            conn.close()
+        self._check(resp.status, payload, path)
+        if not payload:
+            return None
+        ct = resp.getheader("Content-Type", "")
+        if ct.startswith("application/json"):
+            return json.loads(payload)
+        return payload
+
+    def _stream(
+        self,
+        method: str,
+        path: str,
+        *,
+        query: dict[str, Any] | None = None,
+        body: Any = None,
+        raw_body: bytes | io.BufferedIOBase | None = None,
+        headers: dict[str, str] | None = None,
+    ) -> Iterator[dict]:
+        """Request returning a stream of JSON objects (build/pull/events)."""
+        conn = _SockConnection(self._factory)
+        hdrs = {"Host": "docker"}
+        data: Any = None
+        if raw_body is not None:
+            data = raw_body
+            hdrs["Content-Type"] = "application/x-tar"
+        elif body is not None:
+            data = json.dumps(body).encode()
+            hdrs["Content-Type"] = "application/json"
+        if headers:
+            hdrs.update(headers)
+        try:
+            conn.request(method, self._url(path, query), body=data, headers=hdrs)
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            raise DriverError(f"daemon unreachable ({method} {path}): {e}") from e
+        if resp.status >= 400:
+            payload = resp.read()
+            conn.close()
+            self._check(resp.status, payload, path)
+        def gen() -> Iterator[dict]:
+            buf = b""
+            try:
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        line = line.strip()
+                        if line:
+                            yield json.loads(line)
+                if buf.strip():
+                    yield json.loads(buf)
+            finally:
+                conn.close()
+
+        return gen()
+
+    def _hijack(
+        self,
+        path: str,
+        *,
+        query: dict[str, Any] | None = None,
+        body: Any = None,
+        tty: bool = False,
+    ) -> HijackedStream:
+        conn = _SockConnection(self._factory)
+        data = json.dumps(body).encode() if body is not None else b""
+        try:
+            conn.putrequest("POST", self._url(path, query), skip_host=True)
+            conn.putheader("Host", "docker")
+            conn.putheader("Content-Type", "application/json")
+            conn.putheader("Content-Length", str(len(data)))
+            conn.putheader("Connection", "Upgrade")
+            conn.putheader("Upgrade", "tcp")
+            conn.endheaders()
+            if data:
+                conn.send(data)
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            raise DriverError(f"daemon unreachable (hijack {path}): {e}") from e
+        if resp.status not in (101, 200):
+            payload = resp.read()
+            conn.close()
+            self._check(resp.status, payload, path)
+        sock = conn.sock
+        assert sock is not None
+        sock.settimeout(None)
+        return HijackedStream(sock, resp, tty)
+
+    @staticmethod
+    def _check(status: int, payload: bytes, path: str) -> None:
+        if status < 400:
+            return
+        msg = ""
+        try:
+            msg = json.loads(payload).get("message", "")
+        except Exception:
+            msg = payload.decode("utf-8", "replace")[:400]
+        raise_for(status, msg, path)
+
+    # -------------------------------------------------------------- system
+
+    def ping(self) -> bool:
+        conn = _SockConnection(self._factory)
+        try:
+            conn.request("GET", "/_ping", headers={"Host": "docker"})
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status == 200
+        except (OSError, http.client.HTTPException):
+            return False
+        finally:
+            conn.close()
+
+    def info(self) -> dict:
+        return self._request("GET", "/info")
+
+    def version(self) -> dict:
+        return self._request("GET", "/version")
+
+    # ---------------------------------------------------------- containers
+
+    def container_create(self, name: str, config: dict) -> dict:
+        return self._request("POST", "/containers/create", query={"name": name}, body=config)
+
+    def container_start(self, cid: str) -> None:
+        self._request("POST", f"/containers/{cid}/start")
+
+    def container_stop(self, cid: str, timeout: int = 10) -> None:
+        self._request("POST", f"/containers/{cid}/stop", query={"t": timeout})
+
+    def container_kill(self, cid: str, signal: str = "KILL") -> None:
+        self._request("POST", f"/containers/{cid}/kill", query={"signal": signal})
+
+    def container_restart(self, cid: str, timeout: int = 10) -> None:
+        self._request("POST", f"/containers/{cid}/restart", query={"t": timeout})
+
+    def container_pause(self, cid: str) -> None:
+        self._request("POST", f"/containers/{cid}/pause")
+
+    def container_unpause(self, cid: str) -> None:
+        self._request("POST", f"/containers/{cid}/unpause")
+
+    def container_remove(self, cid: str, *, force: bool = False, volumes: bool = False) -> None:
+        self._request("DELETE", f"/containers/{cid}", query={"force": force, "v": volumes})
+
+    def container_rename(self, cid: str, new_name: str) -> None:
+        self._request("POST", f"/containers/{cid}/rename", query={"name": new_name})
+
+    def container_inspect(self, cid: str) -> dict:
+        return self._request("GET", f"/containers/{cid}/json")
+
+    def container_list(self, *, all: bool = False, filters: dict | None = None) -> list[dict]:
+        return self._request(
+            "GET", "/containers/json", query={"all": all, "filters": filters or {}}
+        )
+
+    def container_wait(self, cid: str, condition: str = "not-running") -> dict:
+        return self._request(
+            "POST", f"/containers/{cid}/wait", query={"condition": condition}
+        )
+
+    def container_resize(self, cid: str, height: int, width: int) -> None:
+        self._request(
+            "POST", f"/containers/{cid}/resize", query={"h": height, "w": width}
+        )
+
+    def container_attach(
+        self, cid: str, *, tty: bool, stdin: bool = True, logs: bool = False
+    ) -> HijackedStream:
+        return self._hijack(
+            f"/containers/{cid}/attach",
+            query={
+                "stream": True,
+                "stdin": stdin,
+                "stdout": True,
+                "stderr": True,
+                "logs": logs,
+            },
+            tty=tty,
+        )
+
+    def container_logs(
+        self, cid: str, *, follow: bool = False, tail: str = "all"
+    ) -> Iterator[bytes]:
+        conn = _SockConnection(self._factory)
+        q = {"stdout": True, "stderr": True, "follow": follow, "tail": tail}
+        try:
+            conn.request("GET", self._url(f"/containers/{cid}/logs", q), headers={"Host": "docker"})
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            raise DriverError(f"daemon unreachable (logs): {e}") from e
+        if resp.status >= 400:
+            payload = resp.read()
+            conn.close()
+            self._check(resp.status, payload, f"/containers/{cid}/logs")
+
+        def gen() -> Iterator[bytes]:
+            try:
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        return
+                    yield chunk
+            finally:
+                conn.close()
+
+        return gen()
+
+    def put_archive(self, cid: str, path: str, tar_bytes: bytes) -> None:
+        self._request(
+            "PUT",
+            f"/containers/{cid}/archive",
+            query={"path": path},
+            raw_body=tar_bytes,
+        )
+
+    def get_archive(self, cid: str, path: str) -> bytes:
+        return self._request("GET", f"/containers/{cid}/archive", query={"path": path})
+
+    # ---------------------------------------------------------------- exec
+
+    def exec_create(self, cid: str, config: dict) -> dict:
+        return self._request("POST", f"/containers/{cid}/exec", body=config)
+
+    def exec_start(self, exec_id: str, *, tty: bool = False, detach: bool = False):
+        if detach:
+            return self._request(
+                "POST", f"/exec/{exec_id}/start", body={"Detach": True, "Tty": tty}
+            )
+        return self._hijack(
+            f"/exec/{exec_id}/start", body={"Detach": False, "Tty": tty}, tty=tty
+        )
+
+    def exec_inspect(self, exec_id: str) -> dict:
+        return self._request("GET", f"/exec/{exec_id}/json")
+
+    # -------------------------------------------------------------- images
+
+    def image_list(self, *, filters: dict | None = None) -> list[dict]:
+        return self._request("GET", "/images/json", query={"filters": filters or {}})
+
+    def image_inspect(self, ref: str) -> dict:
+        return self._request("GET", f"/images/{urllib.parse.quote(ref, safe='')}/json")
+
+    def image_tag(self, ref: str, repo: str, tag: str) -> None:
+        self._request(
+            "POST",
+            f"/images/{urllib.parse.quote(ref, safe='')}/tag",
+            query={"repo": repo, "tag": tag},
+        )
+
+    def image_remove(self, ref: str, *, force: bool = False) -> None:
+        self._request(
+            "DELETE", f"/images/{urllib.parse.quote(ref, safe='')}", query={"force": force}
+        )
+
+    def image_build(
+        self,
+        context_tar: bytes,
+        *,
+        tags: list[str],
+        labels: dict[str, str] | None = None,
+        dockerfile: str = "Dockerfile",
+        buildargs: dict[str, str] | None = None,
+        target: str = "",
+        pull: bool = False,
+    ) -> Iterator[dict]:
+        q: dict[str, Any] = {
+            "dockerfile": dockerfile,
+            "labels": labels or {},
+            "buildargs": buildargs or {},
+            "pull": pull,
+        }
+        if target:
+            q["target"] = target
+        url = self._url("/build", q)
+        # t= repeats per tag; urlencode can't repeat via dict, append manually
+        for t in tags:
+            url += "&t=" + urllib.parse.quote(t, safe="")
+        conn = _SockConnection(self._factory)
+        try:
+            conn.request(
+                "POST",
+                url,
+                body=context_tar,
+                headers={"Host": "docker", "Content-Type": "application/x-tar"},
+            )
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            raise DriverError(f"daemon unreachable (build): {e}") from e
+        if resp.status >= 400:
+            payload = resp.read()
+            conn.close()
+            self._check(resp.status, payload, "/build")
+
+        def gen() -> Iterator[dict]:
+            buf = b""
+            try:
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if line.strip():
+                            yield json.loads(line)
+                if buf.strip():
+                    yield json.loads(buf)
+            finally:
+                conn.close()
+
+        return gen()
+
+    def image_pull(self, ref: str) -> Iterator[dict]:
+        if ":" in ref.rsplit("/", 1)[-1]:
+            name, tag = ref.rsplit(":", 1)
+        else:
+            name, tag = ref, "latest"
+        return self._stream(
+            "POST", "/images/create", query={"fromImage": name, "tag": tag}
+        )
+
+    # ------------------------------------------------------------- volumes
+
+    def volume_create(self, name: str, labels: dict[str, str] | None = None) -> dict:
+        return self._request(
+            "POST", "/volumes/create", body={"Name": name, "Labels": labels or {}}
+        )
+
+    def volume_list(self, *, filters: dict | None = None) -> dict:
+        return self._request("GET", "/volumes", query={"filters": filters or {}})
+
+    def volume_inspect(self, name: str) -> dict:
+        return self._request("GET", f"/volumes/{name}")
+
+    def volume_remove(self, name: str, *, force: bool = False) -> None:
+        self._request("DELETE", f"/volumes/{name}", query={"force": force})
+
+    # ------------------------------------------------------------ networks
+
+    def network_create(self, name: str, config: dict) -> dict:
+        body = {"Name": name, **config}
+        return self._request("POST", "/networks/create", body=body)
+
+    def network_list(self, *, filters: dict | None = None) -> list[dict]:
+        return self._request("GET", "/networks", query={"filters": filters or {}})
+
+    def network_inspect(self, ref: str) -> dict:
+        return self._request("GET", f"/networks/{ref}")
+
+    def network_remove(self, ref: str) -> None:
+        self._request("DELETE", f"/networks/{ref}")
+
+    def network_connect(self, net: str, cid: str, *, ipv4: str = "") -> None:
+        body: dict[str, Any] = {"Container": cid}
+        if ipv4:
+            body["EndpointConfig"] = {"IPAMConfig": {"IPv4Address": ipv4}}
+        self._request("POST", f"/networks/{net}/connect", body=body)
+
+    def network_disconnect(self, net: str, cid: str, *, force: bool = False) -> None:
+        self._request(
+            "POST", f"/networks/{net}/disconnect", body={"Container": cid, "Force": force}
+        )
+
+    # -------------------------------------------------------------- events
+
+    def events(self, *, filters: dict | None = None) -> Iterator[dict]:
+        return self._stream("GET", "/events", query={"filters": filters or {}})

@@ -19,7 +19,7 @@ _FORMAT = "%(asctime)s %(levelname)-5s %(name)s: %(message)s"
 class JsonLinesFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         out = {
-            "ts": round(time.time(), 3),
+            "ts": round(record.created, 3),
             "level": record.levelname.lower(),
             "logger": record.name,
             "msg": record.getMessage(),

@@ -36,13 +36,24 @@ def _strategy_for(path: PathKey, strategies: Mapping[PathKey, str]) -> str:
     return OVERWRITE
 
 
+def _canon(item: Any) -> str:
+    """Order-insensitive canonical key for union dedupe (two YAML mappings
+    with the same keys in different order are the same rule)."""
+    import json
+
+    try:
+        return json.dumps(item, sort_keys=True, default=repr)
+    except (TypeError, ValueError):
+        return repr(item)
+
+
 def _dedupe(items: list[Any]) -> list[Any]:
-    seen: list[Any] = []
+    seen: set[str] = set()
     out: list[Any] = []
     for it in items:
-        key = repr(it)
+        key = _canon(it)
         if key not in seen:
-            seen.append(key)
+            seen.add(key)
             out.append(it)
     return out
 

@@ -54,14 +54,6 @@ class Layer:
             raise ValueError(f"layer {self.name} ({self.path}): top level must be a mapping")
         return data
 
-    def write(self, tree: dict) -> None:
-        if not self.writable:
-            raise PermissionError(f"layer {self.name} is read-only")
-        text = yaml.safe_dump(tree, sort_keys=False, default_flow_style=False)
-        with file_lock(self.path):
-            atomic_write(self.path, text)
-
-
 @dataclass
 class _Snapshot:
     merged: Any

@@ -26,8 +26,13 @@ def domain_hash(domain: str) -> int:
     route_map; internal/dnsbpf bpfmap.go:29-51).  Python and the C eBPF
     source (native/ebpf) must agree on this exact function.
     """
+    try:
+        encoded = domain.lower().encode("idna")
+    except UnicodeError:
+        # not a valid IDN label set (e.g. wildcard patterns): hash raw UTF-8
+        encoded = domain.lower().encode("utf-8")
     h = 0xCBF29CE484222325
-    for b in domain.lower().encode("ascii", "ignore"):
+    for b in encoded:
         h ^= b
         h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
     return h

@@ -1,0 +1,234 @@
+"""Engine layer tests: label jail, container lifecycle over the fake daemon,
+volumes/networks/images, events, exec."""
+
+import threading
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.engine import Engine, FakeDockerAPI
+from clawker_tpu.engine.api import ContainerSpec, _parse_bytes
+from clawker_tpu.engine.fake import echo_behavior, exit_behavior
+from clawker_tpu.errors import ConflictError, JailViolation, NotFoundError
+
+
+@pytest.fixture()
+def eng():
+    api = FakeDockerAPI()
+    api.add_image("alpine:latest")
+    return Engine(api), api
+
+
+def _create(eng_api, name="clawker.demo.dev", **kw):
+    engine, api = eng_api
+    spec = ContainerSpec(image="alpine:latest", **kw)
+    return engine.create_container(name, spec)
+
+
+# -------------------------------------------------------------------- jail
+
+def test_create_injects_managed_label(eng):
+    engine, api = eng
+    cid = _create(eng)
+    info = api.container_inspect(cid)
+    assert info["Config"]["Labels"][consts.LABEL_MANAGED] == "true"
+
+
+def test_jail_blocks_unmanaged_mutation(eng):
+    engine, api = eng
+    # simulate a foreign container created outside the framework
+    api.containers["foreign"] = __import__(
+        "clawker_tpu.engine.fake", fromlist=["FakeContainer"]
+    ).FakeContainer(id="foreign", name="user-db", config={"Image": "alpine:latest"})
+    with pytest.raises(JailViolation):
+        engine.remove_container("user-db")
+    with pytest.raises(JailViolation):
+        engine.start_container("user-db")
+
+
+def test_jail_scopes_listing(eng):
+    engine, api = eng
+    _create(eng)
+    from clawker_tpu.engine.fake import FakeContainer
+
+    api.containers["foreign"] = FakeContainer(
+        id="foreign", name="user-db", config={"Image": "alpine:latest"}
+    )
+    names = [c["Names"][0] for c in engine.list_containers(all=True)]
+    assert names == ["/clawker.demo.dev"]
+
+
+def test_jail_blocks_unmanaged_image_and_volume_removal(eng):
+    engine, api = eng
+    with pytest.raises(JailViolation):
+        engine.remove_image("alpine:latest")
+    api.volumes["user-vol"] = {"Name": "user-vol", "Labels": {}}
+    with pytest.raises(JailViolation):
+        engine.remove_volume("user-vol")
+
+
+# --------------------------------------------------------------- lifecycle
+
+def test_full_lifecycle_and_wait(eng):
+    engine, api = eng
+    api.set_behavior("alpine:latest", exit_behavior(b"hello\n", code=3))
+    cid = _create(eng)
+    engine.start_container(cid)
+    assert engine.wait_container(cid) == 3
+    info = engine.inspect_container(cid)
+    assert info["State"]["Status"] == "exited"
+    engine.remove_container(cid)
+    assert not engine.container_exists(cid)
+
+
+def test_attach_streams_output(eng):
+    engine, api = eng
+    api.set_behavior("alpine:latest", exit_behavior(b"out-bytes", code=0))
+    cid = _create(eng, tty=True, open_stdin=True)
+    stream = engine.attach_container(cid, tty=True)
+    engine.start_container(cid)
+    collected = b"".join(payload for _, payload in stream.frames())
+    assert collected == b"out-bytes"
+
+
+def test_attach_echo_roundtrip(eng):
+    engine, api = eng
+    api.set_behavior("alpine:latest", echo_behavior)
+    cid = _create(eng, tty=True, open_stdin=True)
+    stream = engine.attach_container(cid, tty=True)
+    engine.start_container(cid)
+    stream.write(b"ping")
+    got = stream.read()
+    assert got == b"ping"
+    stream.close_write()
+    assert engine.wait_container(cid) == 0
+
+
+def test_stop_kills_idle_container(eng):
+    engine, api = eng
+    cid = _create(eng)
+    engine.start_container(cid)
+    engine.stop_container(cid)
+    assert engine.inspect_container(cid)["State"]["ExitCode"] == 137
+
+
+def test_remove_running_requires_force(eng):
+    engine, api = eng
+    cid = _create(eng)
+    engine.start_container(cid)
+    with pytest.raises(ConflictError):
+        engine.remove_container(cid)
+    engine.remove_container(cid, force=True)
+
+
+def test_duplicate_name_conflict(eng):
+    _create(eng)
+    with pytest.raises(ConflictError):
+        _create(eng)
+
+
+def test_missing_image_404(eng):
+    engine, api = eng
+    with pytest.raises(NotFoundError):
+        engine.create_container("clawker.x.y", ContainerSpec(image="nope:latest"))
+
+
+# ------------------------------------------------------------ spec builder
+
+def test_container_spec_json():
+    spec = ContainerSpec(
+        image="img",
+        cmd=["sh"],
+        env={"A": "1"},
+        tty=True,
+        open_stdin=True,
+        binds=["/src:/workspace"],
+        network="clawker-net",
+        static_ip="172.28.0.202",
+        memory="2g",
+        restart_policy="on-failure:3",
+        extra_hosts=["host.docker.internal:host-gateway"],
+    )
+    j = spec.to_json()
+    assert j["Env"] == ["A=1"]
+    assert j["HostConfig"]["Binds"] == ["/src:/workspace"]
+    assert j["HostConfig"]["Memory"] == 2 * 1024**3
+    assert j["HostConfig"]["RestartPolicy"] == {"Name": "on-failure", "MaximumRetryCount": 3}
+    assert (
+        j["NetworkingConfig"]["EndpointsConfig"]["clawker-net"]["IPAMConfig"]["IPv4Address"]
+        == "172.28.0.202"
+    )
+
+
+def test_parse_bytes():
+    assert _parse_bytes("512") == 512
+    assert _parse_bytes("8g") == 8 * 1024**3
+    assert _parse_bytes("1.5m") == int(1.5 * 1024**2)
+
+
+# ---------------------------------------------------- volumes and networks
+
+def test_ensure_volume_idempotent(eng):
+    engine, api = eng
+    engine.ensure_volume("clawker.demo.dev.workspace")
+    engine.ensure_volume("clawker.demo.dev.workspace")
+    vols = engine.list_volumes()
+    assert len(vols) == 1
+    assert vols[0]["Labels"][consts.LABEL_MANAGED] == "true"
+
+
+def test_ensure_network_and_static_ip(eng):
+    engine, api = eng
+    engine.ensure_network(consts.NETWORK_NAME, subnet="172.28.0.0/16")
+    engine.ensure_network(consts.NETWORK_NAME, subnet="172.28.0.0/16")
+    assert len(api.networks) == 1
+    ip = engine.network_static_ip(consts.NETWORK_NAME, consts.CONTROLPLANE_HOST_OFFSET)
+    assert ip == "172.28.0.202"
+
+
+# ------------------------------------------------------------------ events
+
+def test_events_stream(eng):
+    engine, api = eng
+    events = []
+    it = engine.events(filters={"type": ["container"]})
+    t = threading.Thread(
+        target=lambda: events.extend(__import__("itertools").islice(it, 2)),
+        daemon=True,
+    )
+    t.start()
+    cid = _create(eng)
+    engine.start_container(cid)
+    t.join(timeout=5)
+    assert [e["Action"] for e in events] == ["create", "start"]
+
+
+# -------------------------------------------------------------------- exec
+
+def test_run_exec(eng):
+    engine, api = eng
+    api.exec_handler = lambda c, cmd: (0, f"ran:{' '.join(cmd)}".encode())
+    cid = _create(eng)
+    engine.start_container(cid)
+    code, out = engine.run_exec(cid, ["echo", "hi"])
+    assert code == 0 and out == b"ran:echo hi"
+
+
+# ------------------------------------------------------------------ build
+
+def test_build_image_tags_and_labels(eng):
+    engine, api = eng
+    progress = list(engine.build_image(b"tar-bytes", tags=["clawker-demo:base"]))
+    assert any("stream" in p for p in progress)
+    assert "clawker-demo:base" in api.images
+    assert api.images["clawker-demo:base"]["Labels"][consts.LABEL_MANAGED] == "true"
+
+
+def test_failure_injection_and_recorder(eng):
+    engine, api = eng
+    from clawker_tpu.errors import DriverError
+
+    api.fail_next["container_list"] = DriverError("boom")
+    with pytest.raises(DriverError):
+        engine.list_containers()
+    assert api.calls_named("container_list")
