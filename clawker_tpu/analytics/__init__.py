@@ -1,0 +1,31 @@
+"""Fleet telemetry analytics on TPU (net-new; no reference counterpart).
+
+The reference ships telemetry to an OpenSearch stack and leaves analysis to
+dashboards (SURVEY.md 2.11).  On a TPU pod the chips are idle while agents
+think, so this build adds an on-accelerator analytics path: per-agent egress
+event windows are scored by a small autoencoder anomaly model, sharded over
+the fleet (data) and feature (model) axes of a jax Mesh.  This backs
+`clawker monitor anomalies` and the loop scheduler's misbehaving-agent
+detection, and is the framework's flagship jittable entry
+(__graft_entry__.py).
+"""
+
+from .anomaly import (
+    AnomalyParams,
+    fleet_mesh,
+    init_params,
+    score,
+    shard_batch,
+    shard_params,
+    train_step,
+)
+
+__all__ = [
+    "AnomalyParams",
+    "fleet_mesh",
+    "init_params",
+    "score",
+    "shard_batch",
+    "shard_params",
+    "train_step",
+]

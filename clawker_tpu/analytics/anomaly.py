@@ -1,0 +1,116 @@
+"""Egress-anomaly autoencoder: jit/pjit-able scoring + training.
+
+Feature vectors summarize an agent's egress behavior over a sliding window
+(decision counts per verdict, unique domains, bytes, DNS rate, burst shape
+-- assembled host-side from the netlogger event stream).  A two-layer
+autoencoder learns the fleet's normal profile; reconstruction error is the
+anomaly score.  Everything is static-shaped, bfloat16 on the matmul path,
+and sharded: batch over the ``data`` (fleet) axis, hidden features over the
+``model`` axis, so scoring a whole pod's agents is one SPMD program.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+FEATURES = 32   # per-window egress feature vector size
+HIDDEN = 128    # autoencoder bottleneck width (MXU-friendly)
+
+
+class AnomalyParams(NamedTuple):
+    w_enc: jax.Array   # [FEATURES, HIDDEN]
+    b_enc: jax.Array   # [HIDDEN]
+    w_dec: jax.Array   # [HIDDEN, FEATURES]
+    b_dec: jax.Array   # [FEATURES]
+
+
+def init_params(key: jax.Array, feat: int = FEATURES, hidden: int = HIDDEN) -> AnomalyParams:
+    k1, k2 = jax.random.split(key)
+    scale_e = (2.0 / feat) ** 0.5
+    scale_d = (2.0 / hidden) ** 0.5
+    return AnomalyParams(
+        w_enc=(jax.random.normal(k1, (feat, hidden)) * scale_e).astype(jnp.float32),
+        b_enc=jnp.zeros((hidden,), jnp.float32),
+        w_dec=(jax.random.normal(k2, (hidden, feat)) * scale_d).astype(jnp.float32),
+        b_dec=jnp.zeros((feat,), jnp.float32),
+    )
+
+
+def _reconstruct(params: AnomalyParams, x: jax.Array) -> jax.Array:
+    # bfloat16 matmuls (MXU path), float32 accumulation/output
+    h = jnp.dot(
+        x.astype(jnp.bfloat16),
+        params.w_enc.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ) + params.b_enc
+    h = jax.nn.gelu(h)
+    r = jnp.dot(
+        h.astype(jnp.bfloat16),
+        params.w_dec.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ) + params.b_dec
+    return r
+
+
+def score(params: AnomalyParams, x: jax.Array) -> jax.Array:
+    """Per-agent anomaly score: mean squared reconstruction error.
+
+    x: [batch, FEATURES] window features; returns [batch] scores.
+    """
+    r = _reconstruct(params, x)
+    return jnp.mean(jnp.square(r - x), axis=-1)
+
+
+def _loss(params: AnomalyParams, x: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.square(_reconstruct(params, x) - x))
+
+
+def train_step(
+    params: AnomalyParams, x: jax.Array, lr: float = 1e-3
+) -> tuple[AnomalyParams, jax.Array]:
+    """One SGD step on the fleet's pooled windows (dp over data axis; the
+    mean-gradient psum is inserted by XLA from the shardings)."""
+    loss, grads = jax.value_and_grad(_loss)(params, x)
+    new = AnomalyParams(*(p - lr * g for p, g in zip(params, grads)))
+    return new, loss
+
+
+# ----------------------------------------------------------------- sharding
+
+def fleet_mesh(n_devices: int | None = None) -> Mesh:
+    """2D mesh: ``data`` (fleet/batch) x ``model`` (hidden features).
+
+    The model axis is 2 when the device count allows, exercising tensor
+    sharding of the hidden dimension; otherwise 1.
+    """
+    devs = jax.devices()[: n_devices or len(jax.devices())]
+    n = len(devs)
+    model = 2 if n % 2 == 0 and n >= 2 else 1
+    data = n // model
+    import numpy as np
+
+    return Mesh(np.array(devs).reshape(data, model), ("data", "model"))
+
+
+def shard_params(params: AnomalyParams, mesh: Mesh) -> AnomalyParams:
+    """Hidden dim sharded over ``model`` (tp); biases/outputs replicated."""
+    specs = AnomalyParams(
+        w_enc=P(None, "model"),
+        b_enc=P("model"),
+        w_dec=P("model", None),
+        b_dec=P(None),
+    )
+    return AnomalyParams(
+        *(
+            jax.device_put(p, NamedSharding(mesh, s))
+            for p, s in zip(params, specs)
+        )
+    )
+
+
+def shard_batch(x: jax.Array, mesh: Mesh) -> jax.Array:
+    return jax.device_put(x, NamedSharding(mesh, P("data", None)))

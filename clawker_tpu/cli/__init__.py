@@ -1,0 +1,11 @@
+"""CLI layer (reference: internal/clawker + internal/cmd/*).
+
+Entry point: ``python -m clawker_tpu`` or the ``clawker`` console script.
+All commands receive a :class:`Factory` through the click context -- tests
+inject one wired to a FakeDriver (reference: Tier-2 command tests with a
+fake Docker client, TESTING-REFERENCE.md:253-299).
+"""
+
+from .root import cli, main
+
+__all__ = ["cli", "main"]

@@ -1,0 +1,51 @@
+"""`clawker init` -- scaffold project config (reference: internal/cmd/init)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import click
+
+from .. import consts
+from .factory import Factory
+
+pass_factory = click.make_pass_decorator(Factory)
+
+TEMPLATE = """\
+# clawker project configuration
+project: {name}
+
+build:
+  stack: {stack}          # language stack bundle: python | go | node | ...
+  harness: claude         # agent harness bundle
+
+workspace:
+  mode: bind              # bind (live) | snapshot (ephemeral copy)
+
+security:
+  egress: []              # extra allowed domains, e.g.
+  #  - dst: pypi.org
+  #    proto: https
+"""
+
+
+@click.command("init")
+@click.option("--name", default="", help="Project name (default: directory name).")
+@click.option("--stack", default="python", show_default=True)
+@click.option("--force", is_flag=True, help="Overwrite existing config.")
+@pass_factory
+def init_cmd(f: Factory, name, stack, force):
+    """Initialize a clawker project in the current directory."""
+    target = f.cwd / consts.PROJECT_FLAT_FORM
+    if target.exists() and not force:
+        raise click.ClickException(f"{target} already exists (use --force)")
+    import re
+
+    raw = (name or f.cwd.name).lower()
+    pname = re.sub(r"[^a-z0-9_-]+", "-", raw).strip("-_") or "project"
+    target.write_text(TEMPLATE.format(name=pname, stack=stack))
+    click.echo(f"initialized project {pname!r} ({target})")
+
+
+def register(root: click.Group) -> None:
+    root.add_command(init_cmd)

@@ -1,0 +1,60 @@
+"""Volume + network verbs (reference: internal/cmd/volume, internal/cmd/network)."""
+
+from __future__ import annotations
+
+import json
+
+import click
+
+from .. import consts
+from .factory import Factory
+
+pass_factory = click.make_pass_decorator(Factory)
+
+
+@click.group("volume")
+def volume_group():
+    """Manage agent volumes."""
+
+
+@volume_group.command("ls")
+@click.option("--format", "fmt", type=click.Choice(["table", "json"]), default="table")
+@pass_factory
+def volume_ls(f: Factory, fmt):
+    vols = f.engine().list_volumes()
+    if fmt == "json":
+        click.echo(json.dumps(vols, indent=2))
+        return
+    for v in vols:
+        labels = v.get("Labels") or {}
+        click.echo(
+            f"{v['Name']}\t{labels.get(consts.LABEL_PROJECT, '')}"
+            f"\t{labels.get(consts.LABEL_VOLUME_PURPOSE, '')}"
+        )
+
+
+@volume_group.command("rm")
+@click.argument("names", nargs=-1, required=True)
+@click.option("--force", "-f", is_flag=True)
+@pass_factory
+def volume_rm(f: Factory, names, force):
+    for n in names:
+        f.engine().remove_volume(n, force=force)
+        click.echo(n)
+
+
+@click.group("network")
+def network_group():
+    """Manage the clawker network."""
+
+
+@network_group.command("ensure")
+@pass_factory
+def network_ensure(f: Factory):
+    n = f.engine().ensure_network(consts.NETWORK_NAME)
+    click.echo(n["Name"])
+
+
+def register(root: click.Group) -> None:
+    root.add_command(volume_group)
+    root.add_command(network_group)

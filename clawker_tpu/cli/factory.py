@@ -1,0 +1,79 @@
+"""Factory DI: lazily wired dependencies handed to every command.
+
+Parity reference: internal/cmd/factory/default.go:58 New -- ~14 lazy
+closures; here, cached properties.  Commands never construct their own
+engine/config; they ask the factory (internal/cmdutil Factory contract).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from pathlib import Path
+
+from ..config import Config, load_config
+from ..engine.api import Engine
+from ..engine.drivers import RuntimeDriver, get_driver
+from ..runtime.orchestrate import AgentRuntime
+
+ENV_DRIVER = "CLAWKER_TPU_DRIVER"
+
+
+class Factory:
+    def __init__(
+        self,
+        *,
+        cwd: Path | None = None,
+        driver: RuntimeDriver | None = None,
+        config: Config | None = None,
+    ):
+        self.cwd = cwd or Path.cwd()
+        self._driver_override = driver
+        self._config_override = config
+
+    @functools.cached_property
+    def config(self) -> Config:
+        if self._config_override is not None:
+            return self._config_override
+        return load_config(self.cwd)
+
+    @functools.cached_property
+    def driver(self) -> RuntimeDriver:
+        if self._driver_override is not None:
+            return self._driver_override
+        return get_driver(self.config.settings, override=os.environ.get(ENV_DRIVER, ""))
+
+    def engine(self) -> Engine:
+        return self.driver.engine()
+
+    def runtime(self, engine: Engine | None = None) -> AgentRuntime:
+        return AgentRuntime(
+            engine or self.engine(),
+            self.config,
+            pre_start=self._pre_start_hook(),
+            post_start=self._post_start_hook(),
+        )
+
+    # Bootstrap hooks: wired to control-plane/firewall bring-up once those
+    # subsystems are configured on (container_start.go:103/:297 parity).
+    def _pre_start_hook(self):
+        from ..controlplane.bootstrap import pre_start_services
+
+        cfg = self.config
+        driver = self.driver
+
+        def hook(container_ref: str) -> None:
+            pre_start_services(cfg, driver, container_ref)
+
+        return hook
+
+    def _post_start_hook(self):
+        from ..controlplane.bootstrap import post_start_services
+
+        cfg = self.config
+        driver = self.driver
+
+        def hook(container_ref: str) -> None:
+            post_start_services(cfg, driver, container_ref)
+
+        return hook

@@ -1,0 +1,85 @@
+"""Root command wiring (reference: internal/cmd/root/root.go:29 NewCmdRoot;
+builtin Docker-style aliases at aliases.go:132).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import click
+
+from .. import __version__, logsetup
+from ..errors import ClawkerError, ExitError, FlagError, SilentError
+from .factory import Factory
+
+CONTEXT_SETTINGS = {"help_option_names": ["-h", "--help"], "max_content_width": 100}
+
+
+class _RootGroup(click.Group):
+    """Centralized domain-error rendering (reference: internal/clawker/cmd.go
+    error presentation): ClawkerErrors become clean one-line CLI errors in
+    both standalone and embedded (test) invocation modes."""
+
+    def invoke(self, ctx: click.Context):
+        try:
+            return super().invoke(ctx)
+        except ExitError as e:
+            raise SystemExit(e.code) from e
+        except SilentError:
+            raise SystemExit(1) from None
+        except FlagError as e:
+            raise click.UsageError(str(e)) from e
+        except ClawkerError as e:
+            raise click.ClickException(str(e)) from e
+
+
+@click.group(cls=_RootGroup, context_settings=CONTEXT_SETTINGS)
+@click.option("--verbose", "-v", is_flag=True, help="Debug logging to stderr.")
+@click.version_option(__version__, prog_name="clawker")
+@click.pass_context
+def cli(ctx: click.Context, verbose: bool) -> None:
+    """clawker -- run AI coding agents in locked-down containers on your
+    laptop's Docker daemon or across the worker VMs of a Cloud TPU pod."""
+    logsetup.setup("debug" if verbose else "warning")
+    if ctx.obj is None:
+        ctx.obj = Factory()
+
+
+def main(argv: list[str] | None = None) -> int:
+    try:
+        cli.main(args=argv, standalone_mode=False)
+        return 0
+    except click.exceptions.Exit as e:
+        return e.exit_code
+    except SystemExit as e:
+        return int(e.code or 0)
+    except click.ClickException as e:
+        e.show()
+        return e.exit_code
+    except click.Abort:
+        click.echo("aborted", err=True)
+        return 130
+    except ExitError as e:
+        return e.code
+    except SilentError:
+        return 1
+    except FlagError as e:
+        click.echo(f"error: {e}", err=True)
+        return 2
+    except ClawkerError as e:
+        click.echo(f"error: {e}", err=True)
+        return 1
+
+
+def register_commands() -> None:
+    """Attach all command groups (import-cycle-free late binding)."""
+    from . import cmd_container, cmd_image, cmd_init, cmd_project, cmd_volume
+
+    cmd_container.register(cli)
+    cmd_image.register(cli)
+    cmd_init.register(cli)
+    cmd_project.register(cli)
+    cmd_volume.register(cli)
+
+
+register_commands()

@@ -1,0 +1,1 @@
+"""Control-plane layer (reference: internal/controlplane + controlplane/*)."""

@@ -1,0 +1,34 @@
+"""Pre/post-start service bootstrap hooks.
+
+Parity reference: internal/cmd/container/shared/container_start.go --
+BootstrapServicesPreStart (:103 -- CP EnsureRunning, firewall init+rules,
+host proxy) and BootstrapServicesPostStart (:297 -- firewall enable on the
+container's cgroup, socket bridge).  Round 1: gated no-ops that light up as
+the subsystems land; the seam exists so the run path never changes shape.
+"""
+
+from __future__ import annotations
+
+from ..config import Config
+from ..engine.drivers import RuntimeDriver
+from .. import logsetup
+
+log = logsetup.get("cp.bootstrap")
+
+
+def pre_start_services(cfg: Config, driver: RuntimeDriver, container_ref: str) -> None:
+    if cfg.settings.firewall.enable:
+        from ..firewall.lifecycle import firewall_pre_start
+
+        firewall_pre_start(cfg, driver, container_ref)
+    if cfg.settings.host_proxy.enable:
+        from ..hostproxy.manager import ensure_running as hostproxy_ensure
+
+        hostproxy_ensure(cfg)
+
+
+def post_start_services(cfg: Config, driver: RuntimeDriver, container_ref: str) -> None:
+    if cfg.settings.firewall.enable:
+        from ..firewall.lifecycle import firewall_post_start
+
+        firewall_post_start(cfg, driver, container_ref)

@@ -63,6 +63,30 @@ class RuntimeDriver:
         pass
 
 
+def _seeded_fake_driver() -> "RuntimeDriver":
+    """Fake driver seeded from the environment, so the real CLI can be driven
+    end-to-end from a shell with no Docker daemon.
+
+    ``CLAWKER_TPU_FAKE_IMAGES`` -- comma-separated image refs to pre-load.
+    Seeded images run an exit(0) behavior that prints one line, so an
+    attached ``run`` streams output and terminates instead of idling.
+    """
+    import os
+
+    from .fakedriver import FakeDriver
+
+    drv = FakeDriver()
+    refs = [r.strip() for r in os.environ.get("CLAWKER_TPU_FAKE_IMAGES", "").split(",") if r.strip()]
+    if refs:
+        from ..fake import exit_behavior
+
+        for api in drv.apis:
+            for ref in refs:
+                api.add_image(ref)
+                api.set_behavior(ref, exit_behavior(b"fake agent ran\r\n", 0))
+    return drv
+
+
 def get_driver(settings: "Settings", *, override: str = "") -> RuntimeDriver:
     """Driver factory from settings.runtime.driver (or explicit override)."""
     from .fakedriver import FakeDriver
@@ -72,7 +96,7 @@ def get_driver(settings: "Settings", *, override: str = "") -> RuntimeDriver:
     if name == "local":
         return LocalDriver(docker_host=settings.runtime.docker_host)
     if name == "fake":
-        return FakeDriver()
+        return _seeded_fake_driver()
     if name == "tpu_vm":
         from .tpu_vm import TPUVMDriver
 

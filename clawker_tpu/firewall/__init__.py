@@ -1,0 +1,1 @@
+"""Firewall subsystem (reference: controlplane/firewall, SURVEY.md 2.8)."""

@@ -1,0 +1,29 @@
+"""Firewall bring-up hooks for the container run path.
+
+Parity reference: container_start.go firewall init/enable calls into the CP
+AdminService (FirewallInit handler.go:300, Enable :538).  Filled in with the
+full stack in the firewall milestone; until then enabling the firewall
+degrades loudly, never silently.
+"""
+
+from __future__ import annotations
+
+from .. import logsetup
+from ..config import Config
+from ..engine.drivers import RuntimeDriver
+
+log = logsetup.get("firewall.lifecycle")
+
+
+def firewall_pre_start(cfg: Config, driver: RuntimeDriver, container_ref: str) -> None:
+    from .stack import FirewallStack
+
+    stack = FirewallStack(driver.engine(), cfg)
+    stack.ensure_running()
+    stack.sync_rules(cfg.egress_rules())
+
+
+def firewall_post_start(cfg: Config, driver: RuntimeDriver, container_ref: str) -> None:
+    from .enroll import enroll_container
+
+    enroll_container(cfg, driver, container_ref)

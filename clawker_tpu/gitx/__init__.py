@@ -1,0 +1,5 @@
+"""Git operations via the git CLI (reference: internal/git go-git GitManager)."""
+
+from .git import GitError, GitManager, WorktreeInfo
+
+__all__ = ["GitError", "GitManager", "WorktreeInfo"]

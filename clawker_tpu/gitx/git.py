@@ -1,0 +1,115 @@
+"""GitManager: worktree lifecycle over the git CLI.
+
+Parity reference: internal/git/git.go -- SetupWorktree (:191),
+RemoveWorktree (:356), ListWorktrees (:392).  The reference uses go-git;
+this build shells out to the ubiquitous git binary (no vendored VCS), which
+also works unchanged over SSH on TPU-VM workers.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from dataclasses import dataclass
+from pathlib import Path
+
+from ..errors import ClawkerError
+
+
+class GitError(ClawkerError):
+    pass
+
+
+@dataclass
+class WorktreeInfo:
+    path: Path
+    branch: str
+    head: str
+
+
+class GitManager:
+    def __init__(self, repo_root: Path):
+        self.root = Path(repo_root)
+
+    def _git(self, *args: str, cwd: Path | None = None, check: bool = True) -> str:
+        res = subprocess.run(
+            ["git", *args],
+            cwd=str(cwd or self.root),
+            capture_output=True,
+            text=True,
+        )
+        if check and res.returncode != 0:
+            raise GitError(
+                f"git {' '.join(args)} failed ({res.returncode}): {res.stderr.strip()}"
+            )
+        return res.stdout
+
+    # ----------------------------------------------------------- queries
+
+    def is_repo(self) -> bool:
+        try:
+            return self._git("rev-parse", "--is-inside-work-tree").strip() == "true"
+        except GitError:
+            return False
+
+    def git_dir(self) -> Path:
+        """Absolute path of the main repository's .git directory (mounted
+        read-only into worktree agent containers, reference setup.go:288)."""
+        out = self._git("rev-parse", "--path-format=absolute", "--git-common-dir").strip()
+        return Path(out)
+
+    def current_branch(self) -> str:
+        return self._git("rev-parse", "--abbrev-ref", "HEAD").strip()
+
+    def is_dirty(self, path: Path | None = None) -> bool:
+        out = self._git("status", "--porcelain", cwd=path or self.root)
+        return bool(out.strip())
+
+    def branch_exists(self, branch: str) -> bool:
+        try:
+            self._git("rev-parse", "--verify", "--quiet", f"refs/heads/{branch}")
+            return True
+        except GitError:
+            return False
+
+    # --------------------------------------------------------- worktrees
+
+    def setup_worktree(self, dest: Path, branch: str, *, base: str = "HEAD") -> WorktreeInfo:
+        """Create a linked worktree at ``dest`` on ``branch`` (created from
+        ``base`` if it does not exist)."""
+        dest = Path(dest)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        if self.branch_exists(branch):
+            self._git("worktree", "add", str(dest), branch)
+        else:
+            self._git("worktree", "add", "-b", branch, str(dest), base)
+        head = self._git("rev-parse", "HEAD", cwd=dest).strip()
+        return WorktreeInfo(path=dest, branch=branch, head=head)
+
+    def list_worktrees(self) -> list[WorktreeInfo]:
+        out = self._git("worktree", "list", "--porcelain")
+        infos: list[WorktreeInfo] = []
+        cur: dict = {}
+        for line in out.splitlines() + [""]:
+            if not line.strip():
+                if cur.get("worktree"):
+                    infos.append(
+                        WorktreeInfo(
+                            path=Path(cur["worktree"]),
+                            branch=cur.get("branch", "").removeprefix("refs/heads/"),
+                            head=cur.get("HEAD", ""),
+                        )
+                    )
+                cur = {}
+                continue
+            key, _, val = line.partition(" ")
+            cur[key] = val
+        return infos
+
+    def remove_worktree(self, path: Path, *, force: bool = False) -> None:
+        args = ["worktree", "remove", str(path)]
+        if force:
+            args.insert(2, "--force")
+        self._git(*args)
+
+    def prune_worktrees(self) -> None:
+        self._git("worktree", "prune")

@@ -1,0 +1,1 @@
+"""Host-proxy side channel (reference: internal/hostproxy, SURVEY.md 2.10)."""

@@ -1,0 +1,29 @@
+"""Host-proxy daemon lifecycle (reference: hostproxy/manager.go:156 daemon
+spawn; server lands in the host-services milestone)."""
+
+from __future__ import annotations
+
+from .. import logsetup
+from ..config import Config
+
+log = logsetup.get("hostproxy.manager")
+
+_started_in_process = False
+
+
+def ensure_running(cfg: Config) -> None:
+    """Start the host-proxy HTTP server if not already serving.
+
+    In-process thread for now (daemonization follows with the full server);
+    idempotent per process.
+    """
+    global _started_in_process
+    if _started_in_process:
+        return
+    try:
+        from .server import start_background
+
+        start_background(cfg)
+        _started_in_process = True
+    except ImportError:
+        log.debug("hostproxy server not yet available")

@@ -1,0 +1,5 @@
+"""Project registry + worktree service (reference: internal/project)."""
+
+from .manager import ProjectManager, ProjectRecord, WorktreeRecord
+
+__all__ = ["ProjectManager", "ProjectRecord", "WorktreeRecord"]

@@ -1,0 +1,197 @@
+"""Agent-container orchestration: create -> bootstrap -> start -> attach.
+
+Parity reference: internal/cmd/container/shared/container_create.go:1473
+CreateContainer (workspace prep, config volumes, env assembly, create,
+bootstrap material) and container_start.go (BootstrapServicesPreStart /
+PostStart).  The control-plane/firewall bootstrap hooks are injected as
+callables so this module stays below the CP layer in the import DAG.
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import BinaryIO, Callable
+
+from .. import consts
+from ..config import Config
+from ..engine.api import ContainerSpec, Engine
+from ..errors import ConflictError
+from . import attach as attach_mod
+from .labels import agent_labels
+from .names import container_name
+from .resolve import resolve_image
+
+
+@dataclass
+class CreateOptions:
+    agent: str = "dev"
+    image: str = "@"                  # '@' = project default harness image
+    cmd: list[str] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+    tty: bool = True
+    workspace_mode: str = ""          # '' = project config value
+    harness: str = ""
+    worker: str = ""                  # tpu_vm worker id (label only here)
+    loop_id: str = ""
+    replace: bool = False             # remove an existing same-name container
+    mount_docker_socket: bool | None = None
+    worktree_git_dir: Path | None = None
+    workspace_root: Path | None = None  # override project root (worktrees)
+
+
+class AgentRuntime:
+    """Create/start/attach/stop agent containers on one worker engine."""
+
+    def __init__(
+        self,
+        engine: Engine,
+        cfg: Config,
+        *,
+        pre_start: Callable[[str], None] | None = None,
+        post_start: Callable[[str], None] | None = None,
+    ):
+        self.engine = engine
+        self.cfg = cfg
+        # bootstrap hooks wired by the CLI factory once CP/firewall exist
+        self.pre_start = pre_start
+        self.post_start = post_start
+
+    # -------------------------------------------------------------- create
+
+    def create(self, opts: CreateOptions) -> str:
+        from ..workspace import setup_mounts  # local import: workspace is a peer
+
+        project = self.cfg.project_name()
+        name = container_name(project, opts.agent)
+
+        if opts.replace and self.engine.container_exists(name):
+            self.engine.remove_container(name, force=True, volumes=False)
+
+        image = resolve_image(self.engine, project, opts.image)
+
+        pconf = self.cfg.project
+        mode = opts.workspace_mode or (pconf.workspace.mode if pconf else "bind")
+        root = opts.workspace_root or self.cfg.project_root or Path.cwd()
+        mount_sock = (
+            opts.mount_docker_socket
+            if opts.mount_docker_socket is not None
+            else bool(pconf and pconf.workspace.mount_docker_socket)
+        )
+        mounts = setup_mounts(
+            self.engine,
+            project,
+            opts.agent,
+            root,
+            mode=mode,
+            extra_mounts=(pconf.workspace.extra_mounts if pconf else None),
+            worktree_git_dir=opts.worktree_git_dir,
+        )
+
+        env = self._build_env(project, opts)
+        harness = opts.harness or (pconf.build.harness if pconf else "")
+        labels = agent_labels(
+            project,
+            opts.agent,
+            harness=harness,
+            worker=opts.worker,
+            loop_id=opts.loop_id,
+        )
+        cmd = opts.cmd or (pconf.agent.cmd if pconf else [])
+        spec = ContainerSpec(
+            image=image,
+            cmd=list(cmd),
+            env=env,
+            labels=labels,
+            tty=opts.tty,
+            open_stdin=True,
+            working_dir=consts.WORKSPACE_DIR,
+            hostname=f"{project}-{opts.agent}",
+            binds=mounts.binds,
+            memory=(pconf.agent.memory if pconf else ""),
+            nano_cpus=int((pconf.agent.cpus if pconf else 0.0) * 1e9),
+            init=False,  # the harness image's clawkerd is PID 1, not tini
+            mount_docker_socket=mount_sock,
+            # host.docker.internal only resolves on Linux daemons with an
+            # explicit host-gateway mapping (CLAWKER_HOSTPROXY points there)
+            extra_hosts=(
+                ["host.docker.internal:host-gateway"]
+                if self.cfg.settings.host_proxy.enable
+                else []
+            ),
+        )
+        try:
+            cid = self.engine.create_container(name, spec)
+        except ConflictError:
+            raise ConflictError(
+                f"agent {opts.agent!r} already exists for project {project!r} "
+                f"(container {name}); use --replace or `clawker start`"
+            )
+        mounts.seed(self.engine, cid)
+        return cid
+
+    def _build_env(self, project: str, opts: CreateOptions) -> dict[str, str]:
+        """Create-time env (reference: buildCreateTimeEnv
+        container_create.go:2117): identity, workspace, host-proxy wiring."""
+        env = {
+            "CLAWKER_PROJECT": project,
+            "CLAWKER_AGENT": opts.agent,
+            "CLAWKER_WORKSPACE": consts.WORKSPACE_DIR,
+        }
+        if self.cfg.settings.host_proxy.enable:
+            env["CLAWKER_HOSTPROXY"] = (
+                f"http://host.docker.internal:{self.cfg.settings.host_proxy.port}"
+            )
+        pconf = self.cfg.project
+        if pconf:
+            env.update(pconf.agent.env)
+        env.update(opts.env)
+        return env
+
+    # --------------------------------------------------------- start/attach
+
+    def start(self, name_or_id: str) -> None:
+        if self.pre_start:
+            self.pre_start(name_or_id)
+        self.engine.start_container(name_or_id)
+        if self.post_start:
+            self.post_start(name_or_id)
+
+    def attach_and_run(
+        self,
+        name_or_id: str,
+        *,
+        tty: bool = True,
+        stdin: BinaryIO | None = None,
+        stdout: BinaryIO | None = None,
+    ) -> int:
+        """Attach first, then start, then pump until exit (mirrors
+        attachThenStart run.go:331 -- attaching before start loses no
+        output).  Returns the container exit code."""
+        out = stdout or sys.stdout.buffer
+        stream = self.engine.attach_container(name_or_id, tty=tty)
+        self.start(name_or_id)
+        attach_mod.wire_resize(self.engine, name_or_id)
+        use_raw = (
+            stdin is None
+            and stdout is None
+            and tty
+            and sys.stdin.isatty()
+            and sys.stdout.isatty()
+        )
+        inp = stdin if stdin is not None else sys.stdin.buffer
+        if use_raw:
+            with attach_mod.raw_terminal(sys.stdin.fileno()):
+                attach_mod.pump_streams(stream, inp, out)
+        else:
+            attach_mod.pump_streams(stream, inp, out)
+        return self.engine.wait_container(name_or_id)
+
+    # --------------------------------------------------------------- query
+
+    def list_agents(self, *, all: bool = True, project: str | None = None) -> list[dict]:
+        filters: dict = {"label": [f"{consts.LABEL_ROLE}=agent"]}
+        if project:
+            filters["label"].append(f"{consts.LABEL_PROJECT}={project}")
+        return self.engine.list_containers(all=all, filters=filters)

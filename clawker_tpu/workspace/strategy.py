@@ -1,0 +1,119 @@
+"""Bind vs snapshot workspace strategies."""
+
+from __future__ import annotations
+
+import io
+import tarfile
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .. import consts
+from ..engine.api import Engine
+from ..runtime.labels import volume_labels
+from ..runtime.names import agent_volume_name
+
+
+@dataclass
+class WorkspaceMounts:
+    """Result of mount setup: bind strings + volumes that were ensured."""
+
+    binds: list[str] = field(default_factory=list)
+    volumes: list[str] = field(default_factory=list)
+    post_create: list["SnapshotSeed"] = field(default_factory=list)
+
+    def seed(self, engine: Engine, container_id: str) -> None:
+        """Run post-create seeding steps (snapshot copies)."""
+        for s in self.post_create:
+            s.run(engine, container_id)
+
+
+@dataclass
+class SnapshotSeed:
+    """Copy a host tree into the container's workspace volume after create.
+
+    On a tpu_vm worker there is no shared filesystem with the laptop, so
+    snapshot seeding travels through put_archive (the same channel bootstrap
+    material uses) rather than host bind mounts -- this is what makes
+    snapshot mode the default for remote workers.
+    """
+
+    src: Path
+    dst: str = consts.WORKSPACE_DIR
+
+    def run(self, engine: Engine, container_id: str) -> None:
+        engine.put_archive(container_id, self.dst, _tar_tree(self.src))
+
+
+def _tar_tree(src: Path) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for p in sorted(src.rglob("*")):
+            if ".git" in p.parts[len(src.parts):-1]:
+                continue
+            tf.add(p, arcname=str(p.relative_to(src)), recursive=False)
+    return buf.getvalue()
+
+
+class BindStrategy:
+    """Live bind-mount of the project root (local driver only)."""
+
+    name = "bind"
+
+    def mounts(
+        self, engine: Engine, project: str, agent: str, project_root: Path
+    ) -> WorkspaceMounts:
+        m = WorkspaceMounts()
+        m.binds.append(f"{project_root}:{consts.WORKSPACE_DIR}")
+        return m
+
+
+class SnapshotStrategy:
+    """Ephemeral copy-on-create workspace in a named volume."""
+
+    name = "snapshot"
+
+    def mounts(
+        self, engine: Engine, project: str, agent: str, project_root: Path
+    ) -> WorkspaceMounts:
+        m = WorkspaceMounts()
+        vol = agent_volume_name(project, agent, "workspace")
+        engine.ensure_volume(vol, labels=volume_labels(project, agent, "workspace"))
+        m.volumes.append(vol)
+        m.binds.append(f"{vol}:{consts.WORKSPACE_DIR}")
+        if project_root.exists():
+            m.post_create.append(SnapshotSeed(src=project_root))
+        return m
+
+
+def setup_mounts(
+    engine: Engine,
+    project: str,
+    agent: str,
+    project_root: Path,
+    *,
+    mode: str = "bind",
+    extra_mounts: list[str] | None = None,
+    worktree_git_dir: Path | None = None,
+) -> WorkspaceMounts:
+    """Full mount assembly (reference: workspace.SetupMounts setup.go:106).
+
+    Adds the workspace (strategy-dependent), per-agent config + history
+    volumes, optional extra mounts, and -- for linked git worktrees -- the
+    main repo's git dir so the worktree's ``.git`` file resolves inside the
+    container (reference: setup.go:288).
+    """
+    strategy = BindStrategy() if mode == "bind" else SnapshotStrategy()
+    m = strategy.mounts(engine, project, agent, project_root)
+    for purpose in ("config", "history"):
+        vol = agent_volume_name(project, agent, purpose)
+        engine.ensure_volume(vol, labels=volume_labels(project, agent, purpose))
+        m.volumes.append(vol)
+    m.binds.append(f"{agent_volume_name(project, agent, 'config')}:/home/agent/.config")
+    m.binds.append(f"{agent_volume_name(project, agent, 'history')}:/home/agent/.history")
+    if worktree_git_dir is not None:
+        if mode != "bind":
+            raise ValueError("worktree agents require bind workspace mode")
+        m.binds.append(f"{worktree_git_dir}:{worktree_git_dir}:ro")
+    for em in extra_mounts or []:
+        m.binds.append(em)
+    return m

@@ -6,10 +6,20 @@ jax is first imported anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# Force CPU: the session env may pin JAX to the real TPU tunnel (axon),
+# which tests must never touch -- it can hang and has 1 chip.  The axon
+# sitecustomize imports jax at interpreter startup, so JAX_PLATFORMS is
+# captured from the env *before* this file runs; mutating os.environ here
+# is too late.  jax.config.update works any time before backend init.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
-import pytest
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
 
 from clawker_tpu.testenv import TestEnv
 

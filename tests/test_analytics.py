@@ -1,0 +1,75 @@
+"""Analytics tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from clawker_tpu.analytics import (
+    fleet_mesh,
+    init_params,
+    score,
+    shard_batch,
+    shard_params,
+    train_step,
+)
+
+
+def test_virtual_device_count():
+    assert len(jax.devices()) == 8
+
+
+def test_score_shapes_and_jit():
+    params = init_params(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (64, 32))
+    s = jax.jit(score)(params, x)
+    assert s.shape == (64,)
+    assert bool(jnp.all(s >= 0))
+
+
+def test_train_reduces_loss():
+    params = init_params(jax.random.key(0))
+    # structured data: low-rank so the autoencoder can learn it
+    basis = jax.random.normal(jax.random.key(2), (4, 32))
+    coef = jax.random.normal(jax.random.key(3), (256, 4))
+    x = coef @ basis
+    step = jax.jit(train_step)
+    _, loss0 = step(params, x)
+    for _ in range(60):
+        params, loss = step(params, x, 1e-2)
+    assert float(loss) < float(loss0)
+
+
+def test_sharded_train_step_runs():
+    mesh = fleet_mesh(8)
+    assert mesh.shape == {"data": 4, "model": 2}
+    params = shard_params(init_params(jax.random.key(0)), mesh)
+    x = shard_batch(jax.random.normal(jax.random.key(1), (32, 32)), mesh)
+    new_params, loss = jax.jit(train_step)(params, x)
+    jax.block_until_ready(loss)
+    s = jax.jit(score)(new_params, x)
+    assert s.shape == (32,)
+
+
+def test_anomalous_agent_scores_higher():
+    params = init_params(jax.random.key(0))
+    basis = jax.random.normal(jax.random.key(2), (4, 32))
+    normal = jax.random.normal(jax.random.key(3), (512, 4)) @ basis
+    step = jax.jit(train_step)
+    for _ in range(120):
+        params, _ = step(params, normal, 1e-2)
+    probe_normal = jax.random.normal(jax.random.key(4), (16, 4)) @ basis
+    probe_weird = jax.random.normal(jax.random.key(5), (16, 32)) * 3.0
+    s_n = score(params, probe_normal)
+    s_w = score(params, probe_weird)
+    assert float(jnp.mean(s_w)) > 2.0 * float(jnp.mean(s_n))
+
+
+def test_graft_entry():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert out.shape == (256,)
+    ge.dryrun_multichip(8)

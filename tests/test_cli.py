@@ -1,0 +1,186 @@
+"""CLI tests over the fake driver (reference Tier-2 pattern: full command
+pipeline with fake engine, TESTING-REFERENCE.md:253-299)."""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+from click.testing import CliRunner
+
+from clawker_tpu import consts
+from clawker_tpu.cli.factory import Factory
+from clawker_tpu.cli.root import cli
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+
+
+@pytest.fixture()
+def env(tenv, tmp_path):
+    tenv.make_project(tmp_path, "project: demo\n")
+    drv = FakeDriver()
+    drv.api.add_image("clawker-demo:default")
+    factory = Factory(cwd=tmp_path, driver=drv)
+    return CliRunner(), factory, drv.api, tmp_path
+
+
+def invoke(runner, factory, *args, **kw):
+    return runner.invoke(cli, list(args), obj=factory, catch_exceptions=False, **kw)
+
+
+def test_run_attaches_and_propagates_exit(env):
+    runner, factory, api, _ = env
+    api.set_behavior("clawker-demo:default", exit_behavior(b"agent says hi\n", code=0))
+    res = invoke(runner, factory, "run", "--agent", "dev")
+    assert res.exit_code == 0, res.output
+    assert "agent says hi" in res.output
+
+
+def test_run_nonzero_exit_code(env):
+    runner, factory, api, _ = env
+    api.set_behavior("clawker-demo:default", exit_behavior(code=3))
+    res = runner.invoke(cli, ["run"], obj=factory)
+    assert res.exit_code == 3
+
+
+def test_run_detach_then_ps_stop_rm(env):
+    runner, factory, api, _ = env
+    res = invoke(runner, factory, "run", "--detach")
+    assert res.exit_code == 0
+    assert "clawker.demo.dev" in res.output
+    res = invoke(runner, factory, "ps")
+    assert "clawker.demo.dev" in res.output and "running" in res.output
+    res = invoke(runner, factory, "stop", "dev")
+    assert res.exit_code == 0
+    res = invoke(runner, factory, "rm", "dev")
+    assert res.exit_code == 0
+    res = invoke(runner, factory, "ps")
+    assert "no agent containers" in res.output
+
+
+def test_run_missing_project_image(env):
+    runner, factory, api, _ = env
+    del api.images["clawker-demo:default"]
+    res = runner.invoke(cli, ["run"], obj=factory)
+    assert res.exit_code == 1
+    assert "clawker build" in res.output
+
+
+def test_container_create_and_inspect(env):
+    runner, factory, api, _ = env
+    res = invoke(runner, factory, "container", "create", "--agent", "aux")
+    assert res.exit_code == 0
+    res = invoke(runner, factory, "container", "inspect", "aux")
+    assert '"clawker.demo.aux"' in res.output.replace("/clawker", "clawker")
+
+
+def test_run_env_flag(env):
+    runner, factory, api, _ = env
+    invoke(runner, factory, "run", "--detach", "-e", "FOO=bar")
+    info = list(api.containers.values())[0].config
+    assert "FOO=bar" in info["Env"]
+
+
+def test_init_scaffold(tenv, tmp_path):
+    runner = CliRunner()
+    factory = Factory(cwd=tmp_path, driver=FakeDriver())
+    res = invoke(runner, factory, "init", "--name", "myproj")
+    assert res.exit_code == 0
+    assert (tmp_path / consts.PROJECT_FLAT_FORM).exists()
+    res = invoke(runner, factory, "init")
+    assert res.exit_code != 0  # already exists
+
+
+def test_volume_ls_after_run(env):
+    runner, factory, api, _ = env
+    invoke(runner, factory, "run", "--detach")
+    res = invoke(runner, factory, "volume", "ls")
+    assert "clawker.demo.dev.config" in res.output
+
+
+# ------------------------------------------------------------- worktrees
+
+@pytest.fixture()
+def git_env(tenv, tmp_path):
+    subprocess.run(["git", "init", "-q", str(tmp_path)], check=True)
+    subprocess.run(
+        ["git", "-C", str(tmp_path), "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "--allow-empty", "-q", "-m", "init"],
+        check=True,
+    )
+    tenv.make_project(tmp_path, "project: demo\n")
+    drv = FakeDriver()
+    drv.api.add_image("clawker-demo:default")
+    return CliRunner(), Factory(cwd=tmp_path, driver=drv), tmp_path
+
+
+def test_worktree_add_list_remove(git_env):
+    runner, factory, root = git_env
+    res = invoke(runner, factory, "worktree", "add", "feat1")
+    assert res.exit_code == 0, res.output
+    assert "clawker/feat1" in res.output
+    res = invoke(runner, factory, "worktree", "list")
+    assert "feat1" in res.output
+    res = invoke(runner, factory, "worktree", "remove", "feat1")
+    assert res.exit_code == 0
+    res = invoke(runner, factory, "worktree", "list")
+    assert "feat1" not in res.output
+
+
+def test_worktree_remove_dirty_requires_force(git_env):
+    runner, factory, root = git_env
+    res = invoke(runner, factory, "worktree", "add", "feat2")
+    wt_path = Path(res.output.split("\t")[1].strip())
+    (wt_path / "junk.txt").write_text("dirty")
+    res = runner.invoke(cli, ["worktree", "remove", "feat2"], obj=factory)
+    assert res.exit_code == 1
+    assert "local changes" in res.output
+    res = invoke(runner, factory, "worktree", "remove", "feat2", "--force")
+    assert res.exit_code == 0
+
+
+def test_run_in_worktree_mounts(git_env):
+    runner, factory, root = git_env
+    invoke(runner, factory, "worktree", "add", "feat3")
+    res = invoke(runner, factory, "run", "--detach", "--worktree", "feat3")
+    assert res.exit_code == 0, res.output
+    api = factory.driver.api
+    c = list(api.containers.values())[0]
+    binds = c.config["HostConfig"]["Binds"]
+    assert any("worktrees/demo/feat3:/workspace" in b for b in binds)
+    # main repo git dir mounted read-only so the worktree .git file resolves
+    assert any(b.endswith(":ro") and "/.git" in b for b in binds)
+
+
+def test_project_register_and_list(git_env):
+    runner, factory, root = git_env
+    res = invoke(runner, factory, "project", "register")
+    assert res.exit_code == 0
+    res = invoke(runner, factory, "project", "list")
+    assert "demo" in res.output
+
+
+def test_stop_long_agent_name_resolves_to_project(env):
+    # agent names up to 63 chars are valid; only hex container ids skip the
+    # project-prefix resolution
+    runner, factory, api, _ = env
+    long_agent = "experiment-long-context-window-ablation-a"
+    res = invoke(runner, factory, "run", "--detach", "--agent", long_agent)
+    assert res.exit_code == 0, res.output
+    res = invoke(runner, factory, "stop", long_agent)
+    assert res.exit_code == 0, res.output
+    res = invoke(runner, factory, "ps", "--running")
+    assert "no agent containers" in res.output
+    res = invoke(runner, factory, "ps")
+    assert long_agent in res.output
+
+
+def test_create_wires_socket_and_hostproxy_mapping(env):
+    runner, factory, api, _ = env
+    res = invoke(runner, factory, "run", "--detach")
+    assert res.exit_code == 0, res.output
+    c = list(api.containers.values())[0]
+    hc = c.config["HostConfig"]
+    # host proxy on by default -> host-gateway mapping for Linux daemons
+    assert hc.get("ExtraHosts") == ["host.docker.internal:host-gateway"]
+    # docker socket NOT mounted unless opted in
+    assert not any("docker.sock" in b for b in hc["Binds"])

@@ -1,0 +1,129 @@
+"""Runtime middleware tests: naming, image resolve, workspace mounts,
+orchestrated create."""
+
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.config import load_config
+from clawker_tpu.engine import Engine, FakeDockerAPI
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.errors import ConflictError, NotFoundError
+from clawker_tpu.runtime import (
+    agent_volume_name,
+    container_name,
+    image_ref,
+    parse_container_name,
+    resolve_image,
+)
+from clawker_tpu.runtime.orchestrate import AgentRuntime, CreateOptions
+from clawker_tpu.workspace import setup_mounts
+
+
+# ------------------------------------------------------------------ names
+
+def test_names_roundtrip():
+    n = container_name("demo", "dev")
+    assert n == "clawker.demo.dev"
+    assert parse_container_name("/" + n) == ("demo", "dev")
+    assert parse_container_name("random-container") is None
+    assert agent_volume_name("demo", "dev", "workspace") == "clawker.demo.dev.workspace"
+    assert image_ref("demo") == "clawker-demo:default"
+    with pytest.raises(ValueError):
+        container_name("Bad Name", "dev")
+
+
+# ---------------------------------------------------------------- resolve
+
+def test_resolve_placeholder_and_literal():
+    api = FakeDockerAPI()
+    eng = Engine(api)
+    api.add_image("clawker-demo:default")
+    assert resolve_image(eng, "demo", "@") == "clawker-demo:default"
+    with pytest.raises(NotFoundError):
+        resolve_image(eng, "demo", "@base")
+    # literal image gets pulled on demand
+    assert resolve_image(eng, "demo", "alpine:3.20") == "alpine:3.20"
+    assert "alpine:3.20" in api.images
+
+
+# ----------------------------------------------------------------- mounts
+
+def test_setup_mounts_bind(tmp_path):
+    eng = Engine(FakeDockerAPI())
+    m = setup_mounts(eng, "demo", "dev", tmp_path, mode="bind")
+    assert f"{tmp_path}:{consts.WORKSPACE_DIR}" in m.binds
+    assert "clawker.demo.dev.config:/home/agent/.config" in m.binds
+    vols = {v["Name"] for v in eng.list_volumes()}
+    assert vols == {"clawker.demo.dev.config", "clawker.demo.dev.history"}
+
+
+def test_setup_mounts_snapshot_seeds(tmp_path):
+    api = FakeDockerAPI()
+    api.add_image("alpine:latest")
+    eng = Engine(api)
+    (tmp_path / "hello.txt").write_text("hi")
+    m = setup_mounts(eng, "demo", "dev", tmp_path, mode="snapshot")
+    assert m.binds[0] == f"clawker.demo.dev.workspace:{consts.WORKSPACE_DIR}"
+    from clawker_tpu.engine.api import ContainerSpec
+
+    cid = eng.create_container("clawker.demo.dev", ContainerSpec(image="alpine:latest"))
+    m.seed(eng, cid)
+    assert consts.WORKSPACE_DIR in api.containers[cid].archives
+
+
+def test_worktree_requires_bind(tmp_path):
+    eng = Engine(FakeDockerAPI())
+    with pytest.raises(ValueError):
+        setup_mounts(
+            eng, "demo", "dev", tmp_path, mode="snapshot", worktree_git_dir=tmp_path / ".git"
+        )
+
+
+# -------------------------------------------------------------- orchestrate
+
+@pytest.fixture()
+def rt(tenv, tmp_path):
+    tenv.make_project(tmp_path, "project: demo\nbuild:\n  harness: claude\n")
+    cfg = load_config(tmp_path)
+    drv = FakeDriver()
+    drv.api.add_image("clawker-demo:default")
+    return AgentRuntime(drv.engine(), cfg), drv.api
+
+
+def test_create_sets_env_labels_mounts(rt):
+    runtime, api = rt
+    cid = runtime.create(CreateOptions(agent="dev"))
+    info = api.container_inspect(cid)
+    labels = info["Config"]["Labels"]
+    assert labels[consts.LABEL_PROJECT] == "demo"
+    assert labels[consts.LABEL_AGENT] == "dev"
+    assert labels[consts.LABEL_HARNESS] == "claude"
+    env = dict(e.split("=", 1) for e in info["Config"]["Env"])
+    assert env["CLAWKER_PROJECT"] == "demo"
+    assert env["CLAWKER_AGENT"] == "dev"
+    assert "CLAWKER_HOSTPROXY" in env
+    assert info["Config"]["WorkingDir"] == consts.WORKSPACE_DIR
+
+
+def test_create_conflict_message_and_replace(rt):
+    runtime, api = rt
+    runtime.create(CreateOptions(agent="dev"))
+    with pytest.raises(ConflictError, match="use --replace"):
+        runtime.create(CreateOptions(agent="dev"))
+    runtime.create(CreateOptions(agent="dev", replace=True))
+
+
+def test_attach_and_run_exit_code(rt):
+    import io
+
+    runtime, api = rt
+    from clawker_tpu.engine.fake import exit_behavior
+
+    api.set_behavior("clawker-demo:default", exit_behavior(b"work done\n", code=7))
+    cid = runtime.create(CreateOptions(agent="dev"))
+    out = io.BytesIO()
+    code = runtime.attach_and_run(cid, tty=True, stdin=io.BytesIO(b""), stdout=out)
+    assert code == 7
+    assert out.getvalue() == b"work done\n"
