@@ -1,0 +1,21 @@
+"""Bundle system: pluggable harness / stack / monitoring components.
+
+Parity reference: internal/bundle (SURVEY.md 2.6) -- three-tier component
+resolution (embedded floor assets, loose directories, installed bundles
+under the data dir) with a Manager facade for install / list / validate /
+remove.  Assets are plain directories holding ``harness.yaml`` /
+``stack.yaml`` plus optional files referenced by Dockerfile generation.
+"""
+
+from .model import Harness, MonitoringUnit, Stack, load_component_dir
+from .resolver import Resolver
+from .manager import BundleManager
+
+__all__ = [
+    "Harness",
+    "Stack",
+    "MonitoringUnit",
+    "Resolver",
+    "BundleManager",
+    "load_component_dir",
+]

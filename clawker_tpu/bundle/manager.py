@@ -1,0 +1,197 @@
+"""Bundle install / list / validate / remove (reference: internal/bundle
+manager.go Install/Update/Remove/Validate + receipt.go fetch receipts).
+
+A bundle is a directory tree holding any of ``harnesses/<name>/``,
+``stacks/<name>/``, ``monitoring/<name>/``.  Sources are local paths or git
+URLs (cloned via the system git).  Installs are atomic (staging dir +
+rename), recorded with a receipt, and symlink-hostile: symlinks in sources
+are rejected rather than followed (reference: install.go symlink-safe
+pipeline).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+from ..config import Config
+from ..errors import ClawkerError, NotFoundError
+from .model import MANIFESTS, load_component_dir
+from .resolver import KIND_DIRS
+
+RECEIPT = ".clawker-bundle-receipt.json"
+
+
+class BundleError(ClawkerError):
+    pass
+
+
+@dataclass
+class InstalledBundle:
+    namespace: str
+    name: str
+    path: Path
+    source: str
+    installed_at: float
+    components: dict[str, list[str]]
+
+
+class BundleManager:
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+
+    # ------------------------------------------------------------ install
+
+    def install(self, source: str, *, namespace: str = "local", name: str = "") -> InstalledBundle:
+        src = Path(source)
+        if src.is_dir():
+            bundle_name = name or src.name
+            staged = self._stage_copy(src)
+        elif "://" in source or source.endswith(".git") or source.startswith("git@"):
+            bundle_name = name or source.rstrip("/").rsplit("/", 1)[-1].removesuffix(".git")
+            staged = self._stage_clone(source)
+        else:
+            raise BundleError(f"bundle source {source!r}: not a directory or git URL")
+        try:
+            comps = self._scan(staged)
+            if not any(comps.values()):
+                raise BundleError(f"{source}: no harness/stack/monitoring components found")
+            errs = self.validate_tree(staged)
+            if errs:
+                raise BundleError(f"{source}: invalid bundle: " + "; ".join(errs))
+            dest = self.cfg.bundles_dir / namespace / bundle_name
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            receipt = {
+                "source": source,
+                "installed_at": time.time(),
+                "components": comps,
+            }
+            (staged / RECEIPT).write_text(json.dumps(receipt, indent=2))
+            # land next to dest first (staging may be on another filesystem,
+            # making move non-atomic); only then swap out any old install
+            landing = dest.parent / f".{bundle_name}.installing"
+            if landing.exists():
+                shutil.rmtree(landing)
+            shutil.move(str(staged), str(landing))
+            old = dest.parent / f".{bundle_name}.old"
+            if old.exists():
+                shutil.rmtree(old)
+            if dest.exists():
+                dest.rename(old)
+            landing.rename(dest)
+            if old.exists():
+                shutil.rmtree(old)
+            return InstalledBundle(
+                namespace=namespace,
+                name=bundle_name,
+                path=dest,
+                source=source,
+                installed_at=receipt["installed_at"],
+                components=comps,
+            )
+        finally:
+            if staged.exists():
+                shutil.rmtree(staged, ignore_errors=True)
+
+    def _staging_dir(self) -> Path:
+        d = self.cfg.cache_dir / "bundle-staging"
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def _stage_copy(self, src: Path) -> Path:
+        staged = self._staging_dir() / f"stage-{int(time.time() * 1e6)}"
+        for p in src.rglob("*"):
+            if p.is_symlink():
+                raise BundleError(f"{src}: symlink {p.relative_to(src)} not allowed in bundles")
+        shutil.copytree(src, staged, symlinks=False)
+        return staged
+
+    def _stage_clone(self, url: str) -> Path:
+        staged = self._staging_dir() / f"stage-{int(time.time() * 1e6)}"
+        res = subprocess.run(
+            ["git", "clone", "--depth", "1", url, str(staged)],
+            capture_output=True,
+            text=True,
+        )
+        if res.returncode != 0:
+            raise BundleError(f"git clone {url} failed: {res.stderr.strip()}")
+        shutil.rmtree(staged / ".git", ignore_errors=True)
+        for p in staged.rglob("*"):
+            if p.is_symlink():
+                shutil.rmtree(staged, ignore_errors=True)
+                raise BundleError(f"{url}: symlinks not allowed in bundles")
+        return staged
+
+    # -------------------------------------------------------------- query
+
+    def _scan(self, root: Path) -> dict[str, list[str]]:
+        comps: dict[str, list[str]] = {}
+        for kind, sub in KIND_DIRS.items():
+            d = root / sub
+            comps[kind] = sorted(
+                c.name
+                for c in (d.iterdir() if d.is_dir() else [])
+                if c.is_dir() and (c / MANIFESTS[kind][0]).is_file()
+            )
+        return comps
+
+    def list_installed(self) -> list[InstalledBundle]:
+        out = []
+        root = self.cfg.bundles_dir
+        if not root.is_dir():
+            return out
+        for ns in sorted(root.iterdir()):
+            if not ns.is_dir() or ns.name.startswith("."):
+                continue
+            for b in sorted(ns.iterdir()):
+                # dot-dirs are install staging (see install() swap)
+                if not b.is_dir() or b.name.startswith("."):
+                    continue
+                receipt = {}
+                rp = b / RECEIPT
+                if rp.is_file():
+                    try:
+                        receipt = json.loads(rp.read_text())
+                    except json.JSONDecodeError:
+                        receipt = {}
+                out.append(
+                    InstalledBundle(
+                        namespace=ns.name,
+                        name=b.name,
+                        path=b,
+                        source=receipt.get("source", ""),
+                        installed_at=receipt.get("installed_at", 0.0),
+                        components=receipt.get("components") or self._scan(b),
+                    )
+                )
+        return out
+
+    def remove(self, namespace: str, name: str) -> None:
+        dest = self.cfg.bundles_dir / namespace / name
+        if not dest.is_dir():
+            raise NotFoundError(f"bundle {namespace}/{name} not installed")
+        shutil.rmtree(dest)
+
+    # ----------------------------------------------------------- validate
+
+    def validate_tree(self, root: Path) -> list[str]:
+        """Validate every component in a bundle tree; [] when clean."""
+        errs: list[str] = []
+        for kind, sub in KIND_DIRS.items():
+            d = root / sub
+            if not d.is_dir():
+                continue
+            for cdir in sorted(d.iterdir()):
+                if not cdir.is_dir():
+                    continue
+                try:
+                    comp = load_component_dir(kind, cdir)
+                except ClawkerError as e:
+                    errs.append(str(e))
+                    continue
+                errs.extend(comp.validate())
+        return errs

@@ -1,0 +1,157 @@
+"""Project image build orchestration: base stage then harness stage.
+
+Reference call stack: internal/cmd/image/build/build.go:110 buildRun ->
+bundler.GenerateBase/GenerateHarness -> client.BuildImage -> tag
+``:<harness>`` + ``:default`` alias (SURVEY.md 3.2).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterator
+
+from .. import consts
+from ..bundle import Resolver
+from ..config import Config
+from ..engine.api import Engine
+from ..errors import ClawkerError
+from .context import build_context
+from .dockerfile import CTX_AGENTD, CTX_CA_CERT, generate_base, generate_harness
+
+ENV_AGENTD_BIN = "CLAWKER_TPU_AGENTD_BIN"
+
+
+def find_agentd_binary() -> bytes | None:
+    """The native agentd binary to embed (reference: clawkerd embedded via
+    clawkerd/embed; here the C++ build output or an env-pointed path)."""
+    cand = os.environ.get(ENV_AGENTD_BIN, "")
+    paths = [Path(cand)] if cand else []
+    paths.append(Path(__file__).resolve().parents[2] / "native" / "build" / "clawkerd")
+    for p in paths:
+        if p.is_file():
+            return p.read_bytes()
+    return None
+
+
+@dataclass
+class BuildResult:
+    base_ref: str = ""
+    harness_ref: str = ""
+    default_ref: str = ""
+    with_agentd: bool = False
+    with_ca: bool = False
+    events: list[str] = field(default_factory=list)
+
+
+class ProjectBuilder:
+    def __init__(
+        self,
+        engine: Engine,
+        cfg: Config,
+        *,
+        ca_cert_pem: bytes | None = None,
+        progress: Callable[[str], None] | None = None,
+    ):
+        self.engine = engine
+        self.cfg = cfg
+        self.ca_cert_pem = ca_cert_pem
+        self.progress = progress or (lambda _line: None)
+
+    def build(self, *, harness_override: str = "", no_cache: bool = False) -> BuildResult:
+        pconf = self.cfg.project
+        if pconf is None:
+            raise ClawkerError("no project config found -- run `clawker init` first")
+        project = self.cfg.project_name()
+        resolver = Resolver(self.cfg)
+        stack = resolver.stack(pconf.build.stack or "python")
+        harness = resolver.harness(harness_override or pconf.build.harness or "claude")
+
+        res = BuildResult()
+        # ---- stage 1: base
+        base_ref = f"{consts.IMAGE_NAME_PREFIX}{project}:{consts.IMAGE_TAG_BASE}"
+        self.progress(f"building {base_ref} (stack {stack.name})")
+        base_df = generate_base(project, stack, pconf.build)
+        self._run_build(
+            build_context({"Dockerfile": base_df.encode()}),
+            tags=[base_ref],
+            labels={consts.LABEL_IMAGE_KIND: "base", consts.LABEL_PROJECT: project},
+            res=res,
+            no_cache=no_cache,
+        )
+        res.base_ref = base_ref
+
+        # ---- stage 2: harness
+        harness_ref = f"{consts.IMAGE_NAME_PREFIX}{project}:{harness.name}"
+        self.progress(f"building {harness_ref} (harness {harness.name})")
+        agentd = find_agentd_binary()
+        files: dict[str, bytes] = {}
+        extra: list[str] = []
+        if harness.source_dir is not None:
+            src_root = harness.source_dir.resolve()
+            for f in harness.files:
+                # containment: a third-party bundle manifest must not reach
+                # outside its own directory (matches the installer's
+                # symlink rejection, bundle/manager.py)
+                p = (src_root / f).resolve()
+                if not p.is_relative_to(src_root):
+                    raise ClawkerError(
+                        f"harness {harness.name}: file {f!r} escapes the bundle directory"
+                    )
+                files[f] = p.read_bytes()
+            extra = list(harness.files)
+        with_ca = self.ca_cert_pem is not None
+        if with_ca:
+            files[CTX_CA_CERT] = self.ca_cert_pem  # type: ignore[assignment]
+        if agentd is not None:
+            files[CTX_AGENTD] = agentd
+        harness_df = generate_harness(
+            project,
+            harness,
+            pconf.build,
+            base_ref=base_ref,
+            with_ca_cert=with_ca,
+            with_agentd=agentd is not None,
+            extra_files=extra,
+        )
+        files["Dockerfile"] = harness_df.encode()
+        self._run_build(
+            build_context(files),
+            tags=[harness_ref],
+            labels={
+                consts.LABEL_IMAGE_KIND: "harness",
+                consts.LABEL_PROJECT: project,
+                consts.LABEL_HARNESS: harness.name,
+            },
+            res=res,
+            no_cache=no_cache,
+        )
+        res.harness_ref = harness_ref
+        res.with_agentd = agentd is not None
+        res.with_ca = with_ca
+
+        # ---- :default alias
+        default_ref = f"{consts.IMAGE_NAME_PREFIX}{project}:{consts.IMAGE_TAG_DEFAULT}"
+        self.engine.tag_image(harness_ref, f"{consts.IMAGE_NAME_PREFIX}{project}", consts.IMAGE_TAG_DEFAULT)
+        res.default_ref = default_ref
+        self.progress(f"tagged {default_ref}")
+        return res
+
+    def _run_build(
+        self, ctx: bytes, *, tags: list[str], labels: dict, res: BuildResult, no_cache: bool = False
+    ) -> None:
+        stream: Iterator[dict] = self.engine.build_image(
+            ctx, tags=tags, labels=labels, no_cache=no_cache
+        )
+        err = ""
+        for ev in stream:
+            if "stream" in ev:
+                line = ev["stream"].rstrip()
+                if line:
+                    res.events.append(line)
+                    self.progress(line)
+            elif "errorDetail" in ev or "error" in ev:
+                err = (ev.get("errorDetail") or {}).get("message") or ev.get("error", "")
+        if err:
+            raise ClawkerError(f"build of {tags[0]} failed: {err}")

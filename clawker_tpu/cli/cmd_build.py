@@ -1,0 +1,39 @@
+"""`clawker build` -- build the project's base + harness images
+(reference: internal/cmd/image/build/build.go:110)."""
+
+from __future__ import annotations
+
+import click
+
+from ..bundler.build import ProjectBuilder
+from .factory import Factory
+
+pass_factory = click.make_pass_decorator(Factory)
+
+
+@click.command("build")
+@click.option("--harness", default="", help="Harness override (default: project config).")
+@click.option("--no-cache", is_flag=True, help="Build without layer cache.")
+@click.option("--quiet", "-q", is_flag=True, help="Only print the final image ref.")
+@pass_factory
+def build_cmd(f: Factory, harness, no_cache, quiet):
+    """Build the project image (base stage + harness stage + :default tag)."""
+    progress = (lambda _line: None) if quiet else (lambda line: click.echo(line))
+    ca_pem = None
+    if f.config.settings.firewall.enable:
+        from ..firewall.pki import ensure_ca
+
+        ca_pem = ensure_ca(f.config.pki_dir).cert_pem
+    builder = ProjectBuilder(f.engine(), f.config, ca_cert_pem=ca_pem, progress=progress)
+    res = builder.build(harness_override=harness, no_cache=no_cache)
+    click.echo(res.default_ref)
+    if not res.with_agentd and not quiet:
+        click.echo(
+            "warning: agentd binary not found -- image runs the harness "
+            "directly without PID-1 supervision (build native/ first)",
+            err=True,
+        )
+
+
+def register(root: click.Group) -> None:
+    root.add_command(build_cmd)

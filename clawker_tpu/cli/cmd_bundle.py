@@ -1,0 +1,71 @@
+"""`clawker bundle` verbs: list / install / validate / remove
+(reference: internal/cmd/bundle over internal/bundle Manager)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import click
+
+from ..bundle import BundleManager, Resolver
+from .factory import Factory
+
+pass_factory = click.make_pass_decorator(Factory)
+
+
+@click.group("bundle")
+def bundle_group():
+    """Manage harness / stack / monitoring bundles."""
+
+
+@bundle_group.command("list")
+@pass_factory
+def bundle_list(f: Factory):
+    """List visible components by kind and tier."""
+    r = Resolver(f.config)
+    for kind in ("harness", "stack", "monitoring"):
+        for comp in r.list(kind):
+            click.echo(f"{kind}\t{comp.name}\t{comp.tier}\t{comp.description}")
+    for b in BundleManager(f.config).list_installed():
+        click.echo(f"bundle\t{b.namespace}/{b.name}\t{b.source or '-'}")
+
+
+@bundle_group.command("install")
+@click.argument("source")
+@click.option("--namespace", "-n", default="local", show_default=True)
+@click.option("--name", default="", help="Bundle name (default: derived from source).")
+@pass_factory
+def bundle_install(f: Factory, source, namespace, name):
+    """Install a bundle from a directory or git URL."""
+    b = BundleManager(f.config).install(source, namespace=namespace, name=name)
+    comps = ", ".join(f"{k}:{len(v)}" for k, v in b.components.items() if v)
+    click.echo(f"installed {b.namespace}/{b.name} ({comps})")
+
+
+@bundle_group.command("validate")
+@click.argument("path", type=click.Path(exists=True, file_okay=False, path_type=Path))
+@pass_factory
+def bundle_validate(f: Factory, path):
+    """Validate a bundle directory without installing it."""
+    errs = BundleManager(f.config).validate_tree(path)
+    if errs:
+        for e in errs:
+            click.echo(e, err=True)
+        raise SystemExit(1)
+    click.echo("ok")
+
+
+@bundle_group.command("remove")
+@click.argument("spec")
+@pass_factory
+def bundle_remove(f: Factory, spec):
+    """Remove an installed bundle (namespace/name)."""
+    ns, _, name = spec.partition("/")
+    if not name:
+        ns, name = "local", ns
+    BundleManager(f.config).remove(ns, name)
+    click.echo(f"removed {ns}/{name}")
+
+
+def register(root: click.Group) -> None:
+    root.add_command(bundle_group)

@@ -73,8 +73,18 @@ def main(argv: list[str] | None = None) -> int:
 
 def register_commands() -> None:
     """Attach all command groups (import-cycle-free late binding)."""
-    from . import cmd_container, cmd_image, cmd_init, cmd_project, cmd_volume
+    from . import (
+        cmd_build,
+        cmd_bundle,
+        cmd_container,
+        cmd_image,
+        cmd_init,
+        cmd_project,
+        cmd_volume,
+    )
 
+    cmd_build.register(cli)
+    cmd_bundle.register(cli)
     cmd_container.register(cli)
     cmd_image.register(cli)
     cmd_init.register(cli)

@@ -316,6 +316,7 @@ class Engine:
         buildargs: dict[str, str] | None = None,
         target: str = "",
         pull: bool = False,
+        no_cache: bool = False,
     ) -> Iterator[dict]:
         return self.api.image_build(
             context_tar,
@@ -325,6 +326,7 @@ class Engine:
             buildargs=buildargs,
             target=target,
             pull=pull,
+            no_cache=no_cache,
         )
 
     def tag_image(self, ref: str, repo: str, tag: str) -> None:

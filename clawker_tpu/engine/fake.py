@@ -512,8 +512,11 @@ class FakeDockerAPI:
         buildargs: dict[str, str] | None = None,
         target: str = "",
         pull: bool = False,
+        no_cache: bool = False,
     ) -> Iterator[dict]:
-        self._record("image_build", tags=tags, labels=labels, dockerfile=dockerfile)
+        self._record(
+            "image_build", tags=tags, labels=labels, dockerfile=dockerfile, no_cache=no_cache
+        )
         if self.build_hook:
             self.build_hook(context_tar, tags)
         for t in tags:

@@ -444,12 +444,14 @@ class HTTPDockerAPI:
         buildargs: dict[str, str] | None = None,
         target: str = "",
         pull: bool = False,
+        no_cache: bool = False,
     ) -> Iterator[dict]:
         q: dict[str, Any] = {
             "dockerfile": dockerfile,
             "labels": labels or {},
             "buildargs": buildargs or {},
             "pull": pull,
+            "nocache": no_cache,
         }
         if target:
             q["target"] = target

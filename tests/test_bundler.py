@@ -1,0 +1,220 @@
+"""Bundle resolution + Dockerfile generation + `clawker build` pipeline."""
+
+import tarfile
+import io
+from pathlib import Path
+
+import pytest
+from click.testing import CliRunner
+
+from clawker_tpu import consts
+from clawker_tpu.bundle import BundleManager, Resolver
+from clawker_tpu.bundler import (
+    ProjectBuilder,
+    build_context,
+    compose_egress_rules,
+    generate_base,
+    generate_harness,
+)
+from clawker_tpu.cli.factory import Factory
+from clawker_tpu.cli.root import cli
+from clawker_tpu.config import load_config
+from clawker_tpu.config.schema import BuildConfig, EgressRule
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.errors import NotFoundError
+
+
+@pytest.fixture()
+def cfg(tenv, tmp_path):
+    tenv.make_project(tmp_path, "project: demo\nbuild:\n  stack: go\n")
+    return load_config(tmp_path)
+
+
+# ---------------------------------------------------------------- resolver
+
+def test_floor_assets_resolve(cfg):
+    r = Resolver(cfg)
+    claude = r.harness("claude")
+    assert claude.tier == "floor" and claude.cmd == ["claude"]
+    assert {s.name for s in r.list("stack")} >= {
+        "python", "go", "node", "rust", "cpp", "java", "ruby", "dotnet"
+    }
+    with pytest.raises(NotFoundError):
+        r.harness("nope")
+
+
+def test_installed_bundle_shadows_floor(cfg, tmp_path):
+    src = tmp_path / "mybundle"
+    (src / "harnesses" / "claude").mkdir(parents=True)
+    (src / "harnesses" / "claude" / "harness.yaml").write_text(
+        "name: claude\ncmd: [my-claude]\n"
+    )
+    mgr = BundleManager(cfg)
+    b = mgr.install(str(src))
+    assert b.components["harness"] == ["claude"]
+    assert Resolver(cfg).harness("claude").cmd == ["my-claude"]
+    mgr.remove("local", "mybundle")
+    assert Resolver(cfg).harness("claude").cmd == ["claude"]
+
+
+def test_bundle_install_rejects_symlinks_and_empty(cfg, tmp_path):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    mgr = BundleManager(cfg)
+    with pytest.raises(Exception, match="no harness"):
+        mgr.install(str(empty))
+    bad = tmp_path / "bad"
+    (bad / "harnesses" / "x").mkdir(parents=True)
+    (bad / "harnesses" / "x" / "harness.yaml").write_text("name: x\ncmd: [x]\n")
+    (bad / "evil").symlink_to("/etc/passwd")
+    with pytest.raises(Exception, match="symlink"):
+        mgr.install(str(bad))
+
+
+# --------------------------------------------------------------- dockerfile
+
+def test_generate_base_deterministic(cfg):
+    stack = Resolver(cfg).stack("go")
+    df1 = generate_base("demo", stack, BuildConfig(packages=["jq"]))
+    df2 = generate_base("demo", stack, BuildConfig(packages=["jq"]))
+    assert df1 == df2
+    assert "FROM golang:" in df1
+    assert "jq" in df1 and "useradd" in df1 and consts.WORKSPACE_DIR in df1
+
+
+def test_generate_harness_cache_tail(cfg):
+    harness = Resolver(cfg).harness("claude")
+    df = generate_harness(
+        "demo", harness, BuildConfig(), with_ca_cert=True, with_agentd=True
+    )
+    # agentd COPY must come after every install RUN and after the CA COPY
+    agentd_at = df.index("COPY clawkerd")
+    assert df.index("npm install") < agentd_at
+    assert df.index("COPY clawker-ca.crt") < agentd_at
+    assert df.rstrip().endswith('CMD ["claude"]')
+    assert f'ENTRYPOINT ["{consts.AGENTD_PATH}"]' in df
+
+
+def test_build_context_deterministic_tar():
+    files = {"Dockerfile": b"FROM x\n", "clawkerd": b"\x7fELF"}
+    t1, t2 = build_context(files), build_context(files)
+    assert t1 == t2
+    names = tarfile.open(fileobj=io.BytesIO(t1)).getnames()
+    assert names == sorted(names)
+
+
+# ------------------------------------------------------------------ egress
+
+def test_compose_egress_rules_dedupes(cfg):
+    harness = Resolver(cfg).harness("claude")
+    pconf = cfg.project
+    pconf.security.egress.append(EgressRule(dst="api.anthropic.com", proto="https"))
+    pconf.security.egress.append(EgressRule(dst="internal.corp", proto="tcp", port=22))
+    rules = compose_egress_rules(pconf, harness)
+    keys = [r.key() for r in rules]
+    assert len(keys) == len(set(keys))
+    assert "api.anthropic.com:https:443" in keys
+    assert "internal.corp:tcp:22" in keys
+
+
+# ------------------------------------------------------------- build + CLI
+
+def test_project_builder_two_stages(cfg):
+    drv = FakeDriver()
+    eng = drv.api and drv.workers()[0].require_engine()
+    pb = ProjectBuilder(eng, cfg)
+    res = pb.build()
+    assert res.base_ref == "clawker-demo:base"
+    assert res.harness_ref == "clawker-demo:claude"
+    assert res.default_ref == "clawker-demo:default"
+    assert "clawker-demo:default" in drv.api.images
+    builds = drv.api.calls_named("image_build")
+    assert [b[1]["tags"] for b in builds] == [["clawker-demo:base"], ["clawker-demo:claude"]]
+    assert builds[0][1]["labels"][consts.LABEL_IMAGE_KIND] == "base"
+    assert builds[1][1]["labels"][consts.LABEL_HARNESS] == "claude"
+
+
+def test_build_cli_then_run(tenv, tmp_path):
+    tenv.make_project(tmp_path, "project: demo\n")
+    drv = FakeDriver()
+    factory = Factory(cwd=tmp_path, driver=drv)
+    runner = CliRunner()
+    res = runner.invoke(cli, ["build", "-q"], obj=factory, catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert "clawker-demo:default" in res.output
+    # the freshly built image satisfies `run` image resolution
+    from clawker_tpu.engine.fake import exit_behavior
+
+    drv.api.set_behavior("clawker-demo:default", exit_behavior(b"hi\n"))
+    res = runner.invoke(cli, ["run"], obj=factory, catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+
+
+def test_bundle_cli_list_validate(tenv, tmp_path):
+    tenv.make_project(tmp_path, "project: demo\n")
+    factory = Factory(cwd=tmp_path, driver=FakeDriver())
+    runner = CliRunner()
+    res = runner.invoke(cli, ["bundle", "list"], obj=factory, catch_exceptions=False)
+    assert res.exit_code == 0
+    assert "claude" in res.output and "floor" in res.output
+    src = tmp_path / "b"
+    (src / "stacks" / "zig").mkdir(parents=True)
+    (src / "stacks" / "zig" / "stack.yaml").write_text("name: zig\nbase_image: alpine\n")
+    res = runner.invoke(cli, ["bundle", "validate", str(src)], obj=factory)
+    assert res.exit_code == 0, res.output
+    res = runner.invoke(cli, ["bundle", "install", str(src)], obj=factory)
+    assert res.exit_code == 0, res.output
+    res = runner.invoke(cli, ["bundle", "list"], obj=factory)
+    assert "zig" in res.output
+    res = runner.invoke(cli, ["bundle", "remove", "b"], obj=factory)
+    assert res.exit_code == 0, res.output
+
+
+def test_harness_file_escape_rejected(cfg, tmp_path):
+    src = tmp_path / "esc"
+    hdir = src / "harnesses" / "h"
+    hdir.mkdir(parents=True)
+    hdir.joinpath("harness.yaml").write_text(
+        "name: h\ncmd: [h]\nfiles: ['../../../secret.txt']\n"
+    )
+    tmp_path.joinpath("secret.txt").write_text("s3cret")
+    cfg.project.build.harness = "h"
+    # loose tier: place under project .clawker/bundles
+    import shutil
+
+    loose = cfg.project_root / ".clawker" / "bundles" / "esc"
+    shutil.copytree(src, loose)
+    drv = FakeDriver()
+    with pytest.raises(Exception, match="escapes"):
+        ProjectBuilder(drv.workers()[0].require_engine(), cfg).build()
+
+
+def test_stack_install_gets_run_prefix_and_cmd_json(cfg):
+    from clawker_tpu.bundle.model import Harness, Stack
+
+    stack = Stack(name="s", base_image="debian", install=["pip install uv"])
+    df = generate_base("demo", stack, BuildConfig())
+    assert "RUN pip install uv" in df
+    h = Harness(name="h", cmd=["sh", "-c", 'echo "hi"'])
+    df = generate_harness("demo", h, BuildConfig(), with_agentd=False)
+    assert 'CMD ["sh", "-c", "echo \\"hi\\""]' in df
+
+
+def test_reinstall_preserves_other_bundles_and_updates(cfg, tmp_path):
+    src = tmp_path / "rb"
+    (src / "stacks" / "s1").mkdir(parents=True)
+    (src / "stacks" / "s1" / "stack.yaml").write_text("name: s1\nbase_image: a:1\n")
+    mgr = BundleManager(cfg)
+    mgr.install(str(src))
+    (src / "stacks" / "s1" / "stack.yaml").write_text("name: s1\nbase_image: a:2\n")
+    mgr.install(str(src))
+    assert Resolver(cfg).stack("s1").base_image == "a:2"
+    assert [b.name for b in mgr.list_installed()] == ["rb"]
+
+
+def test_no_cache_plumbed_to_daemon(cfg):
+    drv = FakeDriver()
+    eng = drv.workers()[0].require_engine()
+    ProjectBuilder(eng, cfg).build(no_cache=True)
+    builds = drv.api.calls_named("image_build")
+    assert all(b[1]["no_cache"] for b in builds)

@@ -1,0 +1,58 @@
+"""PKI: CA lifecycle, domain MITM certs, agent/CP leafs."""
+
+import ssl
+
+from cryptography import x509
+from cryptography.x509.oid import ExtendedKeyUsageOID
+
+from clawker_tpu.firewall import pki
+
+
+def test_ensure_ca_idempotent(tmp_path):
+    ca1 = pki.ensure_ca(tmp_path)
+    ca2 = pki.ensure_ca(tmp_path)
+    assert ca1.cert_pem == ca2.cert_pem
+    cert = ca1.cert
+    bc = cert.extensions.get_extension_for_class(x509.BasicConstraints).value
+    assert bc.ca is True
+    assert (tmp_path / "ca.key").stat().st_mode & 0o777 == 0o600
+
+
+def test_rotate_ca_changes_identity(tmp_path):
+    ca1 = pki.ensure_ca(tmp_path)
+    ca2 = pki.rotate_ca(tmp_path)
+    assert ca1.cert_pem != ca2.cert_pem
+
+
+def test_domain_cert_sans_and_wildcard(tmp_path):
+    ca = pki.ensure_ca(tmp_path)
+    pair = pki.generate_domain_cert(ca, "*.example.com")
+    cert = x509.load_pem_x509_certificate(pair.cert_pem)
+    sans = cert.extensions.get_extension_for_class(x509.SubjectAlternativeName).value
+    assert set(sans.get_values_for_type(x509.DNSName)) == {"*.example.com", "example.com"}
+    eku = cert.extensions.get_extension_for_class(x509.ExtendedKeyUsage).value
+    assert ExtendedKeyUsageOID.SERVER_AUTH in eku
+
+
+def test_agent_cert_client_and_server_auth(tmp_path):
+    ca = pki.ensure_ca(tmp_path)
+    pair = pki.generate_agent_cert(ca, "demo.dev")
+    cert = x509.load_pem_x509_certificate(pair.cert_pem)
+    eku = cert.extensions.get_extension_for_class(x509.ExtendedKeyUsage).value
+    assert ExtendedKeyUsageOID.CLIENT_AUTH in eku and ExtendedKeyUsageOID.SERVER_AUTH in eku
+    assert cert.subject.rfc4514_string() == "CN=demo.dev"
+
+
+def test_leaf_verifies_against_ca_via_ssl(tmp_path):
+    """The chain is usable by real TLS stacks (ssl context load)."""
+    ca = pki.ensure_ca(tmp_path)
+    pair = pki.generate_cp_cert(ca)
+    (tmp_path / "leaf.crt").write_bytes(pair.cert_pem)
+    (tmp_path / "leaf.key").write_bytes(pair.key_pem)
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(tmp_path / "leaf.crt", tmp_path / "leaf.key")
+    store = x509.verification.Store([ca.cert])
+    builder = x509.verification.PolicyBuilder().store(store)
+    builder.build_client_verifier().verify(
+        x509.load_pem_x509_certificate(pair.cert_pem), []
+    )
