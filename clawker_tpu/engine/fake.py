@@ -335,8 +335,9 @@ class FakeDockerAPI:
             c.exited.set()
             self._event("container", "die", c.id, {"name": c.name, "exitCode": str(code)})
 
-        threading.Thread(target=run, daemon=True, name=f"fake-{c.name}").start()
+        # start event precedes any possible die (real daemons order it so)
         self._event("container", "start", c.id, {"name": c.name})
+        threading.Thread(target=run, daemon=True, name=f"fake-{c.name}").start()
 
     def container_stop(self, cid: str, timeout: int = 10) -> None:
         self._record("container_stop", cid)

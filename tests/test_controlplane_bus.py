@@ -1,0 +1,199 @@
+"""Pub/sub topic + docker-events feeder contract tests.
+
+Mirrors the reference's pubsub guarantees (SURVEY.md 2.7): non-blocking
+publish, bounded per-subscriber drop-oldest, recovered delivery; and
+dockerevents reconcile-on-reconnect.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from clawker_tpu import consts
+from clawker_tpu.controlplane.dockerevents import (
+    ContainerStateRepo,
+    DockerEvent,
+    Feeder,
+    _normalize,
+)
+from clawker_tpu.controlplane.pubsub import Topic, run_subscriber
+from clawker_tpu.engine.api import ContainerSpec, Engine
+from clawker_tpu.engine.fake import FakeDockerAPI, exit_behavior
+
+
+class TestTopic:
+    def test_fanout(self):
+        t: Topic[int] = Topic("t")
+        a, b = t.subscribe("a"), t.subscribe("b")
+        for i in range(3):
+            t.publish(i)
+        assert [e.payload for e in (a.get(1), a.get(1), a.get(1))] == [0, 1, 2]
+        assert [e.payload for e in (b.get(1), b.get(1), b.get(1))] == [0, 1, 2]
+
+    def test_seq_monotonic(self):
+        t: Topic[str] = Topic("t")
+        s = t.subscribe()
+        t.publish("x")
+        t.publish("y")
+        assert (s.get(1).seq, s.get(1).seq) == (1, 2)
+
+    def test_slow_subscriber_drops_oldest_without_blocking_publisher(self):
+        t: Topic[int] = Topic("t")
+        s = t.subscribe(buffer=4)
+        for i in range(10):
+            t.publish(i)
+        # oldest dropped: the 4 newest remain
+        got = [s.get(0.1).payload for _ in range(4)]
+        assert got == [6, 7, 8, 9]
+        assert s.dropped == 6
+        assert s.get(0.05) is None
+
+    def test_closed_subscription_detaches(self):
+        t: Topic[int] = Topic("t")
+        s = t.subscribe()
+        s.close()
+        assert t.subscriber_count() == 0
+        t.publish(1)
+        assert s.get(0.05) is None
+
+    def test_topic_close_unblocks_consumers(self):
+        t: Topic[int] = Topic("t")
+        s = t.subscribe()
+        done = threading.Event()
+
+        def consume():
+            for _ in s:
+                pass
+            done.set()
+
+        th = threading.Thread(target=consume, daemon=True)
+        th.start()
+        t.close()
+        assert done.wait(2)
+        t.publish(1)  # publish-after-close is a no-op, not an error
+
+    def test_run_subscriber_recovers_handler_errors(self):
+        t: Topic[int] = Topic("t")
+        s = t.subscribe()
+        seen: list[int] = []
+
+        def handler(ev):
+            if ev.payload == 1:
+                raise RuntimeError("boom")
+            seen.append(ev.payload)
+
+        run_subscriber(s, handler)
+        for i in range(3):
+            t.publish(i)
+        deadline = time.time() + 2
+        while len(seen) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert seen == [0, 2]
+        t.close()
+
+
+class TestNormalize:
+    def test_container_die_event(self):
+        ev = _normalize(
+            {
+                "Type": "container",
+                "Action": "die",
+                "Actor": {
+                    "ID": "abc",
+                    "Attributes": {
+                        "name": "clawker.p.dev",
+                        "exitCode": "137",
+                        consts.LABEL_PROJECT: "p",
+                        consts.LABEL_AGENT: "dev",
+                        consts.LABEL_ROLE: "agent",
+                    },
+                },
+            }
+        )
+        assert ev is not None
+        assert (ev.action, ev.exit_code, ev.full_name) == ("die", 137, "p.dev")
+
+    def test_non_container_and_noise_filtered(self):
+        assert _normalize({"Type": "network", "Action": "connect", "Actor": {}}) is None
+        assert _normalize({"Type": "container", "Action": "exec_create: ls", "Actor": {}}) is None
+
+    def test_health_status_prefix(self):
+        ev = _normalize(
+            {"Type": "container", "Action": "health_status: healthy", "Actor": {"ID": "x", "Attributes": {}}}
+        )
+        assert ev is not None and ev.action == "health_status"
+
+
+def _engine_with_running(name: str = "clawker.p.dev") -> tuple[Engine, str]:
+    api = FakeDockerAPI()
+    api.add_image("img")
+    api.set_behavior("img", exit_behavior(b"", 0))
+    eng = Engine(api)
+    spec = ContainerSpec(
+        image="img",
+        labels={consts.LABEL_PROJECT: "p", consts.LABEL_AGENT: "dev", consts.LABEL_ROLE: "agent"},
+    )
+    cid = eng.create_container(name, spec)
+    return eng, cid
+
+
+class TestRepoAndFeeder:
+    def test_repo_reconcile_and_apply(self):
+        repo = ContainerStateRepo()
+        repo.reconcile(
+            [
+                {
+                    "Id": "c1",
+                    "Names": ["/clawker.p.dev"],
+                    "State": "running",
+                    "Labels": {consts.LABEL_PROJECT: "p", consts.LABEL_AGENT: "dev"},
+                }
+            ]
+        )
+        assert [s.name for s in repo.running()] == ["clawker.p.dev"]
+        repo.apply(DockerEvent(action="die", container_id="c1"))
+        assert repo.running() == []
+        repo.apply(DockerEvent(action="destroy", container_id="c1"))
+        assert repo.get("c1") is None
+
+    def test_feeder_streams_engine_events(self):
+        eng, cid = _engine_with_running()
+        topic: Topic[DockerEvent] = Topic("docker")
+        sub = topic.subscribe()
+        feeder = Feeder(eng, topic)
+        feeder.start()
+        try:
+            deadline = time.time() + 2
+            while feeder.repo.get(cid) is None and time.time() < deadline:
+                time.sleep(0.01)
+            assert feeder.repo.get(cid) is not None  # reconciled before events
+            eng.start_container(cid)
+            ev = sub.get(2)
+            assert ev is not None
+            # the fake (like real daemons) orders start strictly before die
+            assert ev.payload.action == "start"
+            assert ev.payload.project == "p"
+        finally:
+            feeder.stop()
+
+    def test_feeder_reconnects_after_stream_loss(self):
+        eng, cid = _engine_with_running()
+        topic: Topic[DockerEvent] = Topic("docker")
+        feeder = Feeder(eng, topic, backoff_s=0.05)
+        feeder.start()
+        try:
+            time.sleep(0.1)
+            eng.api.close_events()  # simulate daemon dropping the stream
+            deadline = time.time() + 3
+            while feeder.reconnects == 0 and time.time() < deadline:
+                time.sleep(0.01)
+            assert feeder.reconnects >= 1
+            # after reconnect events flow again
+            sub = topic.subscribe()
+            time.sleep(0.15)
+            eng.start_container(cid)
+            ev = sub.get(2)
+            assert ev is not None
+        finally:
+            feeder.stop()
