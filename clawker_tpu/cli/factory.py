@@ -46,12 +46,30 @@ class Factory:
     def engine(self) -> Engine:
         return self.driver.engine()
 
+    @functools.cached_property
+    def agent_registry(self):
+        from ..controlplane.registry import Registry
+
+        return Registry(self.config.data_dir / "agents.db")
+
     def runtime(self, engine: Engine | None = None) -> AgentRuntime:
+        eng = engine or self.engine()
+
+        # Deferred so lifecycle/query commands never pay the cryptography
+        # import or open agents.db; only the create path invokes this.
+        def bootstrap(container_id: str, project: str, agent: str) -> None:
+            from ..controlplane.identity import make_bootstrapper
+
+            make_bootstrapper(self.config, eng, self.agent_registry)(
+                container_id, project, agent
+            )
+
         return AgentRuntime(
-            engine or self.engine(),
+            eng,
             self.config,
             pre_start=self._pre_start_hook(),
             post_start=self._post_start_hook(),
+            bootstrap=bootstrap,
         )
 
     # Bootstrap hooks: wired to control-plane/firewall bring-up once those

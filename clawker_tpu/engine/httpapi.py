@@ -124,6 +124,7 @@ class HTTPDockerAPI:
     def __init__(self, factory: SocketFactory, *, api_prefix: str = API_PREFIX):
         self._factory = factory
         self._prefix = api_prefix
+        self._event_conns: set = set()  # live /events connections (close_events)
 
     # ------------------------------------------------------------ plumbing
 
@@ -189,6 +190,7 @@ class HTTPDockerAPI:
         body: Any = None,
         raw_body: bytes | io.BufferedIOBase | None = None,
         headers: dict[str, str] | None = None,
+        track_events: bool = False,
     ) -> Iterator[dict]:
         """Request returning a stream of JSON objects (build/pull/events)."""
         conn = _SockConnection(self._factory)
@@ -212,11 +214,17 @@ class HTTPDockerAPI:
             payload = resp.read()
             conn.close()
             self._check(resp.status, payload, path)
+        if track_events:
+            self._event_conns.add(conn)
+
         def gen() -> Iterator[dict]:
             buf = b""
             try:
                 while True:
-                    chunk = resp.read1(65536)
+                    try:
+                        chunk = resp.read1(65536)
+                    except OSError:
+                        break  # close_events tore the socket down
                     if not chunk:
                         break
                     buf += chunk
@@ -228,6 +236,7 @@ class HTTPDockerAPI:
                 if buf.strip():
                     yield json.loads(buf)
             finally:
+                self._event_conns.discard(conn)
                 conn.close()
 
         return gen()
@@ -549,4 +558,15 @@ class HTTPDockerAPI:
     # -------------------------------------------------------------- events
 
     def events(self, *, filters: dict | None = None) -> Iterator[dict]:
-        return self._stream("GET", "/events", query={"filters": filters or {}})
+        return self._stream(
+            "GET", "/events", query={"filters": filters or {}}, track_events=True
+        )
+
+    def close_events(self) -> None:
+        """Tear down live event streams so blocked readers unblock
+        (the Feeder's stop path; the fake exposes the same hook)."""
+        for conn in list(self._event_conns):
+            try:
+                conn.close()
+            except Exception:
+                pass

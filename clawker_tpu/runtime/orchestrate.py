@@ -51,12 +51,17 @@ class AgentRuntime:
         *,
         pre_start: Callable[[str], None] | None = None,
         post_start: Callable[[str], None] | None = None,
+        bootstrap: Callable[[str, str, str], None] | None = None,
     ):
         self.engine = engine
         self.cfg = cfg
-        # bootstrap hooks wired by the CLI factory once CP/firewall exist
+        # bootstrap hooks wired by the CLI factory once CP/firewall exist.
+        # ``bootstrap(container_id, project, agent)`` runs between create and
+        # start (reference: InstallAgentBootstrapMaterial in
+        # createAndBootstrapContainer, container_create.go:2074).
         self.pre_start = pre_start
         self.post_start = post_start
+        self.bootstrap = bootstrap
 
     # -------------------------------------------------------------- create
 
@@ -129,6 +134,8 @@ class AgentRuntime:
                 f"(container {name}); use --replace or `clawker start`"
             )
         mounts.seed(self.engine, cid)
+        if self.bootstrap:
+            self.bootstrap(cid, project, opts.agent)
         return cid
 
     def _build_env(self, project: str, opts: CreateOptions) -> dict[str, str]:

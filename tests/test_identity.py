@@ -1,0 +1,173 @@
+"""Agent identity: bootstrap material, assertion JWTs, sqlite registry."""
+
+from __future__ import annotations
+
+import tarfile
+import io
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.controlplane import identity
+from clawker_tpu.controlplane.registry import Registry
+from clawker_tpu.firewall import pki
+
+
+@pytest.fixture(scope="module")
+def ca():
+    return pki.generate_ca()
+
+
+class TestJWT:
+    def test_sign_verify_roundtrip(self, ca):
+        tok = identity.sign_jwt_es256(ca.key, {"sub": "p.dev", "iat": 1, "exp": 2**31})
+        claims = identity.verify_jwt_es256(ca.cert.public_key(), tok)
+        assert claims["sub"] == "p.dev"
+
+    def test_tampered_payload_rejected(self, ca):
+        tok = identity.sign_jwt_es256(ca.key, {"sub": "p.dev"})
+        h, p, s = tok.split(".")
+        forged_payload = identity._b64url(b'{"sub":"p.admin"}')
+        with pytest.raises(identity.IdentityError):
+            identity.verify_jwt_es256(ca.cert.public_key(), f"{h}.{forged_payload}.{s}")
+
+    def test_wrong_key_rejected(self, ca):
+        other = pki.generate_ca()
+        tok = identity.sign_jwt_es256(other.key, {"sub": "p.dev"})
+        with pytest.raises(identity.IdentityError):
+            identity.verify_jwt_es256(ca.cert.public_key(), tok)
+
+    def test_expired_rejected(self, ca):
+        tok = identity.sign_jwt_es256(ca.key, {"sub": "p.dev", "exp": 100})
+        with pytest.raises(identity.IdentityError, match="expired"):
+            identity.verify_jwt_es256(ca.cert.public_key(), tok, now=200)
+
+
+class TestBootstrapMaterial:
+    def test_mint_contents(self, ca):
+        m = identity.mint_bootstrap_material(ca, "proj", "dev", container_id="c1")
+        files = m.files()
+        assert set(files) == set(consts.BOOTSTRAP_FILES)
+        claims = identity.verify_jwt_es256(ca.cert.public_key(), m.assertion_jwt)
+        assert claims["sub"] == "proj.dev"
+        assert claims["container_id"] == "c1"
+        assert claims["scope"] == "self.register"
+        # leaf chains to the CA
+        from cryptography import x509
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.hazmat.primitives import hashes
+
+        leaf = x509.load_pem_x509_certificate(m.agent_cert)
+        ca.cert.public_key().verify(
+            leaf.signature, leaf.tbs_certificate_bytes, ec.ECDSA(hashes.SHA256())
+        )
+        assert leaf.subject.rfc4514_string() == "CN=proj.dev"
+
+    def test_tar_layout_and_modes(self, ca):
+        m = identity.mint_bootstrap_material(ca, "p", "a")
+        with tarfile.open(fileobj=io.BytesIO(m.tar_bytes())) as tf:
+            members = {i.name: i for i in tf.getmembers()}
+        assert set(members) == set(consts.BOOTSTRAP_FILES)
+        assert members["agent.key"].mode == 0o600
+        assert members["assertion.jwt"].mode == 0o600
+        assert members["ca.crt"].mode == 0o644
+
+    def test_tar_prefix_carries_dir_entry(self, ca):
+        """Real daemons 404 if the extraction path is missing; the prefixed
+        form extracts at the parent with a leading bootstrap/ dir entry."""
+        m = identity.mint_bootstrap_material(ca, "p", "a")
+        with tarfile.open(fileobj=io.BytesIO(m.tar_bytes(prefix="bootstrap"))) as tf:
+            members = {i.name: i for i in tf.getmembers()}
+        assert members["bootstrap"].isdir()
+        assert set(members) == {"bootstrap"} | {
+            f"bootstrap/{n}" for n in consts.BOOTSTRAP_FILES
+        }
+
+    def test_session_keys_unique(self, ca):
+        a = identity.mint_bootstrap_material(ca, "p", "a")
+        b = identity.mint_bootstrap_material(ca, "p", "a")
+        assert a.session_key != b.session_key
+
+
+class TestRegistry:
+    def test_bind_and_get(self, tmp_path):
+        r = Registry(tmp_path / "agents.db")
+        r.bind("p.dev", "p", "dev", container_id="c1", cert_sha256="f1")
+        rec = r.get("p.dev")
+        assert rec is not None and rec.container_id == "c1" and rec.state == "created"
+        assert not rec.initialized
+        r.close()
+
+    def test_register_requires_matching_thumbprint(self, tmp_path):
+        r = Registry(tmp_path / "agents.db")
+        r.bind("p.dev", "p", "dev", container_id="c1", cert_sha256="f1")
+        assert not r.mark_registered("p.dev", "WRONG")
+        assert r.mark_registered("p.dev", "f1")
+        assert r.get("p.dev").state == "registered"
+        r.close()
+
+    def test_rebind_new_container_resets_init(self, tmp_path):
+        r = Registry(tmp_path / "agents.db")
+        r.bind("p.dev", "p", "dev", container_id="c1", cert_sha256="f1")
+        r.mark_initialized("p.dev")
+        assert r.get("p.dev").initialized
+        # same container rebind keeps the marker
+        r.bind("p.dev", "p", "dev", container_id="c1", cert_sha256="f2")
+        assert r.get("p.dev").initialized
+        # replacement container resets it (fresh rootfs needs a fresh init)
+        r.bind("p.dev", "p", "dev", container_id="c2", cert_sha256="f3")
+        assert not r.get("p.dev").initialized
+        r.close()
+
+    def test_persistence_across_reopen(self, tmp_path):
+        path = tmp_path / "agents.db"
+        r = Registry(path)
+        r.bind("p.dev", "p", "dev", container_id="c1", cert_sha256="f1")
+        r.close()
+        r2 = Registry(path)
+        assert r2.get("p.dev").container_id == "c1"
+        assert [a.full_name for a in r2.list("p")] == ["p.dev"]
+        assert r2.by_container("c1").full_name == "p.dev"
+        r2.close()
+
+    def test_remove(self, tmp_path):
+        r = Registry(tmp_path / "agents.db")
+        r.bind("p.dev", "p", "dev", container_id="c1", cert_sha256="f1")
+        r.remove("p.dev")
+        assert r.get("p.dev") is None
+        r.close()
+
+
+class TestCreatePathIntegration:
+    def test_run_installs_bootstrap_material(self):
+        """The CLI create path delivers the 5 bootstrap files into the
+        container and binds a registry row before start."""
+        from click.testing import CliRunner
+
+        from clawker_tpu.cli.factory import Factory
+        from clawker_tpu.cli.root import cli
+        from clawker_tpu.engine.drivers import FakeDriver
+        from clawker_tpu.engine.fake import exit_behavior
+        from clawker_tpu.testenv import TestEnv
+
+        with TestEnv() as tenv:
+            proj = tenv.base / "proj"
+            tenv.make_project(proj, "project: demo\n")
+            driver = FakeDriver()
+            driver.api.add_image("clawker-demo:default")
+            driver.api.set_behavior("clawker-demo:default", exit_behavior(b"", 0))
+            factory = Factory(cwd=proj, driver=driver)
+            res = CliRunner().invoke(cli, ["run"], obj=factory)
+            assert res.exit_code == 0, res.output
+            # material landed in the container fs, extracted at the parent
+            # dir (which the image pre-creates) with a bootstrap/ prefix
+            c = next(iter(driver.api.containers.values()))
+            parent = consts.BOOTSTRAP_DIR.rpartition("/")[0]
+            assert parent in c.archives
+            with tarfile.open(fileobj=io.BytesIO(c.archives[parent])) as tf:
+                names = set(i.name for i in tf.getmembers())
+            assert names == {"bootstrap"} | {f"bootstrap/{n}" for n in consts.BOOTSTRAP_FILES}
+            # registry row bound to this container
+            rec = factory.agent_registry.get("demo.dev")
+            assert rec is not None and rec.container_id == c.id
+            assert rec.cert_sha256
