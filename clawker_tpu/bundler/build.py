@@ -7,7 +7,6 @@ bundler.GenerateBase/GenerateHarness -> client.BuildImage -> tag
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Iterator
@@ -18,21 +17,8 @@ from ..config import Config
 from ..engine.api import Engine
 from ..errors import ClawkerError
 from .context import build_context
-from .dockerfile import CTX_AGENTD, CTX_CA_CERT, generate_base, generate_harness
-
-ENV_AGENTD_BIN = "CLAWKER_TPU_AGENTD_BIN"
-
-
-def find_agentd_binary() -> bytes | None:
-    """The native agentd binary to embed (reference: clawkerd embedded via
-    clawkerd/embed; here the C++ build output or an env-pointed path)."""
-    cand = os.environ.get(ENV_AGENTD_BIN, "")
-    paths = [Path(cand)] if cand else []
-    paths.append(Path(__file__).resolve().parents[2] / "native" / "build" / "clawkerd")
-    for p in paths:
-        if p.is_file():
-            return p.read_bytes()
-    return None
+from .dockerfile import CTX_CA_CERT, generate_base, generate_harness
+from .payload import agentd_payload
 
 
 @dataclass
@@ -85,7 +71,7 @@ class ProjectBuilder:
         # ---- stage 2: harness
         harness_ref = f"{consts.IMAGE_NAME_PREFIX}{project}:{harness.name}"
         self.progress(f"building {harness_ref} (harness {harness.name})")
-        agentd = find_agentd_binary()
+        agentd = agentd_payload()
         files: dict[str, bytes] = {}
         extra: list[str] = []
         if harness.source_dir is not None:
@@ -105,7 +91,7 @@ class ProjectBuilder:
         if with_ca:
             files[CTX_CA_CERT] = self.ca_cert_pem  # type: ignore[assignment]
         if agentd is not None:
-            files[CTX_AGENTD] = agentd
+            files.update(agentd)
         harness_df = generate_harness(
             project,
             harness,

@@ -14,6 +14,8 @@ import tarfile
 
 def build_context(files: dict[str, bytes]) -> bytes:
     """files: context-relative path -> content. Must include 'Dockerfile'."""
+    from .dockerfile import CTX_SUPERVISOR
+
     buf = io.BytesIO()
     with tarfile.open(fileobj=buf, mode="w") as tf:
         for name in sorted(files):
@@ -21,6 +23,6 @@ def build_context(files: dict[str, bytes]) -> bytes:
             info = tarfile.TarInfo(name=name)
             info.size = len(data)
             info.mtime = 0
-            info.mode = 0o755 if name == "clawkerd" else 0o644
+            info.mode = 0o755 if name == CTX_SUPERVISOR else 0o644
             tf.addfile(info, io.BytesIO(data))
     return buf.getvalue()

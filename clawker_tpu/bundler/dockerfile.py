@@ -2,7 +2,8 @@
 
 Stage 1 (``clawker-<project>:base``): stack base image + OS packages +
 agent user + workspace.  Stage 2 (``clawker-<project>:<harness>``): harness
-install + env + firewall CA + agentd as PID 1.  Generation is deterministic
+install + env + firewall CA + the native supervisor as PID 1 with the
+agentd zipapp as its service child.  Generation is deterministic
 (sorted packages, stable ordering) so unchanged config hits the daemon's
 layer cache end to end.  Reference: internal/bundler/dockerfile.go
 GenerateBase :367 / GenerateHarness :407; cache-tail invariant pinned by
@@ -21,8 +22,13 @@ AGENT_USER = "agent"
 AGENT_UID = 1001
 
 # context-relative paths (fixed; the tar assembler must provide them)
-CTX_AGENTD = "clawkerd"
+CTX_SUPERVISOR = "clawker-supervisord"
+CTX_AGENTD_PYZ = "clawker-agentd.pyz"
 CTX_CA_CERT = "clawker-ca.crt"
+
+# The agentd session daemon is a stdlib-only zipapp; python3 in the base
+# stage is the one hard package requirement of every agent image.
+BASE_REQUIRED_PACKAGES = ("python3", "ca-certificates")
 
 
 def _env_lines(env: dict[str, str]) -> list[str]:
@@ -38,7 +44,14 @@ def _quote(v: str) -> str:
 def generate_base(project: str, stack: Stack, build: BuildConfig) -> str:
     """Base-stage Dockerfile: stack image, packages, non-root agent user."""
     base_image = build.image or stack.base_image
-    packages = sorted(set(stack.packages) | set(build.packages))
+    packages = set(stack.packages) | set(build.packages)
+    # Stack bases are Debian-family, so the agentd runtime deps ride the
+    # same apt layer.  A custom build.image may not have apt at all: the
+    # user's image contract then includes python3 (documented in
+    # docs/image-requirements) and we emit no unconditional apt RUN.
+    if not build.image:
+        packages |= set(BASE_REQUIRED_PACKAGES)
+    packages = sorted(packages)
     lines = [
         f"# clawker-tpu base image for project {project!r} (stack {stack.name})",
         f"FROM {base_image}",
@@ -112,10 +125,24 @@ def generate_harness(
             f"ENV SSL_CERT_FILE={consts.CA_CERT_PATH}",
         ]
     if with_agentd:
+        # ENTRYPOINT = native supervisor (PID 1) with the agentd zipapp as
+        # its service child; Docker appends CMD to the entrypoint argv, so
+        # the user command lands after --default-cmd and agentd stores it
+        # to spawn on AgentReady (reference: clawkerd runs the image CMD
+        # only when the CP sends AgentReady, SURVEY.md 3.1).
+        entry = [
+            consts.SUPERVISOR_PATH,
+            "--socket", consts.SUPERVISOR_SOCKET,
+            "--child",
+            "python3", consts.AGENTD_PYZ_PATH,
+            "--supervisor-socket", consts.SUPERVISOR_SOCKET,
+            "--default-cmd",
+        ]
         lines += [
-            f"COPY {CTX_AGENTD} {consts.AGENTD_PATH}",
-            f"RUN chmod 0755 {consts.AGENTD_PATH}",
-            f'ENTRYPOINT ["{consts.AGENTD_PATH}"]',
+            f"COPY {CTX_SUPERVISOR} {consts.SUPERVISOR_PATH}",
+            f"COPY {CTX_AGENTD_PYZ} {consts.AGENTD_PYZ_PATH}",
+            f"RUN chmod 0755 {consts.SUPERVISOR_PATH}",
+            "ENTRYPOINT " + json.dumps(entry),
         ]
     cmd = build.env.get("CLAWKER_CMD_OVERRIDE", "")  # env override escape hatch
     harness_cmd = [cmd] if cmd else harness.cmd

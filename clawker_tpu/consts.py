@@ -83,7 +83,9 @@ DNS_PORT = 53
 BOOTSTRAP_DIR = "/run/clawker/bootstrap"   # cert/key/ca/assertion delivered pre-start
 READY_FILE = "/var/run/clawker/ready"      # agentd healthcheck marker
 INIT_MARKER = "/var/lib/clawker/initialized"
-AGENTD_PATH = "/usr/local/bin/clawkerd"
+SUPERVISOR_PATH = "/usr/local/bin/clawker-supervisord"  # native PID 1
+SUPERVISOR_SOCKET = "/run/clawker/supervisor.sock"
+AGENTD_PYZ_PATH = "/usr/local/lib/clawker-agentd.pyz"   # session daemon zipapp
 WORKSPACE_DIR = "/workspace"
 CA_CERT_PATH = "/usr/local/share/ca-certificates/clawker-firewall-ca.crt"
 

@@ -1,0 +1,406 @@
+// clawker-supervisord: native PID-1 supervisor for agent containers.
+//
+// Parity reference: clawkerd/ PID-1 contract (SURVEY.md 2.9) -- single-shot
+// CAS spawn of the user CMD with kernel privilege drop, signal forwarding
+// with exclusions (SIGCHLD/SIGURG stay home), two-phase zombie reaping,
+// SIGKILL watchdog on shutdown, bash-convention exit codes (128+signum).
+// The reference folds supervision into its Go daemon; this build splits the
+// PID-1 core into a dependency-free C++ binary so it works in any image,
+// with the TLS session daemon (clawker_tpu/agentd) riding next to it and
+// driving it over a Unix control socket.
+//
+// Control protocol: netstring frames `<len>:<payload>,` where payload is
+// NUL-separated fields, field 0 = verb:
+//   SPAWN \0 uid \0 gid \0 cwd \0 k=v... \0 -- \0 argv...   -> OK\0pid | ERR\0msg
+//   SIGNAL \0 signum                                        -> OK | ERR\0msg
+//   STATUS                              -> IDLE | RUNNING\0pid | EXITED\0code
+//   WAIT                 (blocks until user CMD exit)       -> EXIT\0code
+//   SHUTDOWN \0 grace_ms                                    -> OK (then exit)
+//
+// Run modes: as PID 1 in a container (normal), or as an ordinary process
+// for tests -- reaping then covers only our own descendants.
+
+#include <cerrno>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <grp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+// ---------------------------------------------------------------- globals
+
+volatile sig_atomic_t g_sigchld = 0;
+volatile sig_atomic_t g_termsig = 0;  // TERM/INT/QUIT received as PID 1
+int g_sigpipe[2] = {-1, -1};  // self-pipe: signal handler -> poll loop
+
+struct UserCmd {
+  pid_t pid = -1;       // -1 = never spawned; 0 = exited
+  int exit_code = -1;   // bash convention once exited
+  bool running() const { return pid > 0; }
+  bool exited() const { return pid == 0; }
+};
+
+struct Client {
+  int fd;
+  std::string inbuf;
+  bool waiting = false;  // parked on WAIT until user CMD exits
+};
+
+UserCmd g_cmd;
+pid_t g_service_pid = -1;  // the session daemon child (agentd), if any
+int g_service_exit = 0;
+bool g_shutdown = false;
+long g_grace_ms = 5000;
+struct timespec g_deadline = {0, 0};  // SIGKILL watchdog deadline
+
+void on_signal(int sig) {
+  int saved = errno;
+  if (sig == SIGCHLD) {
+    g_sigchld = 1;
+    (void)!write(g_sigpipe[1], "c", 1);
+  } else {
+    // PID-1 forwarding: relay to the user CMD's process group. SIGURG is
+    // excluded by never installing this handler for it (Go runtimes use
+    // SIGURG for preemption; forwarding it breaks agents).
+    if (g_cmd.running()) kill(-g_cmd.pid, sig);
+    // termination signals also begin supervisor shutdown (docker stop
+    // sends TERM to PID 1 and expects the container to exit)
+    if (sig == SIGTERM || sig == SIGINT || sig == SIGQUIT) g_termsig = sig;
+    (void)!write(g_sigpipe[1], "s", 1);
+  }
+  errno = saved;
+}
+
+int bash_code(int status) {
+  if (WIFEXITED(status)) return WEXITSTATUS(status);
+  if (WIFSIGNALED(status)) return 128 + WTERMSIG(status);
+  return 1;
+}
+
+// Two-phase reap: phase 1 drains every zombie non-blocking (PID 1 inherits
+// orphans); phase 2 records exit status for the pids we own.  The reference
+// splits these phases to avoid racing concurrent waiters (SURVEY.md 7,
+// "hard parts" #3); here one loop owns all wait4 calls so the race cannot
+// exist by construction.
+void reap() {
+  for (;;) {
+    int status = 0;
+    pid_t p = waitpid(-1, &status, WNOHANG);
+    if (p <= 0) break;
+    if (p == g_cmd.pid) {
+      g_cmd.exit_code = bash_code(status);
+      g_cmd.pid = 0;
+    } else if (p == g_service_pid) {
+      g_service_exit = bash_code(status);
+      g_service_pid = 0;
+    }
+    // orphans reaped silently: that IS the PID-1 job
+  }
+}
+
+// ------------------------------------------------------------- netstrings
+
+bool frame_complete(const std::string& buf, std::string* payload, size_t* consumed) {
+  size_t colon = buf.find(':');
+  if (colon == std::string::npos) return buf.size() < 12;  // still plausible
+  size_t len = 0;
+  for (size_t i = 0; i < colon; i++) {
+    if (buf[i] < '0' || buf[i] > '9') return false;  // malformed -> drop client
+    len = len * 10 + (buf[i] - '0');
+    if (len > 1 << 20) return false;
+  }
+  if (buf.size() < colon + 1 + len + 1) {
+    *consumed = 0;
+    payload->clear();
+    return true;  // incomplete but well-formed so far
+  }
+  if (buf[colon + 1 + len] != ',') return false;
+  *payload = buf.substr(colon + 1, len);
+  *consumed = colon + 1 + len + 1;
+  return true;
+}
+
+std::vector<std::string> split_fields(const std::string& payload) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  for (;;) {
+    size_t nul = payload.find('\0', start);
+    if (nul == std::string::npos) {
+      out.push_back(payload.substr(start));
+      return out;
+    }
+    out.push_back(payload.substr(start, nul - start));
+    start = nul + 1;
+  }
+}
+
+void send_frame(int fd, const std::vector<std::string>& fields) {
+  std::string payload;
+  for (size_t i = 0; i < fields.size(); i++) {
+    if (i) payload.push_back('\0');
+    payload += fields[i];
+  }
+  char head[32];
+  int n = snprintf(head, sizeof head, "%zu:", payload.size());
+  std::string wire(head, n);
+  wire += payload;
+  wire.push_back(',');
+  (void)!write(fd, wire.data(), wire.size());
+}
+
+// ------------------------------------------------------------------ spawn
+
+std::string spawn_cmd(const std::vector<std::string>& f, pid_t* out_pid) {
+  if (g_cmd.running()) return "already running";       // single-shot CAS
+  if (f.size() < 5) return "SPAWN needs uid,gid,cwd,env...,--,argv...";
+  long uid = atol(f[1].c_str());
+  long gid = atol(f[2].c_str());
+  const std::string& cwd = f[3];
+  std::vector<std::string> envs, argv;
+  bool after_sep = false;
+  for (size_t i = 4; i < f.size(); i++) {
+    if (!after_sep && f[i] == "--") { after_sep = true; continue; }
+    (after_sep ? argv : envs).push_back(f[i]);
+  }
+  if (argv.empty()) return "empty argv";
+
+  pid_t pid = fork();
+  if (pid < 0) return std::string("fork: ") + strerror(errno);
+  if (pid == 0) {
+    // child: own session+pgroup so signals hit the whole job
+    setsid();
+    if (!cwd.empty() && chdir(cwd.c_str()) != 0) _exit(127);
+    if (gid > 0) {
+      if (setgroups(0, nullptr) != 0 && errno != EPERM) _exit(126);
+      if (setgid((gid_t)gid) != 0) _exit(126);
+    }
+    if (uid > 0 && setuid((uid_t)uid) != 0) _exit(126);  // kernel drop, no return
+    std::vector<char*> envp, args;
+    for (auto& e : envs) envp.push_back(const_cast<char*>(e.c_str()));
+    envp.push_back(nullptr);
+    for (auto& a : argv) args.push_back(const_cast<char*>(a.c_str()));
+    args.push_back(nullptr);
+    // reset dispositions the parent customized
+    signal(SIGCHLD, SIG_DFL);
+    sigset_t empty; sigemptyset(&empty); sigprocmask(SIG_SETMASK, &empty, nullptr);
+    execve(args[0], args.data(), envp.data());
+    _exit(127);
+  }
+  g_cmd.pid = pid;
+  g_cmd.exit_code = -1;
+  *out_pid = pid;
+  return "";
+}
+
+void arm_watchdog(long grace_ms) {
+  clock_gettime(CLOCK_MONOTONIC, &g_deadline);
+  g_deadline.tv_sec += grace_ms / 1000;
+  g_deadline.tv_nsec += (grace_ms % 1000) * 1000000L;
+  if (g_deadline.tv_nsec >= 1000000000L) { g_deadline.tv_sec++; g_deadline.tv_nsec -= 1000000000L; }
+}
+
+long watchdog_remaining_ms() {
+  if (g_deadline.tv_sec == 0) return -1;
+  struct timespec now;
+  clock_gettime(CLOCK_MONOTONIC, &now);
+  long ms = (g_deadline.tv_sec - now.tv_sec) * 1000 + (g_deadline.tv_nsec - now.tv_nsec) / 1000000L;
+  return ms < 0 ? 0 : ms;
+}
+
+// ---------------------------------------------------------------- request
+
+void notify_waiters(std::vector<Client>& clients) {
+  for (auto& c : clients) {
+    if (c.waiting && g_cmd.exited()) {
+      send_frame(c.fd, {"EXIT", std::to_string(g_cmd.exit_code)});
+      c.waiting = false;
+    }
+  }
+}
+
+bool handle_request(Client& c, const std::vector<std::string>& f) {
+  if (f.empty()) return true;
+  const std::string& verb = f[0];
+  if (verb == "SPAWN") {
+    pid_t pid = -1;
+    std::string err = spawn_cmd(f, &pid);
+    if (err.empty()) send_frame(c.fd, {"OK", std::to_string(pid)});
+    else send_frame(c.fd, {"ERR", err});
+  } else if (verb == "SIGNAL") {
+    if (f.size() < 2 || !g_cmd.running()) {
+      send_frame(c.fd, {"ERR", "no running command"});
+    } else {
+      int sig = atoi(f[1].c_str());
+      if (kill(-g_cmd.pid, sig) == 0) send_frame(c.fd, {"OK"});
+      else send_frame(c.fd, {"ERR", strerror(errno)});
+    }
+  } else if (verb == "STATUS") {
+    if (g_cmd.running()) send_frame(c.fd, {"RUNNING", std::to_string(g_cmd.pid)});
+    else if (g_cmd.exited()) send_frame(c.fd, {"EXITED", std::to_string(g_cmd.exit_code)});
+    else send_frame(c.fd, {"IDLE"});
+  } else if (verb == "WAIT") {
+    if (g_cmd.exited()) send_frame(c.fd, {"EXIT", std::to_string(g_cmd.exit_code)});
+    else if (!g_cmd.running()) send_frame(c.fd, {"ERR", "nothing spawned"});
+    else c.waiting = true;
+  } else if (verb == "SHUTDOWN") {
+    g_shutdown = true;
+    g_grace_ms = f.size() > 1 ? atol(f[1].c_str()) : 5000;
+    send_frame(c.fd, {"OK"});
+    if (g_cmd.running()) kill(-g_cmd.pid, SIGTERM);
+    if (g_service_pid > 0) kill(g_service_pid, SIGTERM);
+    if (g_cmd.running() || g_service_pid > 0) arm_watchdog(g_grace_ms);
+  } else {
+    send_frame(c.fd, {"ERR", "unknown verb"});
+  }
+  return true;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* sock_path = "/run/clawker/supervisor.sock";
+  const char* ready_file = nullptr;
+  std::vector<char*> service_argv;
+  for (int i = 1; i < argc; i++) {
+    if (!strcmp(argv[i], "--socket") && i + 1 < argc) sock_path = argv[++i];
+    else if (!strcmp(argv[i], "--ready-file") && i + 1 < argc) ready_file = argv[++i];
+    else if (!strcmp(argv[i], "--child")) {
+      for (int j = i + 1; j < argc; j++) service_argv.push_back(argv[j]);
+      break;
+    }
+  }
+
+  if (pipe2(g_sigpipe, O_CLOEXEC | O_NONBLOCK) != 0) { perror("pipe2"); return 1; }
+
+  struct sigaction sa = {};
+  sa.sa_handler = on_signal;
+  sigemptyset(&sa.sa_mask);
+  sa.sa_flags = SA_RESTART;
+  sigaction(SIGCHLD, &sa, nullptr);
+  // forwarded set: the job-control signals an operator sends PID 1.
+  for (int sig : {SIGTERM, SIGINT, SIGHUP, SIGQUIT, SIGUSR1, SIGUSR2, SIGWINCH})
+    sigaction(sig, &sa, nullptr);
+  // SIGURG deliberately untouched (default ignore): Go preemption noise.
+
+  unlink(sock_path);
+  int lfd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (lfd < 0) { perror("socket"); return 1; }
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, sock_path, sizeof(addr.sun_path) - 1);
+  if (bind(lfd, (struct sockaddr*)&addr, sizeof addr) != 0) { perror("bind"); return 1; }
+  chmod(sock_path, 0600);
+  if (listen(lfd, 8) != 0) { perror("listen"); return 1; }
+
+  if (!service_argv.empty()) {
+    pid_t pid = fork();
+    if (pid == 0) {
+      signal(SIGCHLD, SIG_DFL);
+      service_argv.push_back(nullptr);
+      execvp(service_argv[0], service_argv.data());
+      _exit(127);
+    }
+    g_service_pid = pid;
+  }
+
+  if (ready_file) {
+    int rfd = open(ready_file, O_CREAT | O_WRONLY | O_TRUNC, 0644);
+    if (rfd >= 0) { (void)!write(rfd, "ok\n", 3); close(rfd); }
+  }
+
+  std::vector<Client> clients;
+  for (;;) {
+    std::vector<struct pollfd> pfds;
+    pfds.push_back({g_sigpipe[0], POLLIN, 0});
+    pfds.push_back({lfd, POLLIN, 0});
+    for (auto& c : clients) pfds.push_back({c.fd, POLLIN, 0});
+
+    long timeout = -1;
+    long wd = watchdog_remaining_ms();
+    if (wd >= 0) timeout = wd;
+    int rc = poll(pfds.data(), pfds.size(), (int)timeout);
+    if (rc < 0 && errno != EINTR) { perror("poll"); return 1; }
+
+    if (g_sigchld) {
+      g_sigchld = 0;
+      char drain[64];
+      while (read(g_sigpipe[0], drain, sizeof drain) > 0) {}
+      reap();
+      notify_waiters(clients);
+    }
+
+    if (g_termsig && !g_shutdown) {
+      // same path as the SHUTDOWN verb: the handler already forwarded the
+      // signal to the user CMD pgroup; arm the KILL watchdog and tell the
+      // service child to wind down
+      g_shutdown = true;
+      if (g_cmd.running() || g_service_pid > 0) arm_watchdog(g_grace_ms);
+      if (g_service_pid > 0) kill(g_service_pid, SIGTERM);
+    }
+
+    // watchdog: grace expired with processes still alive -> SIGKILL
+    if (g_deadline.tv_sec != 0 && watchdog_remaining_ms() == 0) {
+      if (g_cmd.running()) kill(-g_cmd.pid, SIGKILL);
+      if (g_shutdown && g_service_pid > 0) kill(g_service_pid, SIGKILL);
+      g_deadline = {0, 0};
+    }
+
+    if (pfds[1].revents & POLLIN) {
+      int cfd = accept4(lfd, nullptr, nullptr, SOCK_CLOEXEC);
+      if (cfd >= 0) clients.push_back(Client{cfd, {}, false});
+    }
+
+    for (size_t i = 0; i < clients.size();) {
+      Client& c = clients[i];
+      // pfds index: 2 + i only valid if client existed before poll; find by fd
+      bool readable = false, dead = false;
+      for (auto& p : pfds)
+        if (p.fd == c.fd) { readable = p.revents & POLLIN; dead = p.revents & (POLLHUP | POLLERR); }
+      if (readable) {
+        char buf[4096];
+        ssize_t n = read(c.fd, buf, sizeof buf);
+        if (n <= 0) dead = true;
+        else {
+          c.inbuf.append(buf, n);
+          for (;;) {
+            std::string payload;
+            size_t consumed = 0;
+            if (!frame_complete(c.inbuf, &payload, &consumed)) { dead = true; break; }
+            if (consumed == 0) break;  // partial frame
+            c.inbuf.erase(0, consumed);
+            handle_request(c, split_fields(payload));
+          }
+        }
+      }
+      if (dead) {
+        close(c.fd);
+        clients.erase(clients.begin() + i);
+      } else {
+        i++;
+      }
+    }
+
+    if (g_shutdown && !g_cmd.running() && g_service_pid <= 0) break;
+    // service daemon gone and nothing running: container is done
+    if (!g_shutdown && !service_argv.empty() && g_service_pid == 0 && !g_cmd.running()) break;
+  }
+
+  unlink(sock_path);
+  if (g_cmd.exited()) return g_cmd.exit_code;
+  if (!service_argv.empty()) return g_service_exit;
+  return 0;
+}

@@ -1,0 +1,169 @@
+"""agentd <-> native supervisor integration + register flow.
+
+The daemon drives the real C++ clawker-supervisord over its Unix socket for
+AgentReady (the in-container composition), and RegisterRequired is tested
+against a stub CP AgentService that verifies the assertion JWT with the CA
+public key -- the contract the real CP server implements.
+"""
+
+from __future__ import annotations
+
+import socket
+import ssl
+import subprocess
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu.agentd.daemon import Agentd, AgentdConfig
+from clawker_tpu.agentd.protocol import read_msg, write_msg
+from clawker_tpu.controlplane import identity
+from clawker_tpu.controlplane.session_client import dial_with_retry
+from clawker_tpu.firewall import pki
+
+REPO = Path(__file__).resolve().parent.parent
+BIN = REPO / "native" / "build" / "clawker-supervisord"
+
+
+@pytest.fixture(scope="module")
+def ca():
+    return pki.generate_ca()
+
+
+@pytest.fixture(scope="module")
+def cp_certs(ca, tmp_path_factory):
+    d = tmp_path_factory.mktemp("cp-certs")
+    pair = pki.generate_cp_cert(ca)
+    (d / "cp.crt").write_bytes(pair.cert_pem)
+    (d / "cp.key").write_bytes(pair.key_pem)
+    (d / "ca.crt").write_bytes(ca.cert_pem)
+    return d
+
+
+def _mint(ca, tmp_path: Path) -> Path:
+    bdir = tmp_path / "bootstrap"
+    bdir.mkdir()
+    m = identity.mint_bootstrap_material(ca, "proj", "dev", container_id="c9")
+    for name, data in m.files().items():
+        (bdir / name).write_bytes(data)
+    return bdir
+
+
+def test_agent_ready_via_native_supervisor(ca, cp_certs, tmp_path):
+    subprocess.run(["make", "-C", str(REPO / "native")], check=True, capture_output=True)
+    sock_path = tmp_path / "sup.sock"
+    ready = tmp_path / "sup-ready"
+    sup = subprocess.Popen(
+        [str(BIN), "--socket", str(sock_path), "--ready-file", str(ready)],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.time() + 5
+        while not ready.exists() and time.time() < deadline:
+            time.sleep(0.02)
+        bdir = _mint(ca, tmp_path)
+        cfg = AgentdConfig(
+            bootstrap_dir=bdir,
+            port=0,
+            host="127.0.0.1",
+            supervisor_socket=str(sock_path),
+            ready_file=tmp_path / "ready",
+            init_marker=tmp_path / "init",
+        )
+        d = Agentd(cfg)
+        threading.Thread(target=d.serve_forever, daemon=True).start()
+        while d.bound_port == 0 and time.time() < deadline:
+            time.sleep(0.01)
+
+        marker = tmp_path / "cmd-ran"
+        s = dial_with_retry(
+            "127.0.0.1",
+            d.bound_port,
+            cert_file=cp_certs / "cp.crt",
+            key_file=cp_certs / "cp.key",
+            ca_file=cp_certs / "ca.crt",
+            deadline_s=5,
+        )
+        with s:
+            pid = s.agent_ready(
+                ["/bin/sh", "-c", f"touch {marker}; exit 11"], cwd=str(tmp_path)
+            )
+            assert pid > 0
+        # the supervisor (not agentd) reaps and records the exit
+        from clawker_tpu.agentd import SupervisorClient
+
+        with SupervisorClient(sock_path) as c:
+            assert c.wait(timeout=5) == 11
+        assert marker.exists()
+        d.stop()
+    finally:
+        sup.kill()
+        sup.wait(5)
+
+
+class _StubCP(threading.Thread):
+    """Minimal AgentService: mTLS listener that verifies the assertion."""
+
+    def __init__(self, ca, certs_dir: Path):
+        super().__init__(daemon=True)
+        self.ca = ca
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_3
+        ctx.load_cert_chain(certs_dir / "cp.crt", certs_dir / "cp.key")
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        ctx.load_verify_locations(certs_dir / "ca.crt")
+        self._ctx = ctx
+        self._ls = socket.socket()
+        self._ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._ls.bind(("127.0.0.1", 0))
+        self._ls.listen(1)
+        self.port = self._ls.getsockname()[1]
+        self.seen: list[dict] = []
+
+    def run(self):
+        raw, _ = self._ls.accept()
+        tls = self._ctx.wrap_socket(raw, server_side=True)
+        msg = read_msg(tls)
+        self.seen.append(msg)
+        try:
+            claims = identity.verify_jwt_es256(
+                self.ca.cert.public_key(), msg.get("assertion", "")
+            )
+            ok = claims.get("scope") == "self.register"
+            write_msg(tls, {"type": "register_ack", "ok": ok, "sub": claims.get("sub")})
+        except identity.IdentityError as e:
+            write_msg(tls, {"type": "register_ack", "ok": False, "error": str(e)})
+        tls.close()
+
+
+def test_register_flow_end_to_end(ca, cp_certs, tmp_path):
+    stub = _StubCP(ca, cp_certs)
+    stub.start()
+    bdir = _mint(ca, tmp_path)
+    cfg = AgentdConfig(
+        bootstrap_dir=bdir,
+        port=0,
+        host="127.0.0.1",
+        ready_file=tmp_path / "ready",
+        init_marker=tmp_path / "init",
+    )
+    d = Agentd(cfg)
+    threading.Thread(target=d.serve_forever, daemon=True).start()
+    deadline = time.time() + 5
+    while d.bound_port == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    s = dial_with_retry(
+        "127.0.0.1",
+        d.bound_port,
+        cert_file=cp_certs / "cp.crt",
+        key_file=cp_certs / "cp.key",
+        ca_file=cp_certs / "ca.crt",
+        deadline_s=5,
+    )
+    with s:
+        s.register_required("127.0.0.1", stub.port)
+    assert stub.seen and "assertion" in stub.seen[0]
+    d.stop()

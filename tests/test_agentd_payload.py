@@ -1,0 +1,131 @@
+"""The shipped in-container payload, driven exactly as an image would:
+native supervisor as the top process, agentd zipapp as its --child with the
+image CMD after --default-cmd, session driven over real mTLS from outside.
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import time
+import zipfile
+import io
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu.bundler.payload import agentd_payload, build_agentd_pyz
+from clawker_tpu.controlplane import identity
+from clawker_tpu.controlplane.session_client import dial_with_retry
+from clawker_tpu.firewall import pki
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_pyz_is_deterministic_and_stdlib_only():
+    a, b = build_agentd_pyz(), build_agentd_pyz()
+    assert a == b
+    names = zipfile.ZipFile(io.BytesIO(a)).namelist()
+    assert "__main__.py" in names
+    assert "clawker_tpu/agentd/daemon.py" in names
+    # nothing outside the declared closure sneaks in
+    allowed_prefixes = ("__main__.py", "clawker_tpu/agentd/")
+    allowed = {"clawker_tpu/__init__.py", "clawker_tpu/consts.py", "clawker_tpu/errors.py"}
+    for n in names:
+        assert n.startswith(allowed_prefixes) or n in allowed, n
+
+
+def test_payload_includes_supervisor_when_built():
+    subprocess.run(["make", "-C", str(REPO / "native")], check=True, capture_output=True)
+    payload = agentd_payload()
+    assert payload is not None
+    assert payload["clawker-supervisord"][:4] == b"\x7fELF"
+    assert payload["clawker-agentd.pyz"][:2] == b"PK"
+
+
+def test_full_payload_composition(tmp_path):
+    """supervisor --child python3 pyz --default-cmd <image cmd>: AgentReady
+    with no argv runs the image CMD under the supervisor."""
+    subprocess.run(["make", "-C", str(REPO / "native")], check=True, capture_output=True)
+    ca = pki.generate_ca()
+    cp = pki.generate_cp_cert(ca)
+    certs = tmp_path / "certs"
+    certs.mkdir()
+    (certs / "cp.crt").write_bytes(cp.cert_pem)
+    (certs / "cp.key").write_bytes(cp.key_pem)
+    (certs / "ca.crt").write_bytes(ca.cert_pem)
+
+    bdir = tmp_path / "bootstrap"
+    bdir.mkdir()
+    for name, data in identity.mint_bootstrap_material(ca, "p", "dev").files().items():
+        (bdir / name).write_bytes(data)
+
+    pyz = tmp_path / "clawker-agentd.pyz"
+    pyz.write_bytes(build_agentd_pyz())
+    sup_bin = REPO / "native" / "build" / "clawker-supervisord"
+    sock = tmp_path / "sup.sock"
+    port = free_port()
+    marker = tmp_path / "image-cmd-ran"
+
+    proc = subprocess.Popen(
+        [
+            str(sup_bin),
+            "--socket", str(sock),
+            "--child",
+            "python3", str(pyz),
+            "--bootstrap-dir", str(bdir),
+            "--host", "127.0.0.1",
+            "--port", str(port),
+            "--ready-file", str(tmp_path / "ready"),
+            "--init-marker", str(tmp_path / "init"),
+            "--supervisor-socket", str(sock),
+            "--default-cmd",
+            # "image CMD" (what Docker would append to the ENTRYPOINT)
+            "/bin/sh", "-c", f"touch {marker}; exit 21",
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+    )
+    try:
+        s = dial_with_retry(
+            "127.0.0.1",
+            port,
+            cert_file=certs / "cp.crt",
+            key_file=certs / "cp.key",
+            ca_file=certs / "ca.crt",
+            deadline_s=15,
+        )
+        with s:
+            h = s.hello()
+            assert not h.initialized and not h.cmd_running
+            r = s.run_shell([{"argv": ["/bin/echo", "plan-step"]}])
+            assert r.stdout.strip() == b"plan-step" and r.code == 0
+            s.agent_initialized()
+            pid = s.agent_ready([], cwd=str(tmp_path))  # empty argv -> image CMD
+            assert pid > 0
+        # user CMD exits 21; with the service child still alive the
+        # supervisor keeps running (session daemon may serve reconnects)
+        deadline = time.time() + 10
+        while not marker.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert marker.exists()
+        from clawker_tpu.agentd import SupervisorClient
+
+        with SupervisorClient(sock) as c:
+            assert c.wait(timeout=10) == 21
+    finally:
+        if proc.poll() is None:
+            proc.terminate()
+        try:
+            proc.wait(5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(5)

@@ -87,12 +87,17 @@ def test_generate_harness_cache_tail(cfg):
     df = generate_harness(
         "demo", harness, BuildConfig(), with_ca_cert=True, with_agentd=True
     )
-    # agentd COPY must come after every install RUN and after the CA COPY
-    agentd_at = df.index("COPY clawkerd")
+    # supervisor/agentd COPYs must come after every install RUN and after
+    # the CA COPY (cache-tail invariant)
+    agentd_at = df.index("COPY clawker-supervisord")
     assert df.index("npm install") < agentd_at
     assert df.index("COPY clawker-ca.crt") < agentd_at
+    assert df.index("COPY clawker-agentd.pyz") > agentd_at
     assert df.rstrip().endswith('CMD ["claude"]')
-    assert f'ENTRYPOINT ["{consts.AGENTD_PATH}"]' in df
+    # PID 1 = native supervisor; agentd zipapp is its --child; image CMD
+    # flows into agentd's --default-cmd via Docker's ENTRYPOINT+CMD concat
+    assert f'ENTRYPOINT ["{consts.SUPERVISOR_PATH}"' in df
+    assert df.index("--default-cmd") < df.index('CMD ["claude"]')
 
 
 def test_build_context_deterministic_tar():
