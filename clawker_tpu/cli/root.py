@@ -77,6 +77,7 @@ def register_commands() -> None:
         cmd_build,
         cmd_bundle,
         cmd_container,
+        cmd_controlplane,
         cmd_image,
         cmd_init,
         cmd_project,
@@ -86,6 +87,7 @@ def register_commands() -> None:
     cmd_build.register(cli)
     cmd_bundle.register(cli)
     cmd_container.register(cli)
+    cmd_controlplane.register(cli)
     cmd_image.register(cli)
     cmd_init.register(cli)
     cmd_project.register(cli)

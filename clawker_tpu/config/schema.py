@@ -193,9 +193,12 @@ class MonitoringSettings:
 
 @dataclass
 class ControlPlaneSettings:
+    enable: bool = False            # bring the CP up on container start paths
     admin_port: int = 7443
     agent_port: int = 7444
     health_port: int = 7080
+    advertise_host: str = ""        # address agents Register back to; "" = bridge gateway
+    drain_to_zero: bool = False     # self-shutdown when the last agent exits
     per_worker: bool = True         # tpu_vm: one CP per worker VM + fleet aggregation
 
 

@@ -34,6 +34,10 @@ CONTROLPLANE_CONTAINER = "clawker-controlplane"
 ENVOY_CONTAINER = "clawker-envoy"
 COREDNS_CONTAINER = "clawker-coredns"
 NETWORK_NAME = "clawker-net"
+# Default docker0 gateway: how agent containers reach host-side CP/hostproxy
+# when the CP runs as a host daemon (the reference CP is a container at .202
+# on clawker-net instead; ARCHITECTURE.md:490).
+DOCKER_BRIDGE_GATEWAY = "172.17.0.1"
 
 # Deterministic static addressing on clawker-net (reference:
 # .claude/docs/ARCHITECTURE.md:490 -- gateway+.2 Envoy, +.3 CoreDNS, +.202 CP).
@@ -88,6 +92,10 @@ SUPERVISOR_SOCKET = "/run/clawker/supervisor.sock"
 AGENTD_PYZ_PATH = "/usr/local/lib/clawker-agentd.pyz"   # session daemon zipapp
 WORKSPACE_DIR = "/workspace"
 CA_CERT_PATH = "/usr/local/share/ca-certificates/clawker-firewall-ca.crt"
+# Container-side host-proxy scripts (reference: internal/hostproxy/internals
+# host-open.sh + git-credential-clawker.sh, baked in by the bundler)
+GIT_CREDENTIAL_HELPER_PATH = "/usr/local/bin/git-credential-clawker"
+HOST_OPEN_PATH = "/usr/local/bin/host-open"
 
 # Bootstrap file names inside BOOTSTRAP_DIR (reference: clawkerd/bootstrap.go
 # reads cert/key/ca/assertion.jwt).

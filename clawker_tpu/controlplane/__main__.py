@@ -1,0 +1,43 @@
+"""``python -m clawker_tpu.controlplane`` -- the CP daemon entrypoint.
+
+Parity reference: cmd/clawkercp (thin main over internal/controlplane
+cmd.go:193 Main).  Config comes from the same layered settings the CLI
+reads; the runtime driver (and thus which daemon the CP watches) follows
+settings.runtime.driver / CLAWKER_TPU_DRIVER exactly like the CLI.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from .. import consts, logsetup
+from ..config import load_config
+from ..engine.drivers import get_driver
+from .daemon import ControlPlaneDaemon, CPConfig
+
+
+def main() -> int:
+    logsetup.setup(os.environ.get("CLAWKER_TPU_CP_LOG", "info"))
+    cfg = load_config()
+    driver = get_driver(cfg.settings, override=os.environ.get("CLAWKER_TPU_DRIVER", ""))
+    cp = cfg.settings.control_plane
+    daemon = ControlPlaneDaemon(
+        CPConfig(
+            pki_dir=cfg.pki_dir,
+            registry_path=cfg.data_dir / "agents.db",
+            admin_port=cp.admin_port,
+            agent_port=cp.agent_port,
+            health_port=cp.health_port,
+            cp_host=os.environ.get("CLAWKER_TPU_CP_HOST", "")
+            or cp.advertise_host
+            or consts.DOCKER_BRIDGE_GATEWAY,
+            drain_to_zero=cp.drain_to_zero,
+        ),
+        driver.engine(),
+    )
+    return daemon.run_forever()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,10 @@ log = logsetup.get("cp.bootstrap")
 
 
 def pre_start_services(cfg: Config, driver: RuntimeDriver, container_ref: str) -> None:
+    if cfg.settings.control_plane.enable:
+        from . import manager
+
+        manager.ensure_running(cfg)
     if cfg.settings.firewall.enable:
         from ..firewall.lifecycle import firewall_pre_start
 

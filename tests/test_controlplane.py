@@ -1,0 +1,491 @@
+"""Control-plane daemon tests: executor plans, dialer flow against a real
+in-process agentd, AgentService register binding, AdminService auth, the
+watcher's drain-to-zero, and the daemon's health/drain lifecycle."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.agentd.daemon import Agentd, AgentdConfig
+from clawker_tpu.controlplane import identity
+from clawker_tpu.controlplane.adminapi import (
+    AdminClient,
+    AdminError,
+    AdminServer,
+    mint_admin_token,
+)
+from clawker_tpu.controlplane.agentservice import AgentService
+from clawker_tpu.controlplane.daemon import ControlPlaneDaemon, CPConfig, ensure_cp_material
+from clawker_tpu.controlplane.dialer import Dialer, DialerConfig
+from clawker_tpu.controlplane.executor import (
+    AgentProfile,
+    Executor,
+    boot_plan,
+    init_plan,
+)
+from clawker_tpu.controlplane.registry import Registry
+from clawker_tpu.controlplane.session_client import dial_with_retry
+from clawker_tpu.controlplane.watcher import LIST_ERR_CEILING, AgentWatcher
+from clawker_tpu.engine.api import Engine
+from clawker_tpu.engine.fake import FakeDockerAPI
+from clawker_tpu.firewall import pki
+
+
+@pytest.fixture(scope="module")
+def ca():
+    return pki.generate_ca()
+
+
+@pytest.fixture(scope="module")
+def cp_material(ca, tmp_path_factory):
+    d = tmp_path_factory.mktemp("cp-pki")
+    pair = pki.generate_cp_cert(ca)
+    (d / "cp.crt").write_bytes(pair.cert_pem)
+    (d / "cp.key").write_bytes(pair.key_pem)
+    (d / "ca.crt").write_bytes(ca.cert_pem)
+    return d
+
+
+@pytest.fixture
+def agentd_env(ca, tmp_path):
+    bdir = tmp_path / "bootstrap"
+    bdir.mkdir()
+    material = identity.mint_bootstrap_material(ca, "proj", "dev", container_id="c1")
+    for name, data in material.files().items():
+        (bdir / name).write_bytes(data)
+    cfg = AgentdConfig(
+        bootstrap_dir=bdir,
+        port=0,
+        host="127.0.0.1",
+        ready_file=tmp_path / "ready",
+        init_marker=tmp_path / "initialized",
+    )
+    d = Agentd(cfg)
+    threading.Thread(target=d.serve_forever, daemon=True).start()
+    deadline = time.time() + 5
+    while d.bound_port == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    assert d.bound_port
+    yield d, material
+    d.stop()
+
+
+# ---------------------------------------------------------------------------
+# plans
+# ---------------------------------------------------------------------------
+
+
+class TestPlans:
+    def test_init_plan_step_order(self):
+        p = AgentProfile(
+            project="p", agent="a", post_init="/opt/post-init.sh",
+            host_proxy_url="http://172.17.0.1:18374",
+        )
+        names = [s.name for s in init_plan(p)]
+        assert names == ["config", "git", "git-credentials", "ssh", "post-init"]
+
+    def test_init_plan_minimal(self):
+        names = [s.name for s in init_plan(AgentProfile(project="p", agent="a"))]
+        assert names == ["config", "git", "ssh"]
+
+    def test_boot_plan(self):
+        p = AgentProfile(project="p", agent="a", docker_socket=True, pre_run="/opt/pre.sh")
+        assert [s.name for s in boot_plan(p)] == ["docker-socket", "pre-run"]
+        assert boot_plan(AgentProfile(project="p", agent="a")) == []
+
+    def test_stage_uid_drop(self):
+        p = AgentProfile(project="p", agent="a", uid=1000, gid=1000)
+        git = next(s for s in init_plan(p) if s.name == "git")
+        assert git.stages[0]["uid"] == 1000
+
+
+class TestExecutor:
+    def test_runs_plan_over_real_agentd(self, agentd_env, cp_material):
+        d, _ = agentd_env
+        with dial_with_retry(
+            "127.0.0.1", d.bound_port,
+            cert_file=cp_material / "cp.crt", key_file=cp_material / "cp.key",
+            ca_file=cp_material / "ca.crt", deadline_s=5,
+        ) as sess:
+            ex = Executor(sess, full_name="proj.dev")
+            from clawker_tpu.controlplane.executor import Step
+
+            res = ex.run_plan(
+                "t",
+                [
+                    Step(name="one", stages=[{"argv": ["/bin/sh", "-c", "echo hi"], "uid": 0, "gid": 0}]),
+                    Step(name="two", stages=[{"argv": ["/bin/sh", "-c", "exit 3"], "uid": 0, "gid": 0}], best_effort=True),
+                    Step(name="three", stages=[{"argv": ["/bin/true"], "uid": 0, "gid": 0}]),
+                ],
+            )
+        assert res.ok
+        assert [s.name for s in res.steps] == ["one", "two", "three"]
+        assert res.steps[0].stdout.strip() == b"hi"
+        assert res.steps[1].code == 3
+
+    def test_hard_failure_aborts(self, agentd_env, cp_material):
+        d, _ = agentd_env
+        from clawker_tpu.controlplane.executor import Step
+
+        with dial_with_retry(
+            "127.0.0.1", d.bound_port,
+            cert_file=cp_material / "cp.crt", key_file=cp_material / "cp.key",
+            ca_file=cp_material / "ca.crt", deadline_s=5,
+        ) as sess:
+            res = Executor(sess).run_plan(
+                "t",
+                [
+                    Step(name="bad", stages=[{"argv": ["/bin/sh", "-c", "exit 7"], "uid": 0, "gid": 0}]),
+                    Step(name="never", stages=[{"argv": ["/bin/true"], "uid": 0, "gid": 0}]),
+                ],
+            )
+        assert not res.ok
+        assert res.aborted_at == "bad"
+        assert len(res.steps) == 1
+
+
+# ---------------------------------------------------------------------------
+# dialer
+# ---------------------------------------------------------------------------
+
+
+class TestDialer:
+    def _dialer(self, cp_material, registry, d: Agentd, profile: AgentProfile):
+        return Dialer(
+            DialerConfig(
+                cert_file=cp_material / "cp.crt",
+                key_file=cp_material / "cp.key",
+                ca_file=cp_material / "ca.crt",
+                cp_host="",               # no register leg in this test
+                dial_deadline_s=5,
+            ),
+            registry,
+            resolve=lambda cid: ("127.0.0.1", d.bound_port),
+            build_profile=lambda cid: profile,
+        )
+
+    def test_drive_full_flow(self, agentd_env, cp_material, tmp_path):
+        d, material = agentd_env
+        registry = Registry(tmp_path / "agents.db")
+        registry.bind(
+            "proj.dev", "proj", "dev", container_id="c1",
+            cert_sha256=identity.cert_fingerprint(material.agent_cert),
+        )
+        profile = AgentProfile(project="proj", agent="dev", cmd=["/bin/sleep", "5"], workdir="/")
+        dialer = self._dialer(cp_material, registry, d, profile)
+        outcome = dialer.drive("c1")
+        assert outcome == "ready"
+        rec = registry.get("proj.dev")
+        assert rec.initialized
+        assert rec.state == "ready"
+        # idempotent reconnect: hello now reports initialized+cmd_running
+        assert dialer.drive("c1") == "ready"
+
+    def test_register_leg(self, agentd_env, cp_material, tmp_path, ca):
+        d, material = agentd_env
+        registry = Registry(tmp_path / "agents.db")
+        registry.bind(
+            "proj.dev", "proj", "dev", container_id="c1",
+            cert_sha256=identity.cert_fingerprint(material.agent_cert),
+        )
+        svc = AgentService(
+            registry,
+            cert_file=cp_material / "cp.crt", key_file=cp_material / "cp.key",
+            ca_file=cp_material / "ca.crt", host="127.0.0.1", port=0,
+        )
+        svc.start()
+        try:
+            profile = AgentProfile(project="proj", agent="dev", cmd=["/bin/sleep", "5"], workdir="/")
+            dialer = self._dialer(cp_material, registry, d, profile)
+            dialer.cfg.cp_host = "127.0.0.1"
+            dialer.cfg.cp_agent_port = svc.bound_port
+            assert dialer.drive("c1") == "ready"
+            assert registry.get("proj.dev").registered_at > 0
+        finally:
+            svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# agent service (register binding)
+# ---------------------------------------------------------------------------
+
+
+class TestAgentService:
+    @pytest.fixture
+    def service(self, cp_material, tmp_path):
+        registry = Registry(tmp_path / "agents.db")
+        svc = AgentService(
+            registry,
+            cert_file=cp_material / "cp.crt", key_file=cp_material / "cp.key",
+            ca_file=cp_material / "ca.crt", host="127.0.0.1", port=0,
+        )
+        svc.start()
+        yield svc, registry
+        svc.stop()
+
+    def _register(self, ca, svc_port, material, tmp_path) -> dict:
+        from clawker_tpu.agentd.register import RegisterError, register_with_cp
+
+        bdir = tmp_path / "bs"
+        bdir.mkdir(exist_ok=True)
+        for name, data in material.files().items():
+            (bdir / name).write_bytes(data)
+        return register_with_cp(bdir, host="127.0.0.1", port=svc_port)
+
+    def test_accepts_bound_agent(self, service, ca, tmp_path):
+        svc, registry = service
+        m = identity.mint_bootstrap_material(ca, "proj", "dev", container_id="c1")
+        registry.bind(
+            "proj.dev", "proj", "dev", container_id="c1",
+            cert_sha256=identity.cert_fingerprint(m.agent_cert),
+        )
+        reply = self._register(ca, svc.bound_port, m, tmp_path)
+        assert reply["ok"]
+        assert registry.get("proj.dev").registered_at > 0
+
+    def test_rejects_unknown_agent(self, service, ca, tmp_path):
+        from clawker_tpu.agentd.register import RegisterError
+
+        svc, _ = service
+        m = identity.mint_bootstrap_material(ca, "ghost", "dev")
+        with pytest.raises(RegisterError, match="unknown agent"):
+            self._register(ca, svc.bound_port, m, tmp_path)
+
+    def test_rejects_thumbprint_mismatch(self, service, ca, tmp_path):
+        """A stolen assertion presented with a different leaf must fail."""
+        from clawker_tpu.agentd.register import RegisterError
+
+        svc, registry = service
+        m1 = identity.mint_bootstrap_material(ca, "proj", "dev", container_id="c1")
+        registry.bind(
+            "proj.dev", "proj", "dev", container_id="c1",
+            cert_sha256=identity.cert_fingerprint(m1.agent_cert),
+        )
+        # attacker: valid CA-signed cert for another agent + dev's JWT
+        m2 = identity.mint_bootstrap_material(ca, "proj", "other")
+        stolen = identity.BootstrapMaterial(
+            agent_cert=m2.agent_cert, agent_key=m2.agent_key,
+            ca_cert=m1.ca_cert, assertion_jwt=m1.assertion_jwt,
+            session_key=m1.session_key,
+        )
+        with pytest.raises(RegisterError, match="thumbprint"):
+            self._register(ca, svc.bound_port, stolen, tmp_path)
+        assert registry.get("proj.dev").registered_at == 0
+
+
+# ---------------------------------------------------------------------------
+# admin api
+# ---------------------------------------------------------------------------
+
+
+class TestAdminAPI:
+    @pytest.fixture
+    def server(self, cp_material):
+        srv = AdminServer(
+            cert_file=cp_material / "cp.crt", key_file=cp_material / "cp.key",
+            ca_file=cp_material / "ca.crt", host="127.0.0.1", port=0,
+        )
+        srv.register("ListAgents", lambda req: {"agents": [], "echo": req.get("project", "")})
+        srv.start()
+        yield srv
+        srv.stop()
+
+    def _client(self, cp_material, port, token) -> AdminClient:
+        return AdminClient(
+            "127.0.0.1", port,
+            cert_file=cp_material / "cp.crt", key_file=cp_material / "cp.key",
+            ca_file=cp_material / "ca.crt", token=token,
+        )
+
+    def test_call_roundtrip(self, server, cp_material, ca):
+        c = self._client(cp_material, server.bound_port, mint_admin_token(ca))
+        t = c.call("GetSystemTime")
+        assert abs(t["unix"] - time.time()) < 5
+        assert c.call("ListAgents", {"project": "x"})["echo"] == "x"
+
+    def test_bad_token_rejected(self, server, cp_material):
+        c = self._client(cp_material, server.bound_port, "garbage.token.here")
+        with pytest.raises(AdminError, match="401"):
+            c.call("GetSystemTime")
+
+    def test_wrong_scope_rejected(self, server, cp_material, ca):
+        bad = identity.sign_jwt_es256(
+            ca.key,
+            {"scope": "self.register", "iat": int(time.time()), "exp": int(time.time()) + 60},
+        )
+        c = self._client(cp_material, server.bound_port, bad)
+        with pytest.raises(AdminError, match="403"):
+            c.call("GetSystemTime")
+
+    def test_unregistered_method_501(self, server, cp_material, ca):
+        c = self._client(cp_material, server.bound_port, mint_admin_token(ca))
+        with pytest.raises(AdminError, match="501"):
+            c.call("FirewallStatus")
+
+    def test_unknown_method_404(self, server, cp_material, ca):
+        c = self._client(cp_material, server.bound_port, mint_admin_token(ca))
+        with pytest.raises(AdminError, match="404"):
+            c.call("Nope")
+
+    def test_handler_exception_is_500_not_crash(self, server, cp_material, ca):
+        server.register("FirewallReload", lambda req: 1 / 0)
+        c = self._client(cp_material, server.bound_port, mint_admin_token(ca))
+        with pytest.raises(AdminError, match="500"):
+            c.call("FirewallReload")
+        # the server survived
+        assert c.call("GetSystemTime")["unix"] > 0
+
+
+# ---------------------------------------------------------------------------
+# watcher
+# ---------------------------------------------------------------------------
+
+
+class _ListFails:
+    def __init__(self):
+        self.calls = 0
+
+    def list_containers(self, **kw):
+        self.calls += 1
+        raise OSError("daemon wedged")
+
+
+class TestWatcher:
+    def _start_agent(self, engine, name="clawker.p.a"):
+        from clawker_tpu.engine.api import ContainerSpec
+
+        cid = engine.create_container(
+            name, ContainerSpec(image="img", labels={consts.LABEL_ROLE: "agent"})
+        )
+        engine.start_container(cid)
+        return cid
+
+    def test_drain_to_zero(self):
+        api = FakeDockerAPI()
+        api.add_image("img")
+        engine = Engine(api)
+        drained = threading.Event()
+        w = AgentWatcher(engine, drain_grace_polls=2, on_drained=drained.set)
+        # unarmed: zero agents at boot never drains (slow first image pull)
+        assert w.poll_once() == 0
+        assert w.poll_once() == 0
+        assert not drained.is_set()
+        cid = self._start_agent(engine)
+        assert w.poll_once() == 1
+        engine.remove_container(cid, force=True)
+        assert w.poll_once() == 0
+        assert not drained.is_set()
+        assert w.poll_once() == 0
+        assert drained.is_set()
+
+    def test_running_agent_resets_streak(self):
+        api = FakeDockerAPI()
+        api.add_image("img")
+        engine = Engine(api)
+        from clawker_tpu.engine.api import ContainerSpec
+
+        cid = engine.create_container(
+            "clawker.p.a",
+            ContainerSpec(image="img", labels={consts.LABEL_ROLE: "agent"}),
+        )
+        engine.start_container(cid)
+        drained = threading.Event()
+        w = AgentWatcher(engine, drain_grace_polls=1, on_drained=drained.set)
+        assert w.poll_once() == 1
+        assert not drained.is_set()
+
+    def test_blind_ceiling(self):
+        blind = threading.Event()
+        w = AgentWatcher(_ListFails(), on_blind=blind.set)
+        for _ in range(LIST_ERR_CEILING):
+            assert w.poll_once() == -1
+        assert blind.is_set()
+
+
+# ---------------------------------------------------------------------------
+# daemon lifecycle
+# ---------------------------------------------------------------------------
+
+
+class TestDaemon:
+    def test_boot_health_drain(self, tmp_path):
+        api = FakeDockerAPI()
+        engine = Engine(api)
+        daemon = ControlPlaneDaemon(
+            CPConfig(
+                pki_dir=tmp_path / "pki",
+                registry_path=tmp_path / "agents.db",
+                host="127.0.0.1",
+                admin_port=0, agent_port=0, health_port=0,
+                watch_interval_s=0.2,
+            ),
+            engine,
+        )
+        daemon.start()
+        try:
+            assert daemon.healthy(), daemon.health()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{daemon.health_bound_port}/healthz", timeout=3
+            ) as r:
+                h = json.loads(r.read())
+            assert h["admin"] and h["agent_service"] and h["feeder"]
+            # admin surface answers over mTLS with a minted token
+            ca = pki.ensure_ca(tmp_path / "pki")
+            client = AdminClient(
+                "127.0.0.1", daemon.subs.admin.bound_port,
+                cert_file=tmp_path / "pki" / "cp.crt",
+                key_file=tmp_path / "pki" / "cp.key",
+                ca_file=tmp_path / "pki" / "ca.crt",
+                token=mint_admin_token(ca),
+            )
+            assert client.call("ListAgents") == {"agents": []}
+            status = client.call("Status")
+            assert status["healthy"]
+        finally:
+            daemon.request_stop()
+            daemon.drain()
+
+    def test_drain_to_zero_stops_daemon(self, tmp_path):
+        api = FakeDockerAPI()
+        api.add_image("img")
+        engine = Engine(api)
+        daemon = ControlPlaneDaemon(
+            CPConfig(
+                pki_dir=tmp_path / "pki",
+                registry_path=tmp_path / "agents.db",
+                host="127.0.0.1",
+                admin_port=0, agent_port=0, health_port=0,
+                watch_interval_s=0.05,
+                drain_to_zero=True,
+                drain_grace_polls=2,
+            ),
+            engine,
+        )
+        daemon.start()
+        try:
+            # arm the watcher with one agent's lifetime, then remove it
+            from clawker_tpu.engine.api import ContainerSpec
+
+            cid = engine.create_container(
+                "clawker.p.a", ContainerSpec(image="img", labels={consts.LABEL_ROLE: "agent"})
+            )
+            engine.start_container(cid)
+            time.sleep(0.2)
+            engine.remove_container(cid, force=True)
+            assert daemon._stop.wait(5.0), "drain-to-zero never fired"
+        finally:
+            daemon.drain()
+
+    def test_ensure_cp_material_idempotent(self, tmp_path):
+        a = ensure_cp_material(tmp_path)
+        first = (tmp_path / "cp.crt").read_bytes()
+        b = ensure_cp_material(tmp_path)
+        assert a == b
+        assert (tmp_path / "cp.crt").read_bytes() == first
