@@ -1,0 +1,378 @@
+"""DNS policy gate: the name-resolution half of kernel egress enforcement.
+
+The kernel rewrites every :53 flow from enrolled containers to this
+server.  For a query in an allowed zone it forwards to the upstream
+malware-blocking resolvers, writes each answered A record into the
+``dns_cache`` map as {ip -> zone hash, ttl} -- the entry the kernel's
+connect/sendmsg hooks later route on -- and relays the answer.  Docker-
+internal zones forward to the embedded daemon resolver; everything else
+gets NXDOMAIN without ever leaving the host.  Name-based kernel
+enforcement is only possible because resolution and routing share this
+one path (reference: cmd/coredns-clawker + internal/dnsbpf ServeDNS
+dnsbpf.go:49 writing A records into the pinned cache; config semantics
+from controlplane/firewall/coredns_config.go -- per-zone forwards,
+Docker-internal zones, catch-all NXDOMAIN).
+
+Implementation is a first-party minimal DNS codec + threaded UDP/TCP
+servers (no CoreDNS, no third-party DNS lib): the gate only needs
+question parsing, A-record extraction, and NXDOMAIN/SERVFAIL synthesis.
+
+AAAA policy: allowed zones answer NOERROR/empty (the sandbox data plane
+is v4-only and the kernel denies native v6 -- steering dual-stack clients
+to A records); denied zones get NXDOMAIN like everything else.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .. import consts, logsetup
+from .hashes import zone_hash
+from .maps import FirewallMaps
+from .model import DnsEntry
+
+log = logsetup.get("firewall.dnsgate")
+
+QTYPE_A = 1
+QTYPE_AAAA = 28
+RCODE_NOERROR = 0
+RCODE_NXDOMAIN = 3
+RCODE_SERVFAIL = 2
+
+TTL_MIN_S = 30       # floor so the kernel cache outlives immediate reuse
+TTL_MAX_S = 3600
+UPSTREAM_TIMEOUT_S = 2.5
+
+
+# --------------------------------------------------------------------------
+# wire codec (only what the gate needs)
+# --------------------------------------------------------------------------
+
+class DnsWireError(Exception):
+    pass
+
+
+def _read_name(data: bytes, off: int, depth: int = 0) -> tuple[str, int]:
+    """Decode a (possibly compressed) name; returns (name, next offset)."""
+    if depth > 8:
+        raise DnsWireError("compression loop")
+    labels = []
+    while True:
+        if off >= len(data):
+            raise DnsWireError("truncated name")
+        n = data[off]
+        if n == 0:
+            return ".".join(labels), off + 1
+        if n & 0xC0 == 0xC0:  # compression pointer
+            if off + 1 >= len(data):
+                raise DnsWireError("truncated pointer")
+            ptr = ((n & 0x3F) << 8) | data[off + 1]
+            name, _ = _read_name(data, ptr, depth + 1)
+            labels.append(name)
+            return ".".join(labels), off + 2
+        off += 1
+        labels.append(data[off:off + n].decode("ascii", "replace"))
+        off += n
+
+
+def _encode_name(name: str) -> bytes:
+    out = b""
+    for label in name.strip(".").split("."):
+        raw = label.encode("ascii", "ignore")
+        if not raw or len(raw) > 63:
+            raise DnsWireError(f"bad label in {name!r}")
+        out += bytes([len(raw)]) + raw
+    return out + b"\x00"
+
+
+@dataclass
+class Question:
+    qid: int
+    qname: str
+    qtype: int
+    qclass: int
+    flags: int
+    raw_question: bytes  # name+type+class, verbatim (for synthesized replies)
+
+
+def parse_query(data: bytes) -> Question:
+    if len(data) < 12:
+        raise DnsWireError("short packet")
+    qid, flags, qd, _an, _ns, _ar = struct.unpack(">HHHHHH", data[:12])
+    if qd < 1:
+        raise DnsWireError("no question")
+    qname, off = _read_name(data, 12)
+    if off + 4 > len(data):
+        raise DnsWireError("truncated question")
+    qtype, qclass = struct.unpack(">HH", data[off:off + 4])
+    return Question(qid, qname.lower().rstrip("."), qtype, qclass, flags,
+                    data[12:off + 4])
+
+
+def synthesize(q: Question, rcode: int) -> bytes:
+    """Answerless response (NXDOMAIN / NOERROR-empty / SERVFAIL)."""
+    flags = 0x8000 | 0x0400 | (q.flags & 0x0100) | rcode  # QR|AA|RD-echo
+    hdr = struct.pack(">HHHHHH", q.qid, flags, 1, 0, 0, 0)
+    return hdr + q.raw_question
+
+
+def parse_a_records(data: bytes) -> list[tuple[str, int]]:
+    """(ip, ttl) for every A record in the answer section."""
+    if len(data) < 12:
+        return []
+    _, _, qd, an, _, _ = struct.unpack(">HHHHHH", data[:12])
+    off = 12
+    try:
+        for _ in range(qd):
+            _, off = _read_name(data, off)
+            off += 4
+        out = []
+        for _ in range(an):
+            _, off = _read_name(data, off)
+            if off + 10 > len(data):
+                break
+            rtype, _rclass, ttl, rdlen = struct.unpack(">HHIH", data[off:off + 10])
+            off += 10
+            rdata = data[off:off + rdlen]
+            off += rdlen
+            if rtype == QTYPE_A and rdlen == 4:
+                out.append((socket.inet_ntoa(rdata), ttl))
+        return out
+    except DnsWireError:
+        return []
+
+
+# --------------------------------------------------------------------------
+# zone policy
+# --------------------------------------------------------------------------
+
+@dataclass
+class Zone:
+    apex: str            # normalized, no wildcard marker
+    wildcard: bool       # True: apex + any subdomain; False: exact only
+    internal: bool = False  # forward to the Docker-embedded resolver
+
+    @property
+    def hash(self) -> int:
+        return zone_hash(self.apex)
+
+
+@dataclass
+class ZonePolicy:
+    """Longest-apex-wins matcher over allowed + internal zones.
+
+    Wildcard/exact semantics are the reference's e2e contract
+    (firewall_test.go:609/:653): ``*.example.com`` admits the apex and
+    every subdomain; a bare ``example.com`` rule admits only itself.
+    """
+
+    zones: list[Zone] = field(default_factory=list)
+
+    @classmethod
+    def from_rules(cls, rules, internal_zones: tuple[str, ...] = ("docker.internal",)) -> "ZonePolicy":
+        zones: dict[tuple[str, bool, bool], Zone] = {}
+        for rule in rules:
+            dst = rule.dst.strip().lower().rstrip(".")
+            if not dst:
+                continue
+            wild = dst.startswith("*.")
+            apex = dst[2:] if wild else dst
+            z = Zone(apex=apex, wildcard=wild)
+            zones[(z.apex, z.wildcard, False)] = z
+        for apex in internal_zones:
+            z = Zone(apex=apex.strip(".").lower(), wildcard=True, internal=True)
+            zones[(z.apex, z.wildcard, True)] = z
+        return cls(sorted(zones.values(), key=lambda z: len(z.apex), reverse=True))
+
+    def match(self, qname: str) -> Zone | None:
+        q = qname.strip(".").lower()
+        for z in self.zones:
+            if q == z.apex:
+                return z
+            if z.wildcard and q.endswith("." + z.apex):
+                return z
+        return None
+
+
+# --------------------------------------------------------------------------
+# the gate server
+# --------------------------------------------------------------------------
+
+@dataclass
+class GateStats:
+    queries: int = 0
+    allowed: int = 0
+    internal: int = 0
+    refused: int = 0
+    upstream_errors: int = 0
+    cached_ips: int = 0
+
+
+class DnsGate:
+    """UDP+TCP DNS server applying ZonePolicy and feeding dns_cache."""
+
+    def __init__(
+        self,
+        policy: ZonePolicy,
+        maps: FirewallMaps,
+        *,
+        upstreams: tuple[str, ...] = consts.UPSTREAM_DNS,
+        internal_resolver: str = consts.DOCKER_INTERNAL_DNS,
+        host: str = "0.0.0.0",
+        port: int = consts.DNS_PORT,
+    ):
+        self._policy_lock = threading.Lock()
+        self.policy = policy
+        self.maps = maps
+        self.upstreams = upstreams
+        self.internal_resolver = internal_resolver
+        self.host, self.port = host, port
+        self.bound_port = 0
+        self.stats = GateStats()
+        self._udp: socketserver.ThreadingUDPServer | None = None
+        self._tcp: socketserver.ThreadingTCPServer | None = None
+        self._threads: list[threading.Thread] = []
+
+    def set_policy(self, policy: ZonePolicy) -> None:
+        """Atomic zone swap on rule reload (no restart)."""
+        with self._policy_lock:
+            self.policy = policy
+
+    # ----------------------------------------------------------- serving
+
+    def start(self) -> None:
+        gate = self
+
+        class _Udp(socketserver.BaseRequestHandler):
+            def handle(self):
+                data, sock = self.request
+                reply = gate.serve_packet(data)
+                if reply:
+                    sock.sendto(reply, self.client_address)
+
+        class _Tcp(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    hdr = self.request.recv(2)
+                    if len(hdr) < 2:
+                        return
+                    (length,) = struct.unpack(">H", hdr)
+                    data = b""
+                    while len(data) < length:
+                        chunk = self.request.recv(length - len(data))
+                        if not chunk:
+                            return
+                        data += chunk
+                    reply = gate.serve_packet(data, tcp=True)
+                    if reply:
+                        self.request.sendall(struct.pack(">H", len(reply)) + reply)
+                except OSError:
+                    pass
+
+        socketserver.ThreadingUDPServer.allow_reuse_address = True
+        socketserver.ThreadingTCPServer.allow_reuse_address = True
+        self._udp = socketserver.ThreadingUDPServer((self.host, self.port), _Udp)
+        self.bound_port = self._udp.server_address[1]
+        self._tcp = socketserver.ThreadingTCPServer((self.host, self.bound_port), _Tcp)
+        for name, srv in (("dnsgate-udp", self._udp), ("dnsgate-tcp", self._tcp)):
+            t = threading.Thread(target=srv.serve_forever, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        log.info("dns gate listening on %s:%d", self.host, self.bound_port)
+
+    def stop(self) -> None:
+        for srv in (self._udp, self._tcp):
+            if srv is not None:
+                srv.shutdown()
+                srv.server_close()
+        for t in self._threads:
+            t.join(2.0)
+        self._threads.clear()
+
+    # ------------------------------------------------------------ policy
+
+    def serve_packet(self, data: bytes, *, tcp: bool = False) -> bytes | None:
+        try:
+            q = parse_query(data)
+        except DnsWireError:
+            return None
+        self.stats.queries += 1
+        with self._policy_lock:
+            zone = self.policy.match(q.qname)
+        if zone is None:
+            self.stats.refused += 1
+            return synthesize(q, RCODE_NXDOMAIN)
+        if q.qtype == QTYPE_AAAA:
+            # v4-only data plane (internal zones included): empty answer
+            # steers dual-stack clients to A records instead of letting
+            # them dial native v6 that connect6 would deny
+            self.stats.allowed += 1
+            return synthesize(q, RCODE_NOERROR)
+        if zone.internal:
+            self.stats.internal += 1
+            reply = self._forward(data, (self.internal_resolver,), tcp=tcp)
+            if reply is None:
+                return synthesize(q, RCODE_SERVFAIL)
+            self._cache_answers(reply, zone)
+            return reply
+        self.stats.allowed += 1
+        reply = self._forward(data, self.upstreams, tcp=tcp)
+        if reply is None:
+            self.stats.upstream_errors += 1
+            return synthesize(q, RCODE_SERVFAIL)
+        self._cache_answers(reply, zone)
+        return reply
+
+    def _cache_answers(self, reply: bytes, zone: Zone) -> None:
+        now = int(time.time())
+        for ip, ttl in parse_a_records(reply):
+            ttl = max(TTL_MIN_S, min(TTL_MAX_S, ttl))
+            self.maps.cache_dns(ip, DnsEntry(zone_hash=zone.hash, expires_unix=now + ttl))
+            self.stats.cached_ips += 1
+
+    def _forward(self, data: bytes, resolvers: tuple[str, ...], *, tcp: bool) -> bytes | None:
+        for resolver in resolvers:
+            try:
+                if tcp:
+                    with socket.create_connection((resolver, 53), UPSTREAM_TIMEOUT_S) as s:
+                        s.sendall(struct.pack(">H", len(data)) + data)
+                        hdr = s.recv(2)
+                        if len(hdr) < 2:
+                            continue
+                        (length,) = struct.unpack(">H", hdr)
+                        buf = b""
+                        while len(buf) < length:
+                            chunk = s.recv(length - len(buf))
+                            if not chunk:
+                                break
+                            buf += chunk
+                        if len(buf) == length:
+                            return buf
+                else:
+                    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                        s.settimeout(UPSTREAM_TIMEOUT_S)
+                        # connect() so the kernel drops datagrams from any
+                        # other source; the txn-id check below rejects
+                        # same-source forgeries (dns_cache feeds a kernel
+                        # enforcement map -- poisoning it is an egress hole)
+                        s.connect((resolver, 53))
+                        s.send(data)
+                        deadline = time.monotonic() + UPSTREAM_TIMEOUT_S
+                        while time.monotonic() < deadline:
+                            reply = s.recv(4096)
+                            if len(reply) >= 2 and reply[:2] == data[:2]:
+                                return reply
+                        continue
+            except OSError:
+                continue
+        return None
+
+
+def gc_dns_cache(maps: FirewallMaps) -> int:
+    """Periodic dns_cache GC (reference: GarbageCollectDNS manager.go:907)."""
+    return maps.expire_dns()

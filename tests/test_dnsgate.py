@@ -1,0 +1,265 @@
+"""DNS gate suite: codec, zone policy, serving, and dns_cache feeding.
+
+Parity bar: the reference's CoreDNS config semantics
+(controlplane/firewall/coredns_config.go -- per-zone forwards, docker-
+internal zones, catch-all NXDOMAIN) and the dnsbpf cache-writing plugin
+(internal/dnsbpf/dnsbpf.go:49), exercised through a local fake upstream
+resolver instead of Cloudflare.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+import pytest
+
+from clawker_tpu.config.schema import EgressRule
+from clawker_tpu.firewall import dnsgate
+from clawker_tpu.firewall.dnsgate import (
+    QTYPE_A,
+    QTYPE_AAAA,
+    RCODE_NOERROR,
+    RCODE_NXDOMAIN,
+    RCODE_SERVFAIL,
+    DnsGate,
+    ZonePolicy,
+    _encode_name,
+    parse_a_records,
+    parse_query,
+    synthesize,
+)
+from clawker_tpu.firewall.hashes import zone_hash
+from clawker_tpu.firewall.maps import FakeMaps
+
+
+def make_query(name: str, qtype: int = QTYPE_A, qid: int = 0x1234) -> bytes:
+    hdr = struct.pack(">HHHHHH", qid, 0x0100, 1, 0, 0, 0)
+    return hdr + _encode_name(name) + struct.pack(">HH", qtype, 1)
+
+
+def make_answer(query: bytes, ips: list[str], ttl: int = 120) -> bytes:
+    """Upstream-style response: echoed question + A records (compressed)."""
+    qid, _flags, _qd, _an, _ns, _ar = struct.unpack(">HHHHHH", query[:12])
+    hdr = struct.pack(">HHHHHH", qid, 0x8180, 1, len(ips), 0, 0)
+    body = query[12:]
+    for ip in ips:
+        body += struct.pack(">HHHIH", 0xC00C, QTYPE_A, 1, ttl, 4) + socket.inet_aton(ip)
+    return hdr + body
+
+
+class FakeUpstream:
+    """Local UDP resolver answering every A query from a fixed table."""
+
+    def __init__(self, table: dict[str, list[str]], ttl: int = 120):
+        outer = self
+
+        class _H(socketserver.BaseRequestHandler):
+            def handle(self):
+                data, sock = self.request
+                q = parse_query(data)
+                ips = outer.table.get(q.qname)
+                if ips is None:
+                    sock.sendto(synthesize(q, RCODE_NXDOMAIN), self.client_address)
+                else:
+                    sock.sendto(make_answer(data, ips, outer.ttl), self.client_address)
+
+        self.table = table
+        self.ttl = ttl
+        self.srv = socketserver.ThreadingUDPServer(("127.0.0.1", 0), _H)
+        self.port = self.srv.server_address[1]
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+# --------------------------------------------------------------------------
+# codec
+# --------------------------------------------------------------------------
+
+def test_codec_roundtrip_and_compression():
+    q = parse_query(make_query("Sub.Example.COM"))
+    assert q.qname == "sub.example.com" and q.qtype == QTYPE_A
+    ans = make_answer(make_query("a.example.com"), ["1.2.3.4", "5.6.7.8"], ttl=77)
+    assert parse_a_records(ans) == [("1.2.3.4", 77), ("5.6.7.8", 77)]
+
+
+def test_synthesize_rcodes():
+    q = parse_query(make_query("x.example.com"))
+    nx = synthesize(q, RCODE_NXDOMAIN)
+    assert struct.unpack(">H", nx[2:4])[0] & 0xF == RCODE_NXDOMAIN
+    assert struct.unpack(">H", nx[:2])[0] == q.qid
+    assert parse_query(nx).qname == "x.example.com"  # question echoed
+
+
+def test_parse_query_rejects_garbage():
+    with pytest.raises(dnsgate.DnsWireError):
+        parse_query(b"\x00\x01")
+    with pytest.raises(dnsgate.DnsWireError):
+        parse_query(struct.pack(">HHHHHH", 1, 0, 0, 0, 0, 0))
+
+
+# --------------------------------------------------------------------------
+# zone policy (wildcard vs exact: firewall_test.go:609/:653 semantics)
+# --------------------------------------------------------------------------
+
+def test_zone_policy_wildcard_vs_exact():
+    zp = ZonePolicy.from_rules([
+        EgressRule(dst="*.wild.example"), EgressRule(dst="only.example"),
+    ])
+    assert zp.match("sub.wild.example").apex == "wild.example"
+    assert zp.match("deep.sub.wild.example").apex == "wild.example"
+    assert zp.match("wild.example").apex == "wild.example"  # apex included
+    assert zp.match("only.example").apex == "only.example"
+    assert zp.match("sub.only.example") is None              # exact is exact
+    assert zp.match("unrelated.example") is None
+
+
+def test_zone_policy_longest_apex_wins_and_internal():
+    zp = ZonePolicy.from_rules([EgressRule(dst="*.example.com"),
+                                EgressRule(dst="*.api.example.com")])
+    assert zp.match("v1.api.example.com").apex == "api.example.com"
+    assert zp.match("www.example.com").apex == "example.com"
+    assert zp.match("host.docker.internal").internal
+
+
+# --------------------------------------------------------------------------
+# gate serving
+# --------------------------------------------------------------------------
+
+def _patched_gate(rules, maps, upstream_port, internal_port=None):
+    gate = DnsGate(ZonePolicy.from_rules(rules), maps,
+                   upstreams=(f"up:{upstream_port}",),
+                   internal_resolver=f"int:{internal_port}",
+                   host="127.0.0.1", port=0)
+
+    def forward(data, resolvers, *, tcp):
+        target = resolvers[0]
+        port = int(target.split(":")[1]) if ":" in target else 53
+        if "None" in target:
+            return None
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.settimeout(2)
+                s.sendto(data, ("127.0.0.1", port))
+                reply, _ = s.recvfrom(4096)
+                return reply
+        except OSError:
+            return None
+
+    gate._forward = forward  # type: ignore[method-assign]
+    return gate
+
+
+def test_allowed_query_relays_and_caches():
+    upstream = FakeUpstream({"api.example.com": ["93.184.216.34", "93.184.216.35"]})
+    maps = FakeMaps()
+    gate = _patched_gate([EgressRule(dst="*.example.com")], maps, upstream.port)
+    reply = gate.serve_packet(make_query("api.example.com"))
+    assert reply is not None
+    assert [ip for ip, _ in parse_a_records(reply)] == ["93.184.216.34", "93.184.216.35"]
+    entry = maps.lookup_dns("93.184.216.34")
+    assert entry is not None and entry.zone_hash == zone_hash("example.com")
+    assert maps.lookup_dns("93.184.216.35") is not None
+    assert gate.stats.allowed == 1 and gate.stats.cached_ips == 2
+    upstream.stop()
+
+
+def test_denied_query_nxdomain_never_forwarded():
+    maps = FakeMaps()
+    gate = _patched_gate([EgressRule(dst="*.example.com")], maps, 1)  # port 1: would fail
+    reply = gate.serve_packet(make_query("evil.exfil.net"))
+    assert reply is not None
+    assert struct.unpack(">H", reply[2:4])[0] & 0xF == RCODE_NXDOMAIN
+    assert maps.dns_entries() == {}
+    assert gate.stats.refused == 1
+
+
+def test_ttl_clamped_to_floor():
+    upstream = FakeUpstream({"api.example.com": ["9.9.9.9"]}, ttl=1)
+    maps = FakeMaps()
+    gate = _patched_gate([EgressRule(dst="*.example.com")], maps, upstream.port)
+    gate.serve_packet(make_query("api.example.com"))
+    import time as _t
+
+    entry = maps.lookup_dns("9.9.9.9")
+    assert entry is not None
+    assert entry.expires_unix >= int(_t.time()) + dnsgate.TTL_MIN_S - 1
+    upstream.stop()
+
+
+def test_aaaa_in_allowed_zone_returns_empty_noerror():
+    maps = FakeMaps()
+    gate = _patched_gate([EgressRule(dst="*.example.com")], maps, 1)
+    reply = gate.serve_packet(make_query("api.example.com", qtype=QTYPE_AAAA))
+    assert reply is not None
+    flags = struct.unpack(">H", reply[2:4])[0]
+    assert flags & 0xF == RCODE_NOERROR
+    assert struct.unpack(">H", reply[6:8])[0] == 0  # zero answers
+
+
+def test_internal_zone_forwards_to_docker_resolver():
+    internal = FakeUpstream({"db.docker.internal": ["172.17.0.5"]})
+    maps = FakeMaps()
+    gate = _patched_gate([], maps, 1, internal.port)
+    gate._forward_orig = gate._forward
+
+    def forward(data, resolvers, *, tcp):
+        # internal zone must choose the internal resolver, not upstream
+        assert resolvers == (f"int:{internal.port}",)
+        return gate._forward_orig(data, (f"up:{internal.port}",), tcp=tcp)
+
+    gate._forward = forward  # type: ignore[method-assign]
+    reply = gate.serve_packet(make_query("db.docker.internal"))
+    assert reply is not None
+    assert [ip for ip, _ in parse_a_records(reply)] == ["172.17.0.5"]
+    # internal answers are cached so the kernel can route them if ruled
+    assert maps.lookup_dns("172.17.0.5") is not None
+    internal.stop()
+
+
+def test_upstream_down_servfail():
+    maps = FakeMaps()
+    gate = _patched_gate([EgressRule(dst="*.example.com")], maps, 1)
+    reply = gate.serve_packet(make_query("api.example.com"))
+    assert reply is not None
+    assert struct.unpack(">H", reply[2:4])[0] & 0xF == RCODE_SERVFAIL
+    assert gate.stats.upstream_errors == 1
+
+
+def test_live_udp_and_tcp_serving():
+    upstream = FakeUpstream({"api.example.com": ["93.184.216.34"]})
+    maps = FakeMaps()
+    gate = _patched_gate([EgressRule(dst="*.example.com")], maps, upstream.port)
+    gate.start()
+    try:
+        q = make_query("api.example.com")
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.settimeout(3)
+            s.sendto(q, ("127.0.0.1", gate.bound_port))
+            reply, _ = s.recvfrom(4096)
+        assert [ip for ip, _ in parse_a_records(reply)] == ["93.184.216.34"]
+        with socket.create_connection(("127.0.0.1", gate.bound_port), 3) as s:
+            s.sendall(struct.pack(">H", len(q)) + q)
+            hdr = s.recv(2)
+            (length,) = struct.unpack(">H", hdr)
+            buf = b""
+            while len(buf) < length:
+                buf += s.recv(length - len(buf))
+        assert [ip for ip, _ in parse_a_records(buf)] == ["93.184.216.34"]
+    finally:
+        gate.stop()
+        upstream.stop()
+
+
+def test_policy_hot_swap():
+    maps = FakeMaps()
+    gate = _patched_gate([EgressRule(dst="*.example.com")], maps, 1)
+    assert gate.policy.match("api.example.com") is not None
+    gate.set_policy(ZonePolicy.from_rules([EgressRule(dst="*.other.net")]))
+    reply = gate.serve_packet(make_query("api.example.com"))
+    assert struct.unpack(">H", reply[2:4])[0] & 0xF == RCODE_NXDOMAIN

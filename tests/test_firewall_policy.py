@@ -1,0 +1,291 @@
+"""Policy-oracle suite: the reference's e2e firewall scenarios at map level.
+
+Parity bar: /root/reference/test/e2e/firewall_test.go:77-709 (22 scenarios
+-- blocked/allowed domains, ICMP, bypass, wildcard/exact subdomain
+semantics, SSH TCP mapping, docker-internal DNS, host-proxy reachability,
+HTTP domain detection) driven through clawker_tpu.firewall.policy over
+FakeMaps.  The same semantics compile into native/ebpf/fw.c; ABI pins at
+the bottom keep the two in lock-step.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from clawker_tpu.config.schema import EgressRule
+from clawker_tpu.firewall import policy
+from clawker_tpu.firewall.hashes import zone_hash
+from clawker_tpu.firewall.maps import FakeMaps, UDP_FLOWS_MAX, iter_expired_bypass
+from clawker_tpu.firewall.model import (
+    FLAG_ENFORCE,
+    FLAG_HOSTPROXY,
+    PROTO_TCP,
+    PROTO_UDP,
+    Action,
+    ContainerPolicy,
+    DnsEntry,
+    EgressEvent,
+    Reason,
+    RouteKey,
+    RouteVal,
+    UdpFlow,
+)
+
+CG = 4242  # enrolled cgroup id
+ENVOY = "10.99.0.2"
+DNSGATE = "10.99.0.3"
+HOSTPROXY = "10.99.0.1"
+
+
+@pytest.fixture
+def maps():
+    m = FakeMaps()
+    m.enroll(CG, ContainerPolicy(
+        envoy_ip=ENVOY, dns_ip=DNSGATE, hostproxy_ip=HOSTPROXY,
+        hostproxy_port=18374, flags=FLAG_ENFORCE | FLAG_HOSTPROXY,
+    ))
+    return m
+
+
+def cache(maps, ip, zone, ttl=300):
+    maps.cache_dns(ip, DnsEntry(zone_hash=zone_hash(zone), expires_unix=int(time.time()) + ttl))
+
+
+def route(maps, zone, port, proto, val):
+    t = maps.routes()
+    t[RouteKey(zone_hash(zone), port, proto)] = val
+    maps.sync_routes(t)
+
+
+# -- scenario: unmanaged cgroups are never touched --------------------------
+
+def test_unmanaged_cgroup_allowed(maps):
+    v = policy.connect4(maps, 999, "93.184.216.34", 443)
+    assert v.action is Action.ALLOW and v.reason is Reason.UNMANAGED
+
+
+# -- scenario: allowed domain -> Envoy redirect (firewall_test.go:206) ------
+
+def test_allowed_domain_redirects_to_envoy(maps):
+    cache(maps, "93.184.216.34", "example.com")
+    route(maps, "example.com", 443, PROTO_TCP,
+          RouteVal(Action.REDIRECT, redirect_ip=ENVOY, redirect_port=10000))
+    v = policy.connect4(maps, CG, "93.184.216.34", 443)
+    assert v.action is Action.REDIRECT
+    assert (v.redirect_ip, v.redirect_port) == (ENVOY, 10000)
+    assert v.zone_hash == zone_hash("example.com")
+
+
+# -- scenario: blocked domain -> deny (firewall_test.go:77) -----------------
+
+def test_blocked_domain_denied(maps):
+    # DNS gate never resolved it, so no dns_cache entry: ip-literal deny
+    v = policy.connect4(maps, CG, "203.0.113.9", 443)
+    assert v.action is Action.DENY and v.reason is Reason.NO_DNS_ENTRY
+
+
+def test_resolved_but_unrouted_zone_denied(maps):
+    cache(maps, "198.51.100.7", "evil.example.net")
+    v = policy.connect4(maps, CG, "198.51.100.7", 443)
+    assert v.action is Action.DENY and v.reason is Reason.NO_ROUTE
+
+
+# -- scenario: port-specific route + any-port fallback ----------------------
+
+def test_port_specific_route_beats_any_port(maps):
+    cache(maps, "10.1.2.3", "example.com")
+    route(maps, "example.com", 0, PROTO_TCP, RouteVal(Action.ALLOW))
+    route(maps, "example.com", 8443, PROTO_TCP,
+          RouteVal(Action.REDIRECT, redirect_ip=ENVOY, redirect_port=10000))
+    assert policy.connect4(maps, CG, "10.1.2.3", 8443).action is Action.REDIRECT
+    assert policy.connect4(maps, CG, "10.1.2.3", 9999).action is Action.ALLOW
+
+
+# -- scenario: ICMP blocked via raw-socket deny (firewall_test.go:103) ------
+
+def test_raw_socket_denied_blocks_icmp(maps):
+    v = policy.sock_create(maps, CG, 2, policy.SOCK_RAW)
+    assert v.action is Action.DENY and v.reason is Reason.RAW_SOCKET
+    assert policy.sock_create(maps, CG, 2, policy.SOCK_STREAM).action is Action.ALLOW
+    assert policy.sock_create(maps, 999, 2, policy.SOCK_RAW).action is Action.ALLOW
+
+
+# -- scenario: bypass allows everything, dead-man timed (test.go:147) -------
+
+def test_bypass_allows_and_emits_event(maps):
+    maps.set_bypass(CG, int(time.time()) + 60)
+    v = policy.connect4(maps, CG, "203.0.113.9", 443)
+    assert v.action is Action.ALLOW and v.reason is Reason.BYPASS
+    assert policy.sock_create(maps, CG, 2, policy.SOCK_RAW).action is Action.ALLOW
+    evs = maps.drain_events()
+    assert any(e.reason is Reason.BYPASS for e in evs)
+
+
+def test_bypass_deadman_expiry(maps):
+    maps.set_bypass(CG, int(time.time()) - 1)
+    expired = list(iter_expired_bypass(maps))
+    assert expired == [CG]
+    for cg in expired:
+        maps.clear_bypass(cg)
+    assert policy.connect4(maps, CG, "203.0.113.9", 443).action is Action.DENY
+
+
+# -- scenario: DNS is forced through the gate -------------------------------
+
+def test_hardcoded_resolver_rewritten_to_gate(maps):
+    v = policy.connect4(maps, CG, "8.8.8.8", 53, PROTO_UDP)
+    assert v.action is Action.REDIRECT_DNS
+    assert (v.redirect_ip, v.redirect_port) == (DNSGATE, 53)
+
+
+def test_gate_dns_allowed_directly(maps):
+    assert policy.connect4(maps, CG, DNSGATE, 53, PROTO_UDP).action is Action.ALLOW
+
+
+# -- scenario: infra endpoints ----------------------------------------------
+
+def test_envoy_and_loopback_and_hostproxy_allowed(maps):
+    assert policy.connect4(maps, CG, ENVOY, 10000).reason is Reason.ENVOY
+    assert policy.connect4(maps, CG, "127.0.0.1", 8080).reason is Reason.LOOPBACK
+    # host-proxy reachability (firewall_test.go:452)
+    assert policy.connect4(maps, CG, HOSTPROXY, 18374).reason is Reason.HOSTPROXY
+    # ...but only on the flagged port
+    assert policy.connect4(maps, CG, HOSTPROXY, 22).action is Action.DENY
+
+
+def test_hostproxy_flag_off_denies(maps):
+    maps.enroll(CG, ContainerPolicy(envoy_ip=ENVOY, dns_ip=DNSGATE,
+                                    hostproxy_ip=HOSTPROXY, hostproxy_port=18374,
+                                    flags=FLAG_ENFORCE))
+    assert policy.connect4(maps, CG, HOSTPROXY, 18374).action is Action.DENY
+
+
+# -- scenario: UDP reverse NAT via socket cookie ----------------------------
+
+def test_udp_redirect_reverse_nat(maps):
+    cookie = 777
+    v = policy.sendmsg4(maps, CG, cookie, "9.9.9.9", 53)
+    assert v.action is Action.REDIRECT_DNS
+    # reply arrives from the gate; the app sees the resolver it aimed at
+    src = policy.recvmsg4(maps, CG, cookie, DNSGATE, 53)
+    assert src == ("9.9.9.9", 53)
+    # unrelated source passes through untouched
+    assert policy.recvmsg4(maps, CG, cookie, "1.2.3.4", 9) == ("1.2.3.4", 9)
+    # getpeername mirrors the same reverse mapping
+    assert policy.getpeername4(maps, CG, cookie, DNSGATE, 53) == ("9.9.9.9", 53)
+
+
+def test_udp_flow_lru_bound():
+    m = FakeMaps()
+    for c in range(UDP_FLOWS_MAX + 10):
+        m.record_udp_flow(c, UdpFlow("1.1.1.1", 53))
+    assert m.lookup_udp_flow(0) is None          # evicted
+    assert m.lookup_udp_flow(UDP_FLOWS_MAX + 9) is not None
+
+
+# -- scenario: IPv6 ----------------------------------------------------------
+
+def test_connect6_v4mapped_routes_native_denied(maps):
+    cache(maps, "93.184.216.34", "example.com")
+    route(maps, "example.com", 443, PROTO_TCP,
+          RouteVal(Action.REDIRECT, redirect_ip=ENVOY, redirect_port=10000))
+    v = policy.connect6(maps, CG, "::ffff:93.184.216.34", 443)
+    assert v.action is Action.REDIRECT
+    v6 = policy.connect6(maps, CG, "2606:4700::1111", 443)
+    assert v6.action is Action.DENY and v6.reason is Reason.IPV6
+    assert policy.connect6(maps, CG, "::1", 443).action is Action.ALLOW
+    assert policy.connect6(maps, 999, "2606:4700::1111", 443).action is Action.ALLOW
+
+
+# -- scenario: monitor (non-enforcing) mode ---------------------------------
+
+def test_monitor_mode_allows_but_logs(maps):
+    maps.enroll(CG, ContainerPolicy(envoy_ip=ENVOY, dns_ip=DNSGATE, flags=0))
+    v = policy.connect4(maps, CG, "203.0.113.9", 443)
+    assert v.action is Action.ALLOW and v.reason is Reason.MONITOR
+    assert any(e.reason is Reason.MONITOR for e in maps.drain_events())
+
+
+# -- scenario: dns cache TTL GC ---------------------------------------------
+
+def test_dns_cache_expiry_gc(maps):
+    now = int(time.time())
+    maps.cache_dns("1.2.3.4", DnsEntry(zone_hash=1, expires_unix=now - 5))
+    maps.cache_dns("5.6.7.8", DnsEntry(zone_hash=2, expires_unix=now + 500))
+    assert maps.expire_dns() == 1
+    assert maps.lookup_dns("1.2.3.4") is None
+    assert maps.lookup_dns("5.6.7.8") is not None
+
+
+# -- route-table construction from egress rules -----------------------------
+
+def test_build_routes_wildcard_and_tcp_mapping():
+    rules = [
+        EgressRule(dst="*.example.com", proto="https"),
+        EgressRule(dst="plain.example.org", proto="http"),
+        EgressRule(dst="github.com", proto="tcp", port=22),
+        EgressRule(dst="ntp.example.net", proto="udp", port=123),
+    ]
+    table = policy.build_routes(
+        rules, envoy_ip=ENVOY, tls_port=10000,
+        tcp_ports={"github.com:tcp:22": 10001},
+    )
+    # wildcard rule routes on the apex hash
+    https = table[RouteKey(zone_hash("example.com"), 443, PROTO_TCP)]
+    assert https.action is Action.REDIRECT and https.redirect_port == 10000
+    http = table[RouteKey(zone_hash("plain.example.org"), 80, PROTO_TCP)]
+    assert http.action is Action.REDIRECT
+    # SSH TCP mapping (firewall_test.go:503): per-rule Envoy TCP listener
+    ssh = table[RouteKey(zone_hash("github.com"), 22, PROTO_TCP)]
+    assert ssh.action is Action.REDIRECT and ssh.redirect_port == 10001
+    udp = table[RouteKey(zone_hash("ntp.example.net"), 123, PROTO_UDP)]
+    assert udp.action is Action.ALLOW
+
+
+def test_events_ring_bounded():
+    m = FakeMaps()
+    m.enroll(CG, ContainerPolicy(envoy_ip=ENVOY, dns_ip=DNSGATE))
+    from clawker_tpu.firewall.maps import EVENTS_RING_MAX
+
+    for _ in range(EVENTS_RING_MAX + 7):
+        policy.connect4(m, CG, "203.0.113.9", 443)
+    assert m.events_dropped == 7
+
+
+# -- ABI pins: C struct twins must match these exactly ----------------------
+
+def test_abi_struct_sizes():
+    assert ContainerPolicy.SIZE == 20
+    assert DnsEntry.SIZE == 16
+    assert RouteKey.SIZE == 12
+    assert RouteVal.SIZE == 8
+    assert UdpFlow.SIZE == 8
+    assert EgressEvent.SIZE == 40
+
+
+def test_abi_pack_roundtrip():
+    p = ContainerPolicy(envoy_ip="10.0.0.2", dns_ip="10.0.0.3",
+                        hostproxy_ip="172.17.0.1", hostproxy_port=18374,
+                        flags=FLAG_ENFORCE | FLAG_HOSTPROXY)
+    assert ContainerPolicy.unpack(p.pack()) == p
+    k = RouteKey(zone_hash("example.com"), 443, PROTO_TCP)
+    assert RouteKey.unpack(k.pack()) == k
+    v = RouteVal(Action.REDIRECT, redirect_ip="10.0.0.2", redirect_port=10000)
+    assert RouteVal.unpack(v.pack()) == v
+    f = UdpFlow("9.9.9.9", 53)
+    assert UdpFlow.unpack(f.pack()) == f
+    e = EgressEvent(ts_ns=1, cgroup_id=CG, dst_ip="1.2.3.4", dst_port=443,
+                    zone_hash=zone_hash("example.com"), verdict=Action.DENY,
+                    proto=PROTO_TCP, reason=Reason.NO_ROUTE)
+    assert EgressEvent.unpack(e.pack()) == e
+
+
+def test_zone_hash_pinned_vectors():
+    """Known vectors: the C fw_zone_hash must reproduce these exactly
+    (native/ebpf test target checks the same table)."""
+    assert zone_hash("") == 0xCBF29CE484222325
+    assert zone_hash("a") == 0xAF63DC4C8601EC8C
+    assert zone_hash("example.com") == zone_hash("EXAMPLE.COM.")
+    assert zone_hash("example.com") != zone_hash("example.org")
