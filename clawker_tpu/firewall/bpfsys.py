@@ -5,7 +5,7 @@ caching resolutions, the handler enrolling cgroups, route sync, GC) need
 four syscall commands -- OBJ_GET, MAP_LOOKUP/UPDATE/DELETE_ELEM plus
 GET_NEXT_KEY -- none of which require ELF loading.  Program load/attach
 (which does need ELF + relocation handling) stays in the native loader
-(native/ebpf/loader.cpp, built with libbpf on the target host during
+(native/ebpf/fwctl.c, built with libbpf on the target host during
 provisioning).  This split means the Python side works on any kernel with
 a pinned map directory and zero native Python dependencies.
 
@@ -33,6 +33,7 @@ from .maps import (
     MAP_CONTAINERS,
     MAP_DNS_CACHE,
     MAP_ROUTES,
+    MAP_TCP_FLOWS,
     MAP_UDP_FLOWS,
     FirewallMaps,
 )
@@ -188,14 +189,25 @@ class PinnedMaps(FirewallMaps):
         pin = Path(pin_dir)
         self.pin_dir = pin
         self.fwctl = fwctl
-        self.containers = BpfMap(pin / MAP_CONTAINERS, 8, ContainerPolicy.SIZE)
-        self.bypass = BpfMap(pin / MAP_BYPASS, 8, 8)
-        self.dns = BpfMap(pin / MAP_DNS_CACHE, 4, DnsEntry.SIZE)
-        self.route_map = BpfMap(pin / MAP_ROUTES, RouteKey.SIZE, RouteVal.SIZE)
-        self.udp = BpfMap(pin / MAP_UDP_FLOWS, 8, UdpFlow.SIZE)
+        self._maps: list[BpfMap] = []
+        try:
+            self.containers = self._open(pin / MAP_CONTAINERS, 8, ContainerPolicy.SIZE)
+            self.bypass = self._open(pin / MAP_BYPASS, 8, 8)
+            self.dns = self._open(pin / MAP_DNS_CACHE, 4, DnsEntry.SIZE)
+            self.route_map = self._open(pin / MAP_ROUTES, RouteKey.SIZE, RouteVal.SIZE)
+            self.udp = self._open(pin / MAP_UDP_FLOWS, 8, UdpFlow.SIZE)
+            self.tcp = self._open(pin / MAP_TCP_FLOWS, 8, UdpFlow.SIZE)
+        except BpfError:
+            self.close()  # partial pin set: release what was opened
+            raise
+
+    def _open(self, path: Path, ksize: int, vsize: int) -> BpfMap:
+        m = BpfMap(path, ksize, vsize)
+        self._maps.append(m)
+        return m
 
     def close(self) -> None:
-        for m in (self.containers, self.bypass, self.dns, self.route_map, self.udp):
+        for m in self._maps:
             m.close()
 
     # containers --------------------------------------------------------
@@ -283,6 +295,13 @@ class PinnedMaps(FirewallMaps):
         raw = self.udp.lookup(struct.pack("<Q", cookie))
         return UdpFlow.unpack(raw) if raw else None
 
+    def record_tcp_flow(self, cookie, flow):
+        self.tcp.update(struct.pack("<Q", cookie), flow.pack())
+
+    def lookup_tcp_flow(self, cookie):
+        raw = self.tcp.lookup(struct.pack("<Q", cookie))
+        return UdpFlow.unpack(raw) if raw else None
+
     # events ------------------------------------------------------------
     def emit_event(self, ev):
         pass  # kernel-only producer on the real map set
@@ -318,6 +337,6 @@ class PinnedMaps(FirewallMaps):
 
     # lifecycle ---------------------------------------------------------
     def flush_all(self):
-        for m in (self.containers, self.bypass, self.dns, self.route_map, self.udp):
+        for m in self._maps:
             for k in m.keys():
                 m.delete(k)

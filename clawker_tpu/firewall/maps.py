@@ -31,9 +31,14 @@ MAP_BYPASS = "bypass"
 MAP_DNS_CACHE = "dns_cache"
 MAP_ROUTES = "routes"
 MAP_UDP_FLOWS = "udp_flows"
+MAP_TCP_FLOWS = "tcp_flows"
 MAP_EVENTS = "events"
+MAP_RATELIMIT = "ratelimit"
 
-ALL_MAPS = (MAP_CONTAINERS, MAP_BYPASS, MAP_DNS_CACHE, MAP_ROUTES, MAP_UDP_FLOWS, MAP_EVENTS)
+# The single source of truth for the pinned set; fwctl.c's MAPS[] is
+# pinned against this list by tests/test_ebpf_abi.py.
+ALL_MAPS = (MAP_CONTAINERS, MAP_BYPASS, MAP_DNS_CACHE, MAP_ROUTES,
+            MAP_UDP_FLOWS, MAP_TCP_FLOWS, MAP_EVENTS, MAP_RATELIMIT)
 
 UDP_FLOWS_MAX = 4096
 EVENTS_RING_MAX = 8192
@@ -95,11 +100,18 @@ class FirewallMaps:
     def routes(self) -> dict[RouteKey, RouteVal]:
         raise NotImplementedError
 
-    # udp flows ---------------------------------------------------------
+    # reverse-NAT flows -------------------------------------------------
+    # (two LRUs so TCP connect churn can never evict live UDP entries)
     def record_udp_flow(self, cookie: int, flow: UdpFlow) -> None:
         raise NotImplementedError
 
     def lookup_udp_flow(self, cookie: int) -> UdpFlow | None:
+        raise NotImplementedError
+
+    def record_tcp_flow(self, cookie: int, flow: UdpFlow) -> None:
+        raise NotImplementedError
+
+    def lookup_tcp_flow(self, cookie: int) -> UdpFlow | None:
         raise NotImplementedError
 
     # events ------------------------------------------------------------
@@ -129,6 +141,7 @@ class FakeMaps(FirewallMaps):
         self._dns: dict[str, DnsEntry] = {}
         self._routes: dict[RouteKey, RouteVal] = {}
         self._udp: OrderedDict[int, UdpFlow] = OrderedDict()
+        self._tcp: OrderedDict[int, UdpFlow] = OrderedDict()
         self._events: list[EgressEvent] = []
         self.events_dropped = 0
 
@@ -208,6 +221,17 @@ class FakeMaps(FirewallMaps):
         with self._lock:
             return self._udp.get(cookie)
 
+    def record_tcp_flow(self, cookie, flow):
+        with self._lock:
+            self._tcp[cookie] = flow
+            self._tcp.move_to_end(cookie)
+            while len(self._tcp) > UDP_FLOWS_MAX:
+                self._tcp.popitem(last=False)
+
+    def lookup_tcp_flow(self, cookie):
+        with self._lock:
+            return self._tcp.get(cookie)
+
     def emit_event(self, ev):
         with self._lock:
             if len(self._events) >= EVENTS_RING_MAX:
@@ -227,6 +251,7 @@ class FakeMaps(FirewallMaps):
             self._dns.clear()
             self._routes.clear()
             self._udp.clear()
+            self._tcp.clear()
             self._events.clear()
 
 

@@ -125,10 +125,19 @@ def _no_route(pol, reason: Reason, zone: int = 0) -> Verdict:
 # --------------------------------------------------------------------------
 
 def connect4(maps: FirewallMaps, cgroup_id: int, dst_ip: str, dst_port: int,
-             proto: int = PROTO_TCP) -> Verdict:
+             proto: int = PROTO_TCP, sock_cookie: int = 0) -> Verdict:
     """cgroup/connect4 twin.  REDIRECT verdicts mean the kernel rewrote
-    the sockaddr before the connect proceeded."""
-    return decide(maps, cgroup_id, dst_ip, dst_port, proto)
+    the sockaddr before the connect proceeded; the original destination
+    is recorded (TCP and UDP in separate LRUs) so getpeername4 can
+    reverse it."""
+    v = decide(maps, cgroup_id, dst_ip, dst_port, proto)
+    if sock_cookie and v.action in (Action.REDIRECT, Action.REDIRECT_DNS):
+        flow = UdpFlow(orig_ip=dst_ip, orig_port=dst_port)
+        if proto == PROTO_UDP:
+            maps.record_udp_flow(sock_cookie, flow)
+        else:
+            maps.record_tcp_flow(sock_cookie, flow)
+    return v
 
 
 def sendmsg4(maps: FirewallMaps, cgroup_id: int, sock_cookie: int,
@@ -158,10 +167,15 @@ def recvmsg4(maps: FirewallMaps, cgroup_id: int, sock_cookie: int,
 
 def getpeername4(maps: FirewallMaps, cgroup_id: int, sock_cookie: int,
                  peer_ip: str, peer_port: int) -> tuple[str, int]:
-    """cgroup/getpeername4 twin: connected sockets report the destination
-    the app aimed at, not the rewrite target (connect-time redirects also
-    record a flow entry in the C implementation)."""
-    return recvmsg4(maps, cgroup_id, sock_cookie, peer_ip, peer_port)
+    """cgroup/getpeername4 twin: connected sockets (TCP or UDP) report
+    the destination the app aimed at, not the rewrite target."""
+    pol = maps.lookup_container(cgroup_id)
+    if pol is None:
+        return peer_ip, peer_port
+    flow = maps.lookup_udp_flow(sock_cookie) or maps.lookup_tcp_flow(sock_cookie)
+    if flow is not None and peer_ip in (pol.dns_ip, pol.envoy_ip):
+        return flow.orig_ip, flow.orig_port
+    return peer_ip, peer_port
 
 
 def connect6(maps: FirewallMaps, cgroup_id: int, dst_ip6: str, dst_port: int,
@@ -172,6 +186,11 @@ def connect6(maps: FirewallMaps, cgroup_id: int, dst_ip6: str, dst_port: int,
     pol = maps.lookup_container(cgroup_id)
     if pol is None:
         return Verdict(Action.ALLOW, Reason.UNMANAGED)
+    if maps.bypassed(cgroup_id):
+        # break-glass must open v6 too, matching decide()'s bypass step
+        v = Verdict(Action.ALLOW, Reason.BYPASS)
+        _event(maps, cgroup_id, "0.0.0.0", dst_port, proto, v)
+        return v
     low = dst_ip6.lower()
     if low.startswith("::ffff:"):
         return decide(maps, cgroup_id, dst_ip6[7:], dst_port, proto)

@@ -1,0 +1,510 @@
+/* fw.c - cgroup-attached egress enforcement programs.
+ *
+ * Nine programs, attached per managed-container cgroup with
+ * BPF_F_ALLOW_MULTI by the fwctl loader:
+ *
+ *   fw_connect4 / fw_connect6       - TCP/UDP connect() policy + rewrite
+ *   fw_sendmsg4 / fw_sendmsg6      - unconnected-UDP sendto() policy
+ *   fw_recvmsg4 / fw_recvmsg6      - reverse-NAT of redirected UDP replies
+ *   fw_getpeername4 / fw_getpeername6 - apps see the dst they aimed at
+ *   fw_sock_create                  - SOCK_RAW/SOCK_PACKET deny (no ICMP)
+ *
+ * The decision semantics are the executable spec in
+ * clawker_tpu/firewall/policy.py (fw_decide mirrors policy.decide step by
+ * step -- the comments carry the same step numbers); the map ABI is
+ * fw_maps.h / model.py.  Fail-closed property: the maps are pinned, so if
+ * the control plane dies the last-synced policy keeps enforcing.
+ *
+ * Parity reference: the reference's program set lives in
+ * controlplane/firewall/ebpf/bpf/clawker.c (:121 connect4 ... :394
+ * sock_create) with shared logic in common.h.  Re-designed here: reverse-
+ * NAT keys on bpf_get_socket_cookie() instead of a flow tuple (one lookup,
+ * no tuple ambiguity), Envoy loop-prevention falls out of cgroup scoping
+ * (the proxy is not an enrolled cgroup) instead of SO_MARK, and verdicts
+ * ride an explicit action enum shared with userspace.
+ *
+ * Verifier notes: every map value pointer is null-checked before deref;
+ * no loops; event emission bounded by the per-cgroup window counter.
+ */
+#include "fw_helpers.h"
+#include "fw_maps.h"
+
+/* bpf_sock_addr / bpf_sock contexts: declared locally with just the
+ * fields these programs touch, in UAPI layout (uapi/linux/bpf.h).  Using
+ * local declarations keeps the build dependent only on linux/types.h. */
+struct bpf_sock_addr {
+	__u32 user_family;
+	__u32 user_ip4;      /* __be32 */
+	__u32 user_ip6[4];   /* __be32[4] */
+	__u32 user_port;     /* __be16 value in a __u32 slot */
+	__u32 family;
+	__u32 type;
+	__u32 protocol;
+	__u32 msg_src_ip4;
+	__u32 msg_src_ip6[4];
+};
+
+struct bpf_sock {
+	__u32 bound_dev_if;
+	__u32 family;
+	__u32 type;
+	__u32 protocol;
+};
+
+#define FW_OK   1
+#define FW_EPERM 0
+
+/* ------------------------------------------------------------------ maps */
+
+struct {
+	__uint(type, BPF_MAP_TYPE_HASH);
+	__uint(max_entries, FW_CONTAINERS_MAX);
+	__type(key, __u64);                /* cgroup id */
+	__type(value, struct fw_container);
+} containers SEC(".maps");
+
+struct {
+	__uint(type, BPF_MAP_TYPE_HASH);
+	__uint(max_entries, FW_CONTAINERS_MAX);
+	__type(key, __u64);                /* cgroup id */
+	__type(value, __u64);              /* bypass deadline (unix) */
+} bypass SEC(".maps");
+
+struct {
+	__uint(type, BPF_MAP_TYPE_LRU_HASH);
+	__uint(max_entries, FW_DNS_MAX);
+	__type(key, __be32);               /* resolved ip */
+	__type(value, struct fw_dns);
+} dns_cache SEC(".maps");
+
+struct {
+	__uint(type, BPF_MAP_TYPE_HASH);
+	__uint(max_entries, FW_ROUTES_MAX);
+	__type(key, struct fw_route_key);
+	__type(value, struct fw_route);
+} routes SEC(".maps");
+
+struct {
+	__uint(type, BPF_MAP_TYPE_LRU_HASH);
+	__uint(max_entries, FW_UDP_FLOWS_MAX);
+	__type(key, __u64);                /* socket cookie */
+	__type(value, struct fw_udp_flow);
+} udp_flows SEC(".maps");
+
+/* TCP connect-redirect originals live in their own LRU so proxy-bound
+ * TCP churn can never evict a live UDP reverse-NAT entry. */
+struct {
+	__uint(type, BPF_MAP_TYPE_LRU_HASH);
+	__uint(max_entries, FW_UDP_FLOWS_MAX);
+	__type(key, __u64);                /* socket cookie */
+	__type(value, struct fw_udp_flow);
+} tcp_flows SEC(".maps");
+
+struct {
+	__uint(type, BPF_MAP_TYPE_RINGBUF);
+	__uint(max_entries, FW_EVENTS_RING_SZ);
+} events SEC(".maps");
+
+struct {
+	__uint(type, BPF_MAP_TYPE_LRU_HASH);
+	__uint(max_entries, FW_CONTAINERS_MAX);
+	__type(key, __u64);                /* cgroup id */
+	__type(value, struct fw_rl);
+} ratelimit SEC(".maps");
+
+/* ----------------------------------------------------------------- events */
+
+static __always_inline int fw_rl_admit(__u64 cg)
+{
+	__u64 now = bpf_ktime_get_ns();
+	struct fw_rl *rl = bpf_map_lookup_elem(&ratelimit, &cg);
+
+	if (!rl) {
+		struct fw_rl fresh = { .window_start_ns = now, .count = 1, .pad = 0 };
+		bpf_map_update_elem(&ratelimit, &cg, &fresh, BPF_ANY);
+		return 1;
+	}
+	if (now - rl->window_start_ns > FW_RL_WINDOW_NS) {
+		rl->window_start_ns = now;  /* racy reset: approximate is fine */
+		rl->count = 1;
+		return 1;
+	}
+	if (rl->count >= FW_RL_BURST)
+		return 0;
+	rl->count++;
+	return 1;
+}
+
+static __always_inline void fw_emit(__u64 cg, __be32 dst, __be16 dport,
+				    __u8 proto, const struct fw_verdict *v)
+{
+	struct fw_event *ev;
+
+	if (!fw_rl_admit(cg))
+		return;
+	ev = bpf_ringbuf_reserve(&events, sizeof(*ev), 0);
+	if (!ev)
+		return;
+	ev->ts_ns = bpf_ktime_get_ns();
+	ev->cgroup_id = cg;
+	ev->zone_hash = v->zone_hash;
+	ev->dst_ip = dst;
+	ev->dst_port = dport;
+	ev->verdict = v->action;
+	ev->proto = proto;
+	ev->reason = v->reason;
+	ev->pad[0] = ev->pad[1] = ev->pad[2] = 0;
+	ev->pad[3] = ev->pad[4] = ev->pad[5] = ev->pad[6] = 0;
+	bpf_ringbuf_submit(ev, 0);
+}
+
+/* ---------------------------------------------------------------- decide */
+
+/* policy.py decide(), step for step.  Returns 0 when the cgroup is not
+ * enrolled (caller passes through untouched); fills *v otherwise. */
+static __always_inline int fw_decide(const struct fw_container *pol, __u64 cg,
+				     __be32 dst, __be16 dport, __u8 proto,
+				     struct fw_verdict *v)
+{
+	struct fw_dns *dns;
+	struct fw_route *rt;
+	struct fw_route_key rk;
+
+	v->zone_hash = 0;
+	v->redirect_ip = 0;
+	v->redirect_port = 0;
+
+	/* 2. bypass (dead-man entry present -> allow everything, logged) */
+	if (bpf_map_lookup_elem(&bypass, &cg)) {
+		v->action = FW_ALLOW;
+		v->reason = FW_R_BYPASS;
+		fw_emit(cg, dst, dport, proto, v);
+		return 1;
+	}
+
+	/* 3. loopback: first octet 127 (be32 low byte on little-endian) */
+	if ((dst & 0xff) == 127) {
+		v->action = FW_ALLOW;
+		v->reason = FW_R_LOOPBACK;
+		return 1;
+	}
+
+	/* 4. all DNS flows terminate at our gate */
+	if (dport == fw_htons(53)) {
+		if (dst == pol->dns_ip) {
+			v->action = FW_ALLOW;
+			v->reason = FW_R_DNS;
+			return 1;
+		}
+		v->action = FW_REDIRECT_DNS;
+		v->reason = FW_R_DNS;
+		v->redirect_ip = pol->dns_ip;
+		v->redirect_port = fw_htons(53);
+		fw_emit(cg, dst, dport, proto, v);
+		return 1;
+	}
+
+	/* 5. the proxy itself */
+	if (dst == pol->envoy_ip) {
+		v->action = FW_ALLOW;
+		v->reason = FW_R_ENVOY;
+		return 1;
+	}
+
+	/* 6. host side-channel (browser-open / OAuth / git-cred) */
+	if ((pol->flags & FW_F_HOSTPROXY) && dst == pol->hostproxy_ip &&
+	    dport == pol->hostproxy_port) {
+		v->action = FW_ALLOW;
+		v->reason = FW_R_HOSTPROXY;
+		return 1;
+	}
+
+	/* 7. ip-literal egress: no resolution through the gate -> deny */
+	dns = bpf_map_lookup_elem(&dns_cache, &dst);
+	if (!dns) {
+		v->action = (pol->flags & FW_F_ENFORCE) ? FW_DENY : FW_ALLOW;
+		v->reason = (pol->flags & FW_F_ENFORCE) ? FW_R_NO_DNS_ENTRY
+						       : FW_R_MONITOR;
+		fw_emit(cg, dst, dport, proto, v);
+		return 1;
+	}
+	v->zone_hash = dns->zone_hash;
+
+	/* 8. zone route: exact port first, then any-port */
+	rk.zone_hash = dns->zone_hash;
+	rk.port = dport;
+	rk.proto = proto;
+	rk.pad = 0;
+	rt = bpf_map_lookup_elem(&routes, &rk);
+	if (!rt) {
+		rk.port = 0;
+		rt = bpf_map_lookup_elem(&routes, &rk);
+	}
+	if (!rt) {
+		/* 9. resolved zone, but proto/port not ruled */
+		v->action = (pol->flags & FW_F_ENFORCE) ? FW_DENY : FW_ALLOW;
+		v->reason = (pol->flags & FW_F_ENFORCE) ? FW_R_NO_ROUTE
+						       : FW_R_MONITOR;
+		fw_emit(cg, dst, dport, proto, v);
+		return 1;
+	}
+
+	v->action = rt->action;
+	v->reason = FW_R_ROUTE;
+	v->redirect_ip = rt->redirect_ip;
+	v->redirect_port = rt->redirect_port;
+	fw_emit(cg, dst, dport, proto, v);
+	return 1;
+}
+
+/* Record the app's intended destination so recvmsg/getpeername can
+ * reverse the rewrite (policy.py connect4/sendmsg4 flow recording). */
+static __always_inline void fw_note_flow(void *ctx, __be32 dst, __be16 dport,
+					 __u8 proto)
+{
+	__u64 cookie = bpf_get_socket_cookie(ctx);
+	struct fw_udp_flow f = { .orig_ip = dst, .orig_port = dport,
+				 .pad = { 0, 0 } };
+
+	if (!cookie)
+		return;
+	if (proto == FW_PROTO_UDP)
+		bpf_map_update_elem(&udp_flows, &cookie, &f, BPF_ANY);
+	else
+		bpf_map_update_elem(&tcp_flows, &cookie, &f, BPF_ANY);
+}
+
+/* Shared v4 egress path for connect4/sendmsg4. */
+static __always_inline int fw_egress4(struct bpf_sock_addr *ctx, __u8 proto)
+{
+	__u64 cg = bpf_get_current_cgroup_id();
+	struct fw_container *pol;
+	struct fw_verdict v;
+	__be32 dst = ctx->user_ip4;
+	__be16 dport = (__be16)ctx->user_port;
+
+	/* 1. not enrolled -> never interfere */
+	pol = bpf_map_lookup_elem(&containers, &cg);
+	if (!pol)
+		return FW_OK;
+	fw_decide(pol, cg, dst, dport, proto, &v);
+	switch (v.action) {
+	case FW_ALLOW:
+		return FW_OK;
+	case FW_REDIRECT:
+	case FW_REDIRECT_DNS:
+		fw_note_flow(ctx, dst, dport, proto);
+		ctx->user_ip4 = v.redirect_ip;
+		ctx->user_port = (__u32)v.redirect_port;
+		return FW_OK;
+	default:
+		return FW_EPERM;
+	}
+}
+
+SEC("cgroup/connect4")
+int fw_connect4(struct bpf_sock_addr *ctx)
+{
+	__u8 proto = (ctx->protocol == FW_PROTO_UDP) ? FW_PROTO_UDP
+						      : FW_PROTO_TCP;
+	return fw_egress4(ctx, proto);
+}
+
+SEC("cgroup/sendmsg4")
+int fw_sendmsg4(struct bpf_sock_addr *ctx)
+{
+	return fw_egress4(ctx, FW_PROTO_UDP);
+}
+
+/* Reverse-NAT: a reply whose source is our gate/proxy is presented as
+ * coming from the destination the app originally addressed.  recvmsg
+ * consults only udp_flows; getpeername also covers redirected TCP
+ * connects via tcp_flows (policy.py recvmsg4/getpeername4). */
+static __always_inline int fw_ingress_rewrite4(struct bpf_sock_addr *ctx,
+					       int include_tcp)
+{
+	__u64 cg = bpf_get_current_cgroup_id();
+	struct fw_container *pol;
+	struct fw_udp_flow *f;
+	__u64 cookie;
+
+	pol = bpf_map_lookup_elem(&containers, &cg);
+	if (!pol)
+		return FW_OK;
+	cookie = bpf_get_socket_cookie(ctx);
+	if (!cookie)
+		return FW_OK;
+	f = bpf_map_lookup_elem(&udp_flows, &cookie);
+	if (!f && include_tcp)
+		f = bpf_map_lookup_elem(&tcp_flows, &cookie);
+	if (!f)
+		return FW_OK;
+	if (ctx->user_ip4 == pol->dns_ip || ctx->user_ip4 == pol->envoy_ip) {
+		ctx->user_ip4 = f->orig_ip;
+		ctx->user_port = (__u32)f->orig_port;
+	}
+	return FW_OK;
+}
+
+SEC("cgroup/recvmsg4")
+int fw_recvmsg4(struct bpf_sock_addr *ctx)
+{
+	return fw_ingress_rewrite4(ctx, 0);
+}
+
+SEC("cgroup/getpeername4")
+int fw_getpeername4(struct bpf_sock_addr *ctx)
+{
+	return fw_ingress_rewrite4(ctx, 1);
+}
+
+/* ------------------------------------------------------------------ IPv6 */
+
+/* ::ffff:a.b.c.d prefix word (bytes 00 00 ff ff as a be32 load) */
+#define FW_V4MAPPED ((__u32)__builtin_bswap32(0x0000ffffu))
+
+static __always_inline int fw_is_v4mapped(const __u32 ip6[4])
+{
+	return ip6[0] == 0 && ip6[1] == 0 && ip6[2] == FW_V4MAPPED;
+}
+
+static __always_inline int fw_is_v6_loopback(const __u32 ip6[4])
+{
+	return ip6[0] == 0 && ip6[1] == 0 && ip6[2] == 0 &&
+	       ip6[3] == (__u32)__builtin_bswap32(1u);
+}
+
+/* policy.py connect6: v4-mapped routes through the v4 decision (rewrite
+ * stays inside the mapped form); native v6 is denied -- the sandbox data
+ * plane is v4-only, so v6 would be an enforcement hole. */
+static __always_inline int fw_egress6(struct bpf_sock_addr *ctx, __u8 proto)
+{
+	__u64 cg = bpf_get_current_cgroup_id();
+	struct fw_container *pol;
+	struct fw_verdict v;
+	__be32 dst4;
+	__be16 dport = (__be16)ctx->user_port;
+
+	pol = bpf_map_lookup_elem(&containers, &cg);
+	if (!pol)
+		return FW_OK;
+	/* break-glass bypass must open v6 too (policy.py connect6) */
+	if (bpf_map_lookup_elem(&bypass, &cg)) {
+		v.action = FW_ALLOW;
+		v.reason = FW_R_BYPASS;
+		v.zone_hash = 0;
+		v.redirect_ip = 0;
+		v.redirect_port = 0;
+		fw_emit(cg, 0, dport, proto, &v);
+		return FW_OK;
+	}
+	if (fw_is_v6_loopback(ctx->user_ip6))
+		return FW_OK;
+	if (!fw_is_v4mapped(ctx->user_ip6)) {
+		v.action = FW_DENY;
+		v.reason = FW_R_IPV6;
+		v.zone_hash = 0;
+		v.redirect_ip = 0;
+		v.redirect_port = 0;
+		fw_emit(cg, 0, dport, proto, &v);
+		return FW_EPERM;
+	}
+	dst4 = ctx->user_ip6[3];
+	fw_decide(pol, cg, dst4, dport, proto, &v);
+	switch (v.action) {
+	case FW_ALLOW:
+		return FW_OK;
+	case FW_REDIRECT:
+	case FW_REDIRECT_DNS:
+		fw_note_flow(ctx, dst4, dport, proto);
+		ctx->user_ip6[3] = v.redirect_ip;
+		ctx->user_port = (__u32)v.redirect_port;
+		return FW_OK;
+	default:
+		return FW_EPERM;
+	}
+}
+
+SEC("cgroup/connect6")
+int fw_connect6(struct bpf_sock_addr *ctx)
+{
+	__u8 proto = (ctx->protocol == FW_PROTO_UDP) ? FW_PROTO_UDP
+						      : FW_PROTO_TCP;
+	return fw_egress6(ctx, proto);
+}
+
+SEC("cgroup/sendmsg6")
+int fw_sendmsg6(struct bpf_sock_addr *ctx)
+{
+	return fw_egress6(ctx, FW_PROTO_UDP);
+}
+
+static __always_inline int fw_ingress_rewrite6(struct bpf_sock_addr *ctx,
+					       int include_tcp)
+{
+	__u64 cg = bpf_get_current_cgroup_id();
+	struct fw_container *pol;
+	struct fw_udp_flow *f;
+	__u64 cookie;
+
+	pol = bpf_map_lookup_elem(&containers, &cg);
+	if (!pol)
+		return FW_OK;
+	if (!fw_is_v4mapped(ctx->user_ip6))
+		return FW_OK;
+	cookie = bpf_get_socket_cookie(ctx);
+	if (!cookie)
+		return FW_OK;
+	f = bpf_map_lookup_elem(&udp_flows, &cookie);
+	if (!f && include_tcp)
+		f = bpf_map_lookup_elem(&tcp_flows, &cookie);
+	if (!f)
+		return FW_OK;
+	if (ctx->user_ip6[3] == pol->dns_ip || ctx->user_ip6[3] == pol->envoy_ip) {
+		ctx->user_ip6[3] = f->orig_ip;
+		ctx->user_port = (__u32)f->orig_port;
+	}
+	return FW_OK;
+}
+
+SEC("cgroup/recvmsg6")
+int fw_recvmsg6(struct bpf_sock_addr *ctx)
+{
+	return fw_ingress_rewrite6(ctx, 0);
+}
+
+SEC("cgroup/getpeername6")
+int fw_getpeername6(struct bpf_sock_addr *ctx)
+{
+	return fw_ingress_rewrite6(ctx, 1);
+}
+
+/* ------------------------------------------------------------ sock_create */
+
+#define FW_SOCK_RAW    3
+#define FW_SOCK_PACKET 10
+
+/* policy.py sock_create: raw/packet sockets denied for enrolled cgroups
+ * (blocks ICMP ping exfil and packet crafting; reference e2e
+ * firewall_test.go:103). */
+SEC("cgroup/sock_create")
+int fw_sock_create(struct bpf_sock *ctx)
+{
+	__u64 cg = bpf_get_current_cgroup_id();
+	struct fw_verdict v;
+
+	if (!bpf_map_lookup_elem(&containers, &cg))
+		return FW_OK;
+	if (bpf_map_lookup_elem(&bypass, &cg))
+		return FW_OK;
+	if (ctx->type == FW_SOCK_RAW || ctx->type == FW_SOCK_PACKET) {
+		v.action = FW_DENY;
+		v.reason = FW_R_RAW_SOCKET;
+		v.zone_hash = 0;
+		v.redirect_ip = 0;
+		v.redirect_port = 0;
+		fw_emit(cg, 0, 0, 0, &v);
+		return FW_EPERM;
+	}
+	return FW_OK;
+}

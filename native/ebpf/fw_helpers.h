@@ -1,0 +1,53 @@
+/* fw_helpers.h - minimal self-contained BPF program scaffolding.
+ *
+ * First-party replacement for libbpf's bpf_helpers.h so fw.c builds with
+ * nothing but clang and the kernel UAPI headers (the TPU-VM provisioning
+ * container has clang; it does not need libbpf-dev to build the programs,
+ * only to build the fwctl loader).  Helper IDs are the stable UAPI
+ * numbers from uapi/linux/bpf.h.
+ *
+ * The same header compiles under the host compiler (gcc -fsyntax-only)
+ * for the repo-local syntax gate, where no BPF backend exists.
+ */
+#ifndef CLAWKER_FW_HELPERS_H
+#define CLAWKER_FW_HELPERS_H
+
+#include <linux/types.h>
+
+#define SEC(name) __attribute__((section(name), used))
+
+#ifndef __always_inline
+#define __always_inline inline __attribute__((always_inline))
+#endif
+
+/* BTF-style map definition keywords (the libbpf convention, re-declared) */
+#define __uint(name, val) int (*name)[val]
+#define __type(name, val) typeof(val) *name
+
+/* map types used here (uapi enum bpf_map_type) */
+#define BPF_MAP_TYPE_HASH     1
+#define BPF_MAP_TYPE_LRU_HASH 9
+#define BPF_MAP_TYPE_RINGBUF  27
+
+/* bpf_map_update_elem flags */
+#define BPF_ANY 0
+
+/* helpers by stable UAPI id */
+static void *(*bpf_map_lookup_elem)(void *map, const void *key) = (void *)1;
+static long (*bpf_map_update_elem)(void *map, const void *key, const void *value,
+				   __u64 flags) = (void *)2;
+static long (*bpf_map_delete_elem)(void *map, const void *key) = (void *)3;
+static __u64 (*bpf_ktime_get_ns)(void) = (void *)5;
+static __u64 (*bpf_get_socket_cookie)(void *ctx) = (void *)46;
+static __u64 (*bpf_get_current_cgroup_id)(void) = (void *)80;
+static void *(*bpf_ringbuf_reserve)(void *ringbuf, __u64 size, __u64 flags) = (void *)131;
+static void (*bpf_ringbuf_submit)(void *data, __u64 flags) = (void *)132;
+static void (*bpf_ringbuf_discard)(void *data, __u64 flags) = (void *)133;
+
+/* byte-order (constant-foldable) */
+#define fw_htons(x) ((__be16)__builtin_bswap16((__u16)(x)))
+#define fw_ntohs(x) ((__u16)__builtin_bswap16((__u16)(x)))
+
+static const char _license[] SEC("license") = "GPL";
+
+#endif /* CLAWKER_FW_HELPERS_H */

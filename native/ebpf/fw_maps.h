@@ -1,0 +1,132 @@
+/* fw_maps.h - kernel/userspace ABI for the clawker-tpu egress firewall.
+ *
+ * The Python twin of every struct lives in clawker_tpu/firewall/model.py
+ * (pack formats in the class FMT strings); tests/test_ebpf_abi.py compiles
+ * this header with the host compiler and pins sizeof/offsetof against the
+ * Python side, so the two cannot drift.
+ *
+ * Layout convention: IPv4 addresses and L4 ports are stored in NETWORK
+ * byte order exactly as bpf_sock_addr presents them (user_ip4 is __be32,
+ * user_port holds a __be16), so the programs compare and rewrite without
+ * byte swapping.
+ *
+ * Parity reference: controlplane/firewall/ebpf/bpf/common.h defines the
+ * reference's map set (container_map/bypass_map/dns_cache/route_map/
+ * udp_flow_map/events_ringbuf + rate limiting).  This ABI is re-designed:
+ * reverse-NAT is keyed by socket cookie rather than a flow tuple, and the
+ * route table carries an explicit action + redirect target.
+ */
+#ifndef CLAWKER_FW_MAPS_H
+#define CLAWKER_FW_MAPS_H
+
+#include <linux/types.h>
+
+/* route_val.action / event.verdict (model.py Action) */
+#define FW_ALLOW        0
+#define FW_DENY         1
+#define FW_REDIRECT     2
+#define FW_REDIRECT_DNS 3
+
+/* event.reason (model.py Reason) */
+#define FW_R_UNMANAGED    0
+#define FW_R_BYPASS       1
+#define FW_R_LOOPBACK     2
+#define FW_R_DNS          3
+#define FW_R_ENVOY        4
+#define FW_R_HOSTPROXY    5
+#define FW_R_ROUTE        6
+#define FW_R_NO_ROUTE     7
+#define FW_R_NO_DNS_ENTRY 8
+#define FW_R_RAW_SOCKET   9
+#define FW_R_IPV6         10
+#define FW_R_MONITOR      11
+
+/* fw_container.flags (model.py FLAG_*) */
+#define FW_F_ENFORCE   (1u << 0)
+#define FW_F_HOSTPROXY (1u << 1)
+
+#define FW_PROTO_TCP 6
+#define FW_PROTO_UDP 17
+
+/* map capacities (maps.py UDP_FLOWS_MAX; ring sized for event bursts) */
+#define FW_CONTAINERS_MAX 1024
+#define FW_DNS_MAX        65536
+#define FW_ROUTES_MAX     16384
+#define FW_UDP_FLOWS_MAX  4096
+#define FW_EVENTS_RING_SZ (1 << 19)
+
+/* event rate limit: per-cgroup token window (common.h:443 analogue,
+ * simplified to a windowed counter - approximate under races, which is
+ * acceptable for telemetry) */
+#define FW_RL_WINDOW_NS  100000000ull /* 100ms */
+#define FW_RL_BURST      64
+
+/* containers value - model.py ContainerPolicy, 20 bytes */
+struct fw_container {
+	__be32 envoy_ip;
+	__be32 dns_ip;
+	__be32 hostproxy_ip;
+	__be16 hostproxy_port;
+	__u16  pad;
+	__u32  flags;
+};
+
+/* dns_cache value (key = __be32 resolved ip) - model.py DnsEntry, 16 bytes */
+struct fw_dns {
+	__u64 zone_hash;
+	__u64 expires_unix;
+};
+
+/* routes key - model.py RouteKey, 12 bytes (packed: u64 head would pad to 16) */
+struct fw_route_key {
+	__u64  zone_hash;
+	__be16 port;   /* 0 = any port */
+	__u8   proto;  /* FW_PROTO_TCP | FW_PROTO_UDP */
+	__u8   pad;
+} __attribute__((packed));
+
+/* routes value - model.py RouteVal, 8 bytes */
+struct fw_route {
+	__u8   action;
+	__u8   pad;
+	__be16 redirect_port;
+	__be32 redirect_ip;
+};
+
+/* udp_flows value (key = u64 socket cookie) - model.py UdpFlow, 8 bytes */
+struct fw_udp_flow {
+	__be32 orig_ip;
+	__be16 orig_port;
+	__u8   pad[2];
+};
+
+/* events ringbuf record - model.py EgressEvent, 40 bytes */
+struct fw_event {
+	__u64  ts_ns;
+	__u64  cgroup_id;
+	__u64  zone_hash;
+	__be32 dst_ip;
+	__be16 dst_port;
+	__u8   verdict;
+	__u8   proto;
+	__u8   reason;
+	__u8   pad[7];
+};
+
+/* rate-limit state (kernel-internal, not part of the Python ABI) */
+struct fw_rl {
+	__u64 window_start_ns;
+	__u32 count;
+	__u32 pad;
+};
+
+/* the decision a program acts on (kernel-internal) */
+struct fw_verdict {
+	__u8   action;
+	__u8   reason;
+	__be16 redirect_port;
+	__be32 redirect_ip;
+	__u64  zone_hash;
+};
+
+#endif /* CLAWKER_FW_MAPS_H */

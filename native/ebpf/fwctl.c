@@ -1,0 +1,328 @@
+/* fwctl - load/attach/inspect the clawker-tpu egress firewall.
+ *
+ * The one component that needs libbpf (ELF load + relocation); everything
+ * else in userspace reaches the PINNED maps via raw bpf(2) from Python
+ * (clawker_tpu/firewall/bpfsys.py).  Built on the target TPU-VM host by
+ * the provisioning step (`make fwctl`), where clang + libbpf-dev are
+ * installed; never needed on the operator laptop.
+ *
+ *   fwctl load   --obj fw.o [--pin-dir DIR]     load + pin maps/progs
+ *   fwctl attach --cgroup PATH [--pin-dir DIR]  attach all 9 to a cgroup
+ *   fwctl detach --cgroup PATH [--pin-dir DIR]
+ *   fwctl events [--max N] [--follow] [--pin-dir DIR]   JSON lines
+ *   fwctl status [--pin-dir DIR]                map entry counts
+ *   fwctl unload [--pin-dir DIR]                unpin everything
+ *
+ * Parity reference: controlplane/firewall/ebpf/manager.go (Load :81,
+ * Install :605, Remove :656) and cmd/ebpf-manager break-glass CLI; this
+ * is the C equivalent driven over SSH by clawker_tpu/fleet provisioning.
+ */
+#include <errno.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <arpa/inet.h>
+
+#include <bpf/bpf.h>
+#include <bpf/libbpf.h>
+
+#include "fw_maps.h"
+
+#define DEFAULT_PIN_DIR "/sys/fs/bpf/clawker-tpu"
+
+static const struct {
+	const char *prog;
+	enum bpf_attach_type type;
+} ATTACHMENTS[] = {
+	{ "fw_connect4",     BPF_CGROUP_INET4_CONNECT },
+	{ "fw_connect6",     BPF_CGROUP_INET6_CONNECT },
+	{ "fw_sendmsg4",     BPF_CGROUP_UDP4_SENDMSG },
+	{ "fw_sendmsg6",     BPF_CGROUP_UDP6_SENDMSG },
+	{ "fw_recvmsg4",     BPF_CGROUP_UDP4_RECVMSG },
+	{ "fw_recvmsg6",     BPF_CGROUP_UDP6_RECVMSG },
+	{ "fw_getpeername4", BPF_CGROUP_INET4_GETPEERNAME },
+	{ "fw_getpeername6", BPF_CGROUP_INET6_GETPEERNAME },
+	{ "fw_sock_create",  BPF_CGROUP_INET_SOCK_CREATE },
+};
+#define N_ATTACH (sizeof(ATTACHMENTS) / sizeof(ATTACHMENTS[0]))
+
+/* must mirror clawker_tpu/firewall/maps.py ALL_MAPS (pinned by
+ * tests/test_ebpf_abi.py) */
+static const char *MAPS[] = { "containers", "bypass", "dns_cache", "routes",
+			      "udp_flows", "tcp_flows", "events", "ratelimit" };
+#define N_MAPS (sizeof(MAPS) / sizeof(MAPS[0]))
+
+static int die(const char *what)
+{
+	fprintf(stderr, "fwctl: %s: %s\n", what, strerror(errno));
+	return 1;
+}
+
+static void pin_path(char *buf, size_t n, const char *dir, const char *kind,
+		     const char *name)
+{
+	if (kind)
+		snprintf(buf, n, "%s/%s/%s", dir, kind, name);
+	else
+		snprintf(buf, n, "%s/%s", dir, name);
+}
+
+/* ------------------------------------------------------------------ load */
+
+static int cmd_load(const char *obj_path, const char *pin_dir)
+{
+	struct bpf_object *obj;
+	struct bpf_program *prog;
+	struct bpf_map *map;
+	char path[512];
+
+	obj = bpf_object__open_file(obj_path, NULL);
+	if (!obj)
+		return die("open object");
+	if (bpf_object__load(obj))
+		return die("load object (verifier)");
+
+	/* maps pin flat under pin_dir (bpfsys.py opens <pin_dir>/<name>);
+	 * programs pin under pin_dir/progs/ */
+	bpf_object__for_each_map(map, obj) {
+		pin_path(path, sizeof(path), pin_dir, NULL, bpf_map__name(map));
+		unlink(path);
+		if (bpf_map__pin(map, path))
+			return die(path);
+	}
+	snprintf(path, sizeof(path), "%s/progs", pin_dir);
+	mkdir(path, 0755);
+	bpf_object__for_each_program(prog, obj) {
+		pin_path(path, sizeof(path), pin_dir, "progs",
+			 bpf_program__name(prog));
+		unlink(path);
+		if (bpf_program__pin(prog, path))
+			return die(path);
+	}
+	printf("loaded %s: %zu programs, %zu maps pinned under %s\n",
+	       obj_path, N_ATTACH, N_MAPS, pin_dir);
+	bpf_object__close(obj);
+	return 0;
+}
+
+/* --------------------------------------------------------- attach/detach */
+
+static int cmd_attach(const char *cgroup_path, const char *pin_dir, int detach)
+{
+	char path[512];
+	int cg_fd, prog_fd, err = 0;
+	size_t i;
+
+	if (!cgroup_path) {
+		fprintf(stderr, "fwctl: --cgroup PATH required\n");
+		return 2;
+	}
+	cg_fd = open(cgroup_path, O_RDONLY | O_DIRECTORY);
+	if (cg_fd < 0)
+		return die(cgroup_path);
+	for (i = 0; i < N_ATTACH; i++) {
+		pin_path(path, sizeof(path), pin_dir, "progs",
+			 ATTACHMENTS[i].prog);
+		prog_fd = bpf_obj_get(path);
+		if (prog_fd < 0) {
+			fprintf(stderr, "fwctl: %s not pinned (run load)\n", path);
+			err = 1;
+			continue;
+		}
+		if (detach) {
+			/* ignore ENOENT: program may not be attached */
+			bpf_prog_detach2(prog_fd, cg_fd, ATTACHMENTS[i].type);
+		} else if (bpf_prog_attach(prog_fd, cg_fd, ATTACHMENTS[i].type,
+					   BPF_F_ALLOW_MULTI)) {
+			fprintf(stderr, "fwctl: attach %s: %s\n",
+				ATTACHMENTS[i].prog, strerror(errno));
+			err = 1;
+		}
+		close(prog_fd);
+	}
+	close(cg_fd);
+	if (!err)
+		printf("%s %zu programs %s %s\n",
+		       detach ? "detached" : "attached", N_ATTACH,
+		       detach ? "from" : "to", cgroup_path);
+	return err;
+}
+
+/* ---------------------------------------------------------------- events */
+
+static volatile sig_atomic_t stop_flag;
+static long events_left = -1;
+
+static void on_sigint(int sig)
+{
+	(void)sig;
+	stop_flag = 1;
+}
+
+static int on_event(void *ctx, void *data, size_t len)
+{
+	const struct fw_event *ev = data;
+	char ip[INET_ADDRSTRLEN];
+	struct in_addr a;
+
+	(void)ctx;
+	if (len < sizeof(*ev))
+		return 0;
+	a.s_addr = ev->dst_ip;
+	inet_ntop(AF_INET, &a, ip, sizeof(ip));
+	printf("{\"ts_ns\":%llu,\"cgroup\":%llu,\"zone\":%llu,"
+	       "\"dst_ip\":\"%s\",\"dst_port\":%u,\"verdict\":%u,"
+	       "\"proto\":%u,\"reason\":%u}\n",
+	       (unsigned long long)ev->ts_ns,
+	       (unsigned long long)ev->cgroup_id,
+	       (unsigned long long)ev->zone_hash,
+	       ip, ntohs(ev->dst_port), ev->verdict, ev->proto, ev->reason);
+	fflush(stdout);
+	if (events_left > 0 && --events_left == 0)
+		stop_flag = 1;
+	return 0;
+}
+
+static int cmd_events(const char *pin_dir, long max, int follow)
+{
+	struct ring_buffer *rb;
+	char path[512];
+	int map_fd;
+
+	pin_path(path, sizeof(path), pin_dir, NULL, "events");
+	map_fd = bpf_obj_get(path);
+	if (map_fd < 0)
+		return die(path);
+	events_left = max;
+	rb = ring_buffer__new(map_fd, on_event, NULL, NULL);
+	if (!rb)
+		return die("ring_buffer__new");
+	signal(SIGINT, on_sigint);
+	signal(SIGTERM, on_sigint);
+	while (!stop_flag) {
+		int n = ring_buffer__poll(rb, 200 /* ms */);
+		if (n < 0 && n != -EINTR)
+			break;
+		if (!follow && n == 0)
+			break;  /* --max drains what's there, then exits */
+	}
+	ring_buffer__free(rb);
+	close(map_fd);
+	return 0;
+}
+
+/* ---------------------------------------------------------------- status */
+
+static long map_count(const char *pin_dir, const char *name, size_t key_size)
+{
+	char path[512], key[64], next[64];
+	int fd;
+	long n = 0;
+
+	if (key_size > sizeof(key))
+		return -1;
+	pin_path(path, sizeof(path), pin_dir, NULL, name);
+	fd = bpf_obj_get(path);
+	if (fd < 0)
+		return -1;
+	if (bpf_map_get_next_key(fd, NULL, next) == 0) {
+		do {
+			n++;
+			memcpy(key, next, key_size);
+		} while (bpf_map_get_next_key(fd, key, next) == 0);
+	}
+	close(fd);
+	return n;
+}
+
+static int cmd_status(const char *pin_dir)
+{
+	printf("{\"pin_dir\":\"%s\",\"containers\":%ld,\"bypass\":%ld,"
+	       "\"dns_cache\":%ld,\"routes\":%ld,\"udp_flows\":%ld}\n",
+	       pin_dir,
+	       map_count(pin_dir, "containers", 8),
+	       map_count(pin_dir, "bypass", 8),
+	       map_count(pin_dir, "dns_cache", 4),
+	       map_count(pin_dir, "routes", sizeof(struct fw_route_key)),
+	       map_count(pin_dir, "udp_flows", 8));
+	return 0;
+}
+
+/* ---------------------------------------------------------------- unload */
+
+static int cmd_unload(const char *pin_dir)
+{
+	char path[512];
+	size_t i;
+
+	for (i = 0; i < N_MAPS; i++) {
+		pin_path(path, sizeof(path), pin_dir, NULL, MAPS[i]);
+		unlink(path);
+	}
+	for (i = 0; i < N_ATTACH; i++) {
+		pin_path(path, sizeof(path), pin_dir, "progs",
+			 ATTACHMENTS[i].prog);
+		unlink(path);
+	}
+	snprintf(path, sizeof(path), "%s/progs", pin_dir);
+	rmdir(path);
+	printf("unpinned %s\n", pin_dir);
+	return 0;
+}
+
+/* ------------------------------------------------------------------ main */
+
+static const char *flag(int argc, char **argv, const char *name,
+			const char *dflt)
+{
+	int i;
+
+	for (i = 2; i < argc - 1; i++)
+		if (strcmp(argv[i], name) == 0)
+			return argv[i + 1];
+	return dflt;
+}
+
+static int has_flag(int argc, char **argv, const char *name)
+{
+	int i;
+
+	for (i = 2; i < argc; i++)
+		if (strcmp(argv[i], name) == 0)
+			return 1;
+	return 0;
+}
+
+int main(int argc, char **argv)
+{
+	const char *pin_dir;
+
+	if (argc < 2) {
+		fprintf(stderr,
+			"usage: fwctl load|attach|detach|events|status|unload [flags]\n");
+		return 2;
+	}
+	pin_dir = flag(argc, argv, "--pin-dir", DEFAULT_PIN_DIR);
+	libbpf_set_strict_mode(LIBBPF_STRICT_ALL);
+
+	if (strcmp(argv[1], "load") == 0)
+		return cmd_load(flag(argc, argv, "--obj", "fw.o"), pin_dir);
+	if (strcmp(argv[1], "attach") == 0)
+		return cmd_attach(flag(argc, argv, "--cgroup", NULL), pin_dir, 0);
+	if (strcmp(argv[1], "detach") == 0)
+		return cmd_attach(flag(argc, argv, "--cgroup", NULL), pin_dir, 1);
+	if (strcmp(argv[1], "events") == 0)
+		return cmd_events(pin_dir,
+				  atol(flag(argc, argv, "--max", "-1")),
+				  has_flag(argc, argv, "--follow"));
+	if (strcmp(argv[1], "status") == 0)
+		return cmd_status(pin_dir);
+	if (strcmp(argv[1], "unload") == 0)
+		return cmd_unload(pin_dir);
+	fprintf(stderr, "fwctl: unknown command %s\n", argv[1]);
+	return 2;
+}

@@ -1,0 +1,156 @@
+"""Kernel<->userspace ABI lock-step: C structs vs Python model.
+
+Compiles native/ebpf/fw_maps.h with the host compiler and asserts
+sizeof/offsetof of every shared struct against the pack formats in
+clawker_tpu/firewall/model.py -- the C and Python sides of the map ABI
+cannot drift without failing here.  Also runs the fw.c host syntax gate
+so kernel-program breakage shows up in the unit suite, not first on a
+TPU-VM provisioning run.
+"""
+
+from __future__ import annotations
+
+import shutil
+import struct
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu.firewall.model import (
+    ContainerPolicy,
+    DnsEntry,
+    EgressEvent,
+    RouteKey,
+    RouteVal,
+    UdpFlow,
+)
+
+EBPF_DIR = Path(__file__).resolve().parent.parent / "native" / "ebpf"
+
+CC = shutil.which("cc") or shutil.which("gcc")
+pytestmark = pytest.mark.skipif(CC is None, reason="no host C compiler")
+
+HARNESS = r"""
+#include <stdio.h>
+#include <stddef.h>
+#include "fw_maps.h"
+#define S(name, ctype) printf(name " %zu\n", sizeof(struct ctype));
+#define O(name, ctype, field) printf(name " %zu\n", offsetof(struct ctype, field));
+int main(void) {
+    S("sizeof_container", fw_container)
+    S("sizeof_dns", fw_dns)
+    S("sizeof_route_key", fw_route_key)
+    S("sizeof_route", fw_route)
+    S("sizeof_udp_flow", fw_udp_flow)
+    S("sizeof_event", fw_event)
+    O("off_container_flags", fw_container, flags)
+    O("off_container_hp_port", fw_container, hostproxy_port)
+    O("off_route_key_proto", fw_route_key, proto)
+    O("off_route_redirect_ip", fw_route, redirect_ip)
+    O("off_event_zone", fw_event, zone_hash)
+    O("off_event_verdict", fw_event, verdict)
+    O("off_event_reason", fw_event, reason)
+    return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def c_layout(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("abi")
+    src = tmp / "abi.c"
+    src.write_text(HARNESS)
+    exe = tmp / "abi"
+    subprocess.run(
+        [CC, "-I", str(EBPF_DIR), "-o", str(exe), str(src)],
+        check=True, capture_output=True,
+    )
+    out = subprocess.run([str(exe)], check=True, capture_output=True, text=True)
+    return {
+        line.split()[0]: int(line.split()[1])
+        for line in out.stdout.splitlines() if line.strip()
+    }
+
+
+def test_struct_sizes_match(c_layout):
+    assert c_layout["sizeof_container"] == ContainerPolicy.SIZE
+    assert c_layout["sizeof_dns"] == DnsEntry.SIZE
+    assert c_layout["sizeof_route_key"] == RouteKey.SIZE
+    assert c_layout["sizeof_route"] == RouteVal.SIZE
+    assert c_layout["sizeof_udp_flow"] == UdpFlow.SIZE
+    assert c_layout["sizeof_event"] == EgressEvent.SIZE
+
+
+def test_field_offsets_match(c_layout):
+    """Offsets per the Python little-endian pack formats."""
+    # ContainerPolicy "<IIIHHI": flags after 3*u32 + 2*u16 = 16
+    assert c_layout["off_container_flags"] == struct.calcsize("<IIIHH")
+    assert c_layout["off_container_hp_port"] == struct.calcsize("<III")
+    # RouteKey "<QHBx": proto after u64 + u16 = 10
+    assert c_layout["off_route_key_proto"] == struct.calcsize("<QH")
+    # RouteVal "<BxHI": redirect_ip after u8+pad+u16 = 4
+    assert c_layout["off_route_redirect_ip"] == struct.calcsize("<BxH")
+    # EgressEvent "<QQQIHBBB7x"
+    assert c_layout["off_event_zone"] == struct.calcsize("<QQ")
+    assert c_layout["off_event_verdict"] == struct.calcsize("<QQQIH")
+    assert c_layout["off_event_reason"] == struct.calcsize("<QQQIHBB")
+
+
+def test_fw_c_host_syntax_gate():
+    res = subprocess.run(
+        ["make", "-C", str(EBPF_DIR), "check"], capture_output=True, text=True
+    )
+    assert res.returncode == 0, res.stderr
+
+
+def test_fwctl_map_list_matches_all_maps():
+    """fwctl.c MAPS[] must mirror maps.py ALL_MAPS (unload/status cover
+    the whole pinned set)."""
+    from clawker_tpu.firewall.maps import ALL_MAPS
+
+    text = (EBPF_DIR / "fwctl.c").read_text()
+    start = text.index("MAPS[] = {")
+    names = []
+    for chunk in text[start:text.index("}", start)].split('"')[1::2]:
+        names.append(chunk)
+    assert tuple(names) == ALL_MAPS
+
+
+def test_fw_c_defines_every_map():
+    """Every pinned map name exists as a SEC(".maps") symbol in fw.c."""
+    from clawker_tpu.firewall.maps import ALL_MAPS
+
+    text = (EBPF_DIR / "fw.c").read_text()
+    for name in ALL_MAPS:
+        assert f'}} {name} SEC(".maps")' in text, name
+
+
+def test_action_reason_constants_match():
+    """fw_maps.h #defines vs model enums, parsed textually."""
+    from clawker_tpu.firewall.model import Action, Reason
+
+    text = (EBPF_DIR / "fw_maps.h").read_text()
+
+    def defined(name: str) -> int:
+        for line in text.splitlines():
+            parts = line.split()
+            if len(parts) >= 3 and parts[0] == "#define" and parts[1] == name:
+                return int(parts[2].rstrip("u").rstrip("l"), 0)
+        raise AssertionError(f"{name} not defined in fw_maps.h")
+
+    assert defined("FW_ALLOW") == Action.ALLOW
+    assert defined("FW_DENY") == Action.DENY
+    assert defined("FW_REDIRECT") == Action.REDIRECT
+    assert defined("FW_REDIRECT_DNS") == Action.REDIRECT_DNS
+    for reason in Reason:
+        cname = {
+            Reason.UNMANAGED: "FW_R_UNMANAGED", Reason.BYPASS: "FW_R_BYPASS",
+            Reason.LOOPBACK: "FW_R_LOOPBACK", Reason.DNS: "FW_R_DNS",
+            Reason.ENVOY: "FW_R_ENVOY", Reason.HOSTPROXY: "FW_R_HOSTPROXY",
+            Reason.ROUTE: "FW_R_ROUTE", Reason.NO_ROUTE: "FW_R_NO_ROUTE",
+            Reason.NO_DNS_ENTRY: "FW_R_NO_DNS_ENTRY",
+            Reason.RAW_SOCKET: "FW_R_RAW_SOCKET", Reason.IPV6: "FW_R_IPV6",
+            Reason.MONITOR: "FW_R_MONITOR",
+        }[reason]
+        assert defined(cname) == int(reason), cname
