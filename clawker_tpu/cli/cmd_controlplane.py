@@ -19,25 +19,10 @@ pass_factory = click.make_pass_decorator(Factory)
 
 
 def _admin_client(f: Factory):
-    from ..controlplane.adminapi import AdminClient, mint_admin_token
-    from ..firewall import pki
-
-    cfg = f.config
-    cert, key, ca_path = cfg.pki_dir / "cp.crt", cfg.pki_dir / "cp.key", cfg.pki_dir / "ca.crt"
-    if not (cert.exists() and key.exists() and ca_path.exists()):
-        # read commands must not mint fresh PKI the running CP would reject
-        raise click.ClickException(
-            "control-plane PKI not initialized (run `clawker controlplane up` first)"
-        )
-    ca = pki.ensure_ca(cfg.pki_dir)   # loads the existing CA, never re-mints here
-    return AdminClient(
-        "127.0.0.1",
-        cfg.settings.control_plane.admin_port,
-        cert_file=cert,
-        key_file=key,
-        ca_file=ca_path,
-        token=mint_admin_token(ca),
-    )
+    try:
+        return manager.admin_client(f.config)
+    except manager.ControlPlaneError as e:
+        raise click.ClickException(str(e)) from None
 
 
 @click.group("controlplane")

@@ -78,6 +78,7 @@ def register_commands() -> None:
         cmd_bundle,
         cmd_container,
         cmd_controlplane,
+        cmd_firewall,
         cmd_image,
         cmd_init,
         cmd_project,
@@ -88,6 +89,7 @@ def register_commands() -> None:
     cmd_bundle.register(cli)
     cmd_container.register(cli)
     cmd_controlplane.register(cli)
+    cmd_firewall.register(cli)
     cmd_image.register(cli)
     cmd_init.register(cli)
     cmd_project.register(cli)

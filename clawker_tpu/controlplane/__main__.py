@@ -22,6 +22,21 @@ def main() -> int:
     cfg = load_config()
     driver = get_driver(cfg.settings, override=os.environ.get("CLAWKER_TPU_DRIVER", ""))
     cp = cfg.settings.control_plane
+    firewall = None
+    if cfg.settings.firewall.enable:
+        # resilience contract: a failed enforcement build degrades the CP
+        # (verbs answer 501 -> agent starts fail loudly), never kills it
+        from ..firewall.runtime import build_handler
+
+        try:
+            firewall = build_handler(
+                cfg, driver.engine(),
+                monitor_fallback=not cfg.settings.firewall.default_deny,
+            )
+        except Exception as e:
+            import logging
+
+            logging.getLogger("cp").error("event=firewall_unavailable error=%s", e)
     daemon = ControlPlaneDaemon(
         CPConfig(
             pki_dir=cfg.pki_dir,
@@ -35,6 +50,7 @@ def main() -> int:
             drain_to_zero=cp.drain_to_zero,
         ),
         driver.engine(),
+        firewall=firewall,
     )
     return daemon.run_forever()
 

@@ -85,11 +85,13 @@ class Subsystems:
 
 
 class ControlPlaneDaemon:
-    def __init__(self, cfg: CPConfig, engine):
+    def __init__(self, cfg: CPConfig, engine, firewall=None):
         self.cfg = cfg
         self.engine = engine
+        self.firewall = firewall          # FirewallHandler | None
         self.subs = Subsystems()
         self._stop = threading.Event()
+        self._drained_to_zero = False
         self._healthz: ThreadingHTTPServer | None = None
         self._healthz_thread: threading.Thread | None = None
         self.health_bound_port = 0
@@ -120,6 +122,16 @@ class ControlPlaneDaemon:
         )
         admin.register("ListAgents", self._handle_list_agents)
         admin.register("Status", self._handle_status)
+        if self.firewall is not None:
+            # enforcement build (cmd.go:517 buildEnforcement): verbs only
+            # exist when the handler does -- absent = 501, fail-closed
+            self.firewall.register_on(admin)
+            try:
+                cleared = self.firewall.clear_expired_bypass()
+                if cleared:
+                    log.info("cleared %d stale bypass entries", cleared)
+            except Exception as e:
+                log.error("event=firewall_bypass_gc_failed error=%s", e)
         self.subs.agent_service, self.subs.admin = agent_service, admin
 
         # agent dialer (cmd.go:847 startAgentDialer)
@@ -140,7 +152,7 @@ class ControlPlaneDaemon:
             self.engine,
             interval_s=self.cfg.watch_interval_s,
             drain_grace_polls=self.cfg.drain_grace_polls,
-            on_drained=self.request_stop if self.cfg.drain_to_zero else None,
+            on_drained=self._on_drained_to_zero if self.cfg.drain_to_zero else None,
         )
         self.subs.watcher = watcher
 
@@ -242,6 +254,10 @@ class ControlPlaneDaemon:
     def request_stop(self) -> None:
         self._stop.set()
 
+    def _on_drained_to_zero(self) -> None:
+        self._drained_to_zero = True
+        self.request_stop()
+
     def wait(self) -> None:
         while not self._stop.is_set():
             self._stop.wait(1.0)
@@ -251,10 +267,19 @@ class ControlPlaneDaemon:
         s = self.subs
         log.info("drain: begin")
         for name, fn in (
+            # firewall action queue closes FIRST (ordering INV-B2-007):
+            # no mutation may land while listeners wind down
+            ("firewall_queue", lambda: self.firewall and self.firewall.close()),
             ("admin", lambda: s.admin and s.admin.stop()),
             ("agent_service", lambda: s.agent_service and s.agent_service.stop()),
             ("watcher", lambda: s.watcher and s.watcher.stop()),
             ("dialer", lambda: s.dialer and s.dialer.stop()),
+            # drain-to-zero (no agents left): tear the data plane down and
+            # flush maps; on any other exit the pinned maps keep enforcing
+            # the last rule set (fail-closed)
+            ("firewall_teardown",
+             lambda: self.firewall and self._drained_to_zero
+             and self.firewall.teardown()),
             ("feeder", lambda: s.feeder and s.feeder.stop()),
             ("registry", lambda: s.registry and s.registry.close()),
         ):

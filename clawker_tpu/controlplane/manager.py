@@ -154,3 +154,33 @@ def stop(cfg: Config) -> bool:
         _terminate(pid)
     _pidfile(cfg).unlink(missing_ok=True)
     return was
+
+
+def admin_client(cfg: Config, *, ensure_material: bool = False):
+    """The one place the CLI-side mTLS + bearer admin client is assembled
+    (cmd_controlplane, cmd_firewall and the run-path firewall hooks all
+    route through here so connection/token logic can't drift)."""
+    from ..firewall import pki
+    from .adminapi import AdminClient, mint_admin_token
+
+    cert = cfg.pki_dir / "cp.crt"
+    key = cfg.pki_dir / "cp.key"
+    ca_path = cfg.pki_dir / "ca.crt"
+    if not (cert.exists() and key.exists() and ca_path.exists()):
+        if not ensure_material:
+            # read paths must not mint fresh PKI a running CP would reject
+            raise ControlPlaneError(
+                "control-plane PKI not initialized (run `clawker controlplane up` first)"
+            )
+        from .daemon import ensure_cp_material
+
+        cert, key, ca_path = ensure_cp_material(cfg.pki_dir)
+    ca = pki.ensure_ca(cfg.pki_dir)  # loads the existing CA, never re-mints
+    return AdminClient(
+        "127.0.0.1",
+        cfg.settings.control_plane.admin_port,
+        cert_file=cert,
+        key_file=key,
+        ca_file=ca_path,
+        token=mint_admin_token(ca),
+    )

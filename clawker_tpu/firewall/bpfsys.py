@@ -229,18 +229,37 @@ class PinnedMaps(FirewallMaps):
         }
 
     # bypass ------------------------------------------------------------
+    # The Python API speaks unix seconds; the pinned map stores
+    # CLOCK_BOOTTIME ns so the kernel's fw_bypass_active can enforce the
+    # dead-man deadline itself (fail-closed even if every userspace
+    # process dies the moment after granting the bypass).
+
+    @staticmethod
+    def _boottime_ns() -> int:
+        return time.clock_gettime_ns(time.CLOCK_BOOTTIME)
+
+    def _unix_to_boot_ns(self, deadline_unix: float) -> int:
+        return self._boottime_ns() + int((deadline_unix - time.time()) * 1e9)
+
+    def _boot_ns_to_unix(self, deadline_boot_ns: int) -> int:
+        return int(time.time() + (deadline_boot_ns - self._boottime_ns()) / 1e9)
+
     def set_bypass(self, cgroup_id, deadline_unix):
-        self.bypass.update(struct.pack("<Q", cgroup_id), struct.pack("<Q", deadline_unix))
+        self.bypass.update(struct.pack("<Q", cgroup_id),
+                           struct.pack("<Q", self._unix_to_boot_ns(deadline_unix)))
 
     def clear_bypass(self, cgroup_id):
         self.bypass.delete(struct.pack("<Q", cgroup_id))
 
     def bypassed(self, cgroup_id):
-        return self.bypass.lookup(struct.pack("<Q", cgroup_id)) is not None
+        raw = self.bypass.lookup(struct.pack("<Q", cgroup_id))
+        if raw is None:
+            return False
+        return struct.unpack("<Q", raw)[0] > self._boottime_ns()
 
     def bypass_entries(self):
         return {
-            struct.unpack("<Q", k)[0]: struct.unpack("<Q", v)[0]
+            struct.unpack("<Q", k)[0]: self._boot_ns_to_unix(struct.unpack("<Q", v)[0])
             for k, v in self.bypass.items()
         }
 

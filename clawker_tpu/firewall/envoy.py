@@ -1,0 +1,323 @@
+"""Deterministic Envoy bootstrap generation for the egress proxy.
+
+The kernel rewrites allowed flows to Envoy listeners; this module turns
+the egress rule set into the matching proxy config:
+
+- TLS listener (:10000): TLS-inspector sniffs SNI.  Domains with path
+  rules get a MITM filter chain (terminate TLS with the per-domain cert
+  our CA signed, HTTP connection manager allowing only the ruled path
+  prefixes, re-encrypt upstream); plain domain allowances get an SNI
+  passthrough tcp_proxy chain.  No chain matches -> connection refused
+  (default deny).
+- HTTP rules share the sequential listener pool: a plain-HTTP listener
+  with Host-header routing per domain (the reference detects HTTP on
+  a dedicated lane too -- e2e firewall_test.go:709).
+- tcp rules get one sequential tcp_proxy listener each (:10001+); the
+  allocation is returned so policy.build_routes programs the kernel
+  with the same ports.
+
+Everything is emitted in sorted order so the same rule set always
+yields byte-identical YAML -- config drift is detected by hash.
+
+Parity reference: controlplane/firewall/envoy_config.go
+GenerateEnvoyConfig (+ envoy_{tls,tcp,http,upstream}.go): TLS listener
+:10000 w/ TLS Inspector, MITM chains for path rules, SNI passthrough,
+sequential TCP listeners, gRPC ALS.  Re-designed: listener allocation is
+returned as data for the kernel route sync, and access logs go to stdout
+JSON (scraped by the monitor pipeline) instead of a gRPC ALS service.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import yaml
+
+from .. import consts
+from ..config.schema import EgressRule
+
+
+@dataclass
+class EnvoyBundle:
+    """Rendered proxy config + the listener allocation the kernel needs."""
+
+    config_yaml: str
+    tcp_ports: dict[str, int] = field(default_factory=dict)  # rule.key() -> port
+    mitm_domains: list[str] = field(default_factory=list)    # need CA-signed certs
+
+
+def _cluster_name(domain: str, port: int) -> str:
+    return f"up_{domain.replace('.', '_').replace('*', 'w')}_{port}"
+
+
+def _cluster(domain: str, port: int, *, tls: bool) -> dict:
+    c = {
+        "name": _cluster_name(domain, port),
+        "type": "LOGICAL_DNS",
+        "dns_lookup_family": "V4_ONLY",
+        "connect_timeout": "10s",
+        "load_assignment": {
+            "cluster_name": _cluster_name(domain, port),
+            "endpoints": [{
+                "lb_endpoints": [{
+                    "endpoint": {
+                        "address": {
+                            "socket_address": {"address": domain, "port_value": port}
+                        }
+                    }
+                }]
+            }],
+        },
+    }
+    if tls:
+        c["transport_socket"] = {
+            "name": "envoy.transport_sockets.tls",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions.transport_sockets.tls.v3.UpstreamTlsContext",
+                "sni": domain,
+            },
+        }
+    return c
+
+
+def _access_log() -> list[dict]:
+    """JSON access log on stdout; the monitor pipeline ships container
+    stdout to the clawker-envoy index."""
+    return [{
+        "name": "envoy.access_loggers.stdout",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions.access_loggers.stream.v3.StdoutAccessLog",
+            "log_format": {
+                "json_format": {
+                    "ts": "%START_TIME%",
+                    "sni": "%REQUESTED_SERVER_NAME%",
+                    "authority": "%REQ(:AUTHORITY)%",
+                    "path": "%REQ(:PATH)%",
+                    "method": "%REQ(:METHOD)%",
+                    "code": "%RESPONSE_CODE%",
+                    "flags": "%RESPONSE_FLAGS%",
+                    "bytes_tx": "%BYTES_SENT%",
+                    "upstream": "%UPSTREAM_HOST%",
+                }
+            },
+        },
+    }]
+
+
+def _sni_names(domain: str) -> list[str]:
+    """filter_chain_match server_names for a rule dst."""
+    if domain.startswith("*."):
+        return [domain, domain[2:]]  # wildcard matches apex too (zone semantics)
+    return [domain]
+
+
+def _mitm_chain(rule: EgressRule, cert_dir: str) -> dict:
+    apex = rule.dst[2:] if rule.dst.startswith("*.") else rule.dst
+    routes = [
+        {
+            "match": {"prefix": p},
+            "route": {"cluster": _cluster_name(apex, rule.effective_port())},
+        }
+        for p in sorted(rule.paths)
+    ]
+    return {
+        "filter_chain_match": {"server_names": _sni_names(rule.dst)},
+        "transport_socket": {
+            "name": "envoy.transport_sockets.tls",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions.transport_sockets.tls.v3.DownstreamTlsContext",
+                "common_tls_context": {
+                    "tls_certificates": [{
+                        "certificate_chain": {"filename": f"{cert_dir}/{apex}.crt"},
+                        "private_key": {"filename": f"{cert_dir}/{apex}.key"},
+                    }]
+                },
+            },
+        },
+        "filters": [{
+            "name": "envoy.filters.network.http_connection_manager",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions.filters.network.http_connection_manager.v3.HttpConnectionManager",
+                "stat_prefix": f"mitm_{apex.replace('.', '_')}",
+                "access_log": _access_log(),
+                "http_filters": [{
+                    "name": "envoy.filters.http.router",
+                    "typed_config": {
+                        "@type": "type.googleapis.com/envoy.extensions.filters.http.router.v3.Router"
+                    },
+                }],
+                "route_config": {
+                    "name": f"paths_{apex.replace('.', '_')}",
+                    "virtual_hosts": [{
+                        "name": apex,
+                        "domains": ["*"],
+                        "routes": routes,
+                        # anything off the ruled prefixes: 403, logged
+                    }],
+                },
+            },
+        }],
+    }
+
+
+def _passthrough_chain(rule: EgressRule) -> dict:
+    apex = rule.dst[2:] if rule.dst.startswith("*.") else rule.dst
+    return {
+        "filter_chain_match": {"server_names": _sni_names(rule.dst)},
+        "filters": [{
+            "name": "envoy.filters.network.tcp_proxy",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions.filters.network.tcp_proxy.v3.TcpProxy",
+                "stat_prefix": f"sni_{apex.replace('.', '_')}",
+                "cluster": _cluster_name(apex, rule.effective_port()),
+                "access_log": _access_log(),
+            },
+        }],
+    }
+
+
+def _tcp_listener(rule: EgressRule, port: int) -> dict:
+    apex = rule.dst[2:] if rule.dst.startswith("*.") else rule.dst
+    return {
+        "name": f"tcp_{port}",
+        "address": {"socket_address": {"address": "0.0.0.0", "port_value": port}},
+        "filter_chains": [{
+            "filters": [{
+                "name": "envoy.filters.network.tcp_proxy",
+                "typed_config": {
+                    "@type": "type.googleapis.com/envoy.extensions.filters.network.tcp_proxy.v3.TcpProxy",
+                    "stat_prefix": f"tcp_{apex.replace('.', '_')}_{rule.effective_port()}",
+                    "cluster": _cluster_name(apex, rule.effective_port()),
+                    "access_log": _access_log(),
+                },
+            }]
+        }],
+    }
+
+
+def _http_listener(rules: list[EgressRule], port: int) -> dict:
+    """One plain-HTTP listener; Host-header routing across all http rules."""
+    vhosts = []
+    for rule in rules:
+        apex = rule.dst[2:] if rule.dst.startswith("*.") else rule.dst
+        domains = [apex, f"{apex}:*"]
+        if rule.dst.startswith("*."):
+            domains += [f"*.{apex}", f"*.{apex}:*"]
+        vhosts.append({
+            "name": f"http_{apex.replace('.', '_')}",
+            "domains": sorted(domains),
+            "routes": [{
+                "match": {"prefix": p},
+                "route": {"cluster": _cluster_name(apex, rule.effective_port())},
+            } for p in (sorted(rule.paths) or ["/"])],
+        })
+    return {
+        "name": f"http_{port}",
+        "address": {"socket_address": {"address": "0.0.0.0", "port_value": port}},
+        "filter_chains": [{
+            "filters": [{
+                "name": "envoy.filters.network.http_connection_manager",
+                "typed_config": {
+                    "@type": "type.googleapis.com/envoy.extensions.filters.network.http_connection_manager.v3.HttpConnectionManager",
+                    "stat_prefix": "http_egress",
+                    "access_log": _access_log(),
+                    "http_filters": [{
+                        "name": "envoy.filters.http.router",
+                        "typed_config": {
+                            "@type": "type.googleapis.com/envoy.extensions.filters.http.router.v3.Router"
+                        },
+                    }],
+                    "route_config": {
+                        "name": "http_egress",
+                        "virtual_hosts": vhosts,
+                        # no catch-all vhost: unlisted Host -> 404, logged
+                    },
+                },
+            }]
+        }],
+    }
+
+
+def generate_envoy_config(
+    rules: list[EgressRule],
+    *,
+    cert_dir: str = "/etc/clawker/certs",
+    tls_port: int = consts.ENVOY_TLS_PORT,
+    tcp_port_base: int = consts.ENVOY_TCP_PORT_BASE,
+    admin_port: int = consts.ENVOY_HEALTH_PORT,
+) -> EnvoyBundle:
+    """Rule set -> (bootstrap YAML, sequential-listener allocation)."""
+    ordered = sorted(
+        {r.key(): r for r in rules}.values(), key=lambda r: r.key()
+    )
+    tls_chains: list[dict] = []
+    clusters: dict[str, dict] = {}
+    tcp_listeners: list[dict] = []
+    tcp_ports: dict[str, int] = {}
+    http_rules: list[EgressRule] = []
+    mitm_domains: list[str] = []
+    next_port = tcp_port_base
+
+    for rule in ordered:
+        apex = rule.dst[2:] if rule.dst.startswith("*.") else rule.dst
+        if not apex:
+            continue
+        port = rule.effective_port()
+        if rule.proto == "https":
+            if rule.paths:
+                tls_chains.append(_mitm_chain(rule, cert_dir))
+                mitm_domains.append(apex)
+                clusters.setdefault(_cluster_name(apex, port),
+                                    _cluster(apex, port, tls=True))
+            else:
+                tls_chains.append(_passthrough_chain(rule))
+                clusters.setdefault(_cluster_name(apex, port),
+                                    _cluster(apex, port, tls=False))
+        elif rule.proto == "http":
+            http_rules.append(rule)
+            clusters.setdefault(_cluster_name(apex, port),
+                                _cluster(apex, port, tls=False))
+        elif rule.proto == "tcp":
+            tcp_listeners.append(_tcp_listener(rule, next_port))
+            tcp_ports[rule.key()] = next_port
+            clusters.setdefault(_cluster_name(apex, port),
+                                _cluster(apex, port, tls=False))
+            next_port += 1
+        # udp rules never reach Envoy (kernel allows them directly)
+
+    listeners = [{
+        "name": "tls_egress",
+        "address": {"socket_address": {"address": "0.0.0.0", "port_value": tls_port}},
+        "listener_filters": [{
+            "name": "envoy.filters.listener.tls_inspector",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions.filters.listener.tls_inspector.v3.TlsInspector"
+            },
+        }],
+        "filter_chains": tls_chains,
+        # no default chain: unmatched SNI is refused (default deny)
+    }]
+    if http_rules:
+        http_port = next_port
+        listeners.append(_http_listener(http_rules, http_port))
+        for rule in http_rules:
+            tcp_ports[rule.key()] = http_port
+        next_port += 1
+    listeners.extend(tcp_listeners)
+
+    bootstrap = {
+        "admin": {
+            "address": {
+                "socket_address": {"address": "0.0.0.0", "port_value": admin_port}
+            }
+        },
+        "static_resources": {
+            "listeners": listeners,
+            "clusters": [clusters[k] for k in sorted(clusters)],
+        },
+    }
+    return EnvoyBundle(
+        config_yaml=yaml.safe_dump(bootstrap, sort_keys=True),
+        tcp_ports=tcp_ports,
+        mitm_domains=sorted(set(mitm_domains)),
+    )

@@ -171,8 +171,16 @@ class FakeMaps(FirewallMaps):
             self._bypass.pop(cgroup_id, None)
 
     def bypassed(self, cgroup_id):
+        # deadline-aware, like the kernel's fw_bypass_active: an expired
+        # entry never grants bypass even before GC removes it
         with self._lock:
-            return cgroup_id in self._bypass
+            deadline = self._bypass.get(cgroup_id)
+            if deadline is None:
+                return False
+            if deadline <= time.time():
+                del self._bypass[cgroup_id]
+                return False
+            return True
 
     def bypass_entries(self):
         with self._lock:

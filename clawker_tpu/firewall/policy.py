@@ -225,13 +225,13 @@ def build_routes(rules, *, envoy_ip: str, tls_port: int,
                  tcp_ports: dict[str, int] | None = None) -> dict[RouteKey, RouteVal]:
     """Egress rules -> global route table.
 
-    http/https rules redirect to the Envoy TLS/SNI listener (https MITM or
-    passthrough decided by Envoy config, not the kernel); tcp rules
-    redirect to their per-rule sequential Envoy TCP listener; udp rules
-    allow directly (no proxy lane for arbitrary UDP).
+    https rules redirect to the Envoy TLS/SNI listener (MITM or
+    passthrough decided by Envoy config, not the kernel); http and tcp
+    rules redirect to their allocated sequential Envoy listener; udp
+    rules allow directly (no proxy lane for arbitrary UDP).
 
-    ``tcp_ports`` maps rule.key() -> allocated Envoy listener port; built
-    by the Envoy config generator so kernel and proxy agree.
+    ``tcp_ports`` maps rule.key() -> allocated Envoy listener port
+    (EnvoyBundle.tcp_ports) so kernel and proxy agree.
     """
     from .hashes import zone_hash
 
@@ -241,9 +241,17 @@ def build_routes(rules, *, envoy_ip: str, tls_port: int,
         apex = rule.dst[2:] if rule.dst.startswith("*.") else rule.dst
         zh = zone_hash(apex)
         port = rule.effective_port()
-        if rule.proto in ("https", "http"):
+        if rule.proto == "https":
             table[RouteKey(zh, port, PROTO_TCP)] = RouteVal(
                 Action.REDIRECT, redirect_ip=envoy_ip, redirect_port=tls_port)
+        elif rule.proto == "http":
+            lport = tcp_ports.get(rule.key())
+            if lport:
+                table[RouteKey(zh, port, PROTO_TCP)] = RouteVal(
+                    Action.REDIRECT, redirect_ip=envoy_ip, redirect_port=lport)
+            else:  # no HTTP lane allocated: direct allow (never the TLS
+                # listener -- tls_inspector can't parse cleartext)
+                table[RouteKey(zh, port, PROTO_TCP)] = RouteVal(Action.ALLOW)
         elif rule.proto == "tcp":
             lport = tcp_ports.get(rule.key())
             if lport:

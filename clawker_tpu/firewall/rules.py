@@ -1,0 +1,82 @@
+"""Persistent egress rule store: the firewall's source of truth.
+
+``egress-rules.yaml`` in the data dir holds the dynamically-added rules
+(FirewallAddRules); the effective rule set is always
+required-internal + project + stored, deduped by the ``dst:proto:port``
+rule key -- first writer wins, matching the config-layer merge.
+
+Parity reference: controlplane/firewall/rules_store.go
+(storage.Store[EgressRulesFile], RuleKey dedupe).
+"""
+
+from __future__ import annotations
+
+import threading
+from pathlib import Path
+
+import yaml
+
+from ..config.schema import EgressRule, from_dict, to_dict
+from ..errors import ClawkerError
+from ..util.fs import atomic_write
+
+
+class RuleError(ClawkerError):
+    pass
+
+
+class RulesStore:
+    def __init__(self, path: Path):
+        self.path = Path(path)
+        self._lock = threading.Lock()
+
+    def load(self) -> list[EgressRule]:
+        if not self.path.exists():
+            return []
+        data = yaml.safe_load(self.path.read_text(encoding="utf-8")) or {}
+        out: dict[str, EgressRule] = {}
+        for raw in data.get("rules") or []:
+            r = from_dict(EgressRule, raw)
+            if r.dst:
+                out.setdefault(r.key(), r)
+        return list(out.values())
+
+    def _save(self, rules: list[EgressRule]) -> None:
+        body = yaml.safe_dump(
+            {"rules": [to_dict(r) for r in rules]}, sort_keys=False
+        )
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        atomic_write(self.path, body.encode())
+
+    def add(self, new: list[EgressRule]) -> list[EgressRule]:
+        """Dedupe-add; returns the rules actually added."""
+        with self._lock:
+            have = {r.key(): r for r in self.load()}
+            added = []
+            for r in new:
+                if not r.dst:
+                    raise RuleError("rule missing dst")
+                if r.proto not in ("https", "http", "tcp", "udp"):
+                    raise RuleError(f"rule {r.dst}: unknown proto {r.proto!r}")
+                if r.key() not in have:
+                    have[r.key()] = r
+                    added.append(r)
+            if added:
+                self._save(list(have.values()))
+            return added
+
+    def remove(self, key: str) -> bool:
+        with self._lock:
+            rules = self.load()
+            kept = [r for r in rules if r.key() != key]
+            if len(kept) == len(rules):
+                return False
+            self._save(kept)
+            return True
+
+    def effective(self, base: list[EgressRule]) -> list[EgressRule]:
+        """base (required + project) + stored, deduped by key."""
+        out = {r.key(): r for r in base}
+        for r in self.load():
+            out.setdefault(r.key(), r)
+        return list(out.values())

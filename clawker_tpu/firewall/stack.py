@@ -1,0 +1,196 @@
+"""FirewallStack: lifecycle of the proxy container and the DNS gate.
+
+Two data-plane services back the kernel programs:
+
+- **Envoy** runs as the ``clawker-envoy`` container at the deterministic
+  .2 address on clawker-net, config + MITM certs delivered via a
+  generated config directory bind.  Config drift is detected by a
+  content-sha label; a reload with changed bytes recreates the
+  container (deterministic YAML makes the sha meaningful).
+- **The DNS gate** runs in-process in the control-plane daemon, bound to
+  the clawker-net gateway :53.  The reference ships a custom CoreDNS
+  container for this (Stack.ensureCorednsImage stack.go:1039); running
+  the gate in the CP process instead removes an image build + container
+  per worker and gives it direct pinned-map access on the host where
+  the maps live -- the right trade on TPU-VM workers where the CP
+  daemon is already privileged.
+
+Parity reference: controlplane/firewall/stack.go (EnsureRunning :156,
+Reload :214, WaitForHealthy :261, container specs :657/:723, drift
+labels :796).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from pathlib import Path
+
+from .. import consts, logsetup
+from ..config.schema import EgressRule
+from ..engine.api import ContainerSpec, Engine
+from ..errors import ClawkerError
+from .dnsgate import DnsGate, ZonePolicy
+from .envoy import EnvoyBundle, generate_envoy_config
+from .maps import FirewallMaps
+from . import pki
+
+log = logsetup.get("firewall.stack")
+
+ENVOY_IMAGE = "envoyproxy/envoy:v1.30.2"
+ENVOY_CONF_MOUNT = "/etc/clawker"
+
+
+class StackError(ClawkerError):
+    pass
+
+
+class FirewallStack:
+    def __init__(
+        self,
+        engine: Engine,
+        maps: FirewallMaps,
+        *,
+        conf_dir: Path,
+        pki_dir: Path,
+        dns_host: str = "",
+        dns_port: int = consts.DNS_PORT,
+        upstreams: tuple[str, ...] = consts.UPSTREAM_DNS,
+    ):
+        self.engine = engine
+        self.maps = maps
+        self.conf_dir = Path(conf_dir)
+        self.pki_dir = Path(pki_dir)
+        self.dns_host = dns_host
+        self.dns_port = dns_port
+        self.upstreams = upstreams
+        self.gate: DnsGate | None = None
+        self.bundle: EnvoyBundle | None = None
+
+    # ------------------------------------------------------------ network
+
+    def network(self) -> dict:
+        return self.engine.ensure_network(consts.NETWORK_NAME)
+
+    def envoy_ip(self) -> str:
+        return self.engine.network_static_ip(consts.NETWORK_NAME, consts.ENVOY_HOST_OFFSET)
+
+    def gateway_ip(self) -> str:
+        """Gateway = .1: where host daemons (DNS gate, hostproxy) listen."""
+        return self.engine.network_static_ip(consts.NETWORK_NAME, 1)
+
+    # ------------------------------------------------------------- render
+
+    def render(self, rules: list[EgressRule]) -> EnvoyBundle:
+        """Config + certs on disk; returns the bundle (listener ports)."""
+        bundle = generate_envoy_config(rules, cert_dir=ENVOY_CONF_MOUNT + "/certs")
+        self.conf_dir.mkdir(parents=True, exist_ok=True)
+        (self.conf_dir / "envoy.yaml").write_text(bundle.config_yaml)
+        certs = self.conf_dir / "certs"
+        certs.mkdir(exist_ok=True)
+        ca = pki.ensure_ca(self.pki_dir)
+        for domain in bundle.mitm_domains:
+            crt, key = certs / f"{domain}.crt", certs / f"{domain}.key"
+            if not (crt.exists() and key.exists()):
+                pair = pki.generate_domain_cert(ca, domain)
+                crt.write_bytes(pair.cert_pem)
+                key.touch(mode=0o600)
+                key.write_bytes(pair.key_pem)
+        self.bundle = bundle
+        return bundle
+
+    def config_sha(self) -> str:
+        h = hashlib.sha256()
+        for f in sorted(self.conf_dir.rglob("*")):
+            if f.is_file():
+                h.update(f.name.encode())
+                h.update(f.read_bytes())
+        return h.hexdigest()[:16]
+
+    # ------------------------------------------------------------- envoy
+
+    def ensure_envoy(self) -> str:
+        """Idempotent: running container with current config sha, else
+        (re)create (drift label: stack.go:796 analogue)."""
+        self.network()
+        sha = self.config_sha()
+        name = consts.ENVOY_CONTAINER
+        if self.engine.container_exists(name):
+            info = self.engine.inspect_container(name)
+            labels = (info.get("Config") or {}).get("Labels") or {}
+            running = (info.get("State") or {}).get("Running")
+            if labels.get(consts.LABEL_CONTENT_SHA) == sha and running:
+                return info["Id"]
+            log.info("envoy drift (sha %s -> %s): recreating",
+                     labels.get(consts.LABEL_CONTENT_SHA), sha)
+            self.engine.remove_container(name, force=True)
+        if not self.engine.image_exists(ENVOY_IMAGE):
+            for _ in self.engine.pull_image(ENVOY_IMAGE):
+                pass
+        spec = ContainerSpec(
+            image=ENVOY_IMAGE,
+            cmd=["-c", f"{ENVOY_CONF_MOUNT}/envoy.yaml", "--base-id", "7"],
+            labels={
+                consts.LABEL_ROLE: "envoy",
+                consts.LABEL_CONTENT_SHA: sha,
+            },
+            binds=[f"{self.conf_dir}:{ENVOY_CONF_MOUNT}:ro"],
+            network=consts.NETWORK_NAME,
+            static_ip=self.envoy_ip(),
+            restart_policy="on-failure:3",
+        )
+        cid = self.engine.create_container(name, spec)
+        self.engine.start_container(cid)
+        return cid
+
+    # ---------------------------------------------------------- dns gate
+
+    def ensure_gate(self, rules: list[EgressRule]) -> DnsGate:
+        policy = ZonePolicy.from_rules(rules)
+        if self.gate is None:
+            self.gate = DnsGate(
+                policy, self.maps,
+                upstreams=self.upstreams,
+                host=self.dns_host or self.gateway_ip(),
+                port=self.dns_port,
+            )
+            self.gate.start()
+        else:
+            self.gate.set_policy(policy)
+        return self.gate
+
+    # ----------------------------------------------------------- combined
+
+    def ensure_running(self, rules: list[EgressRule]) -> EnvoyBundle:
+        bundle = self.render(rules)
+        self.ensure_envoy()
+        self.ensure_gate(rules)
+        return bundle
+
+    def reload(self, rules: list[EgressRule]) -> EnvoyBundle:
+        """Same as ensure_running: render detects drift, gate hot-swaps."""
+        return self.ensure_running(rules)
+
+    def status(self) -> dict:
+        envoy_running = False
+        try:
+            if self.engine.container_exists(consts.ENVOY_CONTAINER):
+                info = self.engine.inspect_container(consts.ENVOY_CONTAINER)
+                envoy_running = bool((info.get("State") or {}).get("Running"))
+        except ClawkerError:
+            pass
+        return {
+            "envoy_running": envoy_running,
+            "dns_gate_up": bool(self.gate and self.gate.bound_port),
+            "dns_stats": vars(self.gate.stats) if self.gate else {},
+            "config_sha": self.config_sha() if self.conf_dir.exists() else "",
+        }
+
+    def stop(self) -> None:
+        if self.gate is not None:
+            self.gate.stop()
+            self.gate = None
+        try:
+            if self.engine.container_exists(consts.ENVOY_CONTAINER):
+                self.engine.remove_container(consts.ENVOY_CONTAINER, force=True)
+        except ClawkerError as e:
+            log.warning("envoy teardown: %s", e)

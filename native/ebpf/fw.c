@@ -67,8 +67,24 @@ struct {
 	__uint(type, BPF_MAP_TYPE_HASH);
 	__uint(max_entries, FW_CONTAINERS_MAX);
 	__type(key, __u64);                /* cgroup id */
-	__type(value, __u64);              /* bypass deadline (unix) */
+	__type(value, __u64);              /* bypass deadline, CLOCK_BOOTTIME ns */
 } bypass SEC(".maps");
+
+/* The dead-man is enforced HERE, not by a userspace timer: an expired
+ * entry is deleted on first touch and enforcement resumes even if the
+ * control plane died right after granting the bypass (fail-closed). */
+static __always_inline int fw_bypass_active(__u64 cg)
+{
+	__u64 *deadline = bpf_map_lookup_elem(&bypass, &cg);
+
+	if (!deadline)
+		return 0;
+	if (bpf_ktime_get_boot_ns() > *deadline) {
+		bpf_map_delete_elem(&bypass, &cg);
+		return 0;
+	}
+	return 1;
+}
 
 struct {
 	__uint(type, BPF_MAP_TYPE_LRU_HASH);
@@ -174,8 +190,8 @@ static __always_inline int fw_decide(const struct fw_container *pol, __u64 cg,
 	v->redirect_ip = 0;
 	v->redirect_port = 0;
 
-	/* 2. bypass (dead-man entry present -> allow everything, logged) */
-	if (bpf_map_lookup_elem(&bypass, &cg)) {
+	/* 2. bypass (dead-man entry unexpired -> allow everything, logged) */
+	if (fw_bypass_active(cg)) {
 		v->action = FW_ALLOW;
 		v->reason = FW_R_BYPASS;
 		fw_emit(cg, dst, dport, proto, v);
@@ -389,7 +405,7 @@ static __always_inline int fw_egress6(struct bpf_sock_addr *ctx, __u8 proto)
 	if (!pol)
 		return FW_OK;
 	/* break-glass bypass must open v6 too (policy.py connect6) */
-	if (bpf_map_lookup_elem(&bypass, &cg)) {
+	if (fw_bypass_active(cg)) {
 		v.action = FW_ALLOW;
 		v.reason = FW_R_BYPASS;
 		v.zone_hash = 0;
@@ -495,7 +511,7 @@ int fw_sock_create(struct bpf_sock *ctx)
 
 	if (!bpf_map_lookup_elem(&containers, &cg))
 		return FW_OK;
-	if (bpf_map_lookup_elem(&bypass, &cg))
+	if (fw_bypass_active(cg))
 		return FW_OK;
 	if (ctx->type == FW_SOCK_RAW || ctx->type == FW_SOCK_PACKET) {
 		v.action = FW_DENY;

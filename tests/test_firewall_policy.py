@@ -177,6 +177,28 @@ def test_udp_redirect_reverse_nat(maps):
     assert policy.getpeername4(maps, CG, cookie, DNSGATE, 53) == ("9.9.9.9", 53)
 
 
+def test_tcp_connect_redirect_getpeername_reverse(maps):
+    """Connected-TCP redirects report the original dst via getpeername,
+    and TCP churn lives in its own LRU so it can't evict UDP entries."""
+    cache(maps, "93.184.216.34", "example.com")
+    route(maps, "example.com", 443, PROTO_TCP,
+          RouteVal(Action.REDIRECT, redirect_ip=ENVOY, redirect_port=10000))
+    v = policy.connect4(maps, CG, "93.184.216.34", 443, PROTO_TCP, sock_cookie=555)
+    assert v.action is Action.REDIRECT
+    assert policy.getpeername4(maps, CG, 555, ENVOY, 10000) == ("93.184.216.34", 443)
+    # recvmsg (UDP-only path) must NOT consult the tcp flow table
+    assert policy.recvmsg4(maps, CG, 555, ENVOY, 10000) == (ENVOY, 10000)
+    # the TCP entry went to tcp_flows, not udp_flows
+    assert maps.lookup_udp_flow(555) is None
+    assert maps.lookup_tcp_flow(555) is not None
+
+
+def test_bypass_opens_ipv6_too(maps):
+    maps.set_bypass(CG, int(time.time()) + 60)
+    v = policy.connect6(maps, CG, "2606:4700::1111", 443)
+    assert v.action is Action.ALLOW and v.reason is Reason.BYPASS
+
+
 def test_udp_flow_lru_bound():
     m = FakeMaps()
     for c in range(UDP_FLOWS_MAX + 10):
@@ -230,13 +252,17 @@ def test_build_routes_wildcard_and_tcp_mapping():
     ]
     table = policy.build_routes(
         rules, envoy_ip=ENVOY, tls_port=10000,
-        tcp_ports={"github.com:tcp:22": 10001},
+        tcp_ports={"github.com:tcp:22": 10001, "plain.example.org:http:80": 10002},
     )
     # wildcard rule routes on the apex hash
     https = table[RouteKey(zone_hash("example.com"), 443, PROTO_TCP)]
     assert https.action is Action.REDIRECT and https.redirect_port == 10000
+    # http rides its allocated plain-HTTP lane, never the TLS listener
     http = table[RouteKey(zone_hash("plain.example.org"), 80, PROTO_TCP)]
-    assert http.action is Action.REDIRECT
+    assert http.action is Action.REDIRECT and http.redirect_port == 10002
+    # without an allocated lane, http falls back to direct allow
+    bare = policy.build_routes(rules, envoy_ip=ENVOY, tls_port=10000)
+    assert bare[RouteKey(zone_hash("plain.example.org"), 80, PROTO_TCP)].action is Action.ALLOW
     # SSH TCP mapping (firewall_test.go:503): per-rule Envoy TCP listener
     ssh = table[RouteKey(zone_hash("github.com"), 22, PROTO_TCP)]
     assert ssh.action is Action.REDIRECT and ssh.redirect_port == 10001
