@@ -1,0 +1,115 @@
+"""fleet verbs: worker inventory, provisioning, health across a TPU pod.
+
+Net-new command group (the reference is single-host); the operational
+surface of SURVEY.md 7 step 7 -- everything here works over the SSH
+transport + scripted-runner seam, so `--dry-run` shows exactly what will
+run before anything touches a worker.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import click
+
+from .. import consts
+from .factory import Factory
+
+pass_factory = click.make_pass_decorator(Factory)
+
+
+def _transports(f: Factory):
+    from ..fleet.transport import SSHTransport
+
+    tpu = f.config.settings.runtime.tpu
+    from ..fleet.inventory import discover_workers
+
+    hosts = discover_workers(tpu)
+    if not hosts:
+        raise click.ClickException(
+            "no TPU workers configured (runtime.tpu.workers / runtime.tpu.pod)"
+        )
+    mux = f.config.ssh_mux_dir
+    return [SSHTransport(tpu, h, i, mux_dir=mux) for i, h in enumerate(hosts)]
+
+
+@click.group("fleet")
+def fleet_group():
+    """Manage TPU-pod worker VMs (tpu_vm driver substrate)."""
+
+
+@fleet_group.command("workers")
+@pass_factory
+def fleet_workers(f: Factory):
+    """List the pod's worker hosts in pod order."""
+    from ..fleet.inventory import discover_workers
+
+    hosts = discover_workers(f.config.settings.runtime.tpu)
+    for i, h in enumerate(hosts):
+        click.echo(f"{i}\t{h}")
+    if not hosts:
+        raise SystemExit(1)
+
+
+@fleet_group.command("provision")
+@click.option("--dry-run", is_flag=True, help="Print the plan, touch nothing.")
+@click.option("--no-firewall", is_flag=True, help="Skip the eBPF/kernel half.")
+@click.option("--no-cp", is_flag=True, help="Skip the per-worker control plane.")
+@click.option("--worker", "only", type=int, default=-1,
+              help="Provision a single worker index.")
+@pass_factory
+def fleet_provision(f: Factory, dry_run, no_firewall, no_cp, only):
+    """Install the worker stack (native bits, eBPF, control plane)."""
+    from ..fleet.provision import build_plan, provision_worker
+
+    plan = build_plan(with_firewall=not no_firewall, with_cp=not no_cp)
+    if dry_run:
+        for step in plan:
+            opt = " (optional)" if step.optional else ""
+            click.echo(f"{step.name}{opt}\n    {step.cmd}")
+        return
+    repo_root = Path(__file__).resolve().parents[2]
+    failed = 0
+    for t in _transports(f):
+        if only >= 0 and t.index != only:
+            continue
+        report = provision_worker(t, repo_root,
+                                  with_firewall=not no_firewall,
+                                  with_cp=not no_cp)
+        status = "ok" if report.ok else "FAILED"
+        click.echo(f"worker {t.index} ({t.host}): {status}")
+        for r in report.results:
+            mark = "+" if r.ok else "!"
+            click.echo(f"  {mark} {r.name}" + (f": {r.detail}" if r.detail else ""))
+        failed += 0 if report.ok else 1
+    if failed:
+        raise SystemExit(1)
+
+
+@fleet_group.command("status")
+@click.option("--format", "fmt", type=click.Choice(["table", "json"]), default="table")
+@pass_factory
+def fleet_status(f: Factory, fmt):
+    """Per-worker daemon + control-plane health over SSH."""
+    rows = []
+    for t in _transports(f):
+        docker = t.run("docker info --format '{{.ServerVersion}}'", timeout=20.0)
+        cp = t.run(
+            f"curl -fsS -m 3 http://127.0.0.1:{consts.CP_HEALTH_PORT}/healthz",
+            timeout=20.0,
+        )
+        rows.append({
+            "worker": t.index, "host": t.host,
+            "docker": docker.out.strip() if docker.rc == 0 else "DOWN",
+            "control_plane": "ok" if cp.rc == 0 else "DOWN",
+        })
+    if fmt == "json":
+        click.echo(json.dumps(rows, indent=2))
+        return
+    for r in rows:
+        click.echo(f"{r['worker']}\t{r['host']}\tdocker={r['docker']}\tcp={r['control_plane']}")
+
+
+def register(cli: click.Group) -> None:
+    cli.add_command(fleet_group)

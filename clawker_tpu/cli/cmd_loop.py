@@ -1,0 +1,88 @@
+"""``clawker loop``: run N autonomous agent loops across the fleet.
+
+Net-new verb (no reference analogue -- SURVEY.md header); BASELINE.json
+benchmark configs 3-4: a single firewalled loop on one TPU-VM, and
+``--parallel 8`` fanning one loop per v5e-8 worker with aggregated
+status output.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+
+import click
+
+from ..loop import LoopScheduler, LoopSpec
+from .factory import Factory
+
+pass_factory = click.make_pass_decorator(Factory)
+
+
+@click.command("loop")
+@click.option("--parallel", "-p", type=int, default=0,
+              help="Number of agent loops (default: settings loop.parallel).")
+@click.option("--iterations", "-n", type=int, default=-1,
+              help="Iterations per agent (0 = until interrupted; "
+                   "default: settings loop.max_iterations).")
+@click.option("--placement", type=click.Choice(["spread", "pack"]), default=None,
+              help="spread = round-robin over pod workers (default); "
+                   "pack = all on worker 0.")
+@click.option("--image", default="@", help="Agent image ('@' = project default).")
+@click.option("--prompt", default="", help="Prompt handed to each harness loop.")
+@click.option("--worktrees/--no-worktrees", default=False,
+              help="One git worktree per agent loop.")
+@click.option("--env", "env_kv", multiple=True, help="KEY=VAL extra agent env.")
+@click.option("--json", "as_json", is_flag=True, help="Final status as JSON.")
+@click.option("--keep", is_flag=True, help="Keep containers after the run.")
+@pass_factory
+def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
+             worktrees, env_kv, as_json, keep):
+    """Fan autonomous agent loops across the runtime's workers."""
+    env = {}
+    for kv in env_kv:
+        if "=" not in kv:
+            raise click.BadParameter(f"--env {kv!r}: expected KEY=VAL")
+        k, _, v = kv.partition("=")
+        env[k] = v
+    defaults = f.config.settings.loop
+    spec = LoopSpec(
+        parallel=parallel or defaults.parallel,
+        iterations=iterations if iterations >= 0 else defaults.max_iterations,
+        placement=placement or defaults.placement,
+        image=image,
+        prompt=prompt,
+        worktrees=worktrees,
+        env=env,
+    )
+
+    def on_event(agent, event, detail=""):
+        line = f"[{agent}] {event}" + (f" {detail}" if detail else "")
+        click.echo(line, err=True)
+
+    sched = LoopScheduler(f.config, f.driver, spec, on_event=on_event)
+    signal.signal(signal.SIGINT, lambda *_: sched.stop())
+    signal.signal(signal.SIGTERM, lambda *_: sched.stop())
+    click.echo(
+        f"loop {sched.loop_id}: {spec.parallel} agent(s), "
+        f"{spec.iterations or 'unbounded'} iteration(s), {spec.placement} placement",
+        err=True,
+    )
+    sched.start()
+    loops = sched.run()
+    if not keep:
+        sched.cleanup(remove_containers=True)
+    if as_json:
+        click.echo(json.dumps({"loop_id": sched.loop_id,
+                               "agents": sched.status()}, indent=2))
+    else:
+        for l in loops:
+            codes = ",".join(map(str, l.exit_codes)) or "-"
+            click.echo(f"{l.agent}\t{l.worker.id}\t{l.status}\t"
+                       f"iters={l.iteration}\texits={codes}")
+    if any(l.status == "failed" for l in loops):
+        raise SystemExit(1)
+
+
+def register(cli: click.Group) -> None:
+    cli.add_command(loop_cmd)

@@ -79,8 +79,10 @@ def register_commands() -> None:
         cmd_container,
         cmd_controlplane,
         cmd_firewall,
+        cmd_fleet,
         cmd_image,
         cmd_init,
+        cmd_loop,
         cmd_project,
         cmd_volume,
     )
@@ -90,8 +92,10 @@ def register_commands() -> None:
     cmd_container.register(cli)
     cmd_controlplane.register(cli)
     cmd_firewall.register(cli)
+    cmd_fleet.register(cli)
     cmd_image.register(cli)
     cmd_init.register(cli)
+    cmd_loop.register(cli)
     cmd_project.register(cli)
     cmd_volume.register(cli)
 

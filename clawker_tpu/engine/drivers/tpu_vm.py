@@ -1,8 +1,11 @@
-"""Cloud TPU-VM runtime driver (skeleton; full transport in fleet/ + ssh).
+"""Cloud TPU-VM runtime driver: every pod worker is a daemon endpoint.
 
-Provisions and attaches to Docker daemons on every worker VM of a TPU pod
-over SSH (BASELINE.json north_star).  The full implementation lands with the
-fleet subsystem; this module keeps the driver factory importable.
+Provisions and attaches to Docker daemons on the worker VMs of a TPU pod
+over SSH (BASELINE.json north_star).  Worker order follows pod order
+(inventory index = TPU worker index), which the loop scheduler uses for
+topology-aware placement.  Engines ride SSH-forwarded docker sockets
+(fleet/transport.py), so the whole jailed-engine stack works unchanged
+against remote daemons.
 """
 
 from __future__ import annotations
@@ -15,13 +18,13 @@ from .base import RuntimeDriver, Worker
 class TPUVMDriver(RuntimeDriver):
     name = "tpu_vm"
 
-    def __init__(self, tpu: TPUSettings):
+    def __init__(self, tpu: TPUSettings, *, runner=None):
         self.tpu = tpu
+        self.runner = runner          # fleet.transport.Runner seam (tests)
         self._workers: list[Worker] | None = None
 
-    def connect(self) -> list[Worker]:
+    def hosts(self) -> list[str]:
         from ...fleet.inventory import discover_workers
-        from ...fleet.transport import connect_worker_engine
 
         hosts = discover_workers(self.tpu)
         if not hosts:
@@ -29,15 +32,36 @@ class TPUVMDriver(RuntimeDriver):
                 f"tpu_vm: no workers found for pod {self.tpu.pod!r} "
                 "(set runtime.tpu.workers or runtime.tpu.pod in settings.yaml)"
             )
-        self._workers = []
-        for i, host in enumerate(hosts):
-            engine = connect_worker_engine(self.tpu, host, i)
-            self._workers.append(
-                Worker(id=f"tpu-{i}", index=i, hostname=host, engine=engine)
+        return hosts
+
+    def connect(self) -> list[Worker]:
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ...fleet.transport import connect_worker_engine
+
+        hosts = self.hosts()
+
+        def dial(args):
+            i, host = args
+            return Worker(
+                id=f"tpu-{i}", index=i, hostname=host,
+                engine=connect_worker_engine(self.tpu, host, i, runner=self.runner),
             )
+
+        # dial workers concurrently: 8 serial SSH handshakes would eat the
+        # whole <10s cold-start budget on a v5e-8
+        with ThreadPoolExecutor(max_workers=min(16, len(hosts))) as pool:
+            self._workers = list(pool.map(dial, enumerate(hosts)))
         return self._workers
 
     def workers(self) -> list[Worker]:
         if self._workers is None:
             return self.connect()
         return self._workers
+
+    def close(self) -> None:
+        for w in self._workers or []:
+            transport = getattr(w.engine, "transport", None)
+            if transport is not None:
+                transport.close()
+        self._workers = None

@@ -1,0 +1,192 @@
+"""Worker provisioning: turn a bare TPU-VM into a clawker-tpu worker.
+
+A provisioning *plan* is data -- an ordered list of steps, each one
+remote command with a human name -- executed over any transport runner,
+so the full sequence is unit-testable against scripted transcripts and
+auditable before it touches a fleet (``clawker fleet provision
+--dry-run`` prints it).
+
+Steps (mirroring what the reference gets from its multi-stage
+Dockerfile.controlplane build + local installs, re-shaped for remote
+workers -- SURVEY.md 7 step 7):
+
+1. preflight: docker daemon present + cgroup2 + bpffs mounted
+2. toolchain: python3, g++, make (+ clang/libbpf-dev for the kernel half)
+3. push the source payload (native/ + the clawker_tpu package)
+4. build: supervisor binary, fw.o + fwctl (skipped without clang)
+5. install: binaries onto PATH, package into a venv-less site dir
+6. kernel: fwctl load (pin maps+programs) -- skipped without clang
+7. control plane: systemd unit (or nohup fallback) running
+   ``python3 -m clawker_tpu.controlplane`` per worker
+8. verify: healthz answers on the worker
+
+Failure of any step aborts the remaining steps for that worker and
+reports; other workers proceed independently (per-worker isolation).
+"""
+
+from __future__ import annotations
+
+import io
+import tarfile
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .. import consts, logsetup
+from .transport import SSHTransport, TransportError
+
+log = logsetup.get("fleet.provision")
+
+REMOTE_ROOT = "/opt/clawker-tpu"
+
+SYSTEMD_UNIT = f"""[Unit]
+Description=clawker-tpu per-worker control plane
+After=docker.service
+[Service]
+Environment=PYTHONPATH={REMOTE_ROOT}/src
+ExecStart=/usr/bin/python3 -m clawker_tpu.controlplane
+Restart=on-failure
+RestartSec=3
+[Install]
+WantedBy=multi-user.target
+"""
+
+
+@dataclass
+class Step:
+    name: str
+    cmd: str
+    optional: bool = False      # failure logs but does not abort the plan
+    timeout: float = 300.0
+
+
+@dataclass
+class StepResult:
+    name: str
+    ok: bool
+    detail: str = ""
+
+
+@dataclass
+class ProvisionReport:
+    host: str
+    index: int
+    results: list[StepResult] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return all(r.ok for r in self.results)
+
+
+def build_plan(*, with_firewall: bool = True, with_cp: bool = True) -> list[Step]:
+    steps = [
+        Step("preflight-docker", "docker info --format '{{.ServerVersion}}'"),
+        Step("preflight-cgroup2",
+             "test -f /sys/fs/cgroup/cgroup.controllers"),
+        Step("preflight-bpffs",
+             "mountpoint -q /sys/fs/bpf || sudo mount -t bpf bpf /sys/fs/bpf"),
+        Step("toolchain",
+             "which python3 g++ make || sudo apt-get install -y -q "
+             "python3 g++ make"),
+    ]
+    if with_firewall:
+        steps.append(Step(
+            "toolchain-bpf",
+            "which clang || sudo apt-get install -y -q clang libbpf-dev",
+            optional=True,
+        ))
+    steps += [
+        # (payload push happens between these steps; see provision_worker)
+        Step("build-native", f"make -C {REMOTE_ROOT}/src/native"),
+    ]
+    if with_firewall:
+        steps += [
+            Step("build-ebpf",
+                 f"which clang && make -C {REMOTE_ROOT}/src/native/ebpf all",
+                 optional=True),
+            Step("install-fwctl",
+                 f"test -f {REMOTE_ROOT}/src/native/ebpf/build/fwctl && "
+                 f"sudo install {REMOTE_ROOT}/src/native/ebpf/build/fwctl "
+                 "/usr/local/bin/clawker-fwctl",
+                 optional=True),
+            Step("kernel-load",
+                 f"test -f {REMOTE_ROOT}/src/native/ebpf/build/fw.o && "
+                 "sudo clawker-fwctl load "
+                 f"--obj {REMOTE_ROOT}/src/native/ebpf/build/fw.o "
+                 f"--pin-dir {consts.BPF_PIN_DIR}",
+                 optional=True),
+        ]
+    steps.append(Step(
+        "install-supervisor",
+        f"sudo install {REMOTE_ROOT}/src/native/build/clawker-supervisord "
+        "/usr/local/bin/clawker-supervisord",
+    ))
+    if with_cp:
+        steps += [
+            Step("cp-unit",
+                 f"sudo cp {REMOTE_ROOT}/clawker-cp.service "
+                 "/etc/systemd/system/ && sudo systemctl daemon-reload && "
+                 "sudo systemctl enable --now clawker-cp.service || "
+                 f"(PYTHONPATH={REMOTE_ROOT}/src nohup python3 -m "
+                 "clawker_tpu.controlplane >/tmp/clawker-cp.log 2>&1 &)"),
+            Step("verify-healthz",
+                 "for i in $(seq 1 30); do "
+                 f"curl -fsS http://127.0.0.1:{consts.CP_HEALTH_PORT}/healthz "
+                 "&& exit 0; sleep 1; done; exit 1",
+                 timeout=60.0),
+        ]
+    return steps
+
+
+def payload_tar(repo_root: Path) -> bytes:
+    """Source payload: the package + native tree + the CP systemd unit."""
+    buf = io.BytesIO()
+
+    def _clean(ti: tarfile.TarInfo) -> tarfile.TarInfo | None:
+        name = Path(ti.name).name
+        if name in ("__pycache__", ".pytest_cache", "build") or name.endswith(".pyc"):
+            return None
+        return ti
+
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        tf.add(str(repo_root / "clawker_tpu"), arcname="src/clawker_tpu",
+               filter=_clean)
+        tf.add(str(repo_root / "native"), arcname="src/native", filter=_clean)
+        unit = SYSTEMD_UNIT.encode()
+        ti = tarfile.TarInfo("clawker-cp.service")
+        ti.size = len(unit)
+        tf.addfile(ti, io.BytesIO(unit))
+    return buf.getvalue()
+
+
+def provision_worker(
+    transport: SSHTransport,
+    repo_root: Path,
+    *,
+    with_firewall: bool = True,
+    with_cp: bool = True,
+) -> ProvisionReport:
+    report = ProvisionReport(transport.host, transport.index)
+    plan = build_plan(with_firewall=with_firewall, with_cp=with_cp)
+
+    pushed = False
+    for step in plan:
+        # the payload rides in right before the first build step
+        if step.name == "build-native" and not pushed:
+            try:
+                transport.push_tar(payload_tar(repo_root), REMOTE_ROOT, sudo=True)
+                report.results.append(StepResult("push-payload", True))
+            except TransportError as e:
+                report.results.append(StepResult("push-payload", False, str(e)))
+                return report
+            pushed = True
+        res = transport.run(step.cmd, timeout=step.timeout)
+        ok = res.rc == 0
+        detail = (res.err or res.out).strip()[:500]
+        report.results.append(StepResult(step.name, ok or step.optional,
+                                         "" if ok else detail))
+        log.info("worker %d %s: %s", transport.index, step.name,
+                 "ok" if ok else f"FAILED ({detail[:120]})" if not step.optional
+                 else f"skipped ({detail[:120]})")
+        if not ok and not step.optional:
+            return report
+    return report

@@ -1,0 +1,257 @@
+"""SSH transport to TPU-VM workers: exec, file push, socket forwarding.
+
+One ``SSHTransport`` per worker host.  All sessions ride a shared
+OpenSSH ControlMaster mux (ControlPersist keeps the TCP+auth warm, so
+per-command latency is one round trip -- the property the <10s
+cold-start budget depends on).  The Docker Engine API is reached by
+forwarding the worker's ``/var/run/docker.sock`` to a local unix socket
+and pointing ``HTTPDockerAPI``'s socket factory at it: the whole engine
+stack (label jail, PTY attach, build streaming) works unchanged against
+a remote daemon -- the graft is a transport substitution, exactly as
+SURVEY.md 2.13 frames it.
+
+The ``Runner`` seam (subprocess ssh vs ``FakeRunner`` scripted
+transcripts) is the fleet's fake-engine analogue: every provisioning and
+transport decision is unit-testable with no SSH or TPU in sight
+(SURVEY.md 4's "multi-node-without-a-cluster" strategy).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .. import consts, logsetup
+from ..config.schema import TPUSettings
+from ..errors import DriverError
+
+log = logsetup.get("fleet.transport")
+
+FORWARD_READY_DEADLINE_S = 10.0
+
+
+class TransportError(DriverError):
+    pass
+
+
+@dataclass
+class RunResult:
+    rc: int
+    out: str
+    err: str
+
+
+class Runner:
+    """Executes ssh invocations (seam for tests)."""
+
+    def run(self, argv: list[str], *, input_bytes: bytes | None = None,
+            timeout: float = 60.0) -> RunResult:
+        try:
+            res = subprocess.run(argv, input=input_bytes, capture_output=True,
+                                 timeout=timeout)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise TransportError(f"{argv[0]}: {e}") from None
+        return RunResult(res.returncode, res.stdout.decode(errors="replace"),
+                         res.stderr.decode(errors="replace"))
+
+    def spawn(self, argv: list[str]) -> subprocess.Popen:
+        return subprocess.Popen(argv, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+
+
+class FakeRunner(Runner):
+    """Scripted transcripts: remote command string -> (rc, out).
+
+    Keys are matched as substrings of the joined remote command (or the
+    local argv for spawns); unmatched commands succeed empty, so scripts
+    only state what they care about.  Every invocation is recorded.
+    """
+
+    def __init__(self, script: dict[str, tuple[int, str]] | None = None):
+        self.script = dict(script or {})
+        self.calls: list[list[str]] = []
+        self.pushed: dict[str, bytes] = {}   # remote path -> tar bytes
+        self.spawned: list[list[str]] = []
+
+    def run(self, argv, *, input_bytes=None, timeout=60.0):
+        self.calls.append(list(argv))
+        joined = " ".join(argv)
+        if input_bytes is not None and "tar" in joined:
+            # record pushes by their extraction directory
+            dst = argv[-1].split("-C ")[-1].split(" ")[0] if "-C " in argv[-1] else joined
+            self.pushed[dst] = input_bytes
+        for needle, (rc, out) in self.script.items():
+            if needle in joined:
+                return RunResult(rc, out, "" if rc == 0 else out)
+        return RunResult(0, "", "")
+
+    def spawn(self, argv):
+        self.spawned.append(list(argv))
+
+        class _P:
+            def poll(self):
+                return None
+
+            def terminate(self):
+                pass
+
+            def wait(self, timeout=None):
+                return 0
+
+        return _P()
+
+
+class SSHTransport:
+    def __init__(self, tpu: TPUSettings, host: str, index: int,
+                 *, mux_dir: Path, runner: Runner | None = None):
+        self.tpu = tpu
+        self.host = host
+        self.index = index
+        self.mux_dir = Path(mux_dir)
+        self.runner = runner or Runner()
+        self._forwards: list[subprocess.Popen] = []
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ command
+
+    def ssh_base(self) -> list[str]:
+        self.mux_dir.mkdir(parents=True, exist_ok=True)
+        base = [
+            "ssh",
+            "-o", "BatchMode=yes",
+            "-o", "StrictHostKeyChecking=accept-new",
+            "-o", "ControlMaster=auto",
+            "-o", f"ControlPath={self.mux_dir}/%r@%h:%p",
+            "-o", "ControlPersist=300",
+            "-o", "ServerAliveInterval=30",
+        ]
+        if self.tpu.ssh_key:
+            base += ["-i", self.tpu.ssh_key]
+        user = self.tpu.ssh_user or consts.TPU_SSH_USER_DEFAULT
+        return base + [f"{user}@{self.host}"]
+
+    def run(self, remote_cmd: str, *, input_bytes: bytes | None = None,
+            timeout: float = 120.0) -> RunResult:
+        return self.runner.run(self.ssh_base() + [remote_cmd],
+                               input_bytes=input_bytes, timeout=timeout)
+
+    def check(self, remote_cmd: str, *, timeout: float = 120.0) -> str:
+        res = self.run(remote_cmd, timeout=timeout)
+        if res.rc != 0:
+            raise TransportError(
+                f"worker {self.index} ({self.host}): `{remote_cmd}` "
+                f"rc={res.rc}: {res.err.strip() or res.out.strip()}"
+            )
+        return res.out
+
+    # --------------------------------------------------------------- push
+
+    def push_tar(self, tar_bytes: bytes, remote_dir: str, *,
+                 sudo: bool = False) -> None:
+        """Stream a tarball over stdin and extract it on the worker --
+        one round trip, no scp dependency.  ``sudo`` creates root-owned
+        target dirs (e.g. /opt) and hands them to the SSH user so later
+        unprivileged builds can write there."""
+        quoted = shlex.quote(remote_dir)
+        if sudo:
+            setup = (f"sudo mkdir -p {quoted} && "
+                     f"sudo chown \"$(id -u):$(id -g)\" {quoted}")
+        else:
+            setup = f"mkdir -p {quoted}"
+        res = self.run(
+            f"{setup} && tar -xzf - -C {quoted}",
+            input_bytes=tar_bytes, timeout=300.0,
+        )
+        if res.rc != 0:
+            raise TransportError(
+                f"worker {self.index}: push to {remote_dir} failed: {res.err.strip()}"
+            )
+
+    def push_paths(self, paths: dict[str, str | Path], remote_dir: str) -> None:
+        """{archive-name: local path} -> tar.gz -> remote_dir."""
+        import io
+        import tarfile
+
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+            for arcname, local in sorted(paths.items()):
+                tf.add(str(local), arcname=arcname)
+        self.push_tar(buf.getvalue(), remote_dir)
+
+    # ----------------------------------------------------------- forwards
+
+    def forward_unix(self, remote_sock: str, tag: str = "docker") -> Path:
+        """Forward a remote unix socket to a local one; returns the local
+        path once it accepts connections."""
+        local = self.mux_dir / f"{tag}-{self.index}.sock"
+        with self._lock:
+            if local.exists() and self._probe(local):
+                return local
+            local.unlink(missing_ok=True)
+            argv = self.ssh_base()[:-1] + [
+                "-N", "-L", f"{local}:{remote_sock}", self.ssh_base()[-1],
+            ]
+            proc = self.runner.spawn(argv)
+            self._forwards.append(proc)
+        deadline = time.monotonic() + FORWARD_READY_DEADLINE_S
+        while time.monotonic() < deadline:
+            if local.exists() and self._probe(local):
+                return local
+            if proc.poll() is not None:
+                break
+            time.sleep(0.1)
+        raise TransportError(
+            f"worker {self.index}: socket forward {remote_sock} -> {local} "
+            "did not come up"
+        )
+
+    @staticmethod
+    def _probe(path: Path) -> bool:
+        import socket as _s
+
+        try:
+            with _s.socket(_s.AF_UNIX, _s.SOCK_STREAM) as s:
+                s.settimeout(1.0)
+                s.connect(str(path))
+                return True
+        except OSError:
+            return False
+
+    def close(self) -> None:
+        with self._lock:
+            for p in self._forwards:
+                try:
+                    p.terminate()
+                    p.wait(timeout=3)
+                except Exception:
+                    pass
+            self._forwards.clear()
+
+
+def connect_worker_engine(tpu: TPUSettings, host: str, index: int,
+                          *, mux_dir: Path | None = None,
+                          runner: Runner | None = None):
+    """Worker host -> jailed Engine over the forwarded docker socket."""
+    from ..engine.api import Engine
+    from ..engine.httpapi import HTTPDockerAPI, unix_socket_factory
+    from ..util.xdg import state_dir
+
+    mux = mux_dir if mux_dir is not None else state_dir() / consts.TPU_SSH_MUX_DIR
+    transport = SSHTransport(tpu, host, index, mux_dir=mux, runner=runner)
+    try:
+        local_sock = transport.forward_unix("/var/run/docker.sock")
+        engine = Engine(HTTPDockerAPI(unix_socket_factory(local_sock)))
+        if not engine.ping():
+            raise TransportError(
+                f"worker {index} ({host}): forwarded docker daemon not answering"
+            )
+    except Exception:
+        transport.close()  # never orphan the ssh -N forward process
+        raise
+    engine.transport = transport  # keep the mux alive with the engine
+    return engine

@@ -1,0 +1,11 @@
+"""Autonomous-loop scheduler: ``clawker loop --parallel N``.
+
+Net-new (the reference has no loop verb -- SURVEY.md header note); the
+BASELINE.json north-star feature: fan N firewalled autonomous agent
+loops across the worker VMs of a TPU pod, restart each agent per
+iteration, aggregate status.
+"""
+
+from .scheduler import AgentLoop, LoopScheduler, LoopSpec
+
+__all__ = ["AgentLoop", "LoopScheduler", "LoopSpec"]

@@ -1,0 +1,282 @@
+"""The loop scheduler: place, run, restart, and account N agent loops.
+
+An *agent loop* is one autonomous harness container run repeatedly:
+each iteration starts the container, waits for exit, records the
+result, and re-starts until the iteration budget, a stop request, or
+the failure ceiling.  ``--parallel N`` runs N loops at once, placed
+across the runtime driver's workers:
+
+- ``spread`` (default): round-robin across pod workers in TPU worker
+  order -- one loop per worker VM on a v5e-8 with ``--parallel 8``,
+  the BASELINE benchmark shape.
+- ``pack``: fill worker 0 first (single-worker debugging).
+
+Placement is the ONLY thing pod topology feeds (SURVEY.md 2.13: ICI
+carries no control traffic); everything else is per-worker local.
+
+Per-iteration context rides a small state file written into the
+container between restarts (env is immutable after create), so the
+harness can see iteration number + loop id.  Consecutive-failure
+ceiling stops a crash-looping agent from burning a worker forever.
+"""
+
+from __future__ import annotations
+
+import io
+import tarfile
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .. import consts, logsetup
+from ..config import Config
+from ..engine.drivers import RuntimeDriver, Worker
+from ..errors import ClawkerError
+from ..runtime.orchestrate import AgentRuntime, CreateOptions
+from ..util import ids
+
+log = logsetup.get("loop.scheduler")
+
+FAILURE_CEILING = 3          # consecutive nonzero exits -> loop failed
+LOOP_STATE_DIR = "/run/clawker"
+
+
+@dataclass
+class LoopSpec:
+    parallel: int = 1
+    iterations: int = 0              # per-agent budget; 0 = until stop()
+    placement: str = "spread"        # spread | pack
+    image: str = "@"
+    prompt: str = ""                 # handed to the harness via env
+    worktrees: bool = False          # one git worktree per agent loop
+    workspace_mode: str = ""         # default: snapshot (isolation per loop)
+    agent_prefix: str = "loop"
+    env: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class AgentLoop:
+    agent: str
+    worker: Worker
+    container_id: str = ""
+    iteration: int = 0
+    consecutive_failures: int = 0
+    exit_codes: list[int] = field(default_factory=list)
+    status: str = "pending"          # pending|running|done|failed|stopped
+    worktree: Path | None = None
+
+    def summary(self) -> dict:
+        return {
+            "agent": self.agent, "worker": self.worker.id,
+            "status": self.status, "iteration": self.iteration,
+            "exit_codes": list(self.exit_codes),
+        }
+
+
+def place(workers: list[Worker], n: int, policy: str) -> list[Worker]:
+    """n loop slots -> workers.  spread follows TPU worker order."""
+    if not workers:
+        raise ClawkerError("loop: no workers available")
+    if policy == "pack":
+        return [workers[0]] * n
+    if policy == "spread":
+        return [workers[i % len(workers)] for i in range(n)]
+    raise ClawkerError(f"loop: unknown placement {policy!r} (spread|pack)")
+
+
+class LoopScheduler:
+    def __init__(self, cfg: Config, driver: RuntimeDriver, spec: LoopSpec,
+                 *, on_event=None):
+        self.cfg = cfg
+        self.driver = driver
+        self.spec = spec
+        self.loop_id = ids.short_id()
+        self.loops: list[AgentLoop] = []
+        self.on_event = on_event or (lambda agent, event, detail="": None)
+        self._stop = threading.Event()
+
+    # -------------------------------------------------------------- set up
+
+    def _runtime(self, worker: Worker) -> AgentRuntime:
+        from ..controlplane.bootstrap import post_start_services, pre_start_services
+
+        return AgentRuntime(
+            worker.require_engine(), self.cfg,
+            pre_start=lambda ref: pre_start_services(self.cfg, self.driver, ref),
+            post_start=lambda ref: post_start_services(self.cfg, self.driver, ref),
+        )
+
+    def _maybe_worktree(self, agent: str) -> tuple[Path | None, Path | None]:
+        """(workspace_root, worktree_git_dir) for this loop agent."""
+        if not self.spec.worktrees:
+            return None, None
+        from ..gitx.git import GitManager
+
+        root = self.cfg.project_root or Path.cwd()
+        gm = GitManager(root)
+        if not gm.is_repo():
+            raise ClawkerError("loop: --worktrees requires a git repository")
+        dest = self.cfg.data_dir / "worktrees" / self.cfg.project_name() / agent
+        info = gm.setup_worktree(dest, f"loop/{self.loop_id}/{agent}")
+        return info.path, gm.git_dir()
+
+    def start(self) -> None:
+        workers = self.driver.workers()
+        slots = place(workers, self.spec.parallel, self.spec.placement)
+        for i, worker in enumerate(slots):
+            # loop id in the agent name: two concurrent runs in one project
+            # must never collide (replace=True would kill the other run)
+            agent = f"{self.spec.agent_prefix}-{self.loop_id[:6]}-{i}"
+            loop = AgentLoop(agent=agent, worker=worker)
+            self.loops.append(loop)
+        for loop in self.loops:
+            try:
+                self._create(loop)
+            except ClawkerError as e:
+                loop.status = "failed"
+                self.on_event(loop.agent, "create_failed", str(e))
+                log.error("loop %s: create failed: %s", loop.agent, e)
+
+    def _create(self, loop: AgentLoop) -> None:
+        workspace_root, git_dir = self._maybe_worktree(loop.agent)
+        loop.worktree = workspace_root
+        env = {
+            "CLAWKER_LOOP_ID": self.loop_id,
+            "CLAWKER_LOOP_AGENT": loop.agent,
+            **({"CLAWKER_LOOP_PROMPT": self.spec.prompt} if self.spec.prompt else {}),
+            **self.spec.env,
+        }
+        rt = self._runtime(loop.worker)
+        # isolation default: snapshot copies; a worktree IS the isolation
+        # (and the linked .git file only resolves under a live bind)
+        mode = self.spec.workspace_mode or ("bind" if self.spec.worktrees
+                                            else "snapshot")
+        loop.container_id = rt.create(CreateOptions(
+            agent=loop.agent,
+            image=self.spec.image,
+            env=env,
+            tty=False,
+            workspace_mode=mode,
+            worker=loop.worker.id,
+            loop_id=self.loop_id,
+            replace=True,
+            workspace_root=workspace_root,
+            worktree_git_dir=git_dir,
+        ))
+        self.on_event(loop.agent, "created", loop.worker.id)
+
+    # ----------------------------------------------------------- iteration
+
+    def _write_iteration(self, loop: AgentLoop) -> None:
+        """Per-iteration context file (env can't change after create)."""
+        body = (f"loop_id={self.loop_id}\nagent={loop.agent}\n"
+                f"iteration={loop.iteration}\n").encode()
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            ti = tarfile.TarInfo("loop-state")
+            ti.size = len(body)
+            tf.addfile(ti, io.BytesIO(body))
+        engine = loop.worker.require_engine()
+        engine.put_archive(loop.container_id, LOOP_STATE_DIR, buf.getvalue())
+
+    def _start_iteration(self, loop: AgentLoop) -> None:
+        engine = loop.worker.require_engine()
+        rt = self._runtime(loop.worker)
+        try:
+            self._write_iteration(loop)
+        except ClawkerError:
+            pass  # state file is advisory; the loop itself is not
+        if loop.iteration == 0:
+            rt.start(loop.container_id)          # full pre/post bootstrap
+        else:
+            engine.start_container(loop.container_id)
+            # a restarted container gets a fresh cgroup: enforcement must
+            # re-enroll every iteration (the handler's drift guard keys
+            # on exactly this)
+            if rt.post_start:
+                rt.post_start(loop.container_id)
+        loop.status = "running"
+        self.on_event(loop.agent, "iteration_start", str(loop.iteration))
+
+    def _guarded_start(self, loop: AgentLoop) -> None:
+        """One worker's transient failure must never abort the other
+        loops (per-worker isolation) or skip the CLI's cleanup."""
+        try:
+            self._start_iteration(loop)
+        except ClawkerError as e:
+            loop.status = "failed"
+            self.on_event(loop.agent, "failed", f"start: {e}")
+            log.error("loop %s: start failed: %s", loop.agent, e)
+
+    def _finish_iteration(self, loop: AgentLoop, code: int) -> None:
+        loop.exit_codes.append(code)
+        loop.iteration += 1
+        if code == 0:
+            loop.consecutive_failures = 0
+        else:
+            loop.consecutive_failures += 1
+        self.on_event(loop.agent, "iteration_done", f"{loop.iteration - 1}:{code}")
+        if loop.consecutive_failures >= FAILURE_CEILING:
+            loop.status = "failed"
+            self.on_event(loop.agent, "failed",
+                          f"{FAILURE_CEILING} consecutive failures")
+        elif self.spec.iterations and loop.iteration >= self.spec.iterations:
+            loop.status = "done"
+            self.on_event(loop.agent, "done", f"{loop.iteration} iterations")
+
+    # ----------------------------------------------------------------- run
+
+    def run(self, *, poll_s: float = 0.5) -> list[AgentLoop]:
+        """Drive every loop to completion (or stop()); returns final states."""
+        for loop in self.loops:
+            if loop.status == "pending":
+                self._guarded_start(loop)
+        while not self._stop.is_set():
+            active = [l for l in self.loops if l.status == "running"]
+            if not active:
+                break
+            for loop in active:
+                engine = loop.worker.require_engine()
+                try:
+                    info = engine.inspect_container(loop.container_id)
+                except ClawkerError:
+                    loop.status = "failed"
+                    self.on_event(loop.agent, "failed", "container vanished")
+                    continue
+                state = info.get("State") or {}
+                if state.get("Running"):
+                    continue
+                self._finish_iteration(loop, int(state.get("ExitCode") or 0))
+                if loop.status == "running":     # budget left: next iteration
+                    self._guarded_start(loop)
+            self._stop.wait(poll_s)
+        if self._stop.is_set():
+            self._halt_running()
+        return self.loops
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _halt_running(self) -> None:
+        for loop in self.loops:
+            if loop.status != "running":
+                continue
+            try:
+                loop.worker.require_engine().stop_container(loop.container_id, timeout=5)
+            except ClawkerError:
+                pass
+            loop.status = "stopped"
+            self.on_event(loop.agent, "stopped")
+
+    def status(self) -> list[dict]:
+        return [l.summary() for l in self.loops]
+
+    def cleanup(self, *, remove_containers: bool = False) -> None:
+        for loop in self.loops:
+            if remove_containers and loop.container_id:
+                try:
+                    loop.worker.require_engine().remove_container(
+                        loop.container_id, force=True, volumes=True)
+                except ClawkerError:
+                    pass

@@ -1,0 +1,198 @@
+"""Fleet suite: inventory parsing, SSH transport, provisioning plans.
+
+The multi-node-without-a-cluster strategy (SURVEY.md 4): every decision
+runs over the FakeRunner scripted-transcript seam -- no SSH, no TPU, no
+Docker -- while the command lines and tar payloads are asserted exactly
+as a real worker would receive them.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu.config.schema import TPUSettings
+from clawker_tpu.fleet.inventory import parse_describe_json, parse_worker_endpoints
+from clawker_tpu.fleet.provision import (
+    REMOTE_ROOT,
+    build_plan,
+    payload_tar,
+    provision_worker,
+)
+from clawker_tpu.fleet.transport import FakeRunner, SSHTransport, TransportError
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def transport(tmp_path):
+    tpu = TPUSettings(pod="v5e-test", ssh_user="ops", ssh_key="/keys/id")
+    runner = FakeRunner()
+    t = SSHTransport(tpu, "10.0.0.5", 2, mux_dir=tmp_path / "mux", runner=runner)
+    return t, runner
+
+
+# ----------------------------------------------------------------- inventory
+
+def test_parse_worker_endpoints_formats():
+    assert parse_worker_endpoints("10.0.0.1,10.0.0.2") == ["10.0.0.1", "10.0.0.2"]
+    assert parse_worker_endpoints("10.0.0.1:8470:0, 10.0.0.2:8470:1") == [
+        "10.0.0.1", "10.0.0.2"]
+    assert parse_worker_endpoints("") == []
+
+
+def test_parse_describe_json_prefers_external_ip():
+    raw = json.dumps({"networkEndpoints": [
+        {"ipAddress": "10.0.0.1",
+         "accessConfig": {"externalIp": "34.1.2.3"}},
+        {"ipAddress": "10.0.0.2"},
+    ]})
+    assert parse_describe_json(raw) == ["34.1.2.3", "10.0.0.2"]
+
+
+def test_discover_workers_explicit_list_wins():
+    from clawker_tpu.fleet.inventory import discover_workers
+
+    tpu = TPUSettings(workers=["w0", "w1", "w2"])
+    assert discover_workers(tpu) == ["w0", "w1", "w2"]
+
+
+# ----------------------------------------------------------------- transport
+
+def test_ssh_base_has_mux_and_identity(transport):
+    t, _ = transport
+    base = t.ssh_base()
+    joined = " ".join(base)
+    assert "ControlMaster=auto" in joined
+    assert "ControlPersist=300" in joined
+    assert "-i /keys/id" in joined
+    assert base[-1] == "ops@10.0.0.5"
+    assert "BatchMode=yes" in joined  # never hang on a password prompt
+
+
+def test_run_and_check(transport):
+    t, runner = transport
+    runner.script["docker info"] = (0, "27.0.1\n")
+    assert t.check("docker info") == "27.0.1\n"
+    runner.script["false-cmd"] = (1, "boom")
+    with pytest.raises(TransportError, match="boom"):
+        t.check("false-cmd")
+    # every invocation went through the mux'd ssh argv
+    assert all(c[0] == "ssh" for c in runner.calls)
+
+
+def test_push_paths_builds_tar(transport, tmp_path):
+    t, runner = transport
+    src = tmp_path / "hello.txt"
+    src.write_text("payload")
+    t.push_paths({"sub/hello.txt": src}, "/opt/dest")
+    # the remote side got mkdir+tar; the payload round-trips
+    [(dst, blob)] = list(runner.pushed.items())
+    with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tf:
+        names = tf.getnames()
+        assert names == ["sub/hello.txt"]
+        assert tf.extractfile("sub/hello.txt").read() == b"payload"
+    last = " ".join(runner.calls[-1])
+    assert "mkdir -p /opt/dest" in last and "tar -xzf -" in last
+
+
+# --------------------------------------------------------------- provisioning
+
+def test_build_plan_shapes():
+    full = build_plan()
+    names = [s.name for s in full]
+    assert names[0] == "preflight-docker"
+    assert "kernel-load" in names and "verify-healthz" in names
+    # kernel steps are optional (workers without clang still provision)
+    assert all(s.optional for s in full if "ebpf" in s.name or "kernel" in s.name)
+    minimal = build_plan(with_firewall=False, with_cp=False)
+    mnames = [s.name for s in minimal]
+    assert "kernel-load" not in mnames and "verify-healthz" not in mnames
+    assert "install-supervisor" in mnames
+
+
+def test_payload_tar_contents():
+    blob = payload_tar(REPO)
+    with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tf:
+        names = set(tf.getnames())
+    assert "src/clawker_tpu/consts.py" in names
+    assert "src/native/ebpf/fw.c" in names
+    assert "clawker-cp.service" in names
+    assert not any(n.endswith(".pyc") or "__pycache__" in n for n in names)
+
+
+def test_provision_worker_happy_path(transport):
+    t, runner = transport
+    report = provision_worker(t, REPO)
+    assert report.ok, [r for r in report.results if not r.ok]
+    names = [r.name for r in report.results]
+    # payload push lands before the first build
+    assert names.index("push-payload") < names.index("build-native")
+    assert REMOTE_ROOT in list(runner.pushed)[0] or runner.pushed
+
+
+def test_provision_worker_aborts_on_required_failure(transport):
+    t, runner = transport
+    runner.script["docker info"] = (1, "Cannot connect to the Docker daemon")
+    report = provision_worker(t, REPO)
+    assert not report.ok
+    assert [r.name for r in report.results] == ["preflight-docker"]
+
+
+def test_provision_worker_optional_failure_continues(transport):
+    t, runner = transport
+    runner.script["which clang"] = (1, "clang not found")
+    report = provision_worker(t, REPO)
+    assert report.ok  # kernel half skipped, everything else proceeded
+    byname = {r.name: r for r in report.results}
+    assert byname["toolchain-bpf"].ok and byname["toolchain-bpf"].detail
+
+
+# ------------------------------------------------------------------ driver
+
+def test_tpu_vm_driver_hosts_and_order():
+    from clawker_tpu.engine.drivers.tpu_vm import TPUVMDriver
+
+    drv = TPUVMDriver(TPUSettings(workers=["h0", "h1"]))
+    assert drv.hosts() == ["h0", "h1"]
+
+
+def test_tpu_vm_driver_no_workers_errors():
+    from clawker_tpu.engine.drivers.tpu_vm import TPUVMDriver
+    from clawker_tpu.errors import DriverError
+
+    with pytest.raises(DriverError, match="no workers"):
+        TPUVMDriver(TPUSettings()).hosts()
+
+
+# --------------------------------------------------------------------- CLI
+
+def test_fleet_cli_dry_run_and_workers(tmp_path):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.testenv import TestEnv
+
+    with TestEnv() as tenv:
+        tenv.write_settings(
+            "runtime:\n  tpu:\n    workers: [w0.example, w1.example]\n")
+        proj = tenv.base / "p"
+        proj.mkdir()
+        (proj / ".clawker.yaml").write_text("project: fleetproj\n")
+        runner = CliRunner()
+        res = runner.invoke(cli, ["fleet", "workers"],
+                            obj=Factory(cwd=proj, driver=FakeDriver()),
+                            catch_exceptions=False)
+        assert res.exit_code == 0
+        assert "w0.example" in res.stdout and "w1.example" in res.stdout
+        res = runner.invoke(cli, ["fleet", "provision", "--dry-run"],
+                            obj=Factory(cwd=proj, driver=FakeDriver()),
+                            catch_exceptions=False)
+        assert res.exit_code == 0
+        assert "preflight-docker" in res.stdout and "kernel-load" in res.stdout

@@ -1,0 +1,185 @@
+"""Loop-scheduler suite: placement, iteration restarts, failure ceiling,
+worktree fan-out, and the CLI verb over a multi-worker fake driver.
+
+BASELINE configs 3-4 shape: N loops spread across pod workers, each
+iterating until its budget, with per-agent accounting.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.config import load_config
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.errors import ClawkerError
+from clawker_tpu.loop import LoopScheduler, LoopSpec
+from clawker_tpu.loop.scheduler import FAILURE_CEILING, place
+from clawker_tpu.testenv import TestEnv
+
+IMAGE = "clawker-loopproj:default"
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: loopproj\n")
+        cfg = load_config(proj)
+        yield tenv, proj, cfg
+
+
+def driver_with(n_workers: int, behavior=None):
+    drv = FakeDriver(n_workers=n_workers)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, behavior or exit_behavior(b"iter done\n", 0))
+    return drv
+
+
+# ----------------------------------------------------------------- placement
+
+def test_place_spread_round_robin():
+    drv = driver_with(3)
+    ws = drv.workers()
+    assert [w.id for w in place(ws, 8, "spread")] == [
+        "fake-0", "fake-1", "fake-2", "fake-0", "fake-1", "fake-2", "fake-0", "fake-1"]
+
+
+def test_place_pack_and_errors():
+    drv = driver_with(2)
+    assert [w.id for w in place(drv.workers(), 3, "pack")] == ["fake-0"] * 3
+    with pytest.raises(ClawkerError):
+        place(drv.workers(), 2, "best-fit")
+    with pytest.raises(ClawkerError):
+        place([], 1, "spread")
+
+
+# ---------------------------------------------------------------- iteration
+
+def test_single_loop_runs_budgeted_iterations(env):
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    events = []
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=3),
+                          on_event=lambda a, e, d="": events.append((a, e, d)))
+    sched.start()
+    loops = sched.run(poll_s=0.05)
+    assert [l.status for l in loops] == ["done"]
+    assert loops[0].iteration == 3 and loops[0].exit_codes == [0, 0, 0]
+    starts = [e for e in events if e[1] == "iteration_start"]
+    assert [d for _, _, d in starts] == ["0", "1", "2"]
+
+
+def test_parallel_spread_across_workers(env):
+    tenv, proj, cfg = env
+    drv = driver_with(4)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=4, iterations=1))
+    sched.start()
+    loops = sched.run(poll_s=0.05)
+    assert all(l.status == "done" for l in loops)
+    assert sorted(l.worker.id for l in loops) == [
+        "fake-0", "fake-1", "fake-2", "fake-3"]
+    # each worker daemon holds exactly its own loop container, named with
+    # the loop id so concurrent runs can never collide
+    run_tag = sched.loop_id[:6]
+    for i, api in enumerate(drv.apis):
+        names = [c["Names"][0] for c in api.container_list(all=True)]
+        assert [n for n in names if "loop" in n] == [
+            f"/clawker.loopproj.loop-{run_tag}-{i}"]
+
+
+def test_failure_ceiling_stops_crash_loop(env):
+    tenv, proj, cfg = env
+    drv = driver_with(1, behavior=exit_behavior(b"boom\n", 2))
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=10))
+    sched.start()
+    loops = sched.run(poll_s=0.05)
+    assert loops[0].status == "failed"
+    assert loops[0].exit_codes == [2] * FAILURE_CEILING
+
+
+def test_stop_halts_unbounded_loops(env):
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=0))
+    sched.start()
+    t = threading.Thread(target=lambda: sched.run(poll_s=0.05))
+    t.start()
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if sched.loops and sched.loops[0].iteration >= 2:
+            break
+        time.sleep(0.05)
+    sched.stop()
+    t.join(10)
+    assert not t.is_alive()
+    assert sched.loops[0].status in ("stopped", "running") or sched.loops[0].iteration >= 2
+    assert sched.loops[0].iteration >= 2  # it looped before we stopped it
+
+
+def test_loop_state_file_written_per_iteration(env):
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=2))
+    sched.start()
+    sched.run(poll_s=0.05)
+    api = drv.api
+    cid = sched.loops[0].container_id
+    archives = [c for c in api.calls_named("put_archive") if c[0][0] == cid]
+    assert len(archives) >= 2  # one per iteration
+
+
+def test_worktree_per_agent(env):
+    tenv, proj, cfg = env
+    subprocess.run(["git", "init", "-q"], cwd=proj, check=True)
+    subprocess.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    "commit", "-q", "--allow-empty", "-m", "root"],
+                   cwd=proj, check=True)
+    drv = driver_with(2)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=1,
+                                             worktrees=True))
+    sched.start()
+    loops = sched.run(poll_s=0.05)
+    assert all(l.status == "done" for l in loops)
+    trees = {str(l.worktree) for l in loops}
+    assert len(trees) == 2  # distinct worktrees
+    for l in loops:
+        assert l.worktree is not None and l.worktree.exists()
+        branches = subprocess.run(["git", "branch", "--list",
+                                   f"loop/{sched.loop_id}/{l.agent}"],
+                                  cwd=proj, capture_output=True, text=True)
+        assert branches.stdout.strip()
+
+
+# --------------------------------------------------------------------- CLI
+
+def test_cli_loop_json(env):
+    import json as _json
+
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    res = CliRunner().invoke(
+        cli, ["loop", "--parallel", "2", "--iterations", "1", "--json"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    out = _json.loads(res.stdout)
+    assert len(out["agents"]) == 2
+    assert all(a["status"] == "done" for a in out["agents"])
+    # --keep not passed: loop containers were removed
+    for api in drv.apis:
+        assert not [c for c in api.container_list(all=True)
+                    if "loop" in c["Names"][0]]
