@@ -92,6 +92,10 @@ class ProjectBuilder:
             files[CTX_CA_CERT] = self.ca_cert_pem  # type: ignore[assignment]
         if agentd is not None:
             files.update(agentd)
+        from ..hostproxy.scripts import CONTEXT_SCRIPTS
+
+        for arc, (_target, content) in CONTEXT_SCRIPTS.items():
+            files[arc] = content.encode()
         harness_df = generate_harness(
             project,
             harness,

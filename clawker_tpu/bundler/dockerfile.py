@@ -113,6 +113,18 @@ def generate_harness(
         ]
     lines += [f"RUN {cmd}" for cmd in harness.install]
     lines += _env_lines(harness.env)
+    # host-proxy side-channel scripts (no-ops when CLAWKER_HOSTPROXY is
+    # unset; reference bakes internal/hostproxy/internals the same way)
+    from ..hostproxy.scripts import CONTEXT_SCRIPTS
+
+    targets = [t for _, (t, _c) in sorted(CONTEXT_SCRIPTS.items())]
+    for arc, (target, _content) in sorted(CONTEXT_SCRIPTS.items()):
+        lines.append(f"COPY {arc} {target}")
+    lines += [
+        f"RUN chmod 0755 {' '.join(targets)} \\",
+        "    && git config --system credential.helper "
+        "/usr/local/bin/git-credential-clawker || true",
+    ]
     for f in extra_files or []:
         lines.append(f"COPY {f} /opt/clawker/{f}")
     # ---- cache tail: frequently-rotated material goes last ----

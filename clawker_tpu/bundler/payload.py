@@ -32,6 +32,10 @@ _PYZ_MODULES = (
     "agentd/protocol.py",
     "agentd/register.py",
     "agentd/supervisor_client.py",
+    # container side of the socket bridge (exec'd with the pyz on sys.path)
+    "socketbridge/__init__.py",
+    "socketbridge/protocol.py",
+    "socketbridge/container.py",
 )
 
 _PYZ_MAIN = b"""\

@@ -36,3 +36,26 @@ def post_start_services(cfg: Config, driver: RuntimeDriver, container_ref: str) 
         from ..firewall.lifecycle import firewall_post_start
 
         firewall_post_start(cfg, driver, container_ref)
+    _ensure_socket_bridge(cfg, driver, container_ref)
+
+
+def _ensure_socket_bridge(cfg: Config, driver: RuntimeDriver, container_ref: str) -> None:
+    """SSH/GPG agent forwarding (reference: container_start.go:349-371
+    socketbridge EnsureBridge).  Best-effort: a missing host agent or a
+    non-exec-capable engine degrades loudly, never fails the start.
+
+    The manager lives ON the engine (not a module global) so it dies with
+    the engine/factory; individual bridges self-close when their exec
+    stream EOFs -- i.e. when the container stops."""
+    try:
+        from ..socketbridge.host import SocketBridgeManager
+
+        engine = driver.engine()
+        mgr = getattr(engine, "_socketbridge_manager", None)
+        if mgr is None:
+            mgr = SocketBridgeManager(engine)
+            engine._socketbridge_manager = mgr
+        mgr.ensure_bridge(container_ref)
+    except Exception as e:
+        log.warning("event=socketbridge_unavailable container=%s error=%s",
+                    container_ref, e)

@@ -13,19 +13,10 @@ CP (pid alive, healthz dead) is restarted.
 
 from __future__ import annotations
 
-import json
-import os
-import signal
-import subprocess
-import sys
-import time
-from pathlib import Path
-from urllib import error as urlerror
-from urllib import request as urlrequest
-
 from .. import logsetup
 from ..config import Config
 from ..errors import ClawkerError
+from ..util.daemon import DaemonError, DaemonSpec
 
 log = logsetup.get("cp.manager")
 
@@ -37,123 +28,42 @@ class ControlPlaneError(ClawkerError):
     pass
 
 
-def _pidfile(cfg: Config) -> Path:
-    return cfg.state_dir / "cp.pid"
-
-
-def _logfile(cfg: Config) -> Path:
-    return cfg.logs_dir / "cp.log"
+def _spec(cfg: Config) -> DaemonSpec:
+    return DaemonSpec(
+        name="control plane",
+        module="clawker_tpu.controlplane",
+        pidfile=cfg.state_dir / "cp.pid",
+        logfile=cfg.logs_dir / "cp.log",
+        health_url=(
+            f"http://127.0.0.1:{cfg.settings.control_plane.health_port}/healthz"
+        ),
+        start_deadline_s=START_DEADLINE_S,
+    )
 
 
 def health(cfg: Config, timeout: float = 2.0) -> dict | None:
-    """The healthz aggregate, or None when no CP answers.
-
-    A 503 is a *live but degraded* CP: the aggregate body still comes back
-    (so status can show which subsystem is down) instead of being treated
-    as not-running -- which would send ensure_running into a kill/respawn
-    loop against a CP that answers every probe."""
-    port = cfg.settings.control_plane.health_port
-    try:
-        with urlrequest.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=timeout) as r:
-            return json.loads(r.read() or b"{}")
-    except urlerror.HTTPError as e:
-        try:
-            return json.loads(e.read() or b"{}")
-        except (OSError, json.JSONDecodeError):
-            return {"degraded": True}
-    except (urlerror.URLError, OSError, json.JSONDecodeError):
-        return None
+    """The healthz aggregate, or None when no CP answers.  A 503 is a
+    live-but-degraded CP (body still returned) -- see DaemonSpec.health."""
+    return _spec(cfg).health(timeout)
 
 
 def running(cfg: Config) -> bool:
-    h = health(cfg)
-    return bool(h)
-
-
-def _read_pid(cfg: Config) -> int:
-    try:
-        return int(_pidfile(cfg).read_text().strip())
-    except (OSError, ValueError):
-        return 0
-
-
-def _pid_alive(pid: int) -> bool:
-    if pid <= 0:
-        return False
-    try:
-        os.kill(pid, 0)
-        return True
-    except OSError:
-        return False
+    return _spec(cfg).running()
 
 
 def ensure_running(cfg: Config, *, wait_s: float = START_DEADLINE_S) -> None:
     """Idempotent bring-up: healthy CP -> no-op; wedged CP -> replace."""
-    if running(cfg):
-        return
-    pid = _read_pid(cfg)
-    if _pid_alive(pid):
-        log.warning("cp pid %d alive but healthz dead; replacing", pid)
-        _terminate(pid)
-    cfg.logs_dir.mkdir(parents=True, exist_ok=True)
-    cfg.state_dir.mkdir(parents=True, exist_ok=True)
-    logf = open(_logfile(cfg), "ab")
+    spec = _spec(cfg)
+    spec.start_deadline_s = wait_s
     try:
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "clawker_tpu.controlplane"],
-            stdout=logf,
-            stderr=subprocess.STDOUT,
-            stdin=subprocess.DEVNULL,
-            start_new_session=True,      # survive the CLI process
-            env=os.environ.copy(),
-        )
-    finally:
-        logf.close()
-    _pidfile(cfg).write_text(str(proc.pid))
-    deadline = time.monotonic() + wait_s
-    while time.monotonic() < deadline:
-        if running(cfg):
-            log.info("control plane up (pid %d)", proc.pid)
-            return
-        if proc.poll() is not None:
-            _pidfile(cfg).unlink(missing_ok=True)
-            raise ControlPlaneError(
-                f"control plane exited {proc.returncode} during startup; see {_logfile(cfg)}"
-            )
-        time.sleep(0.2)
-    # never got healthy: don't leave a half-alive CP owning the pidfile --
-    # the next ensure_running would kill/respawn it on every container start
-    _terminate(proc.pid)
-    _pidfile(cfg).unlink(missing_ok=True)
-    raise ControlPlaneError(
-        f"control plane not healthy within {wait_s:.0f}s; see {_logfile(cfg)}"
-    )
-
-
-def _terminate(pid: int, deadline_s: float = STOP_DEADLINE_S) -> None:
-    try:
-        os.kill(pid, signal.SIGTERM)
-    except OSError:
-        return
-    deadline = time.monotonic() + deadline_s
-    while time.monotonic() < deadline:
-        if not _pid_alive(pid):
-            return
-        time.sleep(0.1)
-    try:
-        os.kill(pid, signal.SIGKILL)       # drain hung; hard stop
-    except OSError:
-        pass
+        spec.ensure_running(log=log)
+    except DaemonError as e:
+        raise ControlPlaneError(str(e)) from None
 
 
 def stop(cfg: Config) -> bool:
     """Stop the CP if running; returns whether anything was stopped."""
-    pid = _read_pid(cfg)
-    was = _pid_alive(pid)
-    if was:
-        _terminate(pid)
-    _pidfile(cfg).unlink(missing_ok=True)
-    return was
+    return _spec(cfg).stop()
 
 
 def admin_client(cfg: Config, *, ensure_material: bool = False):

@@ -1,29 +1,49 @@
-"""Host-proxy daemon lifecycle (reference: hostproxy/manager.go:156 daemon
-spawn; server lands in the host-services milestone)."""
+"""Host-proxy daemon lifecycle over the shared DaemonSpec state machine.
+
+Parity reference: internal/hostproxy manager.go:156 daemon spawn.  The
+spawn/liveness/terminate discipline lives in util/daemon.py, shared with
+the control-plane manager so the two can never diverge.
+"""
 
 from __future__ import annotations
 
 from .. import logsetup
 from ..config import Config
+from ..errors import ClawkerError
+from ..util.daemon import DaemonError, DaemonSpec
 
 log = logsetup.get("hostproxy.manager")
 
-_started_in_process = False
+
+class HostProxyError(ClawkerError):
+    pass
+
+
+def _spec(cfg: Config) -> DaemonSpec:
+    return DaemonSpec(
+        name="host proxy",
+        module="clawker_tpu.hostproxy",
+        pidfile=cfg.state_dir / "hostproxy.pid",
+        logfile=cfg.logs_dir / "hostproxy.log",
+        health_url=f"http://127.0.0.1:{cfg.settings.host_proxy.port}/healthz",
+        start_deadline_s=10.0,
+    )
+
+
+def health(cfg: Config, timeout: float = 1.5) -> dict | None:
+    return _spec(cfg).health(timeout)
+
+
+def running(cfg: Config) -> bool:
+    return _spec(cfg).running()
 
 
 def ensure_running(cfg: Config) -> None:
-    """Start the host-proxy HTTP server if not already serving.
-
-    In-process thread for now (daemonization follows with the full server);
-    idempotent per process.
-    """
-    global _started_in_process
-    if _started_in_process:
-        return
     try:
-        from .server import start_background
+        _spec(cfg).ensure_running(log=log)
+    except DaemonError as e:
+        raise HostProxyError(str(e)) from None
 
-        start_background(cfg)
-        _started_in_process = True
-    except ImportError:
-        log.debug("hostproxy server not yet available")
+
+def stop(cfg: Config) -> bool:
+    return _spec(cfg).stop()

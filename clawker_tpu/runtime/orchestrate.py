@@ -145,6 +145,10 @@ class AgentRuntime:
             "CLAWKER_PROJECT": project,
             "CLAWKER_AGENT": opts.agent,
             "CLAWKER_WORKSPACE": consts.WORKSPACE_DIR,
+            # socket-bridge landing point: ssh picks the agent up the
+            # moment the bridge materializes the socket; harmless (key-file
+            # fallback) when no bridge is running
+            "SSH_AUTH_SOCK": "/run/clawker/ssh-agent.sock",
         }
         if self.cfg.settings.host_proxy.enable:
             env["CLAWKER_HOSTPROXY"] = (

@@ -37,7 +37,8 @@ def test_pyz_is_deterministic_and_stdlib_only():
     assert "__main__.py" in names
     assert "clawker_tpu/agentd/daemon.py" in names
     # nothing outside the declared closure sneaks in
-    allowed_prefixes = ("__main__.py", "clawker_tpu/agentd/")
+    allowed_prefixes = ("__main__.py", "clawker_tpu/agentd/",
+                            "clawker_tpu/socketbridge/")
     allowed = {"clawker_tpu/__init__.py", "clawker_tpu/consts.py", "clawker_tpu/errors.py"}
     for n in names:
         assert n.startswith(allowed_prefixes) or n in allowed, n

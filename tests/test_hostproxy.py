@@ -1,0 +1,224 @@
+"""Host-proxy suite: open-url, OAuth callback capture, git-credential
+fill with egress gating, health, and image-baked scripts.
+
+Parity bar: internal/hostproxy (server.go:38 /open/url, :507-644 OAuth
+sessions, git_credential.go fill, egress_check.go gating) driven over a
+live HTTP server on loopback with seamed browser/git-fill functions.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import urllib.error
+import urllib.request
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.config import load_config
+from clawker_tpu.hostproxy.server import HostProxy, _host_allowed
+from clawker_tpu.config.schema import EgressRule
+from clawker_tpu.testenv import TestEnv
+
+
+@pytest.fixture
+def proxy():
+    with TestEnv() as tenv:
+        proj = tenv.base / "p"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text(
+            "project: hp\n"
+            "security:\n"
+            "  egress:\n"
+            "    - dst: github.com\n"
+            "    - dst: '*.example.com'\n"
+        )
+        cfg = load_config(proj)
+        opened = []
+        fills = []
+
+        def fake_open(url):
+            opened.append(url)
+            return True
+
+        def fake_fill(request):
+            fills.append(request)
+            if "host=github.com" in request:
+                return ("protocol=https\nhost=github.com\n"
+                        "username=bot\npassword=s3cret\n")
+            return ""
+
+        p = HostProxy(cfg, port=0, open_browser=fake_open, git_fill=fake_fill)
+        p.start()
+        try:
+            yield p, opened, fills
+        finally:
+            p.stop()
+
+
+def call(p: HostProxy, method: str, path: str, body=None,
+         content_type="application/json"):
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{p.bound_port}{path}", data=data, method=method,
+        headers={"Content-Type": content_type},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_healthz(proxy):
+    p, _, _ = proxy
+    code, body = call(p, "GET", "/healthz")
+    assert code == 200 and json.loads(body)["ok"]
+
+
+def test_open_url_and_scheme_guard(proxy):
+    p, opened, _ = proxy
+    code, body = call(p, "POST", "/open/url", {"url": "https://docs.example.com/x"})
+    assert code == 200 and json.loads(body)["opened"]
+    assert opened == ["https://docs.example.com/x"]
+    # anything but http(s) is refused: no shelling out file:///etc/passwd
+    code, _ = call(p, "POST", "/open/url", {"url": "file:///etc/passwd"})
+    assert code == 400
+    code, _ = call(p, "POST", "/open/url", {"url": "javascript:alert(1)"})
+    assert code == 400
+    assert len(opened) == 1
+
+
+def test_oauth_capture_roundtrip(proxy):
+    p, _, _ = proxy
+    code, body = call(p, "POST", "/oauth/listen", {"port": 0})
+    assert code == 200
+    session = json.loads(body)
+    # nothing captured yet
+    code, _ = call(p, "GET", f"/oauth/poll?session={session['session']}")
+    assert code == 204
+    # the "provider" redirects the host browser to the callback port
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{session['port']}/callback?code=abc123&state=xyz",
+        timeout=5,
+    ) as r:
+        assert b"Authentication complete" in r.read()
+    code, body = call(p, "GET", f"/oauth/poll?session={session['session']}")
+    assert code == 200
+    captured = json.loads(body)
+    assert captured == {"path": "/callback",
+                        "query": {"code": "abc123", "state": "xyz"}}
+    # session is one-shot: consumed on delivery
+    code, _ = call(p, "GET", f"/oauth/poll?session={session['session']}")
+    assert code == 404
+    # the callback listener is torn down (async close: poll briefly)
+    import time
+
+    deadline = time.time() + 5
+    closed = False
+    while time.time() < deadline and not closed:
+        try:
+            socket.create_connection(("127.0.0.1", session["port"]), timeout=0.5).close()
+            time.sleep(0.05)
+        except OSError:
+            closed = True
+    assert closed
+
+
+def test_oauth_unknown_session(proxy):
+    p, _, _ = proxy
+    code, _ = call(p, "GET", "/oauth/poll?session=nope")
+    assert code == 404
+
+
+def test_git_credential_fill_allowed_host(proxy):
+    p, _, fills = proxy
+    code, body = call(p, "POST", "/git/credential",
+                      b"protocol=https\nhost=github.com\n\n",
+                      content_type="text/plain")
+    assert code == 200
+    assert b"password=s3cret" in body
+    # only protocol+host are forwarded to the host git (no injected keys)
+    assert fills == ["protocol=https\nhost=github.com\n\n"]
+
+
+def test_git_credential_denied_outside_egress(proxy):
+    p, _, fills = proxy
+    code, body = call(p, "POST", "/git/credential",
+                      b"protocol=https\nhost=evil.net\n\n",
+                      content_type="text/plain")
+    assert code == 403
+    assert fills == []  # never reached the host credential store
+
+
+def test_git_credential_requires_proto_host(proxy):
+    p, _, _ = proxy
+    code, _ = call(p, "POST", "/git/credential", b"host=github.com\n",
+                   content_type="text/plain")
+    assert code == 400
+    code, _ = call(p, "POST", "/git/credential",
+                   b"protocol=ssh\nhost=github.com\n", content_type="text/plain")
+    assert code == 400
+
+
+def test_host_allowed_zone_semantics():
+    rules = [EgressRule(dst="github.com"), EgressRule(dst="*.example.com")]
+    assert _host_allowed("github.com", rules)
+    assert not _host_allowed("sub.github.com", rules)      # exact is exact
+    assert _host_allowed("api.example.com", rules)
+    assert _host_allowed("example.com", rules)             # wildcard admits apex
+    assert not _host_allowed("badexample.com", rules)
+    assert not _host_allowed("example.com.evil.net", rules)
+
+
+def test_scripts_baked_into_harness_dockerfile():
+    from clawker_tpu.bundle.resolver import Resolver
+    from clawker_tpu.bundler.dockerfile import generate_harness
+    from clawker_tpu.config.schema import BuildConfig
+
+    with TestEnv() as tenv:
+        proj = tenv.base / "p"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: p\n")
+        cfg = load_config(proj)
+        harness = Resolver(cfg).harness("claude")
+        df = generate_harness("p", harness, BuildConfig(), base_ref="clawker-p:base")
+        assert f"COPY hostproxy/host-open {consts.HOST_OPEN_PATH}" in df
+        assert f"COPY hostproxy/git-credential-clawker {consts.GIT_CREDENTIAL_HELPER_PATH}" in df
+        assert "COPY hostproxy/oauth-forward /usr/local/bin/oauth-forward" in df
+        assert "credential.helper /usr/local/bin/git-credential-clawker" in df
+
+
+def test_manager_daemon_lifecycle():
+    """Spawn the real daemon process, health it, stop it."""
+    import importlib
+
+    from clawker_tpu.hostproxy import manager
+
+    with TestEnv() as tenv:
+        import socket as _s
+
+        free = _s.socket()
+        free.bind(("127.0.0.1", 0))
+        port = free.getsockname()[1]
+        free.close()
+        tenv.write_settings(f"host_proxy:\n  port: {port}\n")
+        importlib.invalidate_caches()
+        cfg = load_config(tenv.base)
+        assert manager.health(cfg) is None
+        manager.ensure_running(cfg)
+        try:
+            h = manager.health(cfg)
+            assert h is not None and h["ok"]
+            manager.ensure_running(cfg)  # idempotent
+        finally:
+            assert manager.stop(cfg)
+        import time
+
+        deadline = time.time() + 5
+        while manager.health(cfg) is not None and time.time() < deadline:
+            time.sleep(0.1)
+        assert manager.health(cfg) is None
