@@ -1,0 +1,15 @@
+"""Adversarial egress suite: exfiltration payload corpus + capture harness.
+
+Parity reference: /root/reference/test/adversarial (C2 "attacker server"
+recording every contact to sqlite + 30 payload directories of
+exfiltration techniques, test/adversarial/CLAUDE.md).  This build's
+corpus expresses each technique as a driver over the enforcement
+surface (kernel-policy oracle + DNS gate + route table), records every
+attempt in a capture DB, and the report asserts ZERO escapes -- the
+same all-must-be-captured bar, runnable both off-box (policy level, in
+CI) and on a TPU-VM worker against the live kernel.
+"""
+
+from .harness import CaptureDB, EgressSurface, Outcome, run_corpus
+
+__all__ = ["CaptureDB", "EgressSurface", "Outcome", "run_corpus"]

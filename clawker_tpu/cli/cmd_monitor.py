@@ -1,0 +1,92 @@
+"""monitor verbs: init/up/down/status + egress log tail.
+
+Parity reference: internal/cmd/monitor (init/up/down/status/reload,
+SURVEY.md 2.4); `up` drives docker compose over the rendered stack.
+"""
+
+from __future__ import annotations
+
+import json
+
+import click
+
+from ..monitor.stack import LOG_INDICES, MonitorStack
+from .factory import Factory
+
+pass_factory = click.make_pass_decorator(Factory)
+
+
+@click.group("monitor")
+def monitor_group():
+    """Manage the observability stack (OTel, OpenSearch, Prometheus)."""
+
+
+@monitor_group.command("init")
+@pass_factory
+def monitor_init(f: Factory):
+    """Render the compose stack + configs without starting anything."""
+    path = MonitorStack(f.config).render()
+    click.echo(f"rendered monitor stack under {path}")
+    click.echo("indices: " + ", ".join(LOG_INDICES))
+
+
+@monitor_group.command("up")
+@pass_factory
+def monitor_up(f: Factory):
+    MonitorStack(f.config).up()
+    s = f.config.settings.monitoring
+    click.echo(f"monitor stack up: dashboards http://localhost:{s.dashboards_port} "
+               f"prometheus http://localhost:{s.prometheus_port}")
+
+
+@monitor_group.command("down")
+@pass_factory
+def monitor_down(f: Factory):
+    MonitorStack(f.config).down()
+    click.echo("monitor stack down")
+
+
+@monitor_group.command("status")
+@click.option("--format", "fmt", type=click.Choice(["table", "json"]), default="table")
+@pass_factory
+def monitor_status(f: Factory, fmt):
+    rows = MonitorStack(f.config).status()
+    if fmt == "json":
+        click.echo(json.dumps(rows, indent=2))
+        return
+    if not rows:
+        click.echo("monitor stack: not running")
+        raise SystemExit(1)
+    for r in rows:
+        click.echo(f"{r.get('Service', r.get('Name', '?'))}\t{r.get('State', '?')}")
+
+
+@monitor_group.command("egress")
+@click.option("--tail", type=int, default=20, help="Last N egress decisions.")
+@click.option("--deny-only", is_flag=True, help="Only DENY verdicts.")
+@pass_factory
+def monitor_egress(f: Factory, tail, deny_only):
+    """Show recent kernel egress decisions (netlogger output)."""
+    path = f.config.logs_dir / "ebpf-egress.jsonl"
+    if not path.exists():
+        click.echo("no egress log yet (is the control plane running with "
+                   "the firewall enabled?)", err=True)
+        raise SystemExit(1)
+    records = []
+    for line in path.read_text().splitlines():
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if deny_only and rec.get("verdict") != "DENY":
+            continue
+        records.append(rec)
+    for rec in records[-tail:]:  # the NEWEST N matching decisions
+        click.echo(f"{rec.get('@timestamp','')}\t{rec.get('verdict','')}\t"
+                   f"{rec.get('container') or rec.get('cgroup_id')}\t"
+                   f"{rec.get('dst_ip')}:{rec.get('dst_port')}\t"
+                   f"{rec.get('zone') or '-'}\t{rec.get('reason','')}")
+
+
+def register(cli: click.Group) -> None:
+    cli.add_command(monitor_group)

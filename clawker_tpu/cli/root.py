@@ -83,6 +83,7 @@ def register_commands() -> None:
         cmd_image,
         cmd_init,
         cmd_loop,
+        cmd_monitor,
         cmd_project,
         cmd_volume,
     )
@@ -96,6 +97,7 @@ def register_commands() -> None:
     cmd_image.register(cli)
     cmd_init.register(cli)
     cmd_loop.register(cli)
+    cmd_monitor.register(cli)
     cmd_project.register(cli)
     cmd_volume.register(cli)
 

@@ -23,6 +23,7 @@ def main() -> int:
     driver = get_driver(cfg.settings, override=os.environ.get("CLAWKER_TPU_DRIVER", ""))
     cp = cfg.settings.control_plane
     firewall = None
+    netlogger = None
     if cfg.settings.firewall.enable:
         # resilience contract: a failed enforcement build degrades the CP
         # (verbs answer 501 -> agent starts fail loudly), never kills it
@@ -37,6 +38,18 @@ def main() -> int:
             import logging
 
             logging.getLogger("cp").error("event=firewall_unavailable error=%s", e)
+        if firewall is not None:
+            from ..monitor.netlogger import NetLogger, handler_resolvers
+
+            rc, rz = handler_resolvers(firewall)
+            mon = cfg.settings.monitoring
+            netlogger = NetLogger(
+                firewall.maps,
+                out_path=cfg.logs_dir / "ebpf-egress.jsonl",
+                resolve_cgroup=rc,
+                resolve_zone=rz,
+                otlp_endpoint=("http://127.0.0.1:4318" if mon.enable else ""),
+            )
     daemon = ControlPlaneDaemon(
         CPConfig(
             pki_dir=cfg.pki_dir,
@@ -51,6 +64,7 @@ def main() -> int:
         ),
         driver.engine(),
         firewall=firewall,
+        netlogger=netlogger,
     )
     return daemon.run_forever()
 

@@ -85,10 +85,11 @@ class Subsystems:
 
 
 class ControlPlaneDaemon:
-    def __init__(self, cfg: CPConfig, engine, firewall=None):
+    def __init__(self, cfg: CPConfig, engine, firewall=None, netlogger=None):
         self.cfg = cfg
         self.engine = engine
         self.firewall = firewall          # FirewallHandler | None
+        self.netlogger = netlogger        # monitor.netlogger.NetLogger | None
         self.subs = Subsystems()
         self._stop = threading.Event()
         self._drained_to_zero = False
@@ -172,6 +173,12 @@ class ControlPlaneDaemon:
         feeder.start()
         dialer.start(topic, repo)
         watcher.start()
+        if self.netlogger is not None:   # workers (cmd.go:812 startWorkers)
+            try:
+                self.netlogger.start()
+            except Exception as e:
+                log.error("event=netlogger_unavailable error=%s", e)
+                self.subs.unavailable.append("netlogger")
         self._start_healthz()
         log.info(
             "control plane up: admin=:%s agent=:%s health=:%s",
@@ -277,6 +284,9 @@ class ControlPlaneDaemon:
             # drain-to-zero (no agents left): tear the data plane down and
             # flush maps; on any other exit the pinned maps keep enforcing
             # the last rule set (fail-closed)
+            # netlogger stops BEFORE teardown: teardown flushes the maps
+            # (events ring included), so the final drain must land first
+            ("netlogger", lambda: self.netlogger and self.netlogger.stop()),
             ("firewall_teardown",
              lambda: self.firewall and self._drained_to_zero
              and self.firewall.teardown()),

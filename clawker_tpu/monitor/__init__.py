@@ -1,0 +1,7 @@
+"""Observability stack: compose-rendered OTel/OpenSearch/Prometheus +
+the kernel egress netlogger.
+
+Parity reference: internal/monitor (compose stack templates, monitoring
+units, ledger -- SURVEY.md 2.11) and controlplane/firewall/ebpf/netlogger
+(events ringbuf -> log records).
+"""

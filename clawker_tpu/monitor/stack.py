@@ -1,0 +1,249 @@
+"""Monitor stack: deterministic compose rendering + lifecycle.
+
+Renders the observability compose file (OTel Collector gateway,
+OpenSearch single node, OpenSearch Dashboards, Prometheus, one-shot
+bootstrap seeding index templates + saved objects) into the data dir and
+drives ``docker compose`` over it.  Rendering is pure (settings -> bytes)
+so tests pin the output; the compose invocation rides a runner seam.
+
+Parity reference: internal/monitor/templates/compose.yaml.tmpl:11-198
+(service set), otel-config.yaml.tmpl, prometheus.yaml.tmpl; `monitor up`
+shells to docker compose (internal/cmd/monitor/up/up.go:81).  The six
+log indices (SURVEY.md 2.11): claude-code, clawker-cli, clawkercp,
+clawker-envoy, clawker-dnsgate, clawker-ebpf-egress.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+from pathlib import Path
+
+from .. import consts, logsetup
+from ..config import Config
+from ..errors import ClawkerError
+
+log = logsetup.get("monitor.stack")
+
+LOG_INDICES = (
+    "clawker-otlp",       # everything arriving over OTLP (service.name
+    #                       attribute discriminates: claude-code harness
+    #                       telemetry, ebpf-egress, cp subsystems)
+    "claude-code",        # harness telemetry (file-shipped lane)
+    "clawker-cli",        # host CLI logs
+    "clawkercp",          # control-plane logs
+    "clawker-envoy",      # proxy access logs (container stdout)
+    "clawker-dnsgate",    # DNS query decisions
+    "clawker-ebpf-egress",  # per-decision kernel egress events (jsonl lane)
+)
+
+COMPOSE_PROJECT = "clawker-monitor"
+
+
+class MonitorError(ClawkerError):
+    pass
+
+
+def render_otel_config(s) -> str:
+    """OTLP (grpc+http) -> OpenSearch log indices + Prometheus metrics."""
+    cfg = {
+        "receivers": {
+            "otlp": {
+                "protocols": {
+                    "grpc": {"endpoint": f"0.0.0.0:{s.otlp_grpc_port}"},
+                    "http": {"endpoint": "0.0.0.0:4318"},
+                }
+            }
+        },
+        "processors": {
+            "batch": {"timeout": "2s"},
+            # label rename worked around an OpenSearch SQL-plugin bug in
+            # the reference (MONITORING-REFERENCE.md:13-31); kept so
+            # dashboards port over unchanged
+            "transform/metrics": {
+                "metric_statements": [{
+                    "context": "datapoint",
+                    "statements": [
+                        'set(attributes["kind"], attributes["type"]) where attributes["type"] != nil',
+                        'delete_key(attributes, "type")',
+                    ],
+                }]
+            },
+        },
+        "exporters": {
+            "opensearch/logs": {
+                "http": {"endpoint": "http://opensearch:9200"},
+                "logs_index": "clawker-otlp",
+            },
+            "prometheus": {"endpoint": "0.0.0.0:8889"},
+            "debug": {"verbosity": "basic"},
+        },
+        "service": {
+            "pipelines": {
+                "logs": {"receivers": ["otlp"], "processors": ["batch"],
+                         "exporters": ["opensearch/logs"]},
+                "metrics": {"receivers": ["otlp"],
+                            "processors": ["transform/metrics", "batch"],
+                            "exporters": ["prometheus"]},
+                "traces": {"receivers": ["otlp"], "processors": ["batch"],
+                           "exporters": ["debug"]},
+            }
+        },
+    }
+    import yaml
+
+    return yaml.safe_dump(cfg, sort_keys=True)
+
+
+def render_prometheus_config(s) -> str:
+    import yaml
+
+    return yaml.safe_dump({
+        "global": {"scrape_interval": "15s"},
+        "scrape_configs": [
+            {"job_name": "otel-collector",
+             "static_configs": [{"targets": ["otel-collector:8889"]}]},
+            {"job_name": "prometheus",
+             "static_configs": [{"targets": ["localhost:9090"]}]},
+        ],
+    }, sort_keys=True)
+
+
+def render_bootstrap_script() -> str:
+    """One-shot curl seeding: index templates for every log index."""
+    lines = ["#!/bin/sh", "set -e",
+             "until curl -fsS http://opensearch:9200 >/dev/null; do sleep 2; done"]
+    for index in LOG_INDICES:
+        template = json.dumps({
+            "index_patterns": [f"{index}*"],
+            "template": {
+                "settings": {"number_of_replicas": 0},
+                "mappings": {
+                    "properties": {
+                        "@timestamp": {"type": "date"},
+                        "severity": {"type": "keyword"},
+                        "service": {"type": "keyword"},
+                        "message": {"type": "text"},
+                    }
+                },
+            },
+        })
+        lines.append(
+            "curl -fsS -X PUT -H 'Content-Type: application/json' "
+            f"http://opensearch:9200/_index_template/{index} -d '{template}'"
+        )
+    lines.append("echo 'clawker monitor bootstrap complete'")
+    return "\n".join(lines) + "\n"
+
+
+def render_compose(s) -> str:
+    import yaml
+
+    services = {
+        "otel-collector": {
+            "image": "otel/opentelemetry-collector-contrib:0.103.0",
+            "command": ["--config=/etc/otel/config.yaml"],
+            "volumes": ["./otel-config.yaml:/etc/otel/config.yaml:ro"],
+            "ports": [f"{s.otlp_grpc_port}:{s.otlp_grpc_port}", "4318:4318"],
+            "depends_on": ["opensearch"],
+            "restart": "unless-stopped",
+        },
+        "opensearch": {
+            "image": "opensearchproject/opensearch:2.15.0",
+            "environment": [
+                "discovery.type=single-node",
+                "DISABLE_SECURITY_PLUGIN=true",
+                "OPENSEARCH_JAVA_OPTS=-Xms512m -Xmx512m",
+            ],
+            "ports": [f"{s.opensearch_port}:9200"],
+            "volumes": ["opensearch-data:/usr/share/opensearch/data"],
+            "restart": "unless-stopped",
+        },
+        "opensearch-bootstrap": {
+            "image": "curlimages/curl:8.8.0",
+            "entrypoint": ["/bin/sh", "/bootstrap.sh"],
+            "volumes": ["./bootstrap.sh:/bootstrap.sh:ro"],
+            "depends_on": ["opensearch"],
+            "restart": "no",
+        },
+        "opensearch-dashboards": {
+            "image": "opensearchproject/opensearch-dashboards:2.15.0",
+            "environment": [
+                "OPENSEARCH_HOSTS=[\"http://opensearch:9200\"]",
+                "DISABLE_SECURITY_DASHBOARDS_PLUGIN=true",
+            ],
+            "ports": [f"{s.dashboards_port}:5601"],
+            "depends_on": ["opensearch"],
+            "restart": "unless-stopped",
+        },
+        "prometheus": {
+            "image": "prom/prometheus:v2.53.0",
+            "volumes": ["./prometheus.yaml:/etc/prometheus/prometheus.yml:ro"],
+            "ports": [f"{s.prometheus_port}:9090"],
+            "restart": "unless-stopped",
+        },
+    }
+    return yaml.safe_dump({
+        "name": COMPOSE_PROJECT,
+        "services": services,
+        "volumes": {"opensearch-data": {}},
+    }, sort_keys=True)
+
+
+class MonitorStack:
+    def __init__(self, cfg: Config, *, runner=None):
+        self.cfg = cfg
+        self.dir = cfg.data_dir / "monitor"
+        self.runner = runner or self._run_compose
+
+    # ------------------------------------------------------------ render
+
+    def render(self) -> Path:
+        s = self.cfg.settings.monitoring
+        self.dir.mkdir(parents=True, exist_ok=True)
+        (self.dir / "compose.yaml").write_text(render_compose(s))
+        (self.dir / "otel-config.yaml").write_text(render_otel_config(s))
+        (self.dir / "prometheus.yaml").write_text(render_prometheus_config(s))
+        (self.dir / "bootstrap.sh").write_text(render_bootstrap_script())
+        return self.dir
+
+    # --------------------------------------------------------- lifecycle
+
+    def _run_compose(self, *args: str) -> subprocess.CompletedProcess:
+        cmd = ["docker", "compose", "-p", COMPOSE_PROJECT,
+               "-f", str(self.dir / "compose.yaml"), *args]
+        try:
+            return subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise MonitorError(f"docker compose {' '.join(args)}: {e}") from None
+
+    def up(self) -> None:
+        self.render()
+        res = self.runner("up", "-d", "--remove-orphans")
+        if res.returncode != 0:
+            raise MonitorError(f"monitor up failed: {res.stderr.strip()[:500]}")
+        log.info("monitor stack up (dashboards :%d, prometheus :%d)",
+                 self.cfg.settings.monitoring.dashboards_port,
+                 self.cfg.settings.monitoring.prometheus_port)
+
+    def down(self) -> None:
+        res = self.runner("down", "--volumes")
+        if res.returncode != 0:
+            raise MonitorError(f"monitor down failed: {res.stderr.strip()[:500]}")
+
+    def status(self) -> list[dict]:
+        res = self.runner("ps", "--format", "json")
+        if res.returncode != 0:
+            return []
+        out = []
+        for line in res.stdout.splitlines():
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            # compose <2.21 emits one JSON array; newer emits NDJSON rows
+            if isinstance(row, list):
+                out.extend(row)
+            else:
+                out.append(row)
+        return out

@@ -1,0 +1,81 @@
+"""Adversarial suite: the 30-class exfiltration corpus must produce ZERO
+escapes against the enforcement semantics.
+
+Parity bar: /root/reference/test/adversarial -- capture server + 30
+payload classes, all-captured required (BASELINE.md firewall-parity
+row).  Every attempt lands in the capture DB; the report is the gate.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from clawker_tpu.adversarial import CaptureDB, Outcome, run_corpus
+from clawker_tpu.adversarial.harness import EgressSurface
+from clawker_tpu.adversarial.payloads import (
+    ATTACKER_IP,
+    CORPUS,
+    default_resolutions,
+    default_rules,
+)
+from clawker_tpu.firewall.model import Action
+
+
+def test_corpus_runs_all_thirty_classes():
+    assert len(CORPUS) == 30
+    names = [fn.__name__ for fn in CORPUS]
+    assert len(set(names)) == 30
+
+
+def test_zero_escapes(tmp_path):
+    db = CaptureDB(tmp_path / "capture.db")
+    report = run_corpus(db)
+    assert report.total >= 30
+    assert report.ok, f"ESCAPES: {report.escapes}\n{report.to_json()}"
+    assert report.escaped == 0
+    # every attempt was recorded in the capture DB
+    counts = db.counts()
+    assert sum(counts.values()) == report.total
+    assert counts.get("escaped", 0) == 0
+    db.close()
+
+
+def test_report_is_json_gradeable(tmp_path):
+    report = run_corpus()
+    parsed = json.loads(report.to_json())
+    assert parsed["pass"] is True
+    assert parsed["total"] == report.total
+    assert parsed["captured"] + parsed["contained"] == parsed["total"]
+
+
+def test_surface_grades_direct_allow_as_escape():
+    """The grader itself: an ALLOW to an attacker IP must read ESCAPED --
+    guards against the suite rotting into always-green."""
+    s = EgressSurface(default_rules(), resolutions=default_resolutions())
+    from clawker_tpu.firewall.model import Verdict, Reason
+
+    outcome, _ = s.grade_verdict(Verdict(Action.ALLOW, Reason.ROUTE), ATTACKER_IP)
+    assert outcome is Outcome.ESCAPED
+    outcome, _ = s.grade_verdict(
+        Verdict(Action.REDIRECT, Reason.ROUTE, redirect_ip=ATTACKER_IP,
+                redirect_port=443), ATTACKER_IP)
+    assert outcome is Outcome.ESCAPED
+
+
+def test_weakened_policy_is_detected():
+    """Drop enforcement (monitor mode) and the corpus must fail -- the
+    suite detects regressions, it doesn't just bless the status quo."""
+    from clawker_tpu.adversarial import harness
+    from clawker_tpu.firewall.model import ContainerPolicy, FLAG_HOSTPROXY
+
+    s = EgressSurface(default_rules(), resolutions=default_resolutions())
+    s.maps.enroll(harness.CG, ContainerPolicy(
+        envoy_ip=harness.ENVOY_IP, dns_ip=harness.DNS_IP,
+        hostproxy_ip=harness.HOSTPROXY_IP, hostproxy_port=18374,
+        flags=FLAG_HOSTPROXY,  # FLAG_ENFORCE dropped
+    ))
+    v = s.connect(ATTACKER_IP, 443)
+    outcome, _ = s.grade_verdict(v, ATTACKER_IP)
+    assert outcome is Outcome.ESCAPED

@@ -1,0 +1,214 @@
+"""Monitor suite: compose rendering, stack lifecycle over a fake runner,
+netlogger enrichment + drain, CLI verbs.
+
+Parity bar: internal/monitor compose service set (compose.yaml.tmpl:
+11-198 -- otel-collector, prometheus, opensearch + bootstrap +
+dashboards), the six log indices (MONITORING-REFERENCE.md:5), and the
+ebpf netlogger drain->enrich->emit pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+import yaml
+
+from clawker_tpu import consts
+from clawker_tpu.config import load_config
+from clawker_tpu.firewall.hashes import zone_hash
+from clawker_tpu.firewall.maps import FakeMaps
+from clawker_tpu.firewall.model import Action, EgressEvent, PROTO_TCP, Reason
+from clawker_tpu.monitor.netlogger import NetLogger
+from clawker_tpu.monitor.stack import (
+    COMPOSE_PROJECT,
+    LOG_INDICES,
+    MonitorStack,
+    render_bootstrap_script,
+    render_compose,
+)
+from clawker_tpu.testenv import TestEnv
+
+
+@pytest.fixture
+def cfg():
+    with TestEnv() as tenv:
+        proj = tenv.base / "p"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: mon\n")
+        yield load_config(proj)
+
+
+# ---------------------------------------------------------------- rendering
+
+def test_compose_service_set(cfg):
+    compose = yaml.safe_load(render_compose(cfg.settings.monitoring))
+    assert set(compose["services"]) == {
+        "otel-collector", "opensearch", "opensearch-bootstrap",
+        "opensearch-dashboards", "prometheus",
+    }
+    assert compose["name"] == COMPOSE_PROJECT
+    # deterministic: same settings, same bytes
+    assert render_compose(cfg.settings.monitoring) == render_compose(cfg.settings.monitoring)
+
+
+def test_bootstrap_seeds_every_index():
+    script = render_bootstrap_script()
+    for index in LOG_INDICES:
+        assert f"_index_template/{index}" in script
+    assert "clawker-ebpf-egress" in LOG_INDICES  # the kernel lane exists
+
+
+def test_render_writes_stack_dir(cfg):
+    stack = MonitorStack(cfg)
+    d = stack.render()
+    for f in ("compose.yaml", "otel-config.yaml", "prometheus.yaml", "bootstrap.sh"):
+        assert (d / f).exists(), f
+    otel = yaml.safe_load((d / "otel-config.yaml").read_text())
+    assert "logs" in otel["service"]["pipelines"]
+    assert "transform/metrics" in otel["processors"]
+
+
+# ---------------------------------------------------------------- lifecycle
+
+class FakeCompose:
+    def __init__(self, rc=0, stdout=""):
+        self.calls = []
+        self.rc = rc
+        self.stdout = stdout
+
+    def __call__(self, *args):
+        self.calls.append(args)
+        return subprocess.CompletedProcess(args, self.rc, self.stdout, "")
+
+
+def test_up_down_status_over_runner(cfg):
+    runner = FakeCompose(stdout='{"Service": "opensearch", "State": "running"}\n')
+    stack = MonitorStack(cfg, runner=runner)
+    stack.up()
+    assert runner.calls[0][:2] == ("up", "-d")
+    assert (stack.dir / "compose.yaml").exists()  # up renders first
+    rows = stack.status()
+    assert rows == [{"Service": "opensearch", "State": "running"}]
+    stack.down()
+    assert runner.calls[-1][0] == "down"
+
+
+def test_up_failure_raises(cfg):
+    from clawker_tpu.monitor.stack import MonitorError
+
+    stack = MonitorStack(cfg, runner=FakeCompose(rc=1))
+    with pytest.raises(MonitorError):
+        stack.up()
+
+
+# ---------------------------------------------------------------- netlogger
+
+def _event(cg=7, ip="203.0.113.9", verdict=Action.DENY, reason=Reason.NO_ROUTE,
+           zone=""):
+    return EgressEvent(
+        ts_ns=time.monotonic_ns(), cgroup_id=cg, dst_ip=ip, dst_port=443,
+        zone_hash=zone_hash(zone) if zone else 0, verdict=verdict,
+        proto=PROTO_TCP, reason=reason,
+    )
+
+
+def test_netlogger_drains_and_enriches(tmp_path):
+    maps = FakeMaps()
+    maps.emit_event(_event(zone="example.com", verdict=Action.REDIRECT,
+                           reason=Reason.ROUTE))
+    maps.emit_event(_event(verdict=Action.DENY))
+    out = tmp_path / "egress.jsonl"
+    nl = NetLogger(
+        maps, out_path=out,
+        resolve_cgroup=lambda cg: "clawker.mon.dev" if cg == 7 else "",
+        resolve_zone=lambda zh: "example.com" if zh == zone_hash("example.com") else "",
+    )
+    assert nl.drain_once() == 2
+    recs = [json.loads(l) for l in out.read_text().splitlines()]
+    assert recs[0]["verdict"] == "REDIRECT" and recs[0]["zone"] == "example.com"
+    assert recs[0]["container"] == "clawker.mon.dev"
+    assert recs[1]["verdict"] == "DENY" and recs[1]["reason"] == "NO_ROUTE"
+    assert nl.drain_once() == 0  # ring drained
+
+
+def test_netlogger_background_loop(tmp_path):
+    maps = FakeMaps()
+    nl = NetLogger(maps, out_path=tmp_path / "e.jsonl", poll_s=0.05)
+    nl.start()
+    try:
+        maps.emit_event(_event())
+        deadline = time.time() + 5
+        while nl.emitted < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert nl.emitted == 1
+    finally:
+        # final sweep on stop picks up late events
+        maps.emit_event(_event())
+        nl.stop()
+    assert nl.emitted == 2
+
+
+def test_handler_resolvers(cfg):
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.firewall.enroll import FakeAttacher, FakeCgroupResolver
+    from clawker_tpu.firewall.runtime import build_handler
+    from clawker_tpu.monitor.netlogger import handler_resolvers
+
+    driver = FakeDriver()
+    driver.api.add_image("envoyproxy/envoy:v1.30.2")
+    maps = FakeMaps()
+    handler = build_handler(cfg, driver.engine(), maps=maps,
+                            resolver=FakeCgroupResolver(), attacher=FakeAttacher(),
+                            dns_host="127.0.0.1", dns_port=0)
+    try:
+        from clawker_tpu.engine.api import ContainerSpec
+
+        driver.api.add_image("a:1")
+        eng = driver.engine()
+        cid = eng.create_container("clawker.mon.dev", ContainerSpec(image="a:1"))
+        eng.start_container(cid)
+        cgid = handler.enable({"container_id": cid})["cgroup_id"]
+        rc, rz = handler_resolvers(handler)
+        assert rc(cgid) == cid and rc(999999) == ""
+        assert rz(zone_hash("api.anthropic.com")) == "api.anthropic.com"
+        assert rz(0) == "" and rz(12345) == ""
+    finally:
+        handler.close()
+        if handler.stack.gate is not None:
+            handler.stack.gate.stop()
+
+
+# --------------------------------------------------------------------- CLI
+
+def test_cli_monitor_init_and_egress(cfg, tmp_path):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+    from clawker_tpu.engine.drivers import FakeDriver
+
+    proj = Path(cfg.project_root)
+    runner = CliRunner()
+    res = runner.invoke(cli, ["monitor", "init"],
+                        obj=Factory(cwd=proj, driver=FakeDriver()),
+                        catch_exceptions=False)
+    assert res.exit_code == 0
+    assert "clawker-ebpf-egress" in res.stdout
+    assert (cfg.data_dir / "monitor" / "compose.yaml").exists()
+    # egress tail over a seeded log
+    logp = cfg.logs_dir / "ebpf-egress.jsonl"
+    logp.parent.mkdir(parents=True, exist_ok=True)
+    logp.write_text(json.dumps({
+        "@timestamp": "2026-07-29T00:00:00Z", "verdict": "DENY",
+        "container": "clawker.mon.dev", "dst_ip": "1.2.3.4", "dst_port": 443,
+        "zone": "", "reason": "NO_DNS_ENTRY",
+    }) + "\n")
+    res = runner.invoke(cli, ["monitor", "egress", "--deny-only"],
+                        obj=Factory(cwd=proj, driver=FakeDriver()),
+                        catch_exceptions=False)
+    assert res.exit_code == 0
+    assert "DENY" in res.stdout and "clawker.mon.dev" in res.stdout
