@@ -1,0 +1,58 @@
+"""harness/stack listing + CLI reference generation.
+
+Parity reference: internal/cmd/{harness,stack} listing verbs and
+cmd/gen-docs (cobra -> markdown, SURVEY.md 2.1/2.4).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import click
+
+from ..bundle.resolver import Resolver
+from .factory import Factory
+
+pass_factory = click.make_pass_decorator(Factory)
+
+
+@click.group("harness")
+def harness_group():
+    """Agent harness bundles (claude, codex, ...)."""
+
+
+@harness_group.command("ls")
+@pass_factory
+def harness_ls(f: Factory):
+    for h in Resolver(f.config).list("harness"):
+        click.echo(f"{h.name}\t{getattr(h, 'description', '') or ''}")
+
+
+@click.group("stack")
+def stack_group():
+    """Language stack bundles (python, go, node, ...)."""
+
+
+@stack_group.command("ls")
+@pass_factory
+def stack_ls(f: Factory):
+    for s in Resolver(f.config).list("stack"):
+        click.echo(f"{s.name}\t{getattr(s, 'base_image', '') or ''}")
+
+
+@click.command("gen-docs", hidden=True)
+@click.option("--out", type=click.Path(), default="docs/cli-reference",
+              help="Output directory for markdown files.")
+def gen_docs(out):
+    """Generate the CLI reference (one markdown file per command)."""
+    from ..docs import generate_cli_reference
+    from .root import cli as root_cli
+
+    written = generate_cli_reference(root_cli, Path(out))
+    click.echo(f"wrote {len(written)} pages under {out}")
+
+
+def register(cli: click.Group) -> None:
+    cli.add_command(harness_group)
+    cli.add_command(stack_group)
+    cli.add_command(gen_docs)

@@ -1,4 +1,5 @@
-"""Volume + network verbs (reference: internal/cmd/volume, internal/cmd/network)."""
+"""Volume verbs (reference: internal/cmd/volume; the network group
+lives in cmd_network.py)."""
 
 from __future__ import annotations
 
@@ -43,18 +44,5 @@ def volume_rm(f: Factory, names, force):
         click.echo(n)
 
 
-@click.group("network")
-def network_group():
-    """Manage the clawker network."""
-
-
-@network_group.command("ensure")
-@pass_factory
-def network_ensure(f: Factory):
-    n = f.engine().ensure_network(consts.NETWORK_NAME)
-    click.echo(n["Name"])
-
-
 def register(root: click.Group) -> None:
     root.add_command(volume_group)
-    root.add_command(network_group)

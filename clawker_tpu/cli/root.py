@@ -18,7 +18,21 @@ CONTEXT_SETTINGS = {"help_option_names": ["-h", "--help"], "max_content_width": 
 class _RootGroup(click.Group):
     """Centralized domain-error rendering (reference: internal/clawker/cmd.go
     error presentation): ClawkerErrors become clean one-line CLI errors in
-    both standalone and embedded (test) invocation modes."""
+    both standalone and embedded (test) invocation modes.  Unknown names
+    fall back to user aliases (reference: root/useraliases.go), resolved
+    by walking the expansion words through the command tree."""
+
+    def resolve_command(self, ctx: click.Context, args: list):
+        # argv-level alias expansion (docker/gh-style): flags and
+        # arguments inside an expansion survive, because parsing restarts
+        # on the rewritten argv rather than resolving a command object
+        if args and super().get_command(ctx, args[0]) is None:
+            from .cmd_settings import load_aliases
+
+            expansion = load_aliases(None).get(args[0], "")
+            if expansion:
+                args = expansion.split() + list(args[1:])
+        return super().resolve_command(ctx, args)
 
     def invoke(self, ctx: click.Context):
         try:
@@ -80,11 +94,14 @@ def register_commands() -> None:
         cmd_controlplane,
         cmd_firewall,
         cmd_fleet,
+        cmd_harness,
         cmd_image,
         cmd_init,
         cmd_loop,
         cmd_monitor,
+        cmd_network,
         cmd_project,
+        cmd_settings,
         cmd_volume,
     )
 
@@ -94,11 +111,14 @@ def register_commands() -> None:
     cmd_controlplane.register(cli)
     cmd_firewall.register(cli)
     cmd_fleet.register(cli)
+    cmd_harness.register(cli)
     cmd_image.register(cli)
     cmd_init.register(cli)
     cmd_loop.register(cli)
     cmd_monitor.register(cli)
+    cmd_network.register(cli)
     cmd_project.register(cli)
+    cmd_settings.register(cli)
     cmd_volume.register(cli)
 
 

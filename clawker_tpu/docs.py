@@ -1,0 +1,71 @@
+"""CLI reference generation: the click tree -> markdown pages.
+
+Parity reference: internal/docs (cobra -> markdown/mintlify +
+cmd/gen-docs, SURVEY.md 2.1/2.4).  One page per command, named
+``clawker_<path>.md`` like the reference's ``docs/cli-reference``, plus
+an index page; regeneration is deterministic so docs drift shows up as
+a diff.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import click
+
+
+def _page_name(path: list[str]) -> str:
+    return "clawker" + ("_" + "_".join(path) if path else "") + ".md"
+
+
+def _render_command(cmd: click.Command, path: list[str]) -> str:
+    full = " ".join(["clawker", *path])
+    lines = [f"# {full}", ""]
+    if cmd.help:
+        lines += [cmd.help.strip(), ""]
+    ctx = click.Context(cmd, info_name=full)
+    usage = cmd.collect_usage_pieces(ctx)
+    lines += ["```", f"{full} {' '.join(usage)}".rstrip(), "```", ""]
+    params = [p for p in cmd.params if isinstance(p, click.Option) and not p.hidden]
+    if params:
+        lines += ["## Options", ""]
+        for p in sorted(params, key=lambda p: p.opts[0]):
+            names = ", ".join(p.opts + p.secondary_opts)
+            lines.append(f"- `{names}` — {p.help or ''}".rstrip(" —"))
+        lines.append("")
+    if isinstance(cmd, click.Group):
+        subs = [(n, c) for n, c in sorted(cmd.commands.items()) if not c.hidden]
+        if subs:
+            lines += ["## Subcommands", ""]
+            for name, sub in subs:
+                short = (sub.get_short_help_str(limit=80) or "").strip()
+                lines.append(f"- [`{name}`]({_page_name(path + [name])}) — {short}".rstrip(" —"))
+            lines.append("")
+    return "\n".join(lines) + "\n"
+
+
+def generate_cli_reference(root: click.Group, out_dir: Path) -> list[Path]:
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    written: list[Path] = []
+
+    def walk(cmd: click.Command, path: list[str]) -> None:
+        page = out_dir / _page_name(path)
+        page.write_text(_render_command(cmd, path))
+        written.append(page)
+        if isinstance(cmd, click.Group):
+            for name, sub in sorted(cmd.commands.items()):
+                if sub.hidden:
+                    continue
+                walk(sub, path + [name])
+
+    walk(root, [])
+    index = ["# clawker CLI reference", ""]
+    for page in sorted(written):
+        title = page.stem.replace("clawker_", "clawker ").replace("_", " ")
+        if page.stem == "clawker":
+            title = "clawker"
+        index.append(f"- [{title}]({page.name})")
+    (out_dir / "README.md").write_text("\n".join(index) + "\n")
+    written.append(out_dir / "README.md")
+    return written

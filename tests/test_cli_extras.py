@@ -1,0 +1,167 @@
+"""CLI surface suite: network, settings, auth, alias, version,
+harness/stack listing, docs generation.
+
+Parity bar: the reference's command-group inventory (SURVEY.md 2.4 --
+network Docker-parity, settings, auth rotate, alias, version) and
+cmd/gen-docs; worktree verbs are covered in test_cli.py.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+from click.testing import CliRunner
+
+from clawker_tpu import consts
+from clawker_tpu.cli.factory import Factory
+from clawker_tpu.cli.root import cli
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.testenv import TestEnv
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: extras\n")
+        yield tenv, proj
+
+
+def invoke(proj, *args, driver=None, input=None):
+    return CliRunner().invoke(
+        cli, list(args), obj=Factory(cwd=proj, driver=driver or FakeDriver()),
+        catch_exceptions=False, input=input,
+    )
+
+
+# ------------------------------------------------------------------ network
+
+def test_network_verbs(env):
+    tenv, proj = env
+    drv = FakeDriver()
+    res = invoke(proj, "network", "ensure", driver=drv)
+    assert res.exit_code == 0 and consts.NETWORK_NAME in res.stdout
+    res = invoke(proj, "network", "ls", driver=drv)
+    assert consts.NETWORK_NAME in res.stdout
+    res = invoke(proj, "network", "inspect", consts.NETWORK_NAME, driver=drv)
+    assert json.loads(res.stdout)["Name"] == consts.NETWORK_NAME
+    res = invoke(proj, "network", "rm", consts.NETWORK_NAME, driver=drv)
+    assert res.exit_code == 0
+    assert consts.NETWORK_NAME not in invoke(proj, "network", "ls", driver=drv).stdout
+
+
+# ----------------------------------------------------------------- settings
+
+def test_settings_get_set_list(env):
+    tenv, proj = env
+    res = invoke(proj, "settings", "get", "firewall.enable")
+    assert res.stdout.strip() == "false"
+    res = invoke(proj, "settings", "set", "firewall.enable", "true")
+    assert res.exit_code == 0
+    assert invoke(proj, "settings", "get", "firewall.enable").stdout.strip() == "true"
+    assert "firewall" in invoke(proj, "settings", "list").stdout
+    res = invoke(proj, "settings", "get", "no.such.key")
+    assert res.exit_code != 0
+    # non-leaf get answers the whole subtree as JSON
+    res = invoke(proj, "settings", "get", "monitoring")
+    assert res.exit_code == 0 and "opensearch_port" in json.dumps(json.loads(res.stdout))
+    # value-type guard: a truthy string must never flip a boolean
+    res = CliRunner().invoke(cli, ["settings", "set", "firewall.enable", "no"],
+                             obj=Factory(cwd=proj, driver=FakeDriver()))
+    assert res.exit_code != 0 and "boolean" in res.output
+    res = CliRunner().invoke(cli, ["settings", "set", "host_proxy.port", "abc"],
+                             obj=Factory(cwd=proj, driver=FakeDriver()))
+    assert res.exit_code != 0
+
+
+# --------------------------------------------------------------------- auth
+
+def test_auth_status_and_rotate(env):
+    tenv, proj = env
+    assert "not initialized" in invoke(proj, "auth", "status").stdout
+    from clawker_tpu.firewall import pki
+
+    cfg = Factory(cwd=proj).config
+    ca1 = pki.ensure_ca(cfg.pki_dir)
+    assert "CA:" in invoke(proj, "auth", "status").stdout
+    res = invoke(proj, "auth", "rotate", input="y\n")
+    assert res.exit_code == 0
+    ca2 = pki.ensure_ca(cfg.pki_dir)
+    assert ca1.cert_pem != ca2.cert_pem
+
+
+# ------------------------------------------------------------ alias/version
+
+def test_version_cmd(env):
+    tenv, proj = env
+    from clawker_tpu import __version__
+
+    out = invoke(proj, "version").stdout
+    assert consts.PRODUCT in out and __version__ in out
+
+
+def test_alias_set_expand_dispatch(env):
+    tenv, proj = env
+    res = invoke(proj, "alias", "set", "st", "settings list")
+    assert res.exit_code == 0
+    assert "st\tsettings list" in invoke(proj, "alias", "ls").stdout
+    # the alias dispatches through the rewritten argv
+    res = invoke(proj, "st")
+    assert res.exit_code == 0
+    res = invoke(proj, "alias", "rm", "st")
+    assert res.exit_code == 0
+    res = CliRunner().invoke(cli, ["st"], obj=Factory(cwd=proj, driver=FakeDriver()))
+    assert res.exit_code != 0  # gone
+
+
+def test_alias_with_flags_and_args(env):
+    """argv-level expansion: flags inside expansions work (docker-style)."""
+    tenv, proj = env
+    invoke(proj, "alias", "set", "fg", "settings get")
+    res = invoke(proj, "fg", "firewall.enable")   # alias + trailing arg
+    assert res.exit_code == 0 and res.stdout.strip() == "false"
+    invoke(proj, "alias", "set", "sl", "settings list")
+    assert invoke(proj, "sl").exit_code == 0
+
+
+def test_corrupt_aliases_file_never_crashes_dispatch(env):
+    tenv, proj = env
+    from clawker_tpu.util import xdg
+
+    (xdg.config_dir() / "aliases.yaml").write_text("- just\n- a list\n")
+    res = CliRunner().invoke(cli, ["definitely-not-a-command"],
+                             obj=Factory(cwd=proj, driver=FakeDriver()))
+    assert res.exit_code == 2 and "No such command" in res.output
+    (xdg.config_dir() / "aliases.yaml").write_text("st: [settings, list]\n")
+    res = CliRunner().invoke(cli, ["st"], obj=Factory(cwd=proj, driver=FakeDriver()))
+    assert res.exit_code == 2  # non-string expansion ignored, clean error
+
+
+# ------------------------------------------------------- harness/stack/docs
+
+def test_harness_and_stack_ls(env):
+    tenv, proj = env
+    out = invoke(proj, "harness", "ls").stdout
+    assert "claude" in out and "codex" in out
+    out = invoke(proj, "stack", "ls").stdout
+    for s in ("python", "go", "node", "rust"):
+        assert s in out
+
+
+def test_gen_docs(env, tmp_path):
+    tenv, proj = env
+    out_dir = tmp_path / "ref"
+    res = invoke(proj, "gen-docs", "--out", str(out_dir))
+    assert res.exit_code == 0, res.output
+    pages = {p.name for p in out_dir.iterdir()}
+    assert "clawker.md" in pages and "README.md" in pages
+    assert "clawker_firewall.md" in pages
+    assert "clawker_loop.md" in pages
+    assert "clawker_worktree_add.md" in pages
+    body = (out_dir / "clawker_loop.md").read_text()
+    assert "--parallel" in body and "# clawker loop" in body
+    # hidden commands stay out of the reference
+    assert "clawker_gen-docs.md" not in pages
