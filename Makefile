@@ -1,0 +1,45 @@
+# clawker-tpu build + test targets (reference: the Makefile test tier,
+# SURVEY.md 4 -- test / test-ci / native builds / docs drift check).
+
+PY ?= python
+
+.PHONY: all test test-fast bench native ebpf-check docs docs-check \
+        adversarial graft clean
+
+all: native test
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:
+	$(PY) -m pytest tests/ -q -x -m "not slow"
+
+bench:
+	$(PY) bench.py
+
+native:
+	$(MAKE) -C native
+
+ebpf-check:
+	$(MAKE) -C native/ebpf check
+
+adversarial:
+	$(PY) -c "from clawker_tpu.adversarial import run_corpus; \
+	r = run_corpus(); print(r.to_json()); \
+	import sys; sys.exit(0 if r.ok else 1)"
+
+graft:
+	$(PY) __graft_entry__.py
+
+docs:
+	$(PY) -c "from clawker_tpu.cli.root import main; \
+	main(['gen-docs', '--out', 'docs/cli-reference'])"
+
+# regenerating must be a no-op against the committed reference
+docs-check: docs
+	git diff --exit-code docs/cli-reference \
+	|| (echo 'docs drift: run `make docs` and commit' && exit 1)
+
+clean:
+	$(MAKE) -C native clean
+	$(MAKE) -C native/ebpf clean

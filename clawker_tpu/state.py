@@ -1,0 +1,97 @@
+"""CLI state store + release update check.
+
+Parity reference: internal/state (CLI state store in the XDG state dir)
+and internal/update (GitHub release check with a TTL cache; the check
+runs in the background and surfaces a one-line teaser, never blocks a
+command -- internal/clawker/cmd.go:79-120).
+
+The fetcher is a seam: the default hits the GitHub releases API, tests
+inject a canned responder, and air-gapped hosts (TPU-VM workers with
+deny-by-default egress) simply get a cache miss and stay quiet.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+from . import __version__, consts, logsetup
+from .util import xdg
+from .util.fs import atomic_write
+
+log = logsetup.get("state")
+
+UPDATE_TTL_S = 24 * 3600
+RELEASES_URL = "https://api.github.com/repos/clawker-tpu/clawker-tpu/releases/latest"
+
+
+class StateStore:
+    """Small JSON key/value store in the XDG state dir (atomic writes)."""
+
+    def __init__(self, path: Path | None = None):
+        self.path = path or (xdg.state_dir() / "cli-state.json")
+
+    def _load(self) -> dict:
+        try:
+            return json.loads(self.path.read_text())
+        except (OSError, ValueError):
+            return {}
+
+    def get(self, key: str, default=None):
+        return self._load().get(key, default)
+
+    def set(self, key: str, value) -> None:
+        data = self._load()
+        data[key] = value
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        atomic_write(self.path, json.dumps(data, indent=1).encode())
+
+    def delete(self, key: str) -> None:
+        data = self._load()
+        if key in data:
+            del data[key]
+            atomic_write(self.path, json.dumps(data, indent=1).encode())
+
+
+def _default_fetch(timeout: float = 3.0) -> str:
+    req = urlrequest.Request(RELEASES_URL,
+                             headers={"Accept": "application/vnd.github+json"})
+    try:
+        with urlrequest.urlopen(req, timeout=timeout) as r:
+            return str(json.loads(r.read()).get("tag_name") or "")
+    except (urlerror.URLError, OSError, ValueError):
+        return ""
+
+
+def _newer(latest: str, current: str) -> bool:
+    def parse(v: str) -> tuple:
+        try:
+            return tuple(int(x) for x in v.lstrip("v").split("."))
+        except ValueError:
+            return ()
+    lp, cp = parse(latest), parse(current)
+    return bool(lp and cp and lp > cp)
+
+
+def check_for_update(*, state: StateStore | None = None, fetch=_default_fetch,
+                     now: float | None = None) -> str:
+    """Returns a teaser line when a newer release exists, else "".
+
+    TTL-cached: at most one network probe per day; failures cache an
+    empty result so offline hosts never retry per command.
+    """
+    state = state or StateStore()
+    now = time.time() if now is None else now
+    cached = state.get("update_check") or {}
+    if "at" in cached and now - float(cached["at"]) < UPDATE_TTL_S:
+        latest = str(cached.get("latest") or "")
+    else:
+        latest = fetch()
+        state.set("update_check", {"at": now, "latest": latest})
+    if latest and _newer(latest, __version__):
+        return (f"{consts.PRODUCT} {latest} is available "
+                f"(you have {__version__})")
+    return ""

@@ -442,3 +442,45 @@ def test_cli_firewall_verbs_cp_less(tmp_path):
         assert res.exit_code == 0
         out = _json.loads(res.stdout)
         assert out["allowed"] and out["zone"] == "pypi.org"
+
+
+def test_run_path_bootstrap_hooks_monitor_mode(tmp_path):
+    """`clawker run` with the firewall enabled (monitor fallback) drives
+    init through pre-start and enrollment through post-start -- the
+    container_start.go:103/:297 hook shape end-to-end."""
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+    from clawker_tpu.firewall import lifecycle
+
+    with TestEnv() as tenv:
+        tenv.write_settings("firewall:\n  enable: true\n  default_deny: false\n")
+        proj = tenv.base / "p"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: runfw\n")
+        driver = FakeDriver()
+        driver.api.add_image("clawker-runfw:default")
+        driver.api.add_image("envoyproxy/envoy:v1.30.2")
+        res = CliRunner().invoke(
+            cli, ["run", "--detach", "--workspace", "snapshot"],
+            obj=Factory(cwd=proj, driver=driver), catch_exceptions=False)
+        assert res.exit_code == 0, res.output
+        cfg_key = None
+        for key, handler in lifecycle._local_handlers.items():
+            if str(tenv.data) in key:
+                cfg_key = key
+                break
+        assert cfg_key is not None, "run path never built the local handler"
+        handler = lifecycle._local_handlers[cfg_key]
+        try:
+            assert handler.initialized
+            assert len(handler.enrollments) == 1     # the agent got enrolled
+            assert handler.maps.enrolled()
+            # the proxy container came up beside the agent
+            assert driver.engine().container_exists(consts.ENVOY_CONTAINER)
+        finally:
+            handler.close()
+            if handler.stack.gate is not None:
+                handler.stack.gate.stop()
+            del lifecycle._local_handlers[cfg_key]

@@ -1,0 +1,69 @@
+"""State store + update-check suite (reference: internal/state +
+internal/update TTL-cached release check)."""
+
+from __future__ import annotations
+
+import pytest
+
+from clawker_tpu import __version__
+from clawker_tpu.state import UPDATE_TTL_S, StateStore, _newer, check_for_update
+
+
+@pytest.fixture
+def store(tmp_path):
+    return StateStore(tmp_path / "state.json")
+
+
+def test_state_store_roundtrip(store):
+    assert store.get("k") is None
+    store.set("k", {"a": 1})
+    assert store.get("k") == {"a": 1}
+    store.set("j", [1, 2])
+    assert store.get("k") == {"a": 1} and store.get("j") == [1, 2]
+    store.delete("k")
+    assert store.get("k") is None
+
+
+def test_state_store_corrupt_file_resets(store):
+    store.path.parent.mkdir(parents=True, exist_ok=True)
+    store.path.write_text("{not json")
+    assert store.get("k") is None
+    store.set("k", 1)   # recoverable: write replaces the corrupt file
+    assert store.get("k") == 1
+
+
+def test_newer_semver():
+    assert _newer("v9.0.0", "0.1.0")
+    assert not _newer("0.0.1", "0.1.0")
+    assert not _newer("", "0.1.0")
+    assert not _newer("garbage", "0.1.0")
+
+
+def test_update_check_ttl_and_teaser(store):
+    calls = []
+
+    def fetch():
+        calls.append(1)
+        return "v99.0.0"
+
+    teaser = check_for_update(state=store, fetch=fetch, now=1000.0)
+    assert "v99.0.0" in teaser and __version__ in teaser
+    # within TTL: cached, no second probe
+    teaser2 = check_for_update(state=store, fetch=fetch, now=1000.0 + 60)
+    assert teaser2 == teaser and len(calls) == 1
+    # TTL expiry probes again
+    check_for_update(state=store, fetch=fetch, now=1000.0 + UPDATE_TTL_S + 1)
+    assert len(calls) == 2
+
+
+def test_update_check_offline_is_quiet(store):
+    calls = []
+
+    def fetch():
+        calls.append(1)
+        return ""   # network down / air-gapped
+
+    assert check_for_update(state=store, fetch=fetch, now=1.0) == ""
+    # the failure is cached too: no per-command retries
+    assert check_for_update(state=store, fetch=fetch, now=2.0) == ""
+    assert len(calls) == 1
