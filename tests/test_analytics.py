@@ -1,5 +1,7 @@
 """Analytics tests on the virtual 8-device CPU mesh."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -73,3 +75,37 @@ def test_graft_entry():
     jax.block_until_ready(out)
     assert out.shape == (256,)
     ge.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_after_premature_backend_init():
+    """The driver calls dryrun_multichip directly in a process where a JAX
+    backend may already be initialized with fewer devices (round-1 failure:
+    the real single-chip TPU came up first).  Simulate with a 1-device CPU
+    backend in a subprocess and require the function to rebuild to 8."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax\n"
+        "assert len(jax.devices()) == 1, jax.devices()\n"  # backend up, too small
+        "import __graft_entry__ as ge\n"
+        "ge.dryrun_multichip(8)\n"
+        "print('REBUILT-OK')\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    # Neutralize the axon sitecustomize (registers the real-TPU plugin at
+    # interpreter startup regardless of JAX_PLATFORMS); tests must never
+    # touch the TPU tunnel.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        timeout=300,
+    )
+    assert res.returncode == 0, res.stderr
+    assert "REBUILT-OK" in res.stdout
