@@ -145,7 +145,8 @@ REQUIRED_EGRESS_DOMAINS = (
 # Upstream resolvers for allowed zones (reference:
 # controlplane/firewall/coredns_config.go -- Cloudflare malware-blocking).
 UPSTREAM_DNS = ("1.1.1.2", "1.0.0.2")
-DOCKER_INTERNAL_DNS = "127.0.0.11"
+DOCKER_INTERNAL_DNS = "127.0.0.11"  # only valid INSIDE a container netns
+INTERNAL_ZONE = "docker.internal"   # answered from the engine inventory
 
 # ---------------------------------------------------------------------------
 # TPU-VM runtime

@@ -67,6 +67,7 @@ class CPConfig:
     watch_interval_s: float = 30.0
     drain_to_zero: bool = False
     drain_grace_polls: int = 2
+    dns_gc_interval_s: float = 30.0      # dns_cache/bypass map GC ticker
 
 
 @dataclass
@@ -92,6 +93,7 @@ class ControlPlaneDaemon:
         self.netlogger = netlogger        # monitor.netlogger.NetLogger | None
         self.subs = Subsystems()
         self._stop = threading.Event()
+        self._gc_thread: threading.Thread | None = None
         self._drained_to_zero = False
         self._healthz: ThreadingHTTPServer | None = None
         self._healthz_thread: threading.Thread | None = None
@@ -179,11 +181,33 @@ class ControlPlaneDaemon:
             except Exception as e:
                 log.error("event=netlogger_unavailable error=%s", e)
                 self.subs.unavailable.append("netlogger")
+        if self.firewall is not None and self.cfg.dns_gc_interval_s > 0:
+            # periodic dns_cache + bypass GC (reference: ebpf/dns_gc.go
+            # ticker) -- TTL expiry is enforced ONLY here, the kernel skips
+            # expires_unix at lookup by design
+            self._gc_thread = threading.Thread(
+                target=self._gc_loop, name="dns-gc", daemon=True
+            )
+            self._gc_thread.start()
         self._start_healthz()
         log.info(
             "control plane up: admin=:%s agent=:%s health=:%s",
             admin.bound_port, agent_service.bound_port, self.health_bound_port,
         )
+
+    def _gc_loop(self) -> None:
+        """Recovered worker: tick map GC until drain (serve-path contract:
+        errors degrade with a structured log, never crash)."""
+        while not self._stop.wait(self.cfg.dns_gc_interval_s):
+            try:
+                res = self.firewall.gc_tick()
+                if res.get("dns_expired") or res.get("bypass_cleared"):
+                    log.info(
+                        "event=map_gc dns_expired=%d bypass_cleared=%d",
+                        res.get("dns_expired", 0), res.get("bypass_cleared", 0),
+                    )
+            except Exception as e:
+                log.error("event=map_gc_failed error=%s", e)
 
     # ------------------------------------------------------------- healthz
 
@@ -273,6 +297,9 @@ class ControlPlaneDaemon:
         """Ordered shutdown (reference: runDrainSequence cmd.go:306)."""
         s = self.subs
         log.info("drain: begin")
+        self._stop.set()                 # stops the GC ticker
+        if self._gc_thread is not None:
+            self._gc_thread.join(2.0)
         for name, fn in (
             # firewall action queue closes FIRST (ordering INV-B2-007):
             # no mutation may land while listeners wind down

@@ -121,6 +121,19 @@ def synthesize(q: Question, rcode: int) -> bytes:
     return hdr + q.raw_question
 
 
+def synthesize_a(q: Question, ip: str, ttl: int = 60) -> bytes:
+    """Single-A-record answer (internal-zone resolution is answered
+    directly from the engine's container inventory, never forwarded)."""
+    flags = 0x8000 | 0x0400 | (q.flags & 0x0100) | RCODE_NOERROR
+    hdr = struct.pack(">HHHHHH", q.qid, flags, 1, 1, 0, 0)
+    # answer: pointer to qname at offset 12, A/IN, ttl, rdata
+    answer = (
+        struct.pack(">HHHIH", 0xC00C, QTYPE_A, 1, ttl, 4)
+        + socket.inet_aton(ip)
+    )
+    return hdr + q.raw_question + answer
+
+
 def parse_a_records(data: bytes) -> list[tuple[str, int]]:
     """(ip, ttl) for every A record in the answer section."""
     if len(data) < 12:
@@ -222,15 +235,24 @@ class DnsGate:
         maps: FirewallMaps,
         *,
         upstreams: tuple[str, ...] = consts.UPSTREAM_DNS,
-        internal_resolver: str = consts.DOCKER_INTERNAL_DNS,
+        internal_resolver: str | None = None,
+        internal_lookup=None,   # Callable[[str], str | None]: qname -> IP
         host: str = "0.0.0.0",
         port: int = consts.DNS_PORT,
     ):
+        """internal_lookup answers internal zones from the engine's
+        container inventory (the gate runs host-resident, where Docker's
+        embedded 127.0.0.11 resolver does not exist -- that address is
+        only valid inside a container netns, reference coredns_config.go
+        runs CoreDNS on the clawker network for exactly this reason).
+        internal_resolver is the in-netns fallback for gates that DO run
+        on the container network."""
         self._policy_lock = threading.Lock()
         self.policy = policy
         self.maps = maps
         self.upstreams = upstreams
         self.internal_resolver = internal_resolver
+        self.internal_lookup = internal_lookup
         self.host, self.port = host, port
         self.bound_port = 0
         self.stats = GateStats()
@@ -315,6 +337,26 @@ class DnsGate:
             return synthesize(q, RCODE_NOERROR)
         if zone.internal:
             self.stats.internal += 1
+            if self.internal_lookup is not None:
+                if q.qtype != QTYPE_A:
+                    # only A is answerable from the container inventory;
+                    # NOERROR-empty for TXT/SRV/HTTPS etc. (never fabricate
+                    # an A answer to a non-A question)
+                    return synthesize(q, RCODE_NOERROR)
+                ip = None
+                try:
+                    ip = self.internal_lookup(q.qname)
+                except Exception as e:
+                    log.warning("internal lookup failed for %s: %s", q.qname, e)
+                if ip is None:
+                    return synthesize(q, RCODE_NXDOMAIN)
+                now = int(time.time())
+                self.maps.cache_dns(
+                    ip, DnsEntry(zone_hash=zone.hash, expires_unix=now + TTL_MIN_S))
+                self.stats.cached_ips += 1
+                return synthesize_a(q, ip, ttl=TTL_MIN_S)
+            if self.internal_resolver is None:
+                return synthesize(q, RCODE_SERVFAIL)
             reply = self._forward(data, (self.internal_resolver,), tcp=tcp)
             if reply is None:
                 return synthesize(q, RCODE_SERVFAIL)
@@ -371,8 +413,3 @@ class DnsGate:
             except OSError:
                 continue
         return None
-
-
-def gc_dns_cache(maps: FirewallMaps) -> int:
-    """Periodic dns_cache GC (reference: GarbageCollectDNS manager.go:907)."""
-    return maps.expire_dns()

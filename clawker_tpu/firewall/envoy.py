@@ -46,18 +46,23 @@ class EnvoyBundle:
     mitm_domains: list[str] = field(default_factory=list)    # need CA-signed certs
 
 
-def _cluster_name(domain: str, port: int) -> str:
-    return f"up_{domain.replace('.', '_').replace('*', 'w')}_{port}"
+def _cluster_name(domain: str, port: int, *, tls: bool) -> str:
+    # tls mode is part of the key: an exact MITM rule (re-encrypt upstream)
+    # and a passthrough rule sharing an apex must not collide on one cluster.
+    mode = "tls" if tls else "plain"
+    return f"up_{domain.replace('.', '_').replace('*', 'w')}_{port}_{mode}"
 
 
 def _cluster(domain: str, port: int, *, tls: bool) -> dict:
+    """Exact-host upstream: LOGICAL_DNS pinned to the rule's host."""
+    name = _cluster_name(domain, port, tls=tls)
     c = {
-        "name": _cluster_name(domain, port),
+        "name": name,
         "type": "LOGICAL_DNS",
         "dns_lookup_family": "V4_ONLY",
         "connect_timeout": "10s",
         "load_assignment": {
-            "cluster_name": _cluster_name(domain, port),
+            "cluster_name": name,
             "endpoints": [{
                 "lb_endpoints": [{
                     "endpoint": {
@@ -78,6 +83,66 @@ def _cluster(domain: str, port: int, *, tls: bool) -> dict:
             },
         }
     return c
+
+
+# Dynamic-forward-proxy upstreams for wildcard rules: the destination host is
+# whatever subdomain the client named (SNI for passthrough, Host/:authority
+# for MITM/HTTP), so it cannot be pinned at config time.  Parity:
+# envoy_config.go:269-297 (httpsWildcardUpstreamLayer / httpWildcardUpstream
+# use DFP; exact rules keep pinned clusters).
+DFP_CACHE_PLAIN = "dfp_cache_plain"
+DFP_CACHE_TLS = "dfp_cache_tls"
+DFP_CLUSTER_PLAIN = "dfp_plain"
+DFP_CLUSTER_TLS = "dfp_tls"
+
+
+def _dfp_cache(name: str) -> dict:
+    return {"name": name, "dns_lookup_family": "V4_ONLY"}
+
+
+def _dfp_cluster(name: str, cache: str, *, tls: bool) -> dict:
+    c = {
+        "name": name,
+        "lb_policy": "CLUSTER_PROVIDED",
+        "connect_timeout": "10s",
+        "cluster_type": {
+            "name": "envoy.clusters.dynamic_forward_proxy",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions.clusters.dynamic_forward_proxy.v3.ClusterConfig",
+                "dns_cache_config": _dfp_cache(cache),
+            },
+        },
+    }
+    if tls:
+        # auto_sni/auto_san_validation: SNI + cert check follow the request
+        # authority, since there is no single configurable hostname.
+        c["typed_extension_protocol_options"] = {
+            "envoy.extensions.upstreams.http.v3.HttpProtocolOptions": {
+                "@type": "type.googleapis.com/envoy.extensions.upstreams.http.v3.HttpProtocolOptions",
+                "upstream_http_protocol_options": {
+                    "auto_sni": True,
+                    "auto_san_validation": True,
+                },
+                "explicit_http_config": {"http_protocol_options": {}},
+            }
+        }
+        c["transport_socket"] = {
+            "name": "envoy.transport_sockets.tls",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions.transport_sockets.tls.v3.UpstreamTlsContext"
+            },
+        }
+    return c
+
+
+def _dfp_http_filter(cache: str) -> dict:
+    return {
+        "name": "envoy.filters.http.dynamic_forward_proxy",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions.filters.http.dynamic_forward_proxy.v3.FilterConfig",
+            "dns_cache_config": _dfp_cache(cache),
+        },
+    }
 
 
 def _access_log() -> list[dict]:
@@ -112,14 +177,28 @@ def _sni_names(domain: str) -> list[str]:
 
 
 def _mitm_chain(rule: EgressRule, cert_dir: str) -> dict:
-    apex = rule.dst[2:] if rule.dst.startswith("*.") else rule.dst
+    wildcard = rule.dst.startswith("*.")
+    apex = rule.dst[2:] if wildcard else rule.dst
+    # Wildcard: upstream host is the request authority (any subdomain), so
+    # route through the TLS dynamic-forward-proxy cluster; exact: pinned.
+    cluster = (
+        DFP_CLUSTER_TLS
+        if wildcard
+        else _cluster_name(apex, rule.effective_port(), tls=True)
+    )
     routes = [
-        {
-            "match": {"prefix": p},
-            "route": {"cluster": _cluster_name(apex, rule.effective_port())},
-        }
+        {"match": {"prefix": p}, "route": {"cluster": cluster}}
         for p in sorted(rule.paths)
     ]
+    http_filters = []
+    if wildcard:
+        http_filters.append(_dfp_http_filter(DFP_CACHE_TLS))
+    http_filters.append({
+        "name": "envoy.filters.http.router",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions.filters.http.router.v3.Router"
+        },
+    })
     return {
         "filter_chain_match": {"server_names": _sni_names(rule.dst)},
         "transport_socket": {
@@ -140,12 +219,7 @@ def _mitm_chain(rule: EgressRule, cert_dir: str) -> dict:
                 "@type": "type.googleapis.com/envoy.extensions.filters.network.http_connection_manager.v3.HttpConnectionManager",
                 "stat_prefix": f"mitm_{apex.replace('.', '_')}",
                 "access_log": _access_log(),
-                "http_filters": [{
-                    "name": "envoy.filters.http.router",
-                    "typed_config": {
-                        "@type": "type.googleapis.com/envoy.extensions.filters.http.router.v3.Router"
-                    },
-                }],
+                "http_filters": http_filters,
                 "route_config": {
                     "name": f"paths_{apex.replace('.', '_')}",
                     "virtual_hosts": [{
@@ -161,18 +235,36 @@ def _mitm_chain(rule: EgressRule, cert_dir: str) -> dict:
 
 
 def _passthrough_chain(rule: EgressRule) -> dict:
-    apex = rule.dst[2:] if rule.dst.startswith("*.") else rule.dst
+    wildcard = rule.dst.startswith("*.")
+    apex = rule.dst[2:] if wildcard else rule.dst
+    filters = []
+    if wildcard:
+        # SNI-derived upstream: the client named some subdomain; forward the
+        # bytes to that host, not the apex (sni_dynamic_forward_proxy sets
+        # the upstream from the sniffed SNI).
+        filters.append({
+            "name": "envoy.filters.network.sni_dynamic_forward_proxy",
+            "typed_config": {
+                "@type": "type.googleapis.com/envoy.extensions.filters.network.sni_dynamic_forward_proxy.v3.FilterConfig",
+                "port_value": rule.effective_port(),
+                "dns_cache_config": _dfp_cache(DFP_CACHE_PLAIN),
+            },
+        })
+        cluster = DFP_CLUSTER_PLAIN
+    else:
+        cluster = _cluster_name(apex, rule.effective_port(), tls=False)
+    filters.append({
+        "name": "envoy.filters.network.tcp_proxy",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions.filters.network.tcp_proxy.v3.TcpProxy",
+            "stat_prefix": f"sni_{apex.replace('.', '_')}",
+            "cluster": cluster,
+            "access_log": _access_log(),
+        },
+    })
     return {
         "filter_chain_match": {"server_names": _sni_names(rule.dst)},
-        "filters": [{
-            "name": "envoy.filters.network.tcp_proxy",
-            "typed_config": {
-                "@type": "type.googleapis.com/envoy.extensions.filters.network.tcp_proxy.v3.TcpProxy",
-                "stat_prefix": f"sni_{apex.replace('.', '_')}",
-                "cluster": _cluster_name(apex, rule.effective_port()),
-                "access_log": _access_log(),
-            },
-        }],
+        "filters": filters,
     }
 
 
@@ -187,7 +279,7 @@ def _tcp_listener(rule: EgressRule, port: int) -> dict:
                 "typed_config": {
                     "@type": "type.googleapis.com/envoy.extensions.filters.network.tcp_proxy.v3.TcpProxy",
                     "stat_prefix": f"tcp_{apex.replace('.', '_')}_{rule.effective_port()}",
-                    "cluster": _cluster_name(apex, rule.effective_port()),
+                    "cluster": _cluster_name(apex, rule.effective_port(), tls=False),
                     "access_log": _access_log(),
                 },
             }]
@@ -196,21 +288,41 @@ def _tcp_listener(rule: EgressRule, port: int) -> dict:
 
 
 def _http_listener(rules: list[EgressRule], port: int) -> dict:
-    """One plain-HTTP listener; Host-header routing across all http rules."""
+    """One plain-HTTP listener; Host-header routing across all http rules.
+
+    Wildcard rules route to the plaintext dynamic-forward-proxy cluster
+    (upstream = whatever in-zone subdomain the Host header names); exact
+    rules keep their pinned clusters.
+    """
     vhosts = []
+    any_wildcard = False
     for rule in rules:
-        apex = rule.dst[2:] if rule.dst.startswith("*.") else rule.dst
+        wildcard = rule.dst.startswith("*.")
+        apex = rule.dst[2:] if wildcard else rule.dst
         domains = [apex, f"{apex}:*"]
-        if rule.dst.startswith("*."):
+        if wildcard:
+            any_wildcard = True
             domains += [f"*.{apex}", f"*.{apex}:*"]
+            cluster = DFP_CLUSTER_PLAIN
+        else:
+            cluster = _cluster_name(apex, rule.effective_port(), tls=False)
         vhosts.append({
             "name": f"http_{apex.replace('.', '_')}",
             "domains": sorted(domains),
             "routes": [{
                 "match": {"prefix": p},
-                "route": {"cluster": _cluster_name(apex, rule.effective_port())},
+                "route": {"cluster": cluster},
             } for p in (sorted(rule.paths) or ["/"])],
         })
+    http_filters = []
+    if any_wildcard:
+        http_filters.append(_dfp_http_filter(DFP_CACHE_PLAIN))
+    http_filters.append({
+        "name": "envoy.filters.http.router",
+        "typed_config": {
+            "@type": "type.googleapis.com/envoy.extensions.filters.http.router.v3.Router"
+        },
+    })
     return {
         "name": f"http_{port}",
         "address": {"socket_address": {"address": "0.0.0.0", "port_value": port}},
@@ -221,12 +333,7 @@ def _http_listener(rules: list[EgressRule], port: int) -> dict:
                     "@type": "type.googleapis.com/envoy.extensions.filters.network.http_connection_manager.v3.HttpConnectionManager",
                     "stat_prefix": "http_egress",
                     "access_log": _access_log(),
-                    "http_filters": [{
-                        "name": "envoy.filters.http.router",
-                        "typed_config": {
-                            "@type": "type.googleapis.com/envoy.extensions.filters.http.router.v3.Router"
-                        },
-                    }],
+                    "http_filters": http_filters,
                     "route_config": {
                         "name": "http_egress",
                         "virtual_hosts": vhosts,
@@ -259,7 +366,8 @@ def generate_envoy_config(
     next_port = tcp_port_base
 
     for rule in ordered:
-        apex = rule.dst[2:] if rule.dst.startswith("*.") else rule.dst
+        wildcard = rule.dst.startswith("*.")
+        apex = rule.dst[2:] if wildcard else rule.dst
         if not apex:
             continue
         port = rule.effective_port()
@@ -267,20 +375,41 @@ def generate_envoy_config(
             if rule.paths:
                 tls_chains.append(_mitm_chain(rule, cert_dir))
                 mitm_domains.append(apex)
-                clusters.setdefault(_cluster_name(apex, port),
-                                    _cluster(apex, port, tls=True))
+                if wildcard:
+                    clusters.setdefault(
+                        DFP_CLUSTER_TLS,
+                        _dfp_cluster(DFP_CLUSTER_TLS, DFP_CACHE_TLS, tls=True))
+                else:
+                    clusters.setdefault(_cluster_name(apex, port, tls=True),
+                                        _cluster(apex, port, tls=True))
             else:
                 tls_chains.append(_passthrough_chain(rule))
-                clusters.setdefault(_cluster_name(apex, port),
-                                    _cluster(apex, port, tls=False))
+                if wildcard:
+                    clusters.setdefault(
+                        DFP_CLUSTER_PLAIN,
+                        _dfp_cluster(DFP_CLUSTER_PLAIN, DFP_CACHE_PLAIN, tls=False))
+                else:
+                    clusters.setdefault(_cluster_name(apex, port, tls=False),
+                                        _cluster(apex, port, tls=False))
         elif rule.proto == "http":
             http_rules.append(rule)
-            clusters.setdefault(_cluster_name(apex, port),
-                                _cluster(apex, port, tls=False))
+            if wildcard:
+                clusters.setdefault(
+                    DFP_CLUSTER_PLAIN,
+                    _dfp_cluster(DFP_CLUSTER_PLAIN, DFP_CACHE_PLAIN, tls=False))
+            else:
+                clusters.setdefault(_cluster_name(apex, port, tls=False),
+                                    _cluster(apex, port, tls=False))
         elif rule.proto == "tcp":
+            if wildcard:
+                # Opaque TCP carries no L7 signal (no SNI/Host) to derive the
+                # in-zone subdomain from, so no proxy lane is allocated: the
+                # kernel direct-allows the flow, still DNS-gated by the
+                # dns_cache zone match (same model as udp allows).
+                continue
             tcp_listeners.append(_tcp_listener(rule, next_port))
             tcp_ports[rule.key()] = next_port
-            clusters.setdefault(_cluster_name(apex, port),
+            clusters.setdefault(_cluster_name(apex, port, tls=False),
                                 _cluster(apex, port, tls=False))
             next_port += 1
         # udp rules never reach Envoy (kernel allows them directly)

@@ -282,6 +282,23 @@ class FirewallHandler:
             n += 1
         return n
 
+    def gc_tick(self) -> dict:
+        """Periodic map GC: expire dns_cache entries + stale bypass deadlines.
+
+        The kernel deliberately skips expires_unix at lookup (common.h:98:
+        TTL "enforced exclusively by userspace GC"), so without this ticker
+        stale ip->zone entries keep direct-ALLOW routes open long past DNS
+        TTL.  Reference: ebpf/dns_gc.go (GarbageCollectDNS on a ticker).
+        Serialized through the action queue like every other map mutation.
+        """
+        def act():
+            return {
+                "dns_expired": self.maps.expire_dns(),
+                "bypass_cleared": self.clear_expired_bypass(),
+            }
+
+        return self.queue.run(act)
+
     def add_rules(self, req: dict) -> dict:
         raw = req.get("rules") or []
         new = [from_dict(EgressRule, r) for r in raw]

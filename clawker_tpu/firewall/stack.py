@@ -144,12 +144,35 @@ class FirewallStack:
 
     # ---------------------------------------------------------- dns gate
 
+    def internal_lookup(self, qname: str) -> str | None:
+        """docker.internal resolution from the engine's inventory: the gate
+        is host-resident, so Docker's embedded 127.0.0.11 resolver (netns-
+        local) is unreachable -- answer ``<name>.docker.internal`` with the
+        container's clawker-net address via inspect instead."""
+        name = qname.strip(".").lower()
+        suffix = "." + consts.INTERNAL_ZONE
+        if name.endswith(suffix):
+            name = name[: -len(suffix)]
+        if not name:
+            return None
+        try:
+            info = self.engine.inspect_container(name)
+        except ClawkerError:
+            return None
+        nets = ((info.get("NetworkSettings") or {}).get("Networks") or {})
+        net = nets.get(consts.NETWORK_NAME)
+        if net and net.get("IPAddress"):
+            return net["IPAddress"]
+        ip = (info.get("NetworkSettings") or {}).get("IPAddress")
+        return ip or None
+
     def ensure_gate(self, rules: list[EgressRule]) -> DnsGate:
         policy = ZonePolicy.from_rules(rules)
         if self.gate is None:
             self.gate = DnsGate(
                 policy, self.maps,
                 upstreams=self.upstreams,
+                internal_lookup=self.internal_lookup,
                 host=self.dns_host or self.gateway_ip(),
                 port=self.dns_port,
             )

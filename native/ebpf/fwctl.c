@@ -83,17 +83,23 @@ static int cmd_load(const char *obj_path, const char *pin_dir)
 	obj = bpf_object__open_file(obj_path, NULL);
 	if (!obj)
 		return die("open object");
-	if (bpf_object__load(obj))
-		return die("load object (verifier)");
 
-	/* maps pin flat under pin_dir (bpfsys.py opens <pin_dir>/<name>);
-	 * programs pin under pin_dir/progs/ */
+	/* Maps pin flat under pin_dir (bpfsys.py opens <pin_dir>/<name>).
+	 * Setting the pin path BEFORE load makes libbpf REUSE a compatible
+	 * existing pin instead of creating a fresh map: programs already
+	 * attached to cgroups keep enforcing the same maps userspace writes
+	 * to.  Unlink+re-pin here would silently decouple enforcement from
+	 * the control plane until every cgroup re-attached.  An existing pin
+	 * with a changed schema fails the load -- run `fwctl unload` first
+	 * (refuse, never orphan). */
 	bpf_object__for_each_map(map, obj) {
 		pin_path(path, sizeof(path), pin_dir, NULL, bpf_map__name(map));
-		unlink(path);
-		if (bpf_map__pin(map, path))
+		if (bpf_map__set_pin_path(map, path))
 			return die(path);
 	}
+	if (bpf_object__load(obj))
+		return die("load object (verifier, or incompatible existing "
+			   "pin -- `fwctl unload` to reset)");
 	snprintf(path, sizeof(path), "%s/progs", pin_dir);
 	mkdir(path, 0755);
 	bpf_object__for_each_program(prog, obj) {

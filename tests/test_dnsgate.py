@@ -222,6 +222,57 @@ def test_internal_zone_forwards_to_docker_resolver():
     internal.stop()
 
 
+def test_internal_zone_answered_from_engine_inventory():
+    """Host-resident gates answer docker.internal from the engine's
+    container inventory (127.0.0.11 only exists inside a container netns,
+    so forwarding there from the CP daemon can never work)."""
+    maps = FakeMaps()
+    gate = DnsGate(
+        ZonePolicy.from_rules([]), maps,
+        upstreams=("up:1",),
+        internal_lookup=lambda name: {"db.docker.internal": "172.28.0.9"}.get(name),
+        host="127.0.0.1", port=0,
+    )
+    reply = gate.serve_packet(make_query("db.docker.internal"))
+    assert reply is not None
+    assert [ip for ip, _ in parse_a_records(reply)] == ["172.28.0.9"]
+    assert maps.lookup_dns("172.28.0.9") is not None
+    # unknown container: NXDOMAIN, nothing cached
+    reply = gate.serve_packet(make_query("ghost.docker.internal"))
+    assert struct.unpack(">H", reply[2:4])[0] & 0xF == RCODE_NXDOMAIN
+    assert maps.lookup_dns("1.1.1.1") is None
+
+
+def test_stack_internal_lookup_resolves_via_inspect():
+    """FirewallStack.internal_lookup: <name>.docker.internal -> the
+    container's clawker-net address via the engine API."""
+    from clawker_tpu import consts
+    from clawker_tpu.engine.api import ContainerSpec
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.firewall.stack import FirewallStack
+
+    driver = FakeDriver()
+    driver.api.add_image("img:1")
+    eng = driver.engine()
+    eng.ensure_network(consts.NETWORK_NAME)
+    ip = eng.network_static_ip(consts.NETWORK_NAME, 9)
+    cid = eng.create_container(
+        "clawker.proj.db",
+        ContainerSpec(image="img:1", network=consts.NETWORK_NAME, static_ip=ip),
+    )
+    eng.start_container(cid)
+    import pathlib
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        stack = FirewallStack(
+            eng, FakeMaps(),
+            conf_dir=pathlib.Path(td) / "conf", pki_dir=pathlib.Path(td) / "pki",
+        )
+        assert stack.internal_lookup("clawker.proj.db.docker.internal") == ip
+        assert stack.internal_lookup("nope.docker.internal") is None
+
+
 def test_upstream_down_servfail():
     maps = FakeMaps()
     gate = _patched_gate([EgressRule(dst="*.example.com")], maps, 1)

@@ -339,6 +339,95 @@ def test_envoy_config_deterministic_and_structured():
     assert f"http_{consts.ENVOY_TCP_PORT_BASE + 1}" in listeners
 
 
+def test_envoy_wildcard_rules_use_dynamic_forward_proxy():
+    """Wildcard rules must not pin upstreams to the apex host: traffic to
+    api.example.com must reach api.example.com (SNI/Host-derived upstream),
+    not example.com.  Parity: envoy_config.go:269-297 (DFP upstreams)."""
+    from clawker_tpu.firewall import envoy as envoy_mod
+
+    rules = [
+        EgressRule(dst="*.example.com", proto="https"),                  # passthrough
+        EgressRule(dst="*.mitm.dev", proto="https", paths=["/api/"]),    # MITM
+        EgressRule(dst="*.plainhttp.io", proto="http"),                  # http
+        EgressRule(dst="exact.net", proto="https"),                      # exact control
+    ]
+    b = generate_envoy_config(rules)
+    cfg = yaml.safe_load(b.config_yaml)
+    clusters = {c["name"]: c for c in cfg["static_resources"]["clusters"]}
+
+    # DFP clusters exist; no LOGICAL_DNS cluster is pinned to a wildcard apex
+    assert envoy_mod.DFP_CLUSTER_PLAIN in clusters
+    assert envoy_mod.DFP_CLUSTER_TLS in clusters
+    for name in (envoy_mod.DFP_CLUSTER_PLAIN, envoy_mod.DFP_CLUSTER_TLS):
+        assert clusters[name]["cluster_type"]["name"] == \
+            "envoy.clusters.dynamic_forward_proxy"
+    pinned_hosts = {
+        ep["endpoint"]["address"]["socket_address"]["address"]
+        for c in clusters.values()
+        if "load_assignment" in c
+        for e in c["load_assignment"]["endpoints"]
+        for ep in e["lb_endpoints"]
+    }
+    assert "example.com" not in pinned_hosts
+    assert "mitm.dev" not in pinned_hosts
+    assert "plainhttp.io" not in pinned_hosts
+    assert "exact.net" in pinned_hosts  # exact rules stay pinned
+
+    listeners = {l["name"]: l for l in cfg["static_resources"]["listeners"]}
+    chains = listeners["tls_egress"]["filter_chains"]
+    # wildcard passthrough chain: sni_dynamic_forward_proxy ahead of tcp_proxy
+    pt = next(c for c in chains
+              if "*.example.com" in c["filter_chain_match"]["server_names"])
+    assert [f["name"] for f in pt["filters"]] == [
+        "envoy.filters.network.sni_dynamic_forward_proxy",
+        "envoy.filters.network.tcp_proxy",
+    ]
+    assert pt["filters"][1]["typed_config"]["cluster"] == envoy_mod.DFP_CLUSTER_PLAIN
+    # wildcard MITM chain: DFP http filter + routes to the TLS DFP cluster
+    mitm = next(c for c in chains
+                if "*.mitm.dev" in c["filter_chain_match"]["server_names"])
+    hcm = mitm["filters"][0]["typed_config"]
+    assert hcm["http_filters"][0]["name"] == "envoy.filters.http.dynamic_forward_proxy"
+    for vh in hcm["route_config"]["virtual_hosts"]:
+        for route in vh["routes"]:
+            assert route["route"]["cluster"] == envoy_mod.DFP_CLUSTER_TLS
+    # exact rule keeps a plain per-host passthrough chain (no DFP filter)
+    exact = next(c for c in chains
+                 if c["filter_chain_match"]["server_names"] == ["exact.net"])
+    assert [f["name"] for f in exact["filters"]] == [
+        "envoy.filters.network.tcp_proxy"]
+
+
+def test_envoy_wildcard_tcp_gets_no_proxy_lane():
+    """Opaque TCP has no SNI/Host to derive an in-zone upstream from, so a
+    wildcard tcp rule allocates no Envoy lane; the kernel direct-allows it,
+    DNS-gated by the zone match (build_routes falls back to ALLOW)."""
+    from clawker_tpu.firewall.policy import Action, build_routes
+
+    rules = [EgressRule(dst="*.ssh.example", proto="tcp", port=22)]
+    b = generate_envoy_config(rules)
+    assert b.tcp_ports == {}
+    table = build_routes(rules, envoy_ip="172.28.0.2",
+                         tls_port=consts.ENVOY_TLS_PORT, tcp_ports=b.tcp_ports)
+    (val,) = table.values()
+    assert val.action == Action.ALLOW
+
+
+def test_envoy_shared_apex_mitm_and_passthrough_clusters_distinct():
+    """An exact MITM rule (TLS re-encrypt upstream) and a passthrough rule on
+    the same apex must land on distinct clusters (tls mode is in the key)."""
+    rules = [
+        EgressRule(dst="dual.example", proto="https", paths=["/v1/"]),
+        EgressRule(dst="dual.example", proto="https", port=8443),
+    ]
+    b = generate_envoy_config(rules)
+    cfg = yaml.safe_load(b.config_yaml)
+    clusters = {c["name"]: c for c in cfg["static_resources"]["clusters"]}
+    tls_clusters = [c for c in clusters.values() if "transport_socket" in c]
+    plain_clusters = [c for c in clusters.values() if "transport_socket" not in c]
+    assert len(tls_clusters) == 1 and len(plain_clusters) == 1
+
+
 def test_rules_store_roundtrip(tmp_path: Path):
     store = RulesStore(tmp_path / "egress-rules.yaml")
     added = store.add([EgressRule(dst="a.com"), EgressRule(dst="a.com")])
@@ -357,6 +446,58 @@ def test_rules_store_rejects_bad_rules(tmp_path: Path):
         store.add([EgressRule(dst="x.com", proto="quic")])
     with pytest.raises(RuleError):
         store.add([EgressRule(dst="")])
+
+
+def test_gc_tick_expires_dns_and_bypass(env):
+    """DNS TTL is enforced ONLY by userspace GC (kernel skips expires_unix
+    at lookup by design); gc_tick must remove expired entries + bypass."""
+    from clawker_tpu.firewall.maps import DnsEntry
+
+    _, driver, maps, handler = env
+    handler.init({})
+    now = int(time.time())
+    maps.cache_dns("1.2.3.4", DnsEntry(zone_hash("example.com"), expires_unix=now - 5))
+    maps.cache_dns("5.6.7.8", DnsEntry(zone_hash("example.com"), expires_unix=now + 300))
+    cid = start_agent(driver)
+    handler.enable({"container_id": cid})
+    cg = handler.enrollments[cid].cgroup_id
+    maps.set_bypass(cg, now - 5)  # deadline already past
+    res = handler.gc_tick()
+    assert res["dns_expired"] == 1
+    assert res["bypass_cleared"] == 1
+    assert maps.lookup_dns("1.2.3.4") is None
+    assert maps.lookup_dns("5.6.7.8") is not None
+
+
+def test_cp_daemon_runs_periodic_map_gc(env, tmp_path):
+    """The CP daemon must schedule gc_tick on a ticker (reference:
+    ebpf/dns_gc.go GarbageCollectDNS loop), not just clear at boot."""
+    from clawker_tpu.controlplane.daemon import ControlPlaneDaemon, CPConfig
+    from clawker_tpu.firewall.maps import DnsEntry
+
+    _, driver, maps, handler = env
+    handler.init({})
+    maps.cache_dns(
+        "9.9.9.9",
+        DnsEntry(zone_hash("example.com"), expires_unix=int(time.time()) - 5),
+    )
+    daemon = ControlPlaneDaemon(
+        CPConfig(
+            pki_dir=tmp_path / "pki", registry_path=tmp_path / "reg.sqlite",
+            host="127.0.0.1", admin_port=0, agent_port=0, health_port=0,
+            dns_gc_interval_s=0.05,
+        ),
+        driver.engine(),
+        firewall=handler,
+    )
+    daemon.start()
+    try:
+        deadline = time.time() + 5.0
+        while maps.lookup_dns("9.9.9.9") is not None and time.time() < deadline:
+            time.sleep(0.02)
+        assert maps.lookup_dns("9.9.9.9") is None
+    finally:
+        daemon.drain()
 
 
 # --------------------------------------------- CP daemon + admin API wiring
