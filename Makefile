@@ -21,7 +21,7 @@ native:
 	$(MAKE) -C native
 
 ebpf-check:
-	$(MAKE) -C native/ebpf check
+	./scripts/check_bpf.sh
 
 adversarial:
 	$(PY) -c "from clawker_tpu.adversarial import run_corpus; \

@@ -1,0 +1,267 @@
+/* fw_harness.c - userspace harness around the REAL kernel programs.
+ *
+ * Compiles fw.c with the host compiler (CLAWKER_FW_HARNESS routes the BPF
+ * helpers to the emulations below) into a shared library the unit suite
+ * drives via ctypes (tests/test_fw_kernel.py).  The point: fw_decide and
+ * every program entry run as written, against emulated maps, so the
+ * kernel decision logic is differential-tested against the Python policy
+ * oracle (clawker_tpu/firewall/policy.py) without clang, libbpf, or a
+ * verifier in the dev environment.  The clang/verifier gate proper is
+ * scripts/check_bpf.sh, run where clang exists (TPU-VM provisioning).
+ *
+ * Map emulation: fixed-slot linear tables keyed by memcmp -- semantics
+ * (update/lookup/delete, LRU approximated as plain hash) match what the
+ * programs assume; capacity-full behaves like E2BIG (update fails),
+ * which none of the tests rely on.
+ */
+#define CLAWKER_FW_HARNESS
+#include "fw.c"
+
+#include <string.h>
+
+/* ------------------------------------------------------------ map tables */
+
+#define FWH_SLOTS 4096
+#define FWH_KEY_MAX 16
+#define FWH_VAL_MAX 32
+
+struct fwh_map {
+	void *id;          /* &containers, &bypass, ... (map identity) */
+	int key_sz, val_sz;
+	int used[FWH_SLOTS];
+	unsigned char keys[FWH_SLOTS][FWH_KEY_MAX];
+	unsigned char vals[FWH_SLOTS][FWH_VAL_MAX];
+};
+
+/* map ids exported to Python (order is part of the harness ABI) */
+enum {
+	FWH_MAP_CONTAINERS = 0,
+	FWH_MAP_BYPASS,
+	FWH_MAP_DNS,
+	FWH_MAP_ROUTES,
+	FWH_MAP_UDP_FLOWS,
+	FWH_MAP_TCP_FLOWS,
+	FWH_MAP_RATELIMIT,
+	FWH_N_MAPS,
+};
+
+static struct fwh_map fwh_maps[FWH_N_MAPS];
+
+static void fwh_bind_maps(void)
+{
+	static const struct { void *id; int k, v; } spec[FWH_N_MAPS] = {
+		[FWH_MAP_CONTAINERS] = { &containers, 8, sizeof(struct fw_container) },
+		[FWH_MAP_BYPASS]     = { &bypass, 8, 8 },
+		[FWH_MAP_DNS]        = { &dns_cache, 4, sizeof(struct fw_dns) },
+		[FWH_MAP_ROUTES]     = { &routes, sizeof(struct fw_route_key),
+					 sizeof(struct fw_route) },
+		[FWH_MAP_UDP_FLOWS]  = { &udp_flows, 8, sizeof(struct fw_udp_flow) },
+		[FWH_MAP_TCP_FLOWS]  = { &tcp_flows, 8, sizeof(struct fw_udp_flow) },
+		[FWH_MAP_RATELIMIT]  = { &ratelimit, 8, sizeof(struct fw_rl) },
+	};
+	int i;
+
+	for (i = 0; i < FWH_N_MAPS; i++) {
+		fwh_maps[i].id = spec[i].id;
+		fwh_maps[i].key_sz = spec[i].k;
+		fwh_maps[i].val_sz = spec[i].v;
+	}
+}
+
+static struct fwh_map *fwh_by_ptr(void *map)
+{
+	int i;
+
+	if (!fwh_maps[0].id)
+		fwh_bind_maps();
+	for (i = 0; i < FWH_N_MAPS; i++)
+		if (fwh_maps[i].id == map)
+			return &fwh_maps[i];
+	return 0;
+}
+
+static int fwh_find(struct fwh_map *m, const void *key)
+{
+	int i;
+
+	for (i = 0; i < FWH_SLOTS; i++)
+		if (m->used[i] && !memcmp(m->keys[i], key, m->key_sz))
+			return i;
+	return -1;
+}
+
+void *fwh_map_lookup_elem(void *map, const void *key)
+{
+	struct fwh_map *m = fwh_by_ptr(map);
+	int i;
+
+	if (!m)
+		return 0;
+	i = fwh_find(m, key);
+	return i < 0 ? 0 : (void *)m->vals[i];
+}
+
+long fwh_map_update_elem(void *map, const void *key, const void *value,
+			 __u64 flags)
+{
+	struct fwh_map *m = fwh_by_ptr(map);
+	int i;
+
+	(void)flags;
+	if (!m)
+		return -1;
+	i = fwh_find(m, key);
+	if (i < 0) {
+		for (i = 0; i < FWH_SLOTS; i++)
+			if (!m->used[i])
+				break;
+		if (i >= FWH_SLOTS)
+			return -1;
+		m->used[i] = 1;
+		memcpy(m->keys[i], key, m->key_sz);
+	}
+	memcpy(m->vals[i], value, m->val_sz);
+	return 0;
+}
+
+long fwh_map_delete_elem(void *map, const void *key)
+{
+	struct fwh_map *m = fwh_by_ptr(map);
+	int i;
+
+	if (!m)
+		return -1;
+	i = fwh_find(m, key);
+	if (i < 0)
+		return -1;
+	m->used[i] = 0;
+	return 0;
+}
+
+/* --------------------------------------------------- clock/identity stubs */
+
+static __u64 fwh_now_ns;
+static __u64 fwh_boot_ns;
+static __u64 fwh_cgroup;
+static __u64 fwh_cookie;
+
+__u64 fwh_ktime_get_ns(void) { return fwh_now_ns; }
+__u64 fwh_ktime_get_boot_ns(void) { return fwh_boot_ns; }
+__u64 fwh_get_current_cgroup_id(void) { return fwh_cgroup; }
+__u64 fwh_get_socket_cookie(void *ctx) { (void)ctx; return fwh_cookie; }
+
+/* ------------------------------------------------------- ringbuf emulation */
+
+#define FWH_EVQ 256
+static struct fw_event fwh_events[FWH_EVQ];
+static int fwh_ev_head, fwh_ev_count, fwh_ev_dropped;
+static struct fw_event fwh_pending;  /* one in-flight reserve, like the ring */
+static int fwh_reserved;
+
+void *fwh_ringbuf_reserve(void *ringbuf, __u64 size, __u64 flags)
+{
+	(void)ringbuf; (void)flags;
+	if (size != sizeof(struct fw_event) || fwh_reserved)
+		return 0;
+	if (fwh_ev_count >= FWH_EVQ) {
+		fwh_ev_dropped++;
+		return 0;
+	}
+	fwh_reserved = 1;
+	return &fwh_pending;
+}
+
+void fwh_ringbuf_submit(void *data, __u64 flags)
+{
+	(void)flags;
+	if (!fwh_reserved || data != (void *)&fwh_pending)
+		return;
+	fwh_events[(fwh_ev_head + fwh_ev_count) % FWH_EVQ] = fwh_pending;
+	fwh_ev_count++;
+	fwh_reserved = 0;
+}
+
+void fwh_ringbuf_discard(void *data, __u64 flags)
+{
+	(void)data; (void)flags;
+	fwh_reserved = 0;
+}
+
+/* ------------------------------------------------------------ test API */
+
+void fwh_reset(void)
+{
+	memset(fwh_maps, 0, sizeof(fwh_maps));
+	fwh_bind_maps();
+	fwh_now_ns = fwh_boot_ns = 0;
+	fwh_cgroup = fwh_cookie = 0;
+	fwh_ev_head = fwh_ev_count = fwh_ev_dropped = fwh_reserved = 0;
+}
+
+void fwh_set_cgroup(__u64 cg) { fwh_cgroup = cg; }
+void fwh_set_cookie(__u64 c) { fwh_cookie = c; }
+void fwh_set_time_ns(__u64 t) { fwh_now_ns = t; }
+void fwh_set_boot_ns(__u64 t) { fwh_boot_ns = t; }
+
+int fwh_map_update(int map_id, const void *key, const void *val)
+{
+	if (map_id < 0 || map_id >= FWH_N_MAPS)
+		return -1;
+	if (!fwh_maps[0].id)
+		fwh_bind_maps();
+	return (int)fwh_map_update_elem(fwh_maps[map_id].id, key, val, 0);
+}
+
+int fwh_map_lookup(int map_id, const void *key, void *val_out)
+{
+	void *v;
+
+	if (map_id < 0 || map_id >= FWH_N_MAPS)
+		return 0;
+	if (!fwh_maps[0].id)
+		fwh_bind_maps();
+	v = fwh_map_lookup_elem(fwh_maps[map_id].id, key);
+	if (!v)
+		return 0;
+	memcpy(val_out, v, fwh_maps[map_id].val_sz);
+	return 1;
+}
+
+int fwh_map_delete(int map_id, const void *key)
+{
+	if (map_id < 0 || map_id >= FWH_N_MAPS)
+		return -1;
+	if (!fwh_maps[0].id)
+		fwh_bind_maps();
+	return (int)fwh_map_delete_elem(fwh_maps[map_id].id, key);
+}
+
+int fwh_pop_event(struct fw_event *out)
+{
+	if (!fwh_ev_count)
+		return 0;
+	*out = fwh_events[fwh_ev_head];
+	fwh_ev_head = (fwh_ev_head + 1) % FWH_EVQ;
+	fwh_ev_count--;
+	return 1;
+}
+
+int fwh_event_drops(void) { return fwh_ev_dropped; }
+
+/* program drivers: run the REAL entry points against a caller ctx */
+
+int fwh_run_connect4(struct bpf_sock_addr *ctx) { return fw_connect4(ctx); }
+int fwh_run_sendmsg4(struct bpf_sock_addr *ctx) { return fw_sendmsg4(ctx); }
+int fwh_run_recvmsg4(struct bpf_sock_addr *ctx) { return fw_recvmsg4(ctx); }
+int fwh_run_getpeername4(struct bpf_sock_addr *ctx) { return fw_getpeername4(ctx); }
+int fwh_run_connect6(struct bpf_sock_addr *ctx) { return fw_connect6(ctx); }
+int fwh_run_sendmsg6(struct bpf_sock_addr *ctx) { return fw_sendmsg6(ctx); }
+int fwh_run_recvmsg6(struct bpf_sock_addr *ctx) { return fw_recvmsg6(ctx); }
+int fwh_run_getpeername6(struct bpf_sock_addr *ctx) { return fw_getpeername6(ctx); }
+
+int fwh_run_sock_create(__u32 family, __u32 type, __u32 protocol)
+{
+	struct bpf_sock sk = { .bound_dev_if = 0, .family = family,
+			       .type = type, .protocol = protocol };
+	return fw_sock_create(&sk);
+}

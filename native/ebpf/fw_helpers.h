@@ -32,6 +32,35 @@
 /* bpf_map_update_elem flags */
 #define BPF_ANY 0
 
+#ifdef CLAWKER_FW_HARNESS
+/* Userspace test harness build (native/ebpf/fw_harness.c): the helpers
+ * resolve to in-process emulations so the REAL program logic runs under
+ * the host compiler and is driven from the unit suite via ctypes.  The
+ * kernel build below uses the stable UAPI helper ids instead. */
+void *fwh_map_lookup_elem(void *map, const void *key);
+long fwh_map_update_elem(void *map, const void *key, const void *value,
+			 __u64 flags);
+long fwh_map_delete_elem(void *map, const void *key);
+__u64 fwh_ktime_get_ns(void);
+__u64 fwh_ktime_get_boot_ns(void);
+__u64 fwh_get_socket_cookie(void *ctx);
+__u64 fwh_get_current_cgroup_id(void);
+void *fwh_ringbuf_reserve(void *ringbuf, __u64 size, __u64 flags);
+void fwh_ringbuf_submit(void *data, __u64 flags);
+void fwh_ringbuf_discard(void *data, __u64 flags);
+
+static void *(*bpf_map_lookup_elem)(void *map, const void *key) = fwh_map_lookup_elem;
+static long (*bpf_map_update_elem)(void *map, const void *key, const void *value,
+				   __u64 flags) = fwh_map_update_elem;
+static long (*bpf_map_delete_elem)(void *map, const void *key) = fwh_map_delete_elem;
+static __u64 (*bpf_ktime_get_ns)(void) = fwh_ktime_get_ns;
+static __u64 (*bpf_ktime_get_boot_ns)(void) = fwh_ktime_get_boot_ns;
+static __u64 (*bpf_get_socket_cookie)(void *ctx) = fwh_get_socket_cookie;
+static __u64 (*bpf_get_current_cgroup_id)(void) = fwh_get_current_cgroup_id;
+static void *(*bpf_ringbuf_reserve)(void *ringbuf, __u64 size, __u64 flags) = fwh_ringbuf_reserve;
+static void (*bpf_ringbuf_submit)(void *data, __u64 flags) = fwh_ringbuf_submit;
+static void (*bpf_ringbuf_discard)(void *data, __u64 flags) = fwh_ringbuf_discard;
+#else
 /* helpers by stable UAPI id */
 static void *(*bpf_map_lookup_elem)(void *map, const void *key) = (void *)1;
 static long (*bpf_map_update_elem)(void *map, const void *key, const void *value,
@@ -44,6 +73,7 @@ static __u64 (*bpf_get_current_cgroup_id)(void) = (void *)80;
 static void *(*bpf_ringbuf_reserve)(void *ringbuf, __u64 size, __u64 flags) = (void *)131;
 static void (*bpf_ringbuf_submit)(void *data, __u64 flags) = (void *)132;
 static void (*bpf_ringbuf_discard)(void *data, __u64 flags) = (void *)133;
+#endif /* CLAWKER_FW_HARNESS */
 
 /* byte-order (constant-foldable) */
 #define fw_htons(x) ((__be16)__builtin_bswap16((__u16)(x)))

@@ -1,0 +1,31 @@
+/* Mock bpf.h (syscall-wrapper half) for fwctl unit tests.
+ *
+ * Attach-type values are the GENUINE uapi/linux/bpf.h enum values: the
+ * recorded "MOCK: attach type=N" lines must read correctly against kernel
+ * documentation, and anything cross-referencing these constants (e.g. the
+ * raw-bpf(2) Python side) must not inherit wrong hook numbers.
+ */
+#ifndef FWCTL_MOCK_BPF_H
+#define FWCTL_MOCK_BPF_H
+
+enum bpf_attach_type {
+	BPF_CGROUP_INET_SOCK_CREATE = 2,
+	BPF_CGROUP_INET4_CONNECT = 10,
+	BPF_CGROUP_INET6_CONNECT = 11,
+	BPF_CGROUP_UDP4_SENDMSG = 14,
+	BPF_CGROUP_UDP6_SENDMSG = 15,
+	BPF_CGROUP_UDP4_RECVMSG = 19,
+	BPF_CGROUP_UDP6_RECVMSG = 20,
+	BPF_CGROUP_INET4_GETPEERNAME = 29,
+	BPF_CGROUP_INET6_GETPEERNAME = 30,
+};
+
+#define BPF_F_ALLOW_MULTI (1u << 1)
+
+int bpf_obj_get(const char *pathname);
+int bpf_prog_attach(int prog_fd, int attachable_fd, enum bpf_attach_type type,
+		    unsigned int flags);
+int bpf_prog_detach2(int prog_fd, int attachable_fd, enum bpf_attach_type type);
+int bpf_map_get_next_key(int fd, const void *key, void *next_key);
+
+#endif /* FWCTL_MOCK_BPF_H */

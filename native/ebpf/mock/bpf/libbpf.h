@@ -1,0 +1,56 @@
+/* Mock libbpf.h for fwctl unit tests (native/ebpf/mock/).
+ *
+ * Declares exactly the libbpf surface fwctl.c consumes, with the real
+ * library's signatures and iteration macros, so fwctl.c compiles
+ * unmodified against either this mock or the genuine libbpf-dev on a
+ * TPU-VM worker.  The implementations (fwctl_mock.c) print a MOCK: line
+ * per call; tests/test_fwctl.py asserts on the sequences.
+ */
+#ifndef FWCTL_MOCK_LIBBPF_H
+#define FWCTL_MOCK_LIBBPF_H
+
+#include <stddef.h>
+
+struct bpf_object;
+struct bpf_map;
+struct bpf_program;
+struct bpf_object_open_opts;
+struct ring_buffer;
+
+enum libbpf_strict_mode { LIBBPF_STRICT_ALL = 0xffffffff };
+int libbpf_set_strict_mode(enum libbpf_strict_mode mode);
+
+struct bpf_object *bpf_object__open_file(const char *path,
+					 const struct bpf_object_open_opts *opts);
+int bpf_object__load(struct bpf_object *obj);
+void bpf_object__close(struct bpf_object *obj);
+
+struct bpf_map *bpf_object__next_map(const struct bpf_object *obj,
+				     const struct bpf_map *map);
+const char *bpf_map__name(const struct bpf_map *map);
+int bpf_map__set_pin_path(struct bpf_map *map, const char *path);
+int bpf_map__pin(struct bpf_map *map, const char *path);
+
+struct bpf_program *bpf_object__next_program(const struct bpf_object *obj,
+					     struct bpf_program *prog);
+const char *bpf_program__name(const struct bpf_program *prog);
+int bpf_program__pin(struct bpf_program *prog, const char *path);
+
+#define bpf_object__for_each_map(pos, obj)                \
+	for ((pos) = bpf_object__next_map((obj), NULL);   \
+	     (pos) != NULL;                               \
+	     (pos) = bpf_object__next_map((obj), (pos)))
+
+#define bpf_object__for_each_program(pos, obj)               \
+	for ((pos) = bpf_object__next_program((obj), NULL);  \
+	     (pos) != NULL;                                  \
+	     (pos) = bpf_object__next_program((obj), (pos)))
+
+typedef int (*ring_buffer_sample_fn)(void *ctx, void *data, size_t size);
+struct ring_buffer_opts;
+struct ring_buffer *ring_buffer__new(int map_fd, ring_buffer_sample_fn sample_cb,
+				     void *ctx, const struct ring_buffer_opts *opts);
+int ring_buffer__poll(struct ring_buffer *rb, int timeout_ms);
+void ring_buffer__free(struct ring_buffer *rb);
+
+#endif /* FWCTL_MOCK_LIBBPF_H */

@@ -1,0 +1,235 @@
+/* fwctl_mock.c - recording libbpf mock behind the mock/bpf headers.
+ *
+ * Each call prints one "MOCK: ..." line on stdout; failure injection via
+ * env:
+ *   FWCTL_MOCK_OPEN_FAIL=1   bpf_object__open_file returns NULL
+ *   FWCTL_MOCK_LOAD_FAIL=1   bpf_object__load fails (verifier/pin clash)
+ *   FWCTL_MOCK_NO_PINS=1     bpf_obj_get fails (nothing pinned)
+ *   FWCTL_MOCK_ATTACH_FAIL=<progname>  that attach fails
+ *   FWCTL_MOCK_EVENTS=<n>    ring_buffer__poll delivers n events, then 0
+ *
+ * The object model mirrors fw.c: 8 maps (fw_maps.h ALL_MAPS order) and 9
+ * programs (fwctl.c ATTACHMENTS order).
+ */
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <bpf/bpf.h>
+#include <bpf/libbpf.h>
+
+#include "../fw_maps.h"
+
+static const char *MOCK_MAPS[] = { "containers", "bypass", "dns_cache",
+				   "routes", "udp_flows", "tcp_flows",
+				   "events", "ratelimit" };
+#define N_MOCK_MAPS 8
+
+static const char *MOCK_PROGS[] = {
+	"fw_connect4", "fw_connect6", "fw_sendmsg4", "fw_sendmsg6",
+	"fw_recvmsg4", "fw_recvmsg6", "fw_getpeername4", "fw_getpeername6",
+	"fw_sock_create",
+};
+#define N_MOCK_PROGS 9
+
+struct bpf_map { int idx; };
+struct bpf_program { int idx; };
+struct bpf_object {
+	struct bpf_map maps[N_MOCK_MAPS];
+	struct bpf_program progs[N_MOCK_PROGS];
+};
+
+static struct bpf_object mock_obj;
+
+int libbpf_set_strict_mode(enum libbpf_strict_mode mode)
+{
+	(void)mode;
+	return 0;
+}
+
+struct bpf_object *bpf_object__open_file(const char *path,
+					 const struct bpf_object_open_opts *opts)
+{
+	int i;
+
+	(void)opts;
+	printf("MOCK: open %s\n", path);
+	if (getenv("FWCTL_MOCK_OPEN_FAIL")) {
+		errno = ENOENT;
+		return NULL;
+	}
+	for (i = 0; i < N_MOCK_MAPS; i++)
+		mock_obj.maps[i].idx = i;
+	for (i = 0; i < N_MOCK_PROGS; i++)
+		mock_obj.progs[i].idx = i;
+	return &mock_obj;
+}
+
+int bpf_object__load(struct bpf_object *obj)
+{
+	(void)obj;
+	printf("MOCK: load\n");
+	if (getenv("FWCTL_MOCK_LOAD_FAIL")) {
+		errno = EINVAL;
+		return -EINVAL;
+	}
+	return 0;
+}
+
+void bpf_object__close(struct bpf_object *obj)
+{
+	(void)obj;
+	printf("MOCK: close\n");
+}
+
+struct bpf_map *bpf_object__next_map(const struct bpf_object *obj,
+				     const struct bpf_map *map)
+{
+	int next = map ? map->idx + 1 : 0;
+
+	if (next >= N_MOCK_MAPS)
+		return NULL;
+	return (struct bpf_map *)&obj->maps[next];
+}
+
+const char *bpf_map__name(const struct bpf_map *map)
+{
+	return MOCK_MAPS[map->idx];
+}
+
+int bpf_map__set_pin_path(struct bpf_map *map, const char *path)
+{
+	printf("MOCK: set_pin_path %s %s\n", MOCK_MAPS[map->idx], path);
+	return 0;
+}
+
+int bpf_map__pin(struct bpf_map *map, const char *path)
+{
+	printf("MOCK: map_pin %s %s\n", MOCK_MAPS[map->idx], path);
+	return 0;
+}
+
+struct bpf_program *bpf_object__next_program(const struct bpf_object *obj,
+					     struct bpf_program *prog)
+{
+	int next = prog ? prog->idx + 1 : 0;
+
+	if (next >= N_MOCK_PROGS)
+		return NULL;
+	return (struct bpf_program *)&obj->progs[next];
+}
+
+const char *bpf_program__name(const struct bpf_program *prog)
+{
+	return MOCK_PROGS[prog->idx];
+}
+
+int bpf_program__pin(struct bpf_program *prog, const char *path)
+{
+	printf("MOCK: prog_pin %s %s\n", MOCK_PROGS[prog->idx], path);
+	return 0;
+}
+
+/* ----------------------------------------------------------- bpf.h half */
+
+/* obj_get encodes the pinned program's index into the returned fd
+ * (100+idx) so attach can resolve the fd back to a name for logging and
+ * name-keyed failure injection. */
+int bpf_obj_get(const char *pathname)
+{
+	const char *base = strrchr(pathname, '/');
+	int i;
+
+	printf("MOCK: obj_get %s\n", pathname);
+	if (getenv("FWCTL_MOCK_NO_PINS")) {
+		errno = ENOENT;
+		return -1;
+	}
+	base = base ? base + 1 : pathname;
+	for (i = 0; i < N_MOCK_PROGS; i++)
+		if (!strcmp(base, MOCK_PROGS[i]))
+			return 100 + i;
+	return 100 + N_MOCK_PROGS;  /* a map pin */
+}
+
+int bpf_prog_attach(int prog_fd, int attachable_fd, enum bpf_attach_type type,
+		    unsigned int flags)
+{
+	const char *fail = getenv("FWCTL_MOCK_ATTACH_FAIL");
+	int idx = prog_fd - 100;
+	const char *name = (idx >= 0 && idx < N_MOCK_PROGS) ? MOCK_PROGS[idx]
+							    : "?";
+
+	(void)attachable_fd;
+	printf("MOCK: attach %s type=%d flags=%u\n", name, (int)type, flags);
+	if (fail && !strcmp(fail, name)) {
+		errno = EPERM;
+		return -1;
+	}
+	return 0;
+}
+
+int bpf_prog_detach2(int prog_fd, int attachable_fd, enum bpf_attach_type type)
+{
+	(void)prog_fd; (void)attachable_fd;
+	printf("MOCK: detach type=%d\n", (int)type);
+	return 0;
+}
+
+int bpf_map_get_next_key(int fd, const void *key, void *next_key)
+{
+	(void)fd; (void)key; (void)next_key;
+	errno = ENOENT;  /* empty map */
+	return -1;
+}
+
+/* ------------------------------------------------------------- ringbuf */
+
+struct ring_buffer {
+	ring_buffer_sample_fn cb;
+	void *ctx;
+	int remaining;
+};
+
+static struct ring_buffer mock_rb;
+
+struct ring_buffer *ring_buffer__new(int map_fd, ring_buffer_sample_fn sample_cb,
+				     void *ctx, const struct ring_buffer_opts *opts)
+{
+	const char *n = getenv("FWCTL_MOCK_EVENTS");
+
+	(void)map_fd; (void)opts;
+	printf("MOCK: ringbuf_new\n");
+	mock_rb.cb = sample_cb;
+	mock_rb.ctx = ctx;
+	mock_rb.remaining = n ? atoi(n) : 0;
+	return &mock_rb;
+}
+
+int ring_buffer__poll(struct ring_buffer *rb, int timeout_ms)
+{
+	struct fw_event ev;
+
+	(void)timeout_ms;
+	if (rb->remaining <= 0)
+		return 0;
+	rb->remaining--;
+	memset(&ev, 0, sizeof(ev));
+	ev.ts_ns = 123;
+	ev.cgroup_id = 42;
+	ev.zone_hash = 0xA1;
+	ev.dst_ip = 0x0100007f;  /* 127.0.0.1 be32 */
+	ev.dst_port = 0xbb01;    /* 443 be16 */
+	ev.verdict = 1;
+	ev.proto = 6;
+	ev.reason = 8;
+	rb->cb(rb->ctx, &ev, sizeof(ev));
+	return 1;
+}
+
+void ring_buffer__free(struct ring_buffer *rb)
+{
+	(void)rb;
+	printf("MOCK: ringbuf_free\n");
+}

@@ -1,0 +1,32 @@
+#!/bin/sh
+# check_bpf.sh - the BPF artifact gate.
+#
+# Fails the build if fw.c stops compiling to a BPF object.  Run wherever
+# clang exists: TPU-VM provisioning runs it before `fwctl load` (see
+# clawker_tpu/fleet/provision.py), and CI images with clang run it on
+# every change to native/ebpf.  On machines without clang (the dev tree)
+# it reports SKIP and exits 0 after running the host-side gates instead:
+# the gcc syntax check, the userspace harness suite (the REAL fw.c logic
+# under test -- tests/test_fw_kernel.py) and the fwctl mock suite.
+#
+# The verifier proper only runs at `fwctl load` on a real kernel; this
+# script is the strongest pre-kernel gate each environment supports.
+set -e
+
+here="$(cd "$(dirname "$0")/.." && pwd)"
+ebpf="$here/native/ebpf"
+
+if command -v clang >/dev/null 2>&1; then
+    # Only the BPF object: fwctl additionally needs libbpf-dev, which a
+    # clang-only image may not have (fw.c deliberately builds without it).
+    echo "check_bpf: clang found -- compiling fw.c -> BPF object"
+    make -C "$ebpf" build/fw.o CLANG="$(command -v clang)"
+    echo "check_bpf: OK ($ebpf/build/fw.o)"
+else
+    echo "check_bpf: clang not present -- running host-side gates"
+    make -C "$ebpf" check harness fwctl-mock
+    if command -v python >/dev/null 2>&1 && python -c "import pytest" 2>/dev/null; then
+        (cd "$here" && python -m pytest tests/test_fw_kernel.py tests/test_fwctl.py -q)
+    fi
+    echo "check_bpf: SKIP bpf-target compile (no clang); host gates OK"
+fi

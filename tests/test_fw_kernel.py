@@ -1,0 +1,471 @@
+"""The REAL kernel programs under test: fw.c compiled with the host
+compiler (native/ebpf/fw_harness.c) and driven via ctypes.
+
+This is the verifier-shaped gate the dev tree can run: the decision logic
+(fw_decide), context rewrites, reverse-NAT, v6 mapping, sock_create and
+the event rate limiter all execute as written, against emulated maps --
+and are differential-tested against the Python policy oracle
+(clawker_tpu/firewall/policy.py), the same dual-guard the storage engine
+uses.  The clang -target bpf artifact gate is scripts/check_bpf.sh (runs
+where clang exists; the TPU-VM provisioner builds fw.o for real).
+
+Parity bar: the reference exercises its programs only through e2e against
+a live kernel (test/e2e/firewall_test.go); this harness reaches the same
+logic without a kernel.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import random
+import shutil
+import socket
+import struct
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu.firewall.model import (
+    FLAG_ENFORCE,
+    FLAG_HOSTPROXY,
+    PROTO_TCP,
+    PROTO_UDP,
+    Action,
+    ContainerPolicy,
+    DnsEntry,
+    Reason,
+    RouteKey,
+    RouteVal,
+)
+
+EBPF_DIR = Path(__file__).resolve().parent.parent / "native" / "ebpf"
+CC = shutil.which("cc") or shutil.which("gcc")
+pytestmark = pytest.mark.skipif(CC is None, reason="no host C compiler")
+
+# map ids (fw_harness.c enum -- harness ABI)
+M_CONTAINERS, M_BYPASS, M_DNS, M_ROUTES, M_UDP, M_TCP, M_RL = range(7)
+
+OK, EPERM = 1, 0
+SOCK_STREAM, SOCK_DGRAM, SOCK_RAW, SOCK_PACKET = 1, 2, 3, 10
+AF_INET, AF_INET6 = 2, 10
+
+
+class SockAddr(ctypes.Structure):
+    """bpf_sock_addr as fw.c declares it (UAPI layout subset)."""
+
+    _fields_ = [
+        ("user_family", ctypes.c_uint32),
+        ("user_ip4", ctypes.c_uint32),
+        ("user_ip6", ctypes.c_uint32 * 4),
+        ("user_port", ctypes.c_uint32),
+        ("family", ctypes.c_uint32),
+        ("type", ctypes.c_uint32),
+        ("protocol", ctypes.c_uint32),
+        ("msg_src_ip4", ctypes.c_uint32),
+        ("msg_src_ip6", ctypes.c_uint32 * 4),
+    ]
+
+
+class Event(ctypes.Structure):
+    _fields_ = [
+        ("ts_ns", ctypes.c_uint64),
+        ("cgroup_id", ctypes.c_uint64),
+        ("zone_hash", ctypes.c_uint64),
+        ("dst_ip", ctypes.c_uint32),
+        ("dst_port", ctypes.c_uint16),
+        ("verdict", ctypes.c_uint8),
+        ("proto", ctypes.c_uint8),
+        ("reason", ctypes.c_uint8),
+        ("pad", ctypes.c_uint8 * 7),
+    ]
+
+
+def ip_be(ip: str) -> int:
+    return struct.unpack("<I", socket.inet_aton(ip))[0]
+
+
+def be_ip(v: int) -> str:
+    return socket.inet_ntoa(struct.pack("<I", v))
+
+
+def port_be(p: int) -> int:
+    return socket.htons(p)
+
+
+@pytest.fixture(scope="module")
+def fw():
+    so = EBPF_DIR / "build" / "fw_harness.so"
+    subprocess.run(["make", "-C", str(EBPF_DIR), "harness"], check=True,
+                   capture_output=True)
+    lib = ctypes.CDLL(str(so))
+    lib.fwh_map_update.argtypes = [ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p]
+    lib.fwh_map_lookup.argtypes = [ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p]
+    lib.fwh_map_delete.argtypes = [ctypes.c_int, ctypes.c_void_p]
+    lib.fwh_set_cgroup.argtypes = [ctypes.c_uint64]
+    lib.fwh_set_cookie.argtypes = [ctypes.c_uint64]
+    lib.fwh_set_time_ns.argtypes = [ctypes.c_uint64]
+    lib.fwh_set_boot_ns.argtypes = [ctypes.c_uint64]
+    lib.fwh_pop_event.argtypes = [ctypes.POINTER(Event)]
+    for name in ("connect4", "sendmsg4", "recvmsg4", "getpeername4",
+                 "connect6", "sendmsg6", "recvmsg6", "getpeername6"):
+        fn = getattr(lib, f"fwh_run_{name}")
+        fn.argtypes = [ctypes.POINTER(SockAddr)]
+        fn.restype = ctypes.c_int
+    lib.fwh_run_sock_create.argtypes = [ctypes.c_uint32] * 3
+    lib.fwh_run_sock_create.restype = ctypes.c_int
+    return lib
+
+
+class Kern:
+    """Typed convenience wrapper over the harness lib."""
+
+    def __init__(self, lib):
+        self.lib = lib
+        lib.fwh_reset()
+
+    # -- state
+    def enroll(self, cg: int, pol: ContainerPolicy) -> None:
+        key = struct.pack("<Q", cg)
+        val = pol.pack()
+        assert self.lib.fwh_map_update(M_CONTAINERS, key, val) == 0
+
+    def set_bypass(self, cg: int, deadline_boot_ns: int) -> None:
+        key = struct.pack("<Q", cg)
+        val = struct.pack("<Q", deadline_boot_ns)
+        assert self.lib.fwh_map_update(M_BYPASS, key, val) == 0
+
+    def bypass_present(self, cg: int) -> bool:
+        out = ctypes.create_string_buffer(8)
+        return bool(self.lib.fwh_map_lookup(M_BYPASS, struct.pack("<Q", cg), out))
+
+    def cache_dns(self, ip: str, entry: DnsEntry) -> None:
+        assert self.lib.fwh_map_update(M_DNS, socket.inet_aton(ip), entry.pack()) == 0
+
+    def add_route(self, rk: RouteKey, rv: RouteVal) -> None:
+        assert self.lib.fwh_map_update(M_ROUTES, rk.pack(), rv.pack()) == 0
+
+    def flow(self, map_id: int, cookie: int):
+        out = ctypes.create_string_buffer(8)
+        if not self.lib.fwh_map_lookup(map_id, struct.pack("<Q", cookie), out):
+            return None
+        ip, port = struct.unpack("<IH2x", out.raw)
+        return be_ip(ip), socket.ntohs(port)
+
+    # -- programs
+    def connect4(self, cg: int, ip: str, port: int, *, udp=False, cookie=1):
+        self.lib.fwh_set_cgroup(cg)
+        self.lib.fwh_set_cookie(cookie)
+        ctx = SockAddr(user_family=AF_INET, user_ip4=ip_be(ip),
+                       user_port=port_be(port), family=AF_INET,
+                       type=SOCK_DGRAM if udp else SOCK_STREAM,
+                       protocol=PROTO_UDP if udp else PROTO_TCP)
+        rc = self.lib.fwh_run_connect4(ctypes.byref(ctx))
+        return rc, be_ip(ctx.user_ip4), socket.ntohs(ctx.user_port & 0xFFFF)
+
+    def sendmsg4(self, cg: int, ip: str, port: int, *, cookie=1):
+        self.lib.fwh_set_cgroup(cg)
+        self.lib.fwh_set_cookie(cookie)
+        ctx = SockAddr(user_family=AF_INET, user_ip4=ip_be(ip),
+                       user_port=port_be(port), family=AF_INET,
+                       type=SOCK_DGRAM, protocol=PROTO_UDP)
+        rc = self.lib.fwh_run_sendmsg4(ctx)
+        return rc, be_ip(ctx.user_ip4), socket.ntohs(ctx.user_port & 0xFFFF)
+
+    def rewrite4(self, prog: str, cg: int, src_ip: str, src_port: int, *, cookie=1):
+        self.lib.fwh_set_cgroup(cg)
+        self.lib.fwh_set_cookie(cookie)
+        ctx = SockAddr(user_family=AF_INET, user_ip4=ip_be(src_ip),
+                       user_port=port_be(src_port), family=AF_INET)
+        rc = getattr(self.lib, f"fwh_run_{prog}")(ctypes.byref(ctx))
+        return rc, be_ip(ctx.user_ip4), socket.ntohs(ctx.user_port & 0xFFFF)
+
+    def connect6(self, cg: int, ip6_words: list[int], port: int, *, udp=False, cookie=1):
+        self.lib.fwh_set_cgroup(cg)
+        self.lib.fwh_set_cookie(cookie)
+        ctx = SockAddr(user_family=AF_INET6,
+                       user_ip6=(ctypes.c_uint32 * 4)(*ip6_words),
+                       user_port=port_be(port), family=AF_INET6,
+                       type=SOCK_DGRAM if udp else SOCK_STREAM,
+                       protocol=PROTO_UDP if udp else PROTO_TCP)
+        rc = self.lib.fwh_run_connect6(ctypes.byref(ctx))
+        return rc, list(ctx.user_ip6), socket.ntohs(ctx.user_port & 0xFFFF)
+
+    def events(self) -> list[Event]:
+        out = []
+        ev = Event()
+        while self.lib.fwh_pop_event(ctypes.byref(ev)):
+            out.append(Event.from_buffer_copy(ev))
+        return out
+
+
+POL = ContainerPolicy(envoy_ip="172.28.0.2", dns_ip="172.28.0.1",
+                      hostproxy_ip="172.28.0.1", hostproxy_port=18374,
+                      flags=FLAG_ENFORCE | FLAG_HOSTPROXY)
+CG = 4242
+
+
+@pytest.fixture()
+def k(fw):
+    kern = Kern(fw)
+    kern.enroll(CG, POL)
+    return kern
+
+
+# ------------------------------------------------------------ decide steps
+
+def test_unenrolled_cgroup_untouched(fw):
+    k = Kern(fw)
+    rc, ip, port = k.connect4(999, "8.8.8.8", 443)
+    assert (rc, ip, port) == (OK, "8.8.8.8", 443)
+    assert k.events() == []
+
+
+def test_ip_literal_denied_enforce_mode(k):
+    rc, *_ = k.connect4(CG, "8.8.4.4", 443)
+    assert rc == EPERM
+    (ev,) = k.events()
+    assert ev.verdict == int(Action.DENY)
+    assert ev.reason == int(Reason.NO_DNS_ENTRY)
+
+
+def test_monitor_mode_allows_and_logs(fw):
+    k = Kern(fw)
+    k.enroll(CG, ContainerPolicy(envoy_ip="172.28.0.2", dns_ip="172.28.0.1",
+                                 hostproxy_ip="0.0.0.0", hostproxy_port=0,
+                                 flags=0))
+    rc, *_ = k.connect4(CG, "8.8.4.4", 443)
+    assert rc == OK
+    (ev,) = k.events()
+    assert ev.reason == int(Reason.MONITOR)
+
+
+def test_loopback_allowed_silently(k):
+    rc, *_ = k.connect4(CG, "127.0.0.1", 9999)
+    assert rc == OK
+    assert k.events() == []
+
+
+def test_dns_rewritten_to_gate(k):
+    rc, ip, port = k.connect4(CG, "8.8.8.8", 53, udp=True, cookie=77)
+    assert rc == OK
+    assert (ip, port) == (POL.dns_ip, 53)       # hardcoded resolver captured
+    assert k.flow(M_UDP, 77) == ("8.8.8.8", 53)  # reverse-NAT noted
+    rc, ip, port = k.connect4(CG, POL.dns_ip, 53, udp=True)
+    assert (rc, ip, port) == (OK, POL.dns_ip, 53)  # gate itself: untouched
+
+
+def test_envoy_and_hostproxy_allowed(k):
+    assert k.connect4(CG, POL.envoy_ip, 10000)[0] == OK
+    assert k.connect4(CG, POL.hostproxy_ip, 18374)[0] == OK
+    # hostproxy on the wrong port is not the side channel
+    assert k.connect4(CG, POL.hostproxy_ip, 2222)[0] == EPERM
+
+
+def test_route_redirects_to_envoy_and_reverses(k):
+    zone = 0xDEAD
+    k.cache_dns("93.184.216.34", DnsEntry(zone, 2**62))
+    k.add_route(RouteKey(zone, 443, PROTO_TCP),
+                RouteVal(Action.REDIRECT, redirect_ip=POL.envoy_ip,
+                         redirect_port=10000))
+    rc, ip, port = k.connect4(CG, "93.184.216.34", 443, cookie=5)
+    assert (rc, ip, port) == (OK, POL.envoy_ip, 10000)
+    (ev,) = k.events()
+    assert ev.verdict == int(Action.REDIRECT) and ev.zone_hash == zone
+    # getpeername presents the original dst (tcp_flows consulted)
+    rc, ip, port = k.rewrite4("getpeername4", CG, POL.envoy_ip, 10000, cookie=5)
+    assert (ip, port) == ("93.184.216.34", 443)
+    # recvmsg does NOT consult tcp_flows
+    rc, ip, port = k.rewrite4("recvmsg4", CG, POL.envoy_ip, 10000, cookie=5)
+    assert (ip, port) == (POL.envoy_ip, 10000)
+
+
+def test_any_port_route_fallback(k):
+    zone = 0xBEEF
+    k.cache_dns("1.2.3.4", DnsEntry(zone, 2**62))
+    k.add_route(RouteKey(zone, 0, PROTO_TCP), RouteVal(Action.ALLOW))
+    assert k.connect4(CG, "1.2.3.4", 8443)[0] == OK
+    # but proto must match: UDP to the same zone has no route
+    assert k.connect4(CG, "1.2.3.4", 8443, udp=True)[0] == EPERM
+
+
+def test_resolved_zone_unruled_port_denied(k):
+    zone = 0xCAFE
+    k.cache_dns("4.4.4.4", DnsEntry(zone, 2**62))
+    k.add_route(RouteKey(zone, 443, PROTO_TCP), RouteVal(Action.ALLOW))
+    assert k.connect4(CG, "4.4.4.4", 443)[0] == OK
+    rc, *_ = k.connect4(CG, "4.4.4.4", 22)
+    assert rc == EPERM
+    evs = k.events()
+    assert evs[-1].reason == int(Reason.NO_ROUTE)
+
+
+def test_udp_reverse_nat_roundtrip(k):
+    """sendmsg rewrite -> recvmsg presents the original source (the app
+    sees replies from the resolver it addressed)."""
+    rc, ip, port = k.sendmsg4(CG, "9.9.9.9", 53, cookie=31)
+    assert (ip, port) == (POL.dns_ip, 53)
+    rc, ip, port = k.rewrite4("recvmsg4", CG, POL.dns_ip, 53, cookie=31)
+    assert (ip, port) == ("9.9.9.9", 53)
+    # replies from unrelated sources are not rewritten
+    rc, ip, port = k.rewrite4("recvmsg4", CG, "5.5.5.5", 53, cookie=31)
+    assert (ip, port) == ("5.5.5.5", 53)
+
+
+# ------------------------------------------------------------------ bypass
+
+def test_bypass_allows_everything_and_deadman_deletes(fw):
+    k = Kern(fw)
+    k.enroll(CG, POL)
+    k.lib.fwh_set_boot_ns(1_000)
+    k.set_bypass(CG, 5_000)
+    rc, *_ = k.connect4(CG, "8.8.4.4", 443)
+    assert rc == OK
+    (ev,) = k.events()
+    assert ev.reason == int(Reason.BYPASS)
+    # deadline passes: first touch deletes the entry IN KERNEL (no
+    # userspace needed -- fail-closed even if the CP died)
+    k.lib.fwh_set_boot_ns(6_000)
+    rc, *_ = k.connect4(CG, "8.8.4.4", 443)
+    assert rc == EPERM
+    assert not k.bypass_present(CG)
+
+
+# -------------------------------------------------------------------- IPv6
+
+V4MAPPED = struct.unpack("<I", bytes([0, 0, 0xFF, 0xFF]))[0]
+
+
+def words(ip4: str) -> list[int]:
+    return [0, 0, V4MAPPED, ip_be(ip4)]
+
+
+def test_v6_native_denied_v4mapped_routed(k):
+    # native v6: denied (v4-only data plane)
+    rc, *_ = k.connect6(CG, [0x20010DB8, 0, 0, 1], 443)
+    assert rc == EPERM
+    (ev,) = k.events()
+    assert ev.reason == int(Reason.IPV6)
+    # v6 loopback: allowed
+    lo = [0, 0, 0, struct.unpack("<I", struct.pack(">I", 1))[0]]
+    assert k.connect6(CG, lo, 9999)[0] == OK
+    # v4-mapped routes through the v4 decision, rewrite stays mapped
+    zone = 0xF00D
+    k.cache_dns("93.184.216.34", DnsEntry(zone, 2**62))
+    k.add_route(RouteKey(zone, 443, PROTO_TCP),
+                RouteVal(Action.REDIRECT, redirect_ip=POL.envoy_ip,
+                         redirect_port=10000))
+    rc, ip6, port = k.connect6(CG, words("93.184.216.34"), 443, cookie=9)
+    assert rc == OK
+    assert ip6[:3] == [0, 0, V4MAPPED]          # still v4-mapped form
+    assert be_ip(ip6[3]) == POL.envoy_ip and port == 10000
+    # getpeername6 reverses it
+    k.lib.fwh_set_cookie(9)
+    ctx = SockAddr(user_family=AF_INET6,
+                   user_ip6=(ctypes.c_uint32 * 4)(*words(POL.envoy_ip)),
+                   user_port=port_be(10000), family=AF_INET6)
+    k.lib.fwh_run_getpeername6(ctypes.byref(ctx))
+    assert be_ip(ctx.user_ip6[3]) == "93.184.216.34"
+
+
+def test_v6_bypass_opens_native_v6(fw):
+    k = Kern(fw)
+    k.enroll(CG, POL)
+    k.lib.fwh_set_boot_ns(0)
+    k.set_bypass(CG, 10_000)
+    rc, *_ = k.connect6(CG, [0x20010DB8, 0, 0, 1], 443)
+    assert rc == OK
+
+
+# ------------------------------------------------------------- sock_create
+
+def test_raw_and_packet_sockets_denied(k):
+    k.lib.fwh_set_cgroup(CG)
+    assert k.lib.fwh_run_sock_create(AF_INET, SOCK_RAW, 1) == EPERM  # ICMP
+    assert k.lib.fwh_run_sock_create(AF_INET, SOCK_PACKET, 0) == EPERM
+    assert k.lib.fwh_run_sock_create(AF_INET, SOCK_STREAM, 6) == OK
+    evs = k.events()
+    assert [e.reason for e in evs] == [int(Reason.RAW_SOCKET)] * 2
+    # unenrolled cgroup: raw sockets are not our business
+    k.lib.fwh_set_cgroup(31337)
+    assert k.lib.fwh_run_sock_create(AF_INET, SOCK_RAW, 1) == OK
+
+
+# --------------------------------------------------------------- ratelimit
+
+def test_event_rate_limit_window(fw):
+    k = Kern(fw)
+    k.enroll(CG, POL)
+    k.lib.fwh_set_time_ns(0)
+    for _ in range(100):
+        k.connect4(CG, "8.8.4.4", 443)      # every one emits (denied)
+    assert len(k.events()) == 64            # FW_RL_BURST
+    # new window refills
+    k.lib.fwh_set_time_ns(200_000_000)
+    k.connect4(CG, "8.8.4.4", 443)
+    assert len(k.events()) == 1
+
+
+# ------------------------------------------------- differential vs oracle
+
+def test_differential_against_policy_oracle(fw):
+    """The kernel C and the Python executable spec must produce the same
+    verdict stream over randomized scenarios (the dual-guard)."""
+    from clawker_tpu.firewall import policy as oracle
+    from clawker_tpu.firewall.maps import FakeMaps
+
+    rng = random.Random(1234)
+    ips = ["8.8.8.8", "127.0.0.1", "172.28.0.1", "172.28.0.2",
+           "93.184.216.34", "1.2.3.4", "4.4.4.4", "10.0.0.7"]
+    ports = [53, 80, 443, 22, 8443, 18374]
+    zones = {"93.184.216.34": 0xA1, "1.2.3.4": 0xB2, "4.4.4.4": 0xC3}
+
+    for trial in range(300):
+        flags = rng.choice([0, FLAG_ENFORCE, FLAG_ENFORCE | FLAG_HOSTPROXY])
+        pol = ContainerPolicy(envoy_ip="172.28.0.2", dns_ip="172.28.0.1",
+                              hostproxy_ip="172.28.0.1", hostproxy_port=18374,
+                              flags=flags)
+        k = Kern(fw)
+        k.enroll(CG, pol)
+        fm = FakeMaps()
+        fm.enroll(CG, pol)
+
+        for ip, zh in zones.items():
+            if rng.random() < 0.7:
+                k.cache_dns(ip, DnsEntry(zh, 2**62))
+                fm.cache_dns(ip, DnsEntry(zh, 2**40))  # unix-s horizon
+        routes = {}
+        for zh in (0xA1, 0xB2, 0xC3):
+            if rng.random() < 0.7:
+                rk = RouteKey(zh, rng.choice([0, 443, 53, 22]),
+                              rng.choice([PROTO_TCP, PROTO_UDP]))
+                rv = rng.choice([
+                    RouteVal(Action.ALLOW),
+                    RouteVal(Action.DENY),
+                    RouteVal(Action.REDIRECT, redirect_ip="172.28.0.2",
+                             redirect_port=10000),
+                ])
+                routes[rk] = rv
+                k.add_route(rk, rv)
+        fm.sync_routes(routes)
+
+        for _ in range(10):
+            ip = rng.choice(ips)
+            port = rng.choice(ports)
+            udp = rng.random() < 0.4
+            proto = PROTO_UDP if udp else PROTO_TCP
+            v = oracle.decide(fm, CG, ip, port, proto)
+            rc, out_ip, out_port = k.connect4(CG, ip, port, udp=udp)
+            ctxt = f"trial={trial} ip={ip} port={port} proto={proto} flags={flags}"
+            if v.action in (Action.ALLOW,):
+                assert rc == OK, ctxt
+                assert (out_ip, out_port) == (ip, port), ctxt
+            elif v.action in (Action.REDIRECT, Action.REDIRECT_DNS):
+                assert rc == OK, ctxt
+                assert (out_ip, out_port) == (v.redirect_ip, v.redirect_port), ctxt
+            else:
+                assert rc == EPERM, ctxt
+            # event streams agree on (verdict, reason)
+            k_evs = [(e.verdict, e.reason) for e in k.events()]
+            o_evs = [(int(e.verdict), int(e.reason)) for e in fm.drain_events()]
+            assert k_evs == o_evs, ctxt

@@ -1,0 +1,156 @@
+"""fwctl loader unit tests over the recording libbpf mock.
+
+The SAME fwctl.c that links genuine libbpf on a TPU-VM worker is compiled
+against native/ebpf/mock (call-recording implementations) and driven as a
+subprocess; assertions are on the recorded call sequences and exit codes.
+Covers the paths VERDICT r1 flagged as untested: argument handling, the
+load->pin ordering contract (pin paths set BEFORE load so libbpf reuses
+compatible existing pins), attach/detach fan-out over all 9 programs with
+BPF_F_ALLOW_MULTI, events drain, and failure propagation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+EBPF_DIR = Path(__file__).resolve().parent.parent / "native" / "ebpf"
+CC = shutil.which("cc") or shutil.which("gcc")
+pytestmark = pytest.mark.skipif(CC is None, reason="no host C compiler")
+
+PROGS = [
+    "fw_connect4", "fw_connect6", "fw_sendmsg4", "fw_sendmsg6",
+    "fw_recvmsg4", "fw_recvmsg6", "fw_getpeername4", "fw_getpeername6",
+    "fw_sock_create",
+]
+MAPS = ["containers", "bypass", "dns_cache", "routes", "udp_flows",
+        "tcp_flows", "events", "ratelimit"]
+
+
+@pytest.fixture(scope="module")
+def fwctl():
+    subprocess.run(["make", "-C", str(EBPF_DIR), "fwctl-mock"], check=True,
+                   capture_output=True)
+    return str(EBPF_DIR / "build" / "fwctl-mock")
+
+
+def run(fwctl, *args, env_extra=None, check=False):
+    env = {k: v for k, v in os.environ.items() if not k.startswith("FWCTL_MOCK")}
+    env.update(env_extra or {})
+    res = subprocess.run([fwctl, *args], capture_output=True, text=True, env=env)
+    if check:
+        assert res.returncode == 0, res.stderr
+    mock_lines = [l[6:] for l in res.stdout.splitlines() if l.startswith("MOCK: ")]
+    return res, mock_lines
+
+
+def test_usage_and_unknown_command(fwctl):
+    res, _ = run(fwctl)
+    assert res.returncode == 2 and "usage" in res.stderr
+    res, _ = run(fwctl, "frobnicate")
+    assert res.returncode == 2 and "unknown command" in res.stderr
+
+
+def test_load_sets_pin_paths_before_load(fwctl):
+    """The pin-reuse contract: every map's pin path is registered BEFORE
+    bpf_object__load so libbpf reuses compatible existing pins (never
+    unlink+re-pin, which would orphan attached programs)."""
+    res, mock = run(fwctl, "load", "--obj", "fw.o", "--pin-dir", "/p", check=True)
+    load_at = mock.index("load")
+    setpins = [l for l in mock if l.startswith("set_pin_path ")]
+    assert [l.split()[1] for l in setpins] == MAPS
+    assert all(mock.index(l) < load_at for l in setpins)
+    assert [l.split()[1] for l in mock if l.startswith("prog_pin ")] == PROGS
+    # programs pin under <pin-dir>/progs/
+    assert all(l.split()[2].startswith("/p/progs/")
+               for l in mock if l.startswith("prog_pin "))
+    assert mock[-1] == "close"
+
+
+def test_load_failure_surfaces(fwctl):
+    res, mock = run(fwctl, "load", env_extra={"FWCTL_MOCK_LOAD_FAIL": "1"})
+    assert res.returncode == 1
+    assert "fwctl unload" in res.stderr  # points at the pin-clash remedy
+    assert not any(l.startswith("prog_pin") for l in mock)  # nothing half-pinned
+    res, _ = run(fwctl, "load", env_extra={"FWCTL_MOCK_OPEN_FAIL": "1"})
+    assert res.returncode == 1
+
+
+def test_attach_all_nine_with_allow_multi(fwctl, tmp_path):
+    res, mock = run(fwctl, "attach", "--cgroup", str(tmp_path), check=True)
+    gets = [l.split()[1] for l in mock if l.startswith("obj_get ")]
+    assert [Path(p).name for p in gets] == PROGS
+    attaches = [l for l in mock if l.startswith("attach ")]
+    assert len(attaches) == 9
+    assert all("flags=2" in l for l in attaches)  # BPF_F_ALLOW_MULTI
+
+
+def test_attach_requires_cgroup_flag_and_dir(fwctl, tmp_path):
+    res, _ = run(fwctl, "attach")
+    assert res.returncode == 2 and "--cgroup" in res.stderr
+    res, _ = run(fwctl, "attach", "--cgroup", str(tmp_path / "missing"))
+    assert res.returncode == 1
+
+
+def test_attach_without_pins_fails_loudly(fwctl, tmp_path):
+    res, mock = run(fwctl, "attach", "--cgroup", str(tmp_path),
+                    env_extra={"FWCTL_MOCK_NO_PINS": "1"})
+    assert res.returncode == 1
+    assert "not pinned" in res.stderr
+    assert not any(l.startswith("attach ") for l in mock)
+
+
+def test_partial_attach_failure_propagates(fwctl, tmp_path):
+    res, mock = run(fwctl, "attach", "--cgroup", str(tmp_path),
+                    env_extra={"FWCTL_MOCK_ATTACH_FAIL": "fw_sendmsg4"})
+    assert res.returncode == 1
+    assert "attach fw_sendmsg4" in res.stderr
+    # the other 8 still attached (partial failure does not abort the loop)
+    assert len([l for l in mock if l.startswith("attach ")]) == 9
+
+
+def test_detach_all_nine(fwctl, tmp_path):
+    res, mock = run(fwctl, "detach", "--cgroup", str(tmp_path), check=True)
+    assert len([l for l in mock if l.startswith("detach ")]) == 9
+
+
+def test_events_drain_max_json(fwctl):
+    res, mock = run(fwctl, "events", "--max", "3",
+                    env_extra={"FWCTL_MOCK_EVENTS": "5"}, check=True)
+    evs = [json.loads(l) for l in res.stdout.splitlines()
+           if l.startswith("{")]
+    assert len(evs) == 3  # --max stops the drain
+    assert evs[0]["cgroup"] == 42 and evs[0]["dst_ip"] == "127.0.0.1"
+    assert evs[0]["dst_port"] == 443 and evs[0]["reason"] == 8
+    assert "ringbuf_free" in mock
+
+
+def test_events_nonfollow_exits_when_drained(fwctl):
+    res, _ = run(fwctl, "events", env_extra={"FWCTL_MOCK_EVENTS": "2"},
+                 check=True)
+    evs = [l for l in res.stdout.splitlines() if l.startswith("{")]
+    assert len(evs) == 2  # drained what was there, then exited (no --follow)
+
+
+def test_status_counts_empty_maps(fwctl):
+    res, _ = run(fwctl, "status", check=True)
+    line = next(l for l in res.stdout.splitlines() if l.startswith("{"))
+    st = json.loads(line)
+    assert st["containers"] == 0 and st["routes"] == 0
+
+
+def test_unload_removes_pins(fwctl, tmp_path):
+    pin = tmp_path / "pins"
+    progs = pin / "progs"
+    progs.mkdir(parents=True)
+    for m in MAPS:
+        (pin / m).touch()
+    for p in PROGS:
+        (progs / p).touch()
+    res, _ = run(fwctl, "unload", "--pin-dir", str(pin), check=True)
+    assert list(pin.iterdir()) == []  # maps, progs dir, everything gone
