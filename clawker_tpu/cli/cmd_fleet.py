@@ -76,7 +76,8 @@ def fleet_provision(f: Factory, dry_run, no_firewall, no_cp, only):
             continue
         report = provision_worker(t, repo_root,
                                   with_firewall=not no_firewall,
-                                  with_cp=not no_cp)
+                                  with_cp=not no_cp,
+                                  monitor=f.config.settings.monitoring.enable)
         status = "ok" if report.ok else "FAILED"
         click.echo(f"worker {t.index} ({t.host}): {status}")
         for r in report.results:

@@ -55,6 +55,13 @@ class Factory:
     def runtime(self, engine: Engine | None = None) -> AgentRuntime:
         eng = engine or self.engine()
 
+        # Lazy: lifecycle/query commands never pay hostproxy startup or
+        # tunnel setup; only the create path resolves the callable.
+        def channels():
+            from ..fleet.channels import open_side_channels
+
+            return open_side_channels(eng, self.config)
+
         # Deferred so lifecycle/query commands never pay the cryptography
         # import or open agents.db; only the create path invokes this.
         def bootstrap(container_id: str, project: str, agent: str) -> None:
@@ -70,6 +77,7 @@ class Factory:
             pre_start=self._pre_start_hook(),
             post_start=self._post_start_hook(),
             bootstrap=bootstrap,
+            channels=channels,
         )
 
     # Bootstrap hooks: wired to control-plane/firewall bring-up once those

@@ -148,6 +148,10 @@ UPSTREAM_DNS = ("1.1.1.2", "1.0.0.2")
 DOCKER_INTERNAL_DNS = "127.0.0.11"  # only valid INSIDE a container netns
 INTERNAL_ZONE = "docker.internal"   # answered from the engine inventory
 
+# OTLP/HTTP ingest of the monitor collector; also the side-channel tunnel
+# port on workers (fleet/channels.py, provision systemd unit).
+OTLP_HTTP_PORT = 4318
+
 # ---------------------------------------------------------------------------
 # TPU-VM runtime
 # ---------------------------------------------------------------------------

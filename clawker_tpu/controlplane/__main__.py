@@ -43,12 +43,19 @@ def main() -> int:
 
             rc, rz = handler_resolvers(firewall)
             mon = cfg.settings.monitoring
+            # CLAWKER_TPU_OTLP: worker CPs ship through the SSH -R tunnel
+            # on worker loopback (fleet/channels.py binds it; the systemd
+            # unit sets the env only when provisioned with monitoring);
+            # locally the collector listens on loopback directly.
+            otlp = os.environ.get("CLAWKER_TPU_OTLP", "") or (
+                f"http://127.0.0.1:{consts.OTLP_HTTP_PORT}"
+                if mon.enable else "")
             netlogger = NetLogger(
                 firewall.maps,
                 out_path=cfg.logs_dir / "ebpf-egress.jsonl",
                 resolve_cgroup=rc,
                 resolve_zone=rz,
-                otlp_endpoint=("http://127.0.0.1:4318" if mon.enable else ""),
+                otlp_endpoint=otlp,
             )
     daemon = ControlPlaneDaemon(
         CPConfig(

@@ -38,12 +38,19 @@ log = logsetup.get("fleet.provision")
 
 REMOTE_ROOT = "/opt/clawker-tpu"
 
-SYSTEMD_UNIT = f"""[Unit]
+def systemd_unit(*, monitor: bool = False) -> str:
+    """The per-worker CP unit.  With ``monitor``, CLAWKER_TPU_OTLP points
+    the worker netlogger at the laptop collector behind the SSH -R
+    loopback tunnel (fleet/channels.py); without it the env is absent so
+    disabled telemetry generates zero failed connects."""
+    otlp = (f"Environment=CLAWKER_TPU_OTLP=http://127.0.0.1:"
+            f"{consts.OTLP_HTTP_PORT}\n" if monitor else "")
+    return f"""[Unit]
 Description=clawker-tpu per-worker control plane
 After=docker.service
 [Service]
 Environment=PYTHONPATH={REMOTE_ROOT}/src
-ExecStart=/usr/bin/python3 -m clawker_tpu.controlplane
+{otlp}ExecStart=/usr/bin/python3 -m clawker_tpu.controlplane
 Restart=on-failure
 RestartSec=3
 [Install]
@@ -87,6 +94,16 @@ def build_plan(*, with_firewall: bool = True, with_cp: bool = True) -> list[Step
         Step("toolchain",
              "which python3 g++ make || sudo apt-get install -y -q "
              "python3 g++ make"),
+        # Reverse forwards for the side channel (hostproxy/OTLP tunnels,
+        # fleet/channels.py) must bind the worker's docker-gateway address
+        # so containers can reach them; sshd only honors non-loopback -R
+        # binds with GatewayPorts clientspecified.
+        Step("sshd-gateway-ports",
+             "test -f /etc/ssh/sshd_config.d/60-clawker.conf || "
+             "(echo 'GatewayPorts clientspecified' | sudo tee "
+             "/etc/ssh/sshd_config.d/60-clawker.conf >/dev/null && "
+             "(sudo systemctl reload sshd || sudo systemctl reload ssh))",
+             optional=True),
     ]
     if with_firewall:
         steps.append(Step(
@@ -137,7 +154,7 @@ def build_plan(*, with_firewall: bool = True, with_cp: bool = True) -> list[Step
     return steps
 
 
-def payload_tar(repo_root: Path) -> bytes:
+def payload_tar(repo_root: Path, *, monitor: bool = False) -> bytes:
     """Source payload: the package + native tree + the CP systemd unit."""
     buf = io.BytesIO()
 
@@ -151,7 +168,7 @@ def payload_tar(repo_root: Path) -> bytes:
         tf.add(str(repo_root / "clawker_tpu"), arcname="src/clawker_tpu",
                filter=_clean)
         tf.add(str(repo_root / "native"), arcname="src/native", filter=_clean)
-        unit = SYSTEMD_UNIT.encode()
+        unit = systemd_unit(monitor=monitor).encode()
         ti = tarfile.TarInfo("clawker-cp.service")
         ti.size = len(unit)
         tf.addfile(ti, io.BytesIO(unit))
@@ -164,6 +181,7 @@ def provision_worker(
     *,
     with_firewall: bool = True,
     with_cp: bool = True,
+    monitor: bool = False,
 ) -> ProvisionReport:
     report = ProvisionReport(transport.host, transport.index)
     plan = build_plan(with_firewall=with_firewall, with_cp=with_cp)
@@ -173,7 +191,8 @@ def provision_worker(
         # the payload rides in right before the first build step
         if step.name == "build-native" and not pushed:
             try:
-                transport.push_tar(payload_tar(repo_root), REMOTE_ROOT, sudo=True)
+                transport.push_tar(payload_tar(repo_root, monitor=monitor),
+                                   REMOTE_ROOT, sudo=True)
                 report.results.append(StepResult("push-payload", True))
             except TransportError as e:
                 report.results.append(StepResult("push-payload", False, str(e)))
@@ -187,6 +206,10 @@ def provision_worker(
         log.info("worker %d %s: %s", transport.index, step.name,
                  "ok" if ok else f"FAILED ({detail[:120]})" if not step.optional
                  else f"skipped ({detail[:120]})")
+        if step.name == "sshd-gateway-ports" and ok:
+            # sshd reload only affects NEW connections; drop the mux so
+            # later -R forwards (which ride it) see GatewayPorts
+            transport.drop_mux()
         if not ok and not step.optional:
             return report
     return report

@@ -115,6 +115,7 @@ class SSHTransport:
         self.mux_dir = Path(mux_dir)
         self.runner = runner or Runner()
         self._forwards: list[subprocess.Popen] = []
+        self._rev_tags: set[str] = set()
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------ command
@@ -210,6 +211,71 @@ class SSHTransport:
             "did not come up"
         )
 
+    def reverse_forward_tcp(self, remote_bind: str, remote_port: int,
+                            local_host: str, local_port: int,
+                            tag: str = "rev") -> None:
+        """Expose a laptop service on the WORKER: ``ssh -R`` so worker-side
+        connections to remote_bind:remote_port land on
+        local_host:local_port here.
+
+        This is the side-channel substrate (north star: "tunnel
+        monitor/TUI streams back"): the host proxy and the monitor OTLP
+        collector run on the laptop, and containers on every worker reach
+        them through these forwards.  Binding a non-loopback remote_bind
+        (the worker's clawker-net gateway, so containers can reach it)
+        requires ``GatewayPorts clientspecified`` on the worker sshd --
+        ensured by the provisioning plan.
+        """
+        key = f"R:{tag}"
+        with self._lock:
+            if key in self._rev_tags:
+                return
+            argv = self.ssh_base()[:-1] + [
+                # a refused -R bind must kill the process (otherwise ssh
+                # only warns and poll() can never detect the failure)
+                "-o", "ExitOnForwardFailure=yes",
+                "-N", "-R",
+                f"{remote_bind}:{remote_port}:{local_host}:{local_port}",
+                self.ssh_base()[-1],
+            ]
+            proc = self.runner.spawn(argv)
+            self._forwards.append(proc)
+            self._rev_tags.add(key)
+        deadline = time.monotonic() + FORWARD_READY_DEADLINE_S
+        probe = (f"timeout 2 bash -c 'exec 3<>/dev/tcp/{remote_bind}/"
+                 f"{remote_port}' 2>/dev/null")
+        while time.monotonic() < deadline:
+            if self.run(probe, timeout=5.0).rc == 0:
+                return
+            if proc is not None and proc.poll() is not None:
+                break
+            time.sleep(0.2)
+        with self._lock:
+            self._rev_tags.discard(key)
+            # reap the dead/stale tunnel so a retry doesn't lose the bind
+            # race against a leaked first attempt
+            try:
+                proc.terminate()
+                proc.wait(timeout=3)
+            except Exception:
+                pass
+            if proc in self._forwards:
+                self._forwards.remove(proc)
+        raise TransportError(
+            f"worker {self.index}: reverse forward {remote_bind}:{remote_port}"
+            f" -> {local_host}:{local_port} did not come up"
+        )
+
+    def drop_mux(self) -> None:
+        """Tear down the ControlMaster session; the next command redials.
+        Needed after remote sshd config changes (GatewayPorts): a reload
+        only affects NEW connections, and every session rides the mux."""
+        argv = self.ssh_base()[:-1] + ["-O", "exit", self.ssh_base()[-1]]
+        try:
+            self.runner.run(argv, timeout=10.0)
+        except TransportError:
+            pass
+
     @staticmethod
     def _probe(path: Path) -> bool:
         import socket as _s
@@ -231,6 +297,7 @@ class SSHTransport:
                 except Exception:
                     pass
             self._forwards.clear()
+            self._rev_tags.clear()
 
 
 def connect_worker_engine(tpu: TPUSettings, host: str, index: int,

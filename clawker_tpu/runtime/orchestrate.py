@@ -52,6 +52,7 @@ class AgentRuntime:
         pre_start: Callable[[str], None] | None = None,
         post_start: Callable[[str], None] | None = None,
         bootstrap: Callable[[str, str, str], None] | None = None,
+        channels=None,                 # fleet.channels.SideChannels | None
     ):
         self.engine = engine
         self.cfg = cfg
@@ -62,6 +63,24 @@ class AgentRuntime:
         self.pre_start = pre_start
         self.post_start = post_start
         self.bootstrap = bootstrap
+        # side-channel URLs for THIS worker (remote workers: SSH -R tunnel
+        # addresses; local: host-gateway) -- fleet/channels.SideChannels,
+        # or a zero-arg callable resolved lazily on the create path only
+        self.channels = channels
+
+    def _resolve_channels(self):
+        if callable(self.channels):
+            try:
+                self.channels = self.channels()
+            except Exception as e:
+                # best-effort: a failed tunnel degrades the agent (no
+                # browser-open/OAuth/telemetry), never blocks the create
+                import logging
+
+                logging.getLogger("runtime").warning(
+                    "event=side_channels_unavailable error=%s", e)
+                self.channels = None
+        return self.channels
 
     # -------------------------------------------------------------- create
 
@@ -119,10 +138,12 @@ class AgentRuntime:
             init=False,  # the harness image's clawkerd is PID 1, not tini
             mount_docker_socket=mount_sock,
             # host.docker.internal only resolves on Linux daemons with an
-            # explicit host-gateway mapping (CLAWKER_HOSTPROXY points there)
+            # explicit host-gateway mapping; needed whenever any injected
+            # URL (hostproxy OR OTLP telemetry) points there
             extra_hosts=(
                 ["host.docker.internal:host-gateway"]
-                if self.cfg.settings.host_proxy.enable
+                if any("host.docker.internal" in v for v in env.values())
+                or self.cfg.settings.host_proxy.enable
                 else []
             ),
         )
@@ -150,10 +171,16 @@ class AgentRuntime:
             # fallback) when no bridge is running
             "SSH_AUTH_SOCK": "/run/clawker/ssh-agent.sock",
         }
-        if self.cfg.settings.host_proxy.enable:
+        channels = self._resolve_channels()
+        if channels is not None and channels.hostproxy_url:
+            # worker-specific side channel (remote: the SSH -R tunnel bind)
+            env["CLAWKER_HOSTPROXY"] = channels.hostproxy_url
+        elif self.cfg.settings.host_proxy.enable:
             env["CLAWKER_HOSTPROXY"] = (
                 f"http://host.docker.internal:{self.cfg.settings.host_proxy.port}"
             )
+        if channels is not None and channels.otlp_endpoint:
+            env["OTEL_EXPORTER_OTLP_ENDPOINT"] = channels.otlp_endpoint
         pconf = self.cfg.project
         if pconf:
             env.update(pconf.agent.env)

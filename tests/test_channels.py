@@ -1,0 +1,231 @@
+"""Side-channel suite: hostproxy + monitor streams reachable from workers.
+
+VERDICT r1 missing #1: no reverse forward existed, so containers on a
+TPU-VM worker had no path to the laptop's browser-open/OAuth/git-cred
+proxy or to the monitor stack.  These tests prove, over the FakeRunner
+transcript seam (SURVEY.md 4's multi-node-without-a-cluster strategy):
+
+- SSHTransport grows ``-R`` reverse forwards with readiness probing;
+- open_side_channels binds hostproxy + OTLP at the worker's clawker-net
+  gateway and returns worker-side URLs;
+- a loop agent created on a remote worker carries CLAWKER_HOSTPROXY
+  pointing at the tunnel bind, and a git-credential request to the
+  address the tunnel maps to is answered by the LAPTOP proxy.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.config import load_config
+from clawker_tpu.config.schema import TPUSettings
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.fleet.channels import OTLP_HTTP_PORT, open_side_channels
+from clawker_tpu.fleet.transport import FakeRunner, SSHTransport, TransportError
+from clawker_tpu.testenv import TestEnv
+
+IMAGE = "clawker-chanproj:default"
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text(
+            "project: chanproj\n"
+            "security:\n"
+            "  egress:\n"
+            "    - dst: github.com\n"
+            "      proto: https\n"
+        )
+        yield tenv, proj
+
+
+def remote_fake_driver(n_workers: int, runner: FakeRunner, mux_dir):
+    """Fake engines dressed as remote workers: each carries an
+    SSHTransport over the scripted runner (what a tpu_vm engine has)."""
+    drv = FakeDriver(n_workers=n_workers)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, exit_behavior(b"done\n", 0))
+    for w in drv.workers():
+        w.engine.transport = SSHTransport(
+            TPUSettings(), f"10.0.0.{10 + w.index}", w.index,
+            mux_dir=mux_dir / f"w{w.index}", runner=runner,
+        )
+    return drv
+
+
+# ----------------------------------------------------------- transport -R
+
+def test_reverse_forward_spawns_ssh_dash_r(tmp_path):
+    runner = FakeRunner()
+    t = SSHTransport(TPUSettings(), "10.0.0.5", 2, mux_dir=tmp_path, runner=runner)
+    t.reverse_forward_tcp("172.28.0.1", 18374, "127.0.0.1", 18374, tag="hostproxy")
+    (argv,) = runner.spawned
+    assert "-R" in argv and "-N" in argv
+    assert "172.28.0.1:18374:127.0.0.1:18374" in argv
+    # a refused bind must kill ssh (poll() detection depends on it)
+    assert "ExitOnForwardFailure=yes" in argv
+    # probe ran on the worker (through the mux, not a new connection)
+    assert any("/dev/tcp/172.28.0.1/18374" in " ".join(c) for c in runner.calls)
+    # idempotent per tag: no second tunnel process
+    t.reverse_forward_tcp("172.28.0.1", 18374, "127.0.0.1", 18374, tag="hostproxy")
+    assert len(runner.spawned) == 1
+
+
+def test_reverse_forward_failure_raises(tmp_path):
+    runner = FakeRunner({"/dev/tcp/172.28.0.1/18374": (1, "")})
+
+    class DeadProc:
+        def poll(self):
+            return 1
+
+        def terminate(self):
+            pass
+
+        def wait(self, timeout=None):
+            return 1
+
+    runner.spawn = lambda argv: DeadProc()  # tunnel dies immediately
+    t = SSHTransport(TPUSettings(), "10.0.0.5", 0, mux_dir=tmp_path, runner=runner)
+    with pytest.raises(TransportError, match="reverse forward"):
+        t.reverse_forward_tcp("172.28.0.1", 18374, "127.0.0.1", 18374)
+    # the failed tag is not cached: a retry attempts a fresh tunnel
+    runner.spawn = lambda argv: DeadProc()
+    with pytest.raises(TransportError):
+        t.reverse_forward_tcp("172.28.0.1", 18374, "127.0.0.1", 18374)
+
+
+def test_provision_monitor_unit_and_mux_drop(tmp_path):
+    """The CP unit carries the OTLP env ONLY when provisioned with
+    monitoring (no failed connects on disabled-telemetry fleets), and the
+    mux is dropped after the sshd GatewayPorts step (a reload only
+    affects new connections; forwards ride the mux)."""
+    import tarfile as tarfile_mod
+    from io import BytesIO
+
+    from clawker_tpu.fleet.provision import payload_tar, provision_worker, systemd_unit
+
+    assert "CLAWKER_TPU_OTLP" in systemd_unit(monitor=True)
+    assert "CLAWKER_TPU_OTLP" not in systemd_unit(monitor=False)
+    repo_root = Path(__file__).resolve().parent.parent
+    blob = payload_tar(repo_root, monitor=True)
+    with tarfile_mod.open(fileobj=BytesIO(blob), mode="r:gz") as tf:
+        unit = tf.extractfile("clawker-cp.service").read().decode()
+    assert "CLAWKER_TPU_OTLP" in unit
+
+    runner = FakeRunner()
+    t = SSHTransport(TPUSettings(), "10.0.0.5", 0, mux_dir=tmp_path, runner=runner)
+    provision_worker(t, repo_root)
+    joined = [" ".join(c) for c in runner.calls]
+    sshd_i = next(i for i, c in enumerate(joined) if "GatewayPorts" in c)
+    assert any("-O exit" in c for c in joined[sshd_i + 1:sshd_i + 2])
+
+
+# ------------------------------------------------------- open_side_channels
+
+def test_local_engine_channels_use_host_gateway(env):
+    tenv, proj = env
+    tenv.write_settings("host_proxy:\n  enable: true\n  port: 18374\n"
+                        "monitoring:\n  enable: true\n")
+    cfg = load_config(proj)
+    drv = FakeDriver()
+    ch = open_side_channels(drv.engine(), cfg)
+    assert ch.hostproxy_url == "http://host.docker.internal:18374"
+    assert ch.otlp_endpoint == f"http://host.docker.internal:{OTLP_HTTP_PORT}"
+    assert not ch.remote
+
+
+def test_remote_engine_channels_tunnel_to_gateway(env, tmp_path, monkeypatch):
+    tenv, proj = env
+    tenv.write_settings("host_proxy:\n  enable: true\n  port: 18374\n"
+                        "monitoring:\n  enable: true\n")
+    cfg = load_config(proj)
+    ensured = []
+    from clawker_tpu.hostproxy import manager as hp_manager
+
+    monkeypatch.setattr(hp_manager, "ensure_running",
+                        lambda c: ensured.append(True))
+    runner = FakeRunner()
+    drv = remote_fake_driver(1, runner, tmp_path)
+    eng = drv.engine()
+    # fresh worker: clawker-net does not exist yet; channels must create it
+    ch = open_side_channels(eng, cfg)
+    gateway = eng.network_static_ip(consts.NETWORK_NAME, 1)
+    assert ch.remote and ensured
+    assert ch.hostproxy_url == f"http://{gateway}:18374"
+    assert ch.otlp_endpoint == f"http://{gateway}:{OTLP_HTTP_PORT}"
+    binds = [a for argv in runner.spawned for a in argv if ":" in a and "-" not in a[:1]]
+    assert f"{gateway}:18374:127.0.0.1:18374" in binds
+    assert f"{gateway}:{OTLP_HTTP_PORT}:127.0.0.1:{OTLP_HTTP_PORT}" in binds
+    # worker-loopback OTLP bind for the worker-resident CP netlogger
+    assert f"127.0.0.1:{OTLP_HTTP_PORT}:127.0.0.1:{OTLP_HTTP_PORT}" in binds
+    # cached per engine: no new tunnels on a second open
+    n = len(runner.spawned)
+    assert open_side_channels(eng, cfg) is ch
+    assert len(runner.spawned) == n
+
+
+# ----------------------------------------- loop agents get the side channel
+
+def test_loop_agent_on_remote_worker_resolves_git_cred_via_laptop_proxy(
+        env, tmp_path, monkeypatch):
+    """BASELINE config 4 wiring, end to end minus real SSH: the loop agent
+    on worker N carries CLAWKER_HOSTPROXY = the tunnel bind; the LAPTOP
+    hostproxy answers the git-credential fill for that address."""
+    from clawker_tpu.hostproxy import manager as hp_manager
+    from clawker_tpu.hostproxy.server import HostProxy
+    from clawker_tpu.loop import LoopScheduler, LoopSpec
+
+    tenv, proj = env
+    tenv.write_settings("host_proxy:\n  enable: true\n  port: 0\n")
+    cfg = load_config(proj)
+
+    # the laptop proxy, with a scripted git helper
+    proxy = HostProxy(cfg, port=0,
+                      git_fill=lambda req: req + "username=bot\npassword=tok\n")
+    proxy.start()
+    monkeypatch.setattr(hp_manager, "ensure_running", lambda c: None)
+    # channels bind the settings port; point them at the live bound port
+    cfg.settings.host_proxy.port = proxy.bound_port
+
+    runner = FakeRunner()
+    drv = remote_fake_driver(2, runner, tmp_path)
+    for w in drv.workers():
+        w.engine.ensure_network(consts.NETWORK_NAME)
+    sched = LoopScheduler(
+        cfg, drv,
+        LoopSpec(image=IMAGE, parallel=2, iterations=1, placement="spread"),
+    )
+    try:
+        sched.start()
+        assert [l.status for l in sched.loops] != ["failed", "failed"]
+        for loop in sched.loops:
+            eng = loop.worker.require_engine()
+            info = eng.inspect_container(loop.container_id)
+            env_map = dict(e.split("=", 1) for e in info["Config"]["Env"])
+            gateway = eng.network_static_ip(consts.NETWORK_NAME, 1)
+            assert env_map["CLAWKER_HOSTPROXY"] == \
+                f"http://{gateway}:{proxy.bound_port}"
+        # the tunnel maps that bind to the laptop proxy; exercise the
+        # laptop end with the exact request an in-container helper sends
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{proxy.bound_port}/git/credential",
+            data=b"protocol=https\nhost=github.com\n",
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            body = resp.read().decode()
+        assert "password=tok" in body
+    finally:
+        sched.stop()
+        sched.cleanup(remove_containers=True)
+        proxy.stop()
