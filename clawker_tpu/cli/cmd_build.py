@@ -1,5 +1,6 @@
 """`clawker build` -- build the project's base + harness images
-(reference: internal/cmd/image/build/build.go:110)."""
+(reference: internal/cmd/image/build/build.go:110; progress tree parity
+with tui.RunProgress at :395)."""
 
 from __future__ import annotations
 
@@ -15,17 +16,48 @@ pass_factory = click.make_pass_decorator(Factory)
 @click.option("--harness", default="", help="Harness override (default: project config).")
 @click.option("--no-cache", is_flag=True, help="Build without layer cache.")
 @click.option("--quiet", "-q", is_flag=True, help="Only print the final image ref.")
+@click.option("--plain", is_flag=True, help="Raw build output (no progress tree).")
 @pass_factory
-def build_cmd(f: Factory, harness, no_cache, quiet):
+def build_cmd(f: Factory, harness, no_cache, quiet, plain):
     """Build the project image (base stage + harness stage + :default tag)."""
-    progress = (lambda _line: None) if quiet else (lambda line: click.echo(line))
+    from ..ui.buildview import BuildProgressView
+    from ..ui.progress import ProgressTree
+
     ca_pem = None
     if f.config.settings.firewall.enable:
         from ..firewall.pki import ensure_ca
 
         ca_pem = ensure_ca(f.config.pki_dir).cert_pem
-    builder = ProjectBuilder(f.engine(), f.config, ca_cert_pem=ca_pem, progress=progress)
-    res = builder.build(harness_override=harness, no_cache=no_cache)
+
+    if quiet:
+        progress = lambda _line: None  # noqa: E731
+        view = None
+    elif plain:
+        progress = lambda line: click.echo(line)  # noqa: E731
+        view = None
+    else:
+        tree = ProgressTree(f.streams)
+        view = BuildProgressView(tree)
+
+        def progress(line: str) -> None:
+            # stage boundary lines come from the builder itself
+            if line.startswith(("building ", "tagged ")):
+                view.stage(line)
+            else:
+                view.line(line)
+
+    builder = ProjectBuilder(f.engine(), f.config, ca_cert_pem=ca_pem,
+                             progress=progress)
+    if view is not None:
+        with view.tree:
+            try:
+                res = builder.build(harness_override=harness, no_cache=no_cache)
+                view.done()
+            except Exception as e:
+                view.failed(str(e))
+                raise
+    else:
+        res = builder.build(harness_override=harness, no_cache=no_cache)
     click.echo(res.default_ref)
     if not res.with_agentd and not quiet:
         click.echo(

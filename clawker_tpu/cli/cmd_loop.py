@@ -56,11 +56,26 @@ def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
         env=env,
     )
 
+    live = f.streams.is_stdout_tty() and not as_json
+    dashboard = None
+
     def on_event(agent, event, detail=""):
+        if dashboard is not None:
+            dashboard.record_event(agent, event, detail)
+            return
         line = f"[{agent}] {event}" + (f" {detail}" if detail else "")
         click.echo(line, err=True)
 
     sched = LoopScheduler(f.config, f.driver, spec, on_event=on_event)
+    if live:
+        # BASELINE config 4: the shared monitor TUI over the fan-out, with
+        # the netlogger's egress stream as a ticker when it exists
+        from ..ui.dashboard import LoopDashboard
+
+        dashboard = LoopDashboard(
+            f.streams, sched,
+            egress_path=f.config.logs_dir / "ebpf-egress.jsonl",
+        )
     signal.signal(signal.SIGINT, lambda *_: sched.stop())
     signal.signal(signal.SIGTERM, lambda *_: sched.stop())
     click.echo(
@@ -69,7 +84,11 @@ def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
         err=True,
     )
     sched.start()
-    loops = sched.run()
+    if dashboard is not None:
+        with dashboard:
+            loops = sched.run()
+    else:
+        loops = sched.run()
     if not keep:
         sched.cleanup(remove_containers=True)
     if as_json:

@@ -32,6 +32,18 @@ class Factory:
         self._config_override = config
 
     @functools.cached_property
+    def streams(self):
+        from ..ui import IOStreams
+
+        return IOStreams()
+
+    @functools.cached_property
+    def prompter(self):
+        from ..ui import Prompter
+
+        return Prompter(self.streams)
+
+    @functools.cached_property
     def config(self) -> Config:
         if self._config_override is not None:
             return self._config_override

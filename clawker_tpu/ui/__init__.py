@@ -1,0 +1,20 @@
+"""Terminal I/O layer: streams, colors, spinners, tables, progress, prompts.
+
+Parity reference: internal/iostreams/ (TTY detect, colorscheme, spinner,
+pager, alt-screen, Test() quad-buffer constructor -- iostreams.go:140) and
+internal/prompter/.  Re-designed for Python: one IOStreams facade object
+threaded through the factory, ANSI rendered directly (no lipgloss), and
+every component degrades to plain line output when stdout is not a TTY --
+the non-interactive path is the contract, the animation is the garnish.
+"""
+
+from .iostreams import IOStreams
+from .colors import ColorScheme
+from .progress import ProgressTree, Node
+from .table import render_table
+from .prompter import Prompter
+
+__all__ = [
+    "IOStreams", "ColorScheme", "ProgressTree", "Node", "render_table",
+    "Prompter",
+]

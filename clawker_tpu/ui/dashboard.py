@@ -1,0 +1,155 @@
+"""Shared monitor dashboard for ``clawker loop --parallel N``.
+
+Parity reference: internal/tui/dashboard.go + progress.go (BubbleTea);
+BASELINE config 4 names the shared monitor TUI for the pod-wide loop
+fan-out.  Re-designed as an ANSI repaint panel over the scheduler's
+public status surface plus two tickers: scheduler events and the
+netlogger's egress jsonl (the same stream the monitor stack indexes).
+
+Non-TTY behavior is handled by the CALLER (the CLI keeps its plain
+event lines); the dashboard itself only paints on a live terminal.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from pathlib import Path
+
+from .colors import visible_len
+from .iostreams import IOStreams
+from .table import render_table
+
+EVENT_TICKER = 6     # recent scheduler events shown
+EGRESS_TICKER = 5    # recent egress decisions shown
+
+
+def tail_jsonl(path: Path, max_lines: int = 64) -> list[dict]:
+    """Last records of a jsonl file (netlogger's ebpf-egress.jsonl)."""
+    try:
+        with path.open("rb") as fh:
+            fh.seek(0, 2)
+            size = fh.tell()
+            fh.seek(max(0, size - 16384))
+            chunk = fh.read().decode(errors="replace")
+    except OSError:
+        return []
+    out = []
+    for line in chunk.splitlines()[-max_lines:]:
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue
+    return out
+
+
+class LoopDashboard:
+    """Live panel: loop table + event ticker + egress ticker."""
+
+    def __init__(self, streams: IOStreams, scheduler, *,
+                 egress_path: Path | None = None, fps: float = 4.0):
+        self.streams = streams
+        self.scheduler = scheduler
+        self.egress_path = egress_path
+        self.fps = fps
+        self.events: collections.deque = collections.deque(maxlen=64)
+        self.started = time.monotonic()
+        self._painted = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------------- feed
+
+    def record_event(self, agent: str, event: str, detail: str = "") -> None:
+        with self._lock:
+            self.events.append((time.strftime("%H:%M:%S"), agent, event, detail))
+
+    # -------------------------------------------------------------- render
+
+    def _frame_lines(self) -> list[str]:
+        cs = self.streams.colors()
+        width = self.streams.terminal_width()
+        sched = self.scheduler
+        rows = []
+        for s in sched.status():
+            codes = ",".join(map(str, s.get("exit_codes", []))) or "-"
+            rows.append([
+                s["agent"], s["worker"], cs.status(s["status"]),
+                str(s["iteration"]), codes,
+            ])
+        elapsed = time.monotonic() - self.started
+        running = sum(1 for s in sched.status() if s["status"] == "running")
+        head = (cs.bold(f"loop {sched.loop_id}")
+                + cs.gray(f"  {running}/{len(rows)} running"
+                          f"  {elapsed:5.0f}s"))
+        lines = [head, ""]
+        lines += render_table(
+            ["AGENT", "WORKER", "STATUS", "ITER", "EXITS"], rows,
+            max_width=width,
+        ).splitlines()
+
+        with self._lock:
+            recent = list(self.events)[-EVENT_TICKER:]
+        if recent:
+            lines += ["", cs.bold("events")]
+            for ts, agent, event, detail in recent:
+                line = f"  {cs.gray(ts)} [{agent}] {event}"
+                if detail:
+                    line += f" {cs.gray(detail)}"
+                lines.append(line[: width + (len(line) - visible_len(line))])
+
+        if self.egress_path is not None:
+            egress = tail_jsonl(self.egress_path)[-EGRESS_TICKER:]
+            if egress:
+                lines += ["", cs.bold("egress")]
+                for ev in egress:
+                    verdict = str(ev.get("verdict", ev.get("action", "?")))
+                    color = cs.red if verdict in ("1", "deny", "DENY") else cs.green
+                    lines.append(
+                        "  " + color(verdict.lower() if not verdict.isdigit()
+                                     else ("deny" if verdict == "1" else "allow"))
+                        + f" {ev.get('dst', ev.get('dst_ip', '?'))}"
+                        + cs.gray(f":{ev.get('dst_port', '?')}"
+                                  f" zone={ev.get('zone', ev.get('zone_hash', ''))}")
+                    )
+        return lines
+
+    def render_once(self) -> None:
+        if not self.streams.is_stdout_tty():
+            return
+        lines = self._frame_lines()
+        w = self.streams.stdout.write
+        if self._painted:
+            w(f"\x1b[{self._painted}A")
+        for line in lines:
+            w("\x1b[2K" + line + "\n")
+        # a shrinking frame must not leave stale tail lines
+        for _ in range(max(0, self._painted - len(lines))):
+            w("\x1b[2K\n")
+        if self._painted > len(lines):
+            w(f"\x1b[{self._painted - len(lines)}A")
+        self.streams.stdout.flush()
+        self._painted = len(lines)
+
+    # ----------------------------------------------------------- lifecycle
+
+    def __enter__(self) -> "LoopDashboard":
+        if self.streams.is_stdout_tty():
+            self._thread = threading.Thread(target=self._loop,
+                                            name="dashboard", daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(1.0 / self.fps):
+            self.render_once()
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(1.0)
+        if self.streams.is_stdout_tty():
+            self.render_once()   # final frame with terminal states

@@ -1,0 +1,211 @@
+"""iostreams/ui suite (parity: internal/iostreams tests + prompter).
+
+Everything runs over the Test() quad-buffer constructor; the live-TTY
+paths are exercised by forcing the tty probes, never by a real pty.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from clawker_tpu.ui import (
+    ColorScheme,
+    IOStreams,
+    ProgressTree,
+    Prompter,
+    render_table,
+)
+from clawker_tpu.ui.buildview import BuildProgressView
+from clawker_tpu.ui.colors import visible_len
+from clawker_tpu.ui.prompter import PromptError
+
+
+def tty(streams: IOStreams) -> IOStreams:
+    """Dress the buffer streams as a TTY (out + err + in)."""
+    for stream in (streams.stdin, streams.stdout, streams.stderr):
+        stream.isatty = lambda: True  # type: ignore[method-assign]
+    return streams
+
+
+# -------------------------------------------------------------- iostreams
+
+def test_quad_buffer_constructor_no_tty_no_color():
+    s, fin, fout, ferr = IOStreams.test()
+    assert not s.is_stdout_tty() and not s.is_interactive()
+    assert not s.color_enabled()
+    assert s.terminal_width() == 80
+    s.println("hello")
+    s.eprintln("oops")
+    assert fout.getvalue() == "hello\n"
+    assert ferr.getvalue() == "oops\n"
+
+
+@pytest.mark.parametrize("env,is_tty,expect", [
+    ({}, True, True),
+    ({}, False, False),
+    ({"NO_COLOR": "1"}, True, False),                 # no-color.org wins
+    ({"CLICOLOR_FORCE": "1"}, False, True),           # force wins over pipe
+    ({"CLICOLOR": "0"}, True, False),
+    ({"TERM": "dumb"}, True, False),
+])
+def test_color_detection_matrix(env, is_tty, expect):
+    s, *_ = IOStreams.test()
+    s.env = env
+    if is_tty:
+        tty(s)
+    assert s.color_enabled() is expect
+
+
+def test_color_capability_tiers():
+    s, *_ = IOStreams.test()
+    s.env = {"TERM": "xterm-256color"}
+    assert s.is_256_color() and not s.is_truecolor()
+    s.env = {"COLORTERM": "truecolor"}
+    assert s.is_truecolor() and s.is_256_color()
+
+
+def test_spinner_noop_without_tty():
+    s, _, _, ferr = IOStreams.test()
+    assert s.run_with_progress("working", lambda: 42) == 42
+    assert ferr.getvalue() == ""  # silent in pipes
+
+
+def test_spinner_animates_on_tty():
+    import time
+
+    s, _, _, ferr = IOStreams.test()
+    tty(s)
+    s.start_progress("thinking")
+    time.sleep(0.25)
+    s.stop_progress()
+    out = ferr.getvalue()
+    assert "thinking" in out and "\r" in out
+
+
+def test_never_prompt_gates_can_prompt():
+    s, *_ = IOStreams.test()
+    tty(s)
+    assert s.can_prompt()
+    s.set_never_prompt(True)
+    assert not s.can_prompt()
+
+
+# ----------------------------------------------------------------- colors
+
+def test_colorscheme_plain_when_disabled():
+    cs = ColorScheme(enabled=False)
+    assert cs.red("x") == "x" and cs.bold("y") == "y"
+    assert cs.success_icon() == "+"
+
+
+def test_colorscheme_wraps_when_enabled():
+    cs = ColorScheme(enabled=True)
+    assert cs.red("x") == "\x1b[31mx\x1b[0m"
+    assert visible_len(cs.red("abc") + cs.bold("de")) == 5
+
+
+# ------------------------------------------------------------------ table
+
+def test_table_alignment_ansi_aware():
+    cs = ColorScheme(enabled=True)
+    out = render_table(
+        ["NAME", "STATE"],
+        [["dev", cs.green("running")], ["longer-name", cs.red("failed")]],
+    )
+    lines = out.splitlines()
+    # the STATE column starts at the same visible offset in every row
+    offsets = {visible_len(l.split("running")[0]) for l in lines if "running" in l}
+    offsets |= {visible_len(l.split("failed")[0]) for l in lines if "failed" in l}
+    assert len(offsets) == 1
+
+
+def test_table_truncates_to_max_width():
+    out = render_table(["A"], [["x" * 100]], max_width=20)
+    assert all(visible_len(l) <= 20 for l in out.splitlines())
+    assert "…" in out
+
+
+# --------------------------------------------------------------- progress
+
+def test_progress_tree_nontty_emits_state_lines():
+    s, _, fout, _ = IOStreams.test()
+    tree = ProgressTree(s)
+    tree.add("a", "stage one")
+    with tree:
+        tree.update("a", "running")
+        tree.add("a.1", "step", parent="a")
+        tree.update("a.1", "running")
+        tree.update("a.1", "done")
+        tree.update("a", "done")
+    out = fout.getvalue()
+    assert "• stage one" in out and "+ step" in out
+    assert tree.failed() == []
+
+
+def test_progress_tree_failure_carries_detail():
+    s, _, fout, _ = IOStreams.test()
+    tree = ProgressTree(s)
+    tree.add("a", "stage")
+    tree.update("a", "running")
+    tree.update("a", "failed", "exit 1")
+    assert "x stage" in fout.getvalue() and "exit 1" in fout.getvalue()
+    assert [n.key for n in tree.failed()] == ["a"]
+
+
+def test_progress_tree_live_repaints_in_place():
+    s, _, fout, _ = IOStreams.test()
+    tty(s)
+    tree = ProgressTree(s)
+    tree.add("a", "stage")
+    tree.update("a", "running")
+    tree.render_once()
+    tree.render_once()
+    out = fout.getvalue()
+    assert "\x1b[2K" in out            # line clear
+    assert "\x1b[1A" in out            # cursor-up repaint on second frame
+
+
+# -------------------------------------------------------------- buildview
+
+def test_buildview_maps_docker_steps_to_tree():
+    s, _, fout, _ = IOStreams.test()
+    view = BuildProgressView(ProgressTree(s))
+    view.stage("building clawker-p:base (stack python)")
+    view.line("Step 1/3 : FROM python:3.12-slim")
+    view.line(" ---> abc123")                       # detail, no new node
+    view.line("Step 2/3 : RUN pip install x")
+    view.stage("building clawker-p:claude (harness claude)")
+    view.line("Step 1/2 : FROM clawker-p:base")
+    view.done()
+    out = fout.getvalue()
+    assert "[1/3] FROM python:3.12-slim" in out
+    assert "[2/3] RUN pip install x" in out
+    assert out.count("• building ") == 2   # each stage started once
+    assert view.tree.failed() == []
+
+
+def test_buildview_failure_marks_current_step():
+    s, *_ = IOStreams.test()
+    view = BuildProgressView(ProgressTree(s))
+    view.stage("building x")
+    view.line("Step 1/1 : RUN false")
+    view.failed("exit code 1")
+    assert {n.key for n in view.tree.failed()} == {"stage-1", "stage-1.1"}
+
+
+# --------------------------------------------------------------- prompter
+
+def test_prompter_refuses_without_tty():
+    s, *_ = IOStreams.test()
+    with pytest.raises(PromptError, match="not an interactive"):
+        Prompter(s).confirm("sure?")
+
+
+def test_prompter_string_confirm_select():
+    s, *_ = IOStreams.test(stdin_data="alice\n\ny\n2\n")
+    tty(s)
+    p = Prompter(s)
+    assert p.string("name") == "alice"
+    assert p.string("role", default="admin") == "admin"   # empty -> default
+    assert p.confirm("proceed?") is True
+    assert p.select("pick", ["a", "b", "c"]) == 1
