@@ -82,20 +82,51 @@ def to_dict(obj) -> dict:
 # --------------------------------------------------------------------------
 
 @dataclass
-class EgressRule:
-    """One egress allowance.
+class PathRule:
+    """One HTTP path verdict inside an egress rule (prefix match, applied
+    in declaration order; reference: httpAllowRoute/httpDenyRoute in
+    controlplane/firewall/envoy_http.go:296/:314)."""
 
-    ``dst`` is a domain (exact or ``*.wildcard``), ``proto`` one of
-    http|https|tcp|udp, ``port`` the destination port (0 = protocol default),
-    ``paths`` optional HTTP path prefixes that force MITM inspection
-    (reference: firewall rules store dedupe key ``dst:proto:port``,
-    controlplane/firewall/rules_store.go).
+    path: str = ""
+    action: str = "allow"           # allow | deny
+    methods: list[str] = field(default_factory=list)  # empty = any verb
+
+    def __post_init__(self) -> None:
+        self.action = (self.action or "allow").lower()
+        self.methods = sorted({m.upper() for m in self.methods if m})
+
+
+@dataclass
+class EgressRule:
+    """One egress rule.
+
+    ``dst`` is a domain -- exact, or wildcard as ``*.zone`` / leading-dot
+    ``.zone`` (reference config syntax, e2e firewall_test.go:678); both
+    normalize to the ``*.`` form.  ``proto`` is one of http|https|tcp|udp,
+    ``port`` the destination port (0 = protocol default).  ``action: deny``
+    carves a more-specific NXDOMAIN zone out of a broader wildcard allow
+    (firewall_test.go:653 DenySubdomainUnderWildcard).  ``path_rules`` +
+    ``path_default`` gate HTTP paths behind MITM/Host inspection
+    (firewall_test.go:842-1320); ``paths`` is the legacy shorthand for
+    allow-prefixes with an implied deny default.  Dedupe key is
+    ``dst:proto:port`` (reference: controlplane/firewall/rules_store.go).
     """
 
     dst: str = ""
     proto: str = "https"
     port: int = 0
+    action: str = "allow"           # allow | deny (domain-level)
     paths: list[str] = field(default_factory=list)
+    path_rules: list[PathRule] = field(default_factory=list)
+    path_default: str = ""          # allow | deny; "" = deny when ruled
+
+    def __post_init__(self) -> None:
+        dst = (self.dst or "").strip().lower().rstrip(".")
+        if dst.startswith(".") and len(dst) > 1:
+            dst = "*" + dst         # ".zone" == "*.zone"
+        self.dst = dst
+        self.action = (self.action or "allow").lower()
+        self.path_default = (self.path_default or "").lower()
 
     def key(self) -> str:
         return f"{self.dst}:{self.proto}:{self.effective_port()}"
@@ -104,6 +135,30 @@ class EgressRule:
         if self.port:
             return self.port
         return {"https": 443, "http": 80, "udp": 0, "tcp": 0}.get(self.proto, 0)
+
+    @property
+    def wildcard(self) -> bool:
+        return self.dst.startswith("*.")
+
+    @property
+    def apex(self) -> str:
+        return self.dst[2:] if self.wildcard else self.dst
+
+    def effective_path_rules(self) -> list[PathRule]:
+        """Declared path_rules followed by legacy ``paths`` allow-prefixes."""
+        out = list(self.path_rules)
+        out.extend(PathRule(path=p) for p in self.paths)
+        return out
+
+    def effective_path_default(self) -> str:
+        if self.path_default in ("allow", "deny"):
+            return self.path_default
+        return "deny" if self.effective_path_rules() else "allow"
+
+    def needs_inspection(self) -> bool:
+        """True when HTTP-layer path/method verdicts exist -- https rules
+        must MITM instead of SNI-passthrough."""
+        return bool(self.effective_path_rules()) or self.path_default == "deny"
 
 
 @dataclass

@@ -169,6 +169,7 @@ class Zone:
     apex: str            # normalized, no wildcard marker
     wildcard: bool       # True: apex + any subdomain; False: exact only
     internal: bool = False  # forward to the Docker-embedded resolver
+    deny: bool = False   # more-specific NXDOMAIN carve-out under an allow
 
     @property
     def hash(self) -> int:
@@ -177,12 +178,14 @@ class Zone:
 
 @dataclass
 class ZonePolicy:
-    """Longest-apex-wins matcher over allowed + internal zones.
+    """Longest-apex-wins matcher over allowed + internal + deny zones.
 
     Wildcard/exact semantics are the reference's e2e contract
     (firewall_test.go:609/:653): ``*.example.com`` admits the apex and
-    every subdomain; a bare ``example.com`` rule admits only itself.
-    """
+    every subdomain; a bare ``example.com`` rule admits only itself.  An
+    ``action: deny`` rule emits a more-specific NXDOMAIN zone that wins
+    over a broader wildcard allow via the longest-apex ordering
+    (firewall_test.go:653 DenySubdomainUnderWildcard)."""
 
     zones: list[Zone] = field(default_factory=list)
 
@@ -191,23 +194,33 @@ class ZonePolicy:
         zones: dict[tuple[str, bool, bool], Zone] = {}
         for rule in rules:
             dst = rule.dst.strip().lower().rstrip(".")
+            if dst.startswith(".") and len(dst) > 1:
+                dst = "*" + dst     # leading-dot wildcard form
             if not dst:
                 continue
             wild = dst.startswith("*.")
             apex = dst[2:] if wild else dst
-            z = Zone(apex=apex, wildcard=wild)
+            deny = getattr(rule, "action", "allow") == "deny"
+            z = Zone(apex=apex, wildcard=wild, deny=deny)
+            prev = zones.get((z.apex, z.wildcard, False))
+            if prev is not None and prev.deny:
+                continue            # deny sticks over a same-shape allow
             zones[(z.apex, z.wildcard, False)] = z
         for apex in internal_zones:
             z = Zone(apex=apex.strip(".").lower(), wildcard=True, internal=True)
             zones[(z.apex, z.wildcard, True)] = z
-        return cls(sorted(zones.values(), key=lambda z: len(z.apex), reverse=True))
+        return cls(sorted(zones.values(),
+                          key=lambda z: (len(z.apex), not z.wildcard),
+                          reverse=True))
 
     def match(self, qname: str) -> Zone | None:
+        """Longest matching zone; exact beats wildcard at equal apex."""
         q = qname.strip(".").lower()
         for z in self.zones:
-            if q == z.apex:
-                return z
-            if z.wildcard and q.endswith("." + z.apex):
+            if not z.wildcard:
+                if q == z.apex:
+                    return z
+            elif q == z.apex or q.endswith("." + z.apex):
                 return z
         return None
 
@@ -326,7 +339,7 @@ class DnsGate:
         self.stats.queries += 1
         with self._policy_lock:
             zone = self.policy.match(q.qname)
-        if zone is None:
+        if zone is None or zone.deny:
             self.stats.refused += 1
             return synthesize(q, RCODE_NXDOMAIN)
         if q.qtype == QTYPE_AAAA:

@@ -176,6 +176,83 @@ def _sni_names(domain: str) -> list[str]:
     return [domain]
 
 
+# Centralized non-fingerprinting deny body: the verdict travels via the
+# access-log metadata, never the body, and the body must not disclose the
+# enforcement product (reference: envoy_config.go firewallBlockedBody;
+# pinned by e2e firewall_test.go:930-933).
+FIREWALL_BLOCKED_BODY = "403 Forbidden\n"
+
+
+def _hcm_hardening() -> dict:
+    """Edge-hardening fields every HTTP connection manager carries.
+
+    normalize_path + merge_slashes + UNESCAPE_AND_REDIRECT close the
+    URL-encoded-traversal path-smuggling vector (reference:
+    envoy_http.go:411 httpConnectionManagerHardening; pinned by e2e
+    firewall_test.go:1131 PathRuleNormalizationDefeatsSmuggling)."""
+    return {
+        "normalize_path": True,
+        "merge_slashes": True,
+        "path_with_escaped_slashes_action": "UNESCAPE_AND_REDIRECT",
+        "common_http_protocol_options": {
+            "headers_with_underscores_action": "REJECT_REQUEST",
+        },
+    }
+
+
+def _action_metadata(action: str) -> dict:
+    """Per-route metadata the access log reads so each record carries the
+    concrete verdict (reference: envoy_http.go clawkerActionMetadata)."""
+    return {"filter_metadata": {"fw": {"action": action}}}
+
+
+def _deny_route(match: dict) -> dict:
+    return {
+        "match": match,
+        "metadata": _action_metadata("denied"),
+        "direct_response": {
+            "status": 403,
+            "body": {"inline_string": FIREWALL_BLOCKED_BODY},
+        },
+    }
+
+
+def _route_match(pr) -> dict:
+    match: dict = {"prefix": pr.path}
+    if pr.methods:
+        if len(pr.methods) == 1:
+            sm = {"exact": pr.methods[0]}
+        else:
+            sm = {"safe_regex": {"regex": "|".join(pr.methods)}}
+        match["headers"] = [{"name": ":method", "string_match": sm}]
+    return match
+
+
+def _path_routes(rule: EgressRule, cluster: str) -> list[dict]:
+    """Ordered route list from path_rules + path_default (allow -> cluster,
+    deny -> direct_response 403), ending in the catch-all default."""
+    routes = []
+    for pr in rule.effective_path_rules():
+        if pr.action == "deny":
+            routes.append(_deny_route(_route_match(pr)))
+        else:
+            routes.append({
+                "match": _route_match(pr),
+                "metadata": _action_metadata("allowed"),
+                "route": {"cluster": cluster, "timeout": "0s"},
+            })
+    default = {"prefix": "/"}
+    if rule.effective_path_default() == "deny":
+        routes.append(_deny_route(default))
+    else:
+        routes.append({
+            "match": default,
+            "metadata": _action_metadata("allowed"),
+            "route": {"cluster": cluster, "timeout": "0s"},
+        })
+    return routes
+
+
 def _mitm_chain(rule: EgressRule, cert_dir: str) -> dict:
     wildcard = rule.dst.startswith("*.")
     apex = rule.dst[2:] if wildcard else rule.dst
@@ -186,10 +263,7 @@ def _mitm_chain(rule: EgressRule, cert_dir: str) -> dict:
         if wildcard
         else _cluster_name(apex, rule.effective_port(), tls=True)
     )
-    routes = [
-        {"match": {"prefix": p}, "route": {"cluster": cluster}}
-        for p in sorted(rule.paths)
-    ]
+    routes = _path_routes(rule, cluster)
     http_filters = []
     if wildcard:
         http_filters.append(_dfp_http_filter(DFP_CACHE_TLS))
@@ -220,13 +294,14 @@ def _mitm_chain(rule: EgressRule, cert_dir: str) -> dict:
                 "stat_prefix": f"mitm_{apex.replace('.', '_')}",
                 "access_log": _access_log(),
                 "http_filters": http_filters,
+                **_hcm_hardening(),
                 "route_config": {
                     "name": f"paths_{apex.replace('.', '_')}",
                     "virtual_hosts": [{
                         "name": apex,
                         "domains": ["*"],
                         "routes": routes,
-                        # anything off the ruled prefixes: 403, logged
+                        # path_default decides the catch-all: 403 or forward
                     }],
                 },
             },
@@ -309,10 +384,7 @@ def _http_listener(rules: list[EgressRule], port: int) -> dict:
         vhosts.append({
             "name": f"http_{apex.replace('.', '_')}",
             "domains": sorted(domains),
-            "routes": [{
-                "match": {"prefix": p},
-                "route": {"cluster": cluster},
-            } for p in (sorted(rule.paths) or ["/"])],
+            "routes": _path_routes(rule, cluster),
         })
     http_filters = []
     if any_wildcard:
@@ -334,6 +406,7 @@ def _http_listener(rules: list[EgressRule], port: int) -> dict:
                     "stat_prefix": "http_egress",
                     "access_log": _access_log(),
                     "http_filters": http_filters,
+                    **_hcm_hardening(),
                     "route_config": {
                         "name": "http_egress",
                         "virtual_hosts": vhosts,
@@ -370,9 +443,14 @@ def generate_envoy_config(
         apex = rule.dst[2:] if wildcard else rule.dst
         if not apex:
             continue
+        if rule.action == "deny":
+            # Domain-level deny never gets a proxy lane: the DNS gate
+            # NXDOMAINs the zone and the kernel route table carries DENY
+            # (firewall_test.go:653 DenySubdomainUnderWildcard).
+            continue
         port = rule.effective_port()
         if rule.proto == "https":
-            if rule.paths:
+            if rule.needs_inspection():
                 tls_chains.append(_mitm_chain(rule, cert_dir))
                 mitm_domains.append(apex)
                 if wildcard:

@@ -48,6 +48,7 @@ class Reason(IntEnum):
     RAW_SOCKET = 9
     IPV6 = 10
     MONITOR = 11
+    INTRA_NET = 12
 
 
 # protocol discriminator used in route keys / events
@@ -96,9 +97,13 @@ class ContainerPolicy:
     hostproxy_ip: str = "0.0.0.0"
     hostproxy_port: int = 0
     flags: int = FLAG_ENFORCE
+    net_ip: str = "0.0.0.0"   # sandbox bridge subnet base
+    net_prefix: int = 0       # prefix length; 0 = no intra-net allowance
 
-    FMT = "<IIIHHI"  # envoy_ip, dns_ip, hostproxy_ip(be32 each), hp_port(be16), pad, flags
-    SIZE = struct.calcsize(FMT)  # 20
+    # envoy_ip, dns_ip, hostproxy_ip (be32 each), hp_port(be16), pad,
+    # flags, net_ip(be32), net_prefix
+    FMT = "<IIIHHIII"
+    SIZE = struct.calcsize(FMT)  # 28
 
     def pack(self) -> bytes:
         return struct.pack(
@@ -109,12 +114,15 @@ class ContainerPolicy:
             port_to_be(self.hostproxy_port),
             0,
             self.flags,
+            ip4_to_be(self.net_ip),
+            self.net_prefix,
         )
 
     @classmethod
     def unpack(cls, raw: bytes) -> "ContainerPolicy":
-        e, d, h, hp, _, flags = struct.unpack(cls.FMT, raw)
-        return cls(be_to_ip4(e), be_to_ip4(d), be_to_ip4(h), be_to_port(hp), flags)
+        e, d, h, hp, _, flags, n, npfx = struct.unpack(cls.FMT, raw)
+        return cls(be_to_ip4(e), be_to_ip4(d), be_to_ip4(h), be_to_port(hp),
+                   flags, be_to_ip4(n), npfx)
 
 
 @dataclass

@@ -94,6 +94,13 @@ def decide(maps: FirewallMaps, cgroup_id: int, dst_ip: str, dst_port: int,
             and dst_port == pol.hostproxy_port):
         return Verdict(Action.ALLOW, Reason.HOSTPROXY)
 
+    if pol.net_prefix and _in_cidr(dst_ip, pol.net_ip, pol.net_prefix):
+        # intra-network bypass: sibling services on the sandbox bridge
+        # (CP, otel-collector, project listeners) are reachable without
+        # rules -- the network is clawker-managed (reference e2e:
+        # firewall_test.go:398 IntraNetworkBypass)
+        return Verdict(Action.ALLOW, Reason.INTRA_NET)
+
     dns = maps.lookup_dns(dst_ip)
     if dns is None:
         v = _no_route(pol, Reason.NO_DNS_ENTRY)
@@ -112,6 +119,22 @@ def decide(maps: FirewallMaps, cgroup_id: int, dst_ip: str, dst_port: int,
                 redirect_port=route.redirect_port, zone_hash=dns.zone_hash)
     _event(maps, cgroup_id, dst_ip, dst_port, proto, v)
     return v
+
+
+def _in_cidr(ip: str, net: str, prefix: int) -> bool:
+    """ip within net/prefix (v4)."""
+    import socket as _s
+    import struct as _struct
+
+    if not 0 < prefix <= 32:
+        return False
+    mask = (0xFFFFFFFF << (32 - prefix)) & 0xFFFFFFFF
+    try:
+        ip_n = _struct.unpack(">I", _s.inet_aton(ip))[0]
+        net_n = _struct.unpack(">I", _s.inet_aton(net))[0]
+    except OSError:
+        return False
+    return (ip_n & mask) == (net_n & mask)
 
 
 def _no_route(pol, reason: Reason, zone: int = 0) -> Verdict:
@@ -237,10 +260,18 @@ def build_routes(rules, *, envoy_ip: str, tls_port: int,
 
     table: dict[RouteKey, RouteVal] = {}
     tcp_ports = tcp_ports or {}
-    for rule in rules:
+    # allow rules first so a domain-level deny sharing a zone wins
+    ordered = sorted(rules, key=lambda r: getattr(r, "action", "allow") == "deny")
+    for rule in ordered:
         apex = rule.dst[2:] if rule.dst.startswith("*.") else rule.dst
         zh = zone_hash(apex)
         port = rule.effective_port()
+        if getattr(rule, "action", "allow") == "deny":
+            # Defense in depth behind the DNS-gate NXDOMAIN: even a stale
+            # dns_cache entry for the denied zone denies on every port.
+            table[RouteKey(zh, 0, PROTO_TCP)] = RouteVal(Action.DENY)
+            table[RouteKey(zh, 0, PROTO_UDP)] = RouteVal(Action.DENY)
+            continue
         if rule.proto == "https":
             table[RouteKey(zh, port, PROTO_TCP)] = RouteVal(
                 Action.REDIRECT, redirect_ip=envoy_ip, redirect_port=tls_port)

@@ -1,0 +1,5 @@
+"""Socket-level firewall parity: executable Envoy-bootstrap interpreter,
+attacker capture server, virtual-internet world, and the 22-scenario
+reference scorecard (`python -m clawker_tpu.parity`)."""
+
+from .world import CurlResult, EgressBlocked, World  # noqa: F401

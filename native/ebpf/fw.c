@@ -235,6 +235,21 @@ static __always_inline int fw_decide(const struct fw_container *pol, __u64 cg,
 		return 1;
 	}
 
+	/* 6b. intra-network bypass: sibling services on the clawker-managed
+	 * bridge (CP, otel-collector, project listeners) need no rules.
+	 * dst/net_ip are network byte order; build the mask in host order
+	 * and compare in host order so the prefix counts leading bits. */
+	if (pol->net_prefix > 0 && pol->net_prefix <= 32) {
+		__u32 mask = pol->net_prefix == 32
+				     ? 0xffffffff
+				     : ~(0xffffffffu >> pol->net_prefix);
+		if ((fw_ntohl(dst) & mask) == (fw_ntohl(pol->net_ip) & mask)) {
+			v->action = FW_ALLOW;
+			v->reason = FW_R_INTRA_NET;
+			return 1;
+		}
+	}
+
 	/* 7. ip-literal egress: no resolution through the gate -> deny */
 	dns = bpf_map_lookup_elem(&dns_cache, &dst);
 	if (!dns) {

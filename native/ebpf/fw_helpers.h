@@ -78,6 +78,8 @@ static void (*bpf_ringbuf_discard)(void *data, __u64 flags) = (void *)133;
 /* byte-order (constant-foldable) */
 #define fw_htons(x) ((__be16)__builtin_bswap16((__u16)(x)))
 #define fw_ntohs(x) ((__u16)__builtin_bswap16((__u16)(x)))
+#define fw_htonl(x) ((__be32)__builtin_bswap32((__u32)(x)))
+#define fw_ntohl(x) ((__u32)__builtin_bswap32((__u32)(x)))
 
 static const char _license[] SEC("license") = "GPL";
 

@@ -40,6 +40,7 @@
 #define FW_R_RAW_SOCKET   9
 #define FW_R_IPV6         10
 #define FW_R_MONITOR      11
+#define FW_R_INTRA_NET    12
 
 /* fw_container.flags (model.py FLAG_*) */
 #define FW_F_ENFORCE   (1u << 0)
@@ -69,6 +70,8 @@ struct fw_container {
 	__be16 hostproxy_port;
 	__u16  pad;
 	__u32  flags;
+	__be32 net_ip;      /* sandbox bridge subnet base */
+	__u32  net_prefix;  /* prefix length; 0 = no intra-net allowance */
 };
 
 /* dns_cache value (key = __be32 resolved ip) - model.py DnsEntry, 16 bytes */

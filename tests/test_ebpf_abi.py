@@ -152,5 +152,6 @@ def test_action_reason_constants_match():
             Reason.NO_DNS_ENTRY: "FW_R_NO_DNS_ENTRY",
             Reason.RAW_SOCKET: "FW_R_RAW_SOCKET", Reason.IPV6: "FW_R_IPV6",
             Reason.MONITOR: "FW_R_MONITOR",
+            Reason.INTRA_NET: "FW_R_INTRA_NET",
         }[reason]
         assert defined(cname) == int(reason), cname

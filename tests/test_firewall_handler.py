@@ -389,8 +389,13 @@ def test_envoy_wildcard_rules_use_dynamic_forward_proxy():
     hcm = mitm["filters"][0]["typed_config"]
     assert hcm["http_filters"][0]["name"] == "envoy.filters.http.dynamic_forward_proxy"
     for vh in hcm["route_config"]["virtual_hosts"]:
-        for route in vh["routes"]:
+        fwd = [r for r in vh["routes"] if "route" in r]
+        assert fwd, "expected at least one forwarding route"
+        for route in fwd:
             assert route["route"]["cluster"] == envoy_mod.DFP_CLUSTER_TLS
+        # legacy paths shorthand implies default deny: catch-all is a 403
+        assert "direct_response" in vh["routes"][-1]
+        assert vh["routes"][-1]["direct_response"]["status"] == 403
     # exact rule keeps a plain per-host passthrough chain (no DFP filter)
     exact = next(c for c in chains
                  if c["filter_chain_match"]["server_names"] == ["exact.net"])

@@ -283,7 +283,7 @@ def test_events_ring_bounded():
 # -- ABI pins: C struct twins must match these exactly ----------------------
 
 def test_abi_struct_sizes():
-    assert ContainerPolicy.SIZE == 20
+    assert ContainerPolicy.SIZE == 28
     assert DnsEntry.SIZE == 16
     assert RouteKey.SIZE == 12
     assert RouteVal.SIZE == 8

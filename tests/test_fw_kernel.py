@@ -246,6 +246,22 @@ def test_loopback_allowed_silently(k):
     assert k.events() == []
 
 
+def test_intra_net_cidr_allowed_silently(fw):
+    """Sibling services on the sandbox bridge need no rules (reference
+    e2e: firewall_test.go:398 IntraNetworkBypass)."""
+    k = Kern(fw)
+    k.enroll(CG, ContainerPolicy(envoy_ip="172.28.0.2", dns_ip="172.28.0.1",
+                                 hostproxy_ip="0.0.0.0", hostproxy_port=0,
+                                 flags=FLAG_ENFORCE,
+                                 net_ip="172.28.0.0", net_prefix=24))
+    rc, ip, port = k.connect4(CG, "172.28.0.77", 8080)
+    assert (rc, ip, port) == (OK, "172.28.0.77", 8080)
+    assert k.events() == []
+    # one bit outside the prefix: back to default deny (no dns entry)
+    rc, *_ = k.connect4(CG, "172.28.1.77", 8080)
+    assert rc == EPERM
+
+
 def test_dns_rewritten_to_gate(k):
     rc, ip, port = k.connect4(CG, "8.8.8.8", 53, udp=True, cookie=77)
     assert rc == OK
@@ -422,9 +438,13 @@ def test_differential_against_policy_oracle(fw):
 
     for trial in range(300):
         flags = rng.choice([0, FLAG_ENFORCE, FLAG_ENFORCE | FLAG_HOSTPROXY])
+        # intra-net CIDR allowance: off, the bridge /24, or a /16 that
+        # also covers the 172.28.* service IPs
+        net_ip, net_prefix = rng.choice([
+            ("0.0.0.0", 0), ("10.0.0.0", 24), ("172.28.0.0", 16)])
         pol = ContainerPolicy(envoy_ip="172.28.0.2", dns_ip="172.28.0.1",
                               hostproxy_ip="172.28.0.1", hostproxy_port=18374,
-                              flags=flags)
+                              flags=flags, net_ip=net_ip, net_prefix=net_prefix)
         k = Kern(fw)
         k.enroll(CG, pol)
         fm = FakeMaps()
