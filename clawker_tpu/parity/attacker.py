@@ -83,15 +83,22 @@ class AttackerServer:
         self.udp_port = 0
         self._servers: list = []
         self._threads: list[threading.Thread] = []
-        self._technique = threading.local()
+        # Captures are inserted from socketserver handler threads, never
+        # the dialer thread, so the technique tag must be a cross-thread
+        # plain attribute (scenarios run sequentially), not a
+        # threading.local that would read unset as "?" in handlers.
+        self._technique_lock = threading.Lock()
+        self._technique_name = "?"
 
     # The dialer tags which technique is currently attacking so captures
     # attribute to it (the reference uses per-test capture paths).
     def set_technique(self, name: str) -> None:
-        self._technique.name = name
+        with self._technique_lock:
+            self._technique_name = name
 
     def _current(self) -> str:
-        return getattr(self._technique, "name", "?")
+        with self._technique_lock:
+            return self._technique_name
 
     # ------------------------------------------------------------ servers
 

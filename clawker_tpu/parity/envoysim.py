@@ -336,11 +336,20 @@ class EnvoySim:
         if not record:
             return
         sni = parse_client_hello_sni(record)
+        # Envoy picks the most-specific filter_chain_match: an exact
+        # server_name beats a wildcard, regardless of declaration order
+        # (exercised by e2e WildcardAndExactCoexist).
         chain = None
-        for c in listener.get("filter_chains", []):
-            names = c.get("filter_chain_match", {}).get("server_names", [])
-            if _sni_matches(names, sni):
-                chain = c
+        chains = listener.get("filter_chains", [])
+        for exact_pass in (True, False):
+            for c in chains:
+                names = c.get("filter_chain_match", {}).get("server_names", [])
+                wanted = [n for n in names
+                          if n.startswith("*.") != exact_pass]
+                if _sni_matches(wanted, sni):
+                    chain = c
+                    break
+            if chain is not None:
                 break
         if chain is None:
             # default deny: no chain for this SNI -> refuse
@@ -460,9 +469,14 @@ class EnvoySim:
                               server_hostname=(req.host or sni or "").split(":")[0])
 
     def _match_vhost(self, hcm: dict, host: str) -> dict | None:
-        for vh in hcm["route_config"]["virtual_hosts"]:
-            if any(_host_matches(d, host) for d in vh["domains"]):
-                return vh
+        # exact domains win over wildcards (Envoy vhost domain search
+        # order: exact, then suffix wildcards), declaration order second
+        for exact_pass in (True, False):
+            for vh in hcm["route_config"]["virtual_hosts"]:
+                domains = [d for d in vh["domains"]
+                           if d.startswith("*") != exact_pass]
+                if any(_host_matches(d, host) for d in domains):
+                    return vh
         return None
 
     @staticmethod

@@ -169,6 +169,8 @@ class World:
 
     def __init__(self, rules: list[EgressRule], tmp: Path, *,
                  enforce: bool = True, hostproxy: bool = True,
+                 enrolled: bool = True,
+                 intra_net: tuple[str, int] | None = None,
                  captures: CaptureStore | None = None):
         tmp.mkdir(parents=True, exist_ok=True)
         self.tmp = tmp
@@ -201,12 +203,16 @@ class World:
         self.attacker.start()
         self.add_attacker_host("attacker.test")
 
-        # enforcement surfaces
-        flags = (FLAG_ENFORCE if enforce else 0) | (FLAG_HOSTPROXY if hostproxy else 0)
-        self.maps.enroll(CG_AGENT, ContainerPolicy(
-            envoy_ip=ENVOY_IP, dns_ip=DNS_IP,
-            hostproxy_ip=HOSTPROXY_IP, hostproxy_port=HOSTPROXY_PORT,
-            flags=flags))
+        # enforcement surfaces.  enrolled=False models `firewall.enable:
+        # false` -- the cgroup is never enrolled, every verdict is
+        # UNMANAGED ALLOW (reference e2e FirewallDisabled).
+        if enrolled:
+            flags = (FLAG_ENFORCE if enforce else 0) | (FLAG_HOSTPROXY if hostproxy else 0)
+            net_ip, net_prefix = intra_net or ("0.0.0.0", 0)
+            self.maps.enroll(CG_AGENT, ContainerPolicy(
+                envoy_ip=ENVOY_IP, dns_ip=DNS_IP,
+                hostproxy_ip=HOSTPROXY_IP, hostproxy_port=HOSTPROXY_PORT,
+                flags=flags, net_ip=net_ip, net_prefix=net_prefix))
         self.bundle = generate_envoy_config(rules, cert_dir=str(tmp / "mitm"))
         (tmp / "mitm").mkdir(exist_ok=True)
         self._write_mitm_certs()
@@ -399,21 +405,26 @@ class World:
     # --------------------------------------------------------- resolvers
 
     def dig(self, name: str, qtype: int = 1) -> tuple[int, list[str]]:
-        """dig through the kernel twin + the REAL gate socket."""
+        """dig through the kernel twin + the REAL gate socket.
+
+        An ALLOW verdict (bypass / unenrolled cgroup) means the kernel
+        did NOT rewrite the resolver address: the query reaches upstream
+        "internet DNS" (the world table) directly, exactly as a bypassed
+        container's queries flow to its configured resolver."""
         v = policy_mod.sendmsg4(self.maps, CG_AGENT, self.cookie(),
                                 "8.8.8.8", 53)
         if v.action is Action.DENY:
             return -1, []
-        if v.action is Action.REDIRECT_DNS:
-            target = ("127.0.0.1", self.gate.bound_port)
-        else:
-            target = ("127.0.0.1", self.gate.bound_port)
+        if v.action is Action.ALLOW:
+            self.upstream_queries.append(name.lower().rstrip("."))
+            ip = self.dns_table.get(name.lower().rstrip("."))
+            return (0, [ip]) if ip else (3, [])
         from ..firewall.dnsgate import _encode_name
         hdr = struct.pack(">HHHHHH", 0x2222, 0x0100, 1, 0, 0, 0)
         query = hdr + _encode_name(name) + struct.pack(">HH", qtype, 1)
         with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
             s.settimeout(5.0)
-            s.sendto(query, target)
+            s.sendto(query, ("127.0.0.1", self.gate.bound_port))
             try:
                 reply = s.recv(4096)
             except socket.timeout:
@@ -434,9 +445,13 @@ class World:
             host = u.hostname or ""
             port = u.port or (443 if u.scheme == "https" else 80)
             path = (u.path or "/") + (f"?{u.query}" if u.query else "")
-            rcode, ips = self.dig(host)
-            if rcode != 0 or not ips:
-                return CurlResult(err=f"could not resolve host: {host}")
+            try:  # IP-literal target: no resolver step (curl semantics)
+                socket.inet_aton(host)
+                ips = [host]
+            except OSError:
+                rcode, ips = self.dig(host)
+                if rcode != 0 or not ips:
+                    return CurlResult(err=f"could not resolve host: {host}")
             try:
                 sock = self.open_tcp(ips[0], port, technique=technique)
             except EgressBlocked as e:
