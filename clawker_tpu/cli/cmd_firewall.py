@@ -68,15 +68,22 @@ def fw_bypass(f: Factory, container, duration_s):
 
 @fw_group.command("add-rule")
 @click.argument("dst")
-@click.option("--proto", type=click.Choice(["https", "http", "tcp", "udp"]),
-              default="https")
+@click.option("--proto", default="https",
+              type=click.Choice(["https", "http", "tcp", "udp", "ssh", "git"]),
+              help="tcp is the generic opaque lane (explicit port required).")
 @click.option("--port", type=int, default=0, help="0 = protocol default.")
 @click.option("--path", "paths", multiple=True,
               help="HTTP path prefix (repeatable; forces MITM inspection).")
+@click.option("--deny", is_flag=True,
+              help="Domain-level deny (NXDOMAIN carve-out under a wildcard).")
 @pass_factory
-def fw_add_rule(f: Factory, dst, proto, port, paths):
-    """Allow egress to DST (domain or *.wildcard)."""
-    rule = {"dst": dst, "proto": proto, "port": port, "paths": list(paths)}
+def fw_add_rule(f: Factory, dst, proto, port, paths, deny):
+    """Allow egress to DST (domain or *.wildcard).
+
+    Rules are validated at ingestion: a glob path or a bad action errors
+    here, not at traffic time."""
+    rule = {"dst": dst, "proto": proto, "port": port, "paths": list(paths),
+            "action": "deny" if deny else "allow"}
     _echo(_call(f, "FirewallAddRules", {"rules": [rule]}))
 
 

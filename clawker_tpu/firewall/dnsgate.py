@@ -315,7 +315,11 @@ class DnsGate:
         self.bound_port = self._udp.server_address[1]
         self._tcp = socketserver.ThreadingTCPServer((self.host, self.bound_port), _Tcp)
         for name, srv in (("dnsgate-udp", self._udp), ("dnsgate-tcp", self._tcp)):
-            t = threading.Thread(target=srv.serve_forever, name=name, daemon=True)
+            # tight poll: stop() should not stall a CP drain for the
+            # default 0.5s-per-server serve_forever poll interval
+            t = threading.Thread(
+                target=srv.serve_forever, kwargs={"poll_interval": 0.05},
+                name=name, daemon=True)
             t.start()
             self._threads.append(t)
         log.info("dns gate listening on %s:%d", self.host, self.bound_port)
@@ -339,6 +343,16 @@ class DnsGate:
         self.stats.queries += 1
         with self._policy_lock:
             zone = self.policy.match(q.qname)
+        if zone is None and "." not in q.qname.strip(".") and (
+                self.internal_lookup is not None
+                or self.internal_resolver is not None):
+            # Single-label names are sibling services on the sandbox
+            # network, answered by the engine inventory the way Docker's
+            # embedded DNS answers bare container names (reference:
+            # firewall_test.go:568 resolves `otel-collector`).  Gates with
+            # no internal plumbing keep the authoritative NXDOMAIN.
+            zone = Zone(apex=q.qname.strip(".").lower(), wildcard=False,
+                        internal=True)
         if zone is None or zone.deny:
             self.stats.refused += 1
             return synthesize(q, RCODE_NXDOMAIN)

@@ -430,6 +430,24 @@ def generate_envoy_config(
     ordered = sorted(
         {r.key(): r for r in rules}.values(), key=lambda r: r.key()
     )
+    # Apexes that also carry an exact https rule: the exact chain owns the
+    # bare-apex SNI, so a coexisting wildcard chain must not claim it
+    # (firewall_test.go:1326 WildcardAndExactCoexist -- independent filter
+    # chains, no SNI collision).  Keyed on dst only: SNI carries no port
+    # signal, and duplicate server_names across chains are an Envoy NACK
+    # (= full egress outage on the next reload), which outranks steering
+    # the apex of an odd-port exact rule.
+    exact_https = {r.dst for r in ordered
+                   if r.proto == "https" and not r.dst.startswith("*.")
+                   and r.action != "deny"}
+
+    def cede_apex_to_exact(chain: dict, rule: EgressRule) -> dict:
+        apex_ = rule.dst[2:] if rule.dst.startswith("*.") else rule.dst
+        if rule.dst.startswith("*.") and apex_ in exact_https:
+            chain["filter_chain_match"]["server_names"] = [
+                n for n in chain["filter_chain_match"]["server_names"]
+                if n != apex_]
+        return chain
     tls_chains: list[dict] = []
     clusters: dict[str, dict] = {}
     tcp_listeners: list[dict] = []
@@ -451,7 +469,8 @@ def generate_envoy_config(
         port = rule.effective_port()
         if rule.proto == "https":
             if rule.needs_inspection():
-                tls_chains.append(_mitm_chain(rule, cert_dir))
+                tls_chains.append(cede_apex_to_exact(
+                    _mitm_chain(rule, cert_dir), rule))
                 mitm_domains.append(apex)
                 if wildcard:
                     clusters.setdefault(
@@ -461,7 +480,8 @@ def generate_envoy_config(
                     clusters.setdefault(_cluster_name(apex, port, tls=True),
                                         _cluster(apex, port, tls=True))
             else:
-                tls_chains.append(_passthrough_chain(rule))
+                tls_chains.append(cede_apex_to_exact(
+                    _passthrough_chain(rule), rule))
                 if wildcard:
                     clusters.setdefault(
                         DFP_CLUSTER_PLAIN,
@@ -478,7 +498,10 @@ def generate_envoy_config(
             else:
                 clusters.setdefault(_cluster_name(apex, port, tls=False),
                                     _cluster(apex, port, tls=False))
-        elif rule.proto == "tcp":
+        elif rule.proto != "udp":
+            # Opaque TCP-mapped protocols (tcp, ssh, git, ...): a named
+            # proto is a labelled TCP lane, same as the reference's ssh
+            # rule riding the sequential listener (firewall_test.go:503).
             if wildcard:
                 # Opaque TCP carries no L7 signal (no SNI/Host) to derive the
                 # in-zone subdomain from, so no proxy lane is allocated: the
@@ -491,6 +514,23 @@ def generate_envoy_config(
                                 _cluster(apex, port, tls=False))
             next_port += 1
         # udp rules never reach Envoy (kernel allows them directly)
+
+    # Residual SNI collisions (e.g. two https rules for the same dst at
+    # different ports): a server_name may appear in exactly ONE chain or
+    # Envoy NACKs the bootstrap -- a full egress outage on the next rule
+    # sync.  First chain in sorted rule-key order keeps the name; a chain
+    # left with no names is dropped.
+    seen_names: set[str] = set()
+    deduped: list[dict] = []
+    for chain in tls_chains:
+        names = [n for n in chain["filter_chain_match"]["server_names"]
+                 if n not in seen_names]
+        if not names:
+            continue
+        seen_names.update(names)
+        chain["filter_chain_match"]["server_names"] = names
+        deduped.append(chain)
+    tls_chains = deduped
 
     listeners = [{
         "name": "tls_egress",

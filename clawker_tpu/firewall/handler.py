@@ -247,6 +247,17 @@ class FirewallHandler:
             enr = self.enrollments.get(container)
             if enr is None:
                 raise ClawkerError(f"bypass: {container} is not enrolled")
+            # Drift guard (INV-B2-016, handler.go:656): re-resolve the
+            # cgroup at bypass time.  A stopped container fails resolution;
+            # a restarted one has a new cgroup id -- either way the stale
+            # enrollment must not receive a blanket allow.
+            try:
+                cgid, _ = self.resolver.resolve(self.stack.engine, container)
+            except (EnrollError, ClawkerError) as e:
+                raise ClawkerError(f"bypass: {container}: {e}") from e
+            if cgid != enr.cgroup_id:
+                raise ClawkerError(
+                    f"bypass: {container}: cgroup drift (INV-B2-016)")
             import math
 
             # ceil: int truncation must never move the deadline into the past
@@ -301,7 +312,12 @@ class FirewallHandler:
 
     def add_rules(self, req: dict) -> dict:
         raw = req.get("rules") or []
-        new = [from_dict(EgressRule, r) for r in raw]
+        try:
+            new = [from_dict(EgressRule, r) for r in raw]
+        except (ValueError, TypeError) as e:
+            # ingestion validation (schema RuleValidationError): reject the
+            # whole update with a clean RPC error, reference ValidateRule
+            raise ClawkerError(str(e)) from e
 
         def act():
             added = self.rules_store.add(new)

@@ -283,13 +283,15 @@ def build_routes(rules, *, envoy_ip: str, tls_port: int,
             else:  # no HTTP lane allocated: direct allow (never the TLS
                 # listener -- tls_inspector can't parse cleartext)
                 table[RouteKey(zh, port, PROTO_TCP)] = RouteVal(Action.ALLOW)
-        elif rule.proto == "tcp":
+        elif rule.proto == "udp":
+            table[RouteKey(zh, port, PROTO_UDP)] = RouteVal(Action.ALLOW)
+        else:
+            # TCP-mapped named protocols (tcp, ssh, git, ...) ride their
+            # allocated sequential Envoy listener (firewall_test.go:503).
             lport = tcp_ports.get(rule.key())
             if lport:
                 table[RouteKey(zh, port, PROTO_TCP)] = RouteVal(
                     Action.REDIRECT, redirect_ip=envoy_ip, redirect_port=lport)
             else:  # no proxy lane allocated: direct allow, still DNS-gated
                 table[RouteKey(zh, port, PROTO_TCP)] = RouteVal(Action.ALLOW)
-        elif rule.proto == "udp":
-            table[RouteKey(zh, port, PROTO_UDP)] = RouteVal(Action.ALLOW)
     return table

@@ -202,6 +202,9 @@ class FirewallStack:
         except ClawkerError:
             pass
         return {
+            # aggregate verdict, the reference's `"running": true` in
+            # `firewall status --json` (firewall_test.go:382)
+            "running": envoy_running and bool(self.gate and self.gate.bound_port),
             "envoy_running": envoy_running,
             "dns_gate_up": bool(self.gate and self.gate.bound_port),
             "dns_stats": vars(self.gate.stats) if self.gate else {},

@@ -1,0 +1,54 @@
+"""``python -m clawker_tpu.parity`` -- print the reference parity scorecard.
+
+Runs the 22 scenarios from :mod:`clawker_tpu.parity.scenarios` against
+the virtual-internet World + the real FirewallHandler and prints one
+line per scenario plus the ``N/22 PASS`` headline BASELINE.md's
+firewall-parity metric is scored on.  Exit code 0 only on a full pass.
+
+``--json`` emits the machine-readable scorecard instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+from .scenarios import SCENARIOS, run_all
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m clawker_tpu.parity")
+    ap.add_argument("--json", action="store_true", help="emit JSON scorecard")
+    ap.add_argument("--workdir", help="keep scenario artifacts here")
+    args = ap.parse_args(argv)
+
+    t0 = time.monotonic()
+    if args.workdir:
+        base = Path(args.workdir)
+        base.mkdir(parents=True, exist_ok=True)
+        rows = run_all(base)
+    else:
+        with tempfile.TemporaryDirectory(prefix="clawker-parity-") as td:
+            rows = run_all(Path(td))
+    wall_s = time.monotonic() - t0
+    passed = sum(1 for r in rows if r["pass"])
+
+    if args.json:
+        print(json.dumps({"passed": passed, "total": len(rows),
+                          "wall_s": round(wall_s, 3), "scenarios": rows}))
+        return 0 if passed == len(rows) else 1
+
+    for r in rows:
+        mark = "PASS" if r["pass"] else "FAIL"
+        detail = "" if r["pass"] else f"  {r['evidence'].get('error', '')}"
+        print(f"  [{mark}] {r['name']:<40} {r['ms']:>6} ms{detail}")
+    print(f"\n{passed}/{len(rows)} PASS  ({wall_s:.1f}s)")
+    return 0 if passed == len(rows) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

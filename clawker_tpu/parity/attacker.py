@@ -181,7 +181,10 @@ class AttackerServer:
             self.tls_port = tls.server_address[1]
             self._servers.append(tls)
         for srv in self._servers:
-            t = threading.Thread(target=srv.serve_forever, daemon=True)
+            # tight poll so stop() returns promptly (default 0.5s/server)
+            t = threading.Thread(
+                target=srv.serve_forever, kwargs={"poll_interval": 0.05},
+                daemon=True)
             t.start()
             self._threads.append(t)
 

@@ -258,6 +258,9 @@ class EnvoySim:
             srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             srv.bind(("127.0.0.1", 0))
             srv.listen(32)
+            # finite accept timeout: close() alone does not wake a thread
+            # blocked in accept(), and stop() would eat the full join
+            srv.settimeout(0.2)
             self.port_map[cport] = srv.getsockname()[1]
             self._servers.append(srv)
             t = threading.Thread(target=self._accept_loop,
@@ -291,6 +294,8 @@ class EnvoySim:
         while not self._stop.is_set():
             try:
                 conn, _ = srv.accept()
+            except socket.timeout:
+                continue
             except OSError:
                 return
             conn.settimeout(10.0)

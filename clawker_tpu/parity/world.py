@@ -103,6 +103,9 @@ class OriginServer:
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind(("127.0.0.1", 0))
         srv.listen(16)
+        # finite accept timeout so stop() joins promptly (close() alone
+        # does not wake a thread blocked in accept())
+        srv.settimeout(0.2)
         self._servers.append(srv)
 
         def loop():
@@ -113,6 +116,8 @@ class OriginServer:
             while not self._stop.is_set():
                 try:
                     conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
                 except OSError:
                     return
                 threading.Thread(target=self._wrap, args=(conn, handler, ctx),

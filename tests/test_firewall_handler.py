@@ -448,7 +448,7 @@ def test_rules_store_rejects_bad_rules(tmp_path: Path):
 
     store = RulesStore(tmp_path / "r.yaml")
     with pytest.raises(RuleError):
-        store.add([EgressRule(dst="x.com", proto="quic")])
+        store.add([EgressRule(dst="x.com", proto="not a proto")])
     with pytest.raises(RuleError):
         store.add([EgressRule(dst="")])
 

@@ -1,0 +1,41 @@
+"""CI gate for the 22-scenario reference firewall parity corpus.
+
+Every scenario from clawker_tpu.parity.scenarios runs against the
+virtual-internet World (real sockets: DnsGate UDP listener, executed
+Envoy bootstrap, origin/attacker/hostproxy servers) or the real
+FirewallHandler over the fake engine.  A regression in any scenario
+fails the suite -- this is the enforcement the round-3 verdict required:
+`make test` fails if the scorecard regresses.
+
+Parity bar: /root/reference/test/e2e/firewall_test.go (22 functions,
+:77-:1326); scorecard entry point: ``python -m clawker_tpu.parity``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from clawker_tpu.parity.scenarios import SCENARIOS
+
+_BY_NAME = dict(SCENARIOS)
+
+
+def test_corpus_is_complete():
+    """The scorecard must cover all 22 reference scenarios by name."""
+    expected = {
+        "BlockedDomain", "UpDown", "ICMPBlocked", "Bypass", "AllowedDomain",
+        "AddRemove", "ConfigRules", "Status", "IntraNetworkBypass",
+        "HostProxyReachable", "SSHTCPMapping", "DockerInternalDNS",
+        "ExactAllowBlocksSubdomain", "DenySubdomainUnderWildcard",
+        "HTTPDomainDetection", "FirewallDisabled", "PathRulesDefaultDeny",
+        "PathRulesExplicitDeny", "TLSPathRulesDefaultDeny",
+        "PathRuleNormalizationDefeatsSmuggling", "TLSPathRulesExplicitDeny",
+        "WildcardAndExactCoexist",
+    }
+    assert set(_BY_NAME) == expected
+    assert len(SCENARIOS) == 22
+
+
+@pytest.mark.parametrize("name", list(_BY_NAME), ids=list(_BY_NAME))
+def test_scenario(name, tmp_path):
+    _BY_NAME[name](tmp_path)

@@ -1,0 +1,236 @@
+"""Ingestion-time egress rule validation + collision-merge semantics.
+
+Round-3 verdict weak #3 / advisor medium #1: a typo'd action must not
+fail open, a glob path must not silently deny everything it meant to
+allow, methods must be HTTP tokens before regex interpolation, and a
+rule-key collision must merge (incoming action wins, path rules
+unioned) instead of dropping the update.
+
+Parity reference: ValidateRule / validateActionField semantics
+(controlplane/firewall/envoy_http.go:337-347, rules_store.go merge).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from clawker_tpu.config.schema import (
+    EgressRule,
+    PathRule,
+    RuleValidationError,
+    from_dict,
+)
+from clawker_tpu.firewall.rules import RuleError, RulesStore
+
+
+# ------------------------------------------------------- action validation
+
+@pytest.mark.parametrize("action", ["denied", "block", "yes", "al low"])
+def test_unknown_rule_action_rejected(action):
+    with pytest.raises(RuleValidationError):
+        EgressRule(dst="example.com", action=action)
+
+
+@pytest.mark.parametrize("action", ["allow", "deny", "Allow", " DENY "])
+def test_known_rule_actions_normalize(action):
+    r = EgressRule(dst="example.com", action=action)
+    assert r.action in ("allow", "deny")
+
+
+@pytest.mark.parametrize("action", ["denied", "open", "None"])
+def test_unknown_path_rule_action_rejected(action):
+    with pytest.raises(RuleValidationError):
+        PathRule(path="/x", action=action)
+
+
+def test_unknown_path_default_rejected():
+    with pytest.raises(RuleValidationError):
+        EgressRule(dst="example.com", path_default="denied")
+
+
+def test_from_dict_propagates_validation():
+    """Config-file ingestion runs the same checks (fail the whole load,
+    not fail open)."""
+    with pytest.raises(RuleValidationError):
+        from_dict(EgressRule, {"dst": "example.com", "action": "denied"})
+
+
+# --------------------------------------------------------- path validation
+
+def test_glob_path_rejected_with_prefix_hint():
+    """The round-3 footgun: paths: ["/repos/*"] silently 403'd everything
+    it meant to allow.  Now it errors at ingestion."""
+    with pytest.raises(RuleValidationError, match="literal prefixes"):
+        EgressRule(dst="example.com", paths=["/repos/*"])
+
+
+@pytest.mark.parametrize("path", ["repos", "/a?b", "/a[1]", "/sp ace"])
+def test_bad_paths_rejected(path):
+    with pytest.raises(RuleValidationError):
+        PathRule(path=path)
+
+
+def test_literal_prefix_path_accepted():
+    r = EgressRule(dst="example.com", paths=["/repos/"],
+                   path_rules=[PathRule(path="/v1/messages", action="allow")])
+    assert r.needs_inspection()
+
+
+# -------------------------------------------------------- method charset
+
+def test_non_token_method_rejected():
+    with pytest.raises(RuleValidationError):
+        PathRule(path="/x", methods=["GET|POST"])
+    with pytest.raises(RuleValidationError):
+        PathRule(path="/x", methods=["GET)"])
+
+
+def test_token_methods_uppercase():
+    assert PathRule(path="/x", methods=["get", "Post"]).methods == ["GET", "POST"]
+
+
+# ------------------------------------------------------------ store checks
+
+def test_store_rejects_bad_domain(tmp_path):
+    store = RulesStore(tmp_path / "rules.yaml")
+    for dst in ["exa mple.com", "-bad.com", "a..b", "*."]:
+        with pytest.raises(RuleError):
+            store.add([EgressRule(dst=dst)])
+
+
+def test_store_accepts_named_tcp_protos(tmp_path):
+    """ssh/git are labelled TCP lanes (firewall_test.go:503 uses
+    proto: ssh); the store must not reject them."""
+    store = RulesStore(tmp_path / "rules.yaml")
+    added = store.add([EgressRule(dst="github.com", proto="ssh", port=22)])
+    assert [r.proto for r in added] == ["ssh"]
+    assert EgressRule(dst="github.com", proto="ssh").effective_port() == 22
+
+
+def test_store_rejects_path_rules_on_opaque_lanes(tmp_path):
+    """A path rule on a lane with no L7 filtering would be accepted and
+    silently never enforced -- reject at ingestion."""
+    store = RulesStore(tmp_path / "rules.yaml")
+    for proto, port in (("udp", 53), ("tcp", 9000), ("ssh", 22)):
+        with pytest.raises(RuleError):
+            store.add([EgressRule(dst="example.com", proto=proto, port=port,
+                                  paths=["/x"])])
+
+
+def test_store_rejects_typod_proto_fail_open(tmp_path):
+    """'htps' (typo) must not become an opaque TCP lane -- with or without
+    an explicit port -- and 'tcp' requires a port."""
+    store = RulesStore(tmp_path / "rules.yaml")
+    with pytest.raises(RuleError, match="unknown proto"):
+        store.add([EgressRule(dst="*.example.com", proto="htps")])
+    with pytest.raises(RuleError, match="unknown proto"):
+        store.add([EgressRule(dst="api.example.com", proto="htps", port=443)])
+    with pytest.raises(RuleError, match="no default port"):
+        store.add([EgressRule(dst="example.com", proto="tcp")])
+
+
+def test_store_load_skips_legacy_invalid_rules(tmp_path):
+    """A rule persisted before ingestion validation existed must not
+    brick load()/add()/remove() -- it is skipped and GC'd on next write."""
+    p = tmp_path / "rules.yaml"
+    p.write_text(
+        "rules:\n"
+        "- dst: good.com\n"
+        "  proto: https\n"
+        "- dst: bad.com\n"
+        "  proto: https\n"
+        "  paths: ['/repos/*']\n"
+    )
+    store = RulesStore(p)
+    assert [r.dst for r in store.load()] == ["good.com"]
+    store.add([EgressRule(dst="new.com")])          # triggers a write
+    assert "bad.com" not in p.read_text()           # GC'd
+
+
+def test_handler_add_rules_rejects_non_mapping_entries(tmp_path):
+    """A non-mapping rule entry must surface as a clean RPC error."""
+    from clawker_tpu.errors import ClawkerError
+    from clawker_tpu.parity.scenarios import _HandlerRig
+
+    rig = _HandlerRig(tmp_path)
+    try:
+        rig.handler.init({})
+        with pytest.raises(ClawkerError):
+            rig.handler.add_rules({"rules": ["example.com"]})
+        with pytest.raises(ClawkerError):
+            rig.handler.add_rules({"rules": [{"dst": "example.com",
+                                              "action": "denied"}]})
+    finally:
+        rig.close()
+
+
+# -------------------------------------------------------- collision merge
+
+def test_collision_action_update_not_dropped(tmp_path):
+    """advisor r3 low #3: an action update for an existing key was
+    silently dropped; the incoming rule must win on action."""
+    store = RulesStore(tmp_path / "rules.yaml")
+    store.add([EgressRule(dst="example.com")])
+    changed = store.add([EgressRule(dst="example.com", action="deny")])
+    assert len(changed) == 1
+    (r,) = [x for x in store.load() if x.dst == "example.com"]
+    assert r.action == "deny"
+
+
+def test_collision_path_rules_unioned(tmp_path):
+    store = RulesStore(tmp_path / "rules.yaml")
+    store.add([EgressRule(dst="example.com",
+                          path_rules=[PathRule(path="/a", action="allow")],
+                          path_default="deny")])
+    store.add([EgressRule(dst="example.com",
+                          path_rules=[PathRule(path="/b", action="allow"),
+                                      PathRule(path="/a", action="deny")])])
+    (r,) = [x for x in store.load() if x.dst == "example.com"]
+    by_path = {p.path: p.action for p in r.path_rules}
+    assert by_path == {"/a": "deny", "/b": "allow"}
+    assert r.path_default == "deny"  # preserved from prior
+
+
+def test_collision_new_carveout_ordered_first(tmp_path):
+    """Routes are first-prefix-wins: a new more-specific allow under a
+    prior broader deny must precede it or it would be unreachable."""
+    store = RulesStore(tmp_path / "rules.yaml")
+    store.add([EgressRule(dst="example.com",
+                          path_rules=[PathRule(path="/repos", action="deny")],
+                          path_default="allow")])
+    store.add([EgressRule(dst="example.com",
+                          path_rules=[PathRule(path="/repos/public",
+                                               action="allow")])])
+    (r,) = [x for x in store.load() if x.dst == "example.com"]
+    assert [(p.path, p.action) for p in r.path_rules] == [
+        ("/repos/public", "allow"), ("/repos", "deny")]
+
+
+def test_sni_chains_never_collide(tmp_path):
+    """Duplicate server_names across filter chains are an Envoy NACK (a
+    full egress outage on reload): exact+wildcard coexistence cedes the
+    apex, and residual same-name chains are deduped first-wins."""
+    from clawker_tpu.firewall.envoy import generate_envoy_config
+
+    rules = [
+        EgressRule(dst="*.example.com", proto="https", port=443),
+        EgressRule(dst="example.com", proto="https", port=8443),
+        EgressRule(dst="*.dup.com", proto="https", port=443),
+        EgressRule(dst="*.dup.com", proto="https", port=8443),
+    ]
+    bundle = generate_envoy_config(rules, cert_dir=str(tmp_path))
+    import yaml as _yaml
+    cfg = _yaml.safe_load(bundle.config_yaml)
+    (tls,) = [l for l in cfg["static_resources"]["listeners"]
+              if l["name"] == "tls_egress"]
+    seen: list[str] = []
+    for chain in tls["filter_chains"]:
+        for n in chain["filter_chain_match"]["server_names"]:
+            assert n not in seen, f"duplicate SNI {n} across chains"
+            seen.append(n)
+
+
+def test_collision_noop_reports_unchanged(tmp_path):
+    store = RulesStore(tmp_path / "rules.yaml")
+    store.add([EgressRule(dst="example.com")])
+    assert store.add([EgressRule(dst="example.com")]) == []
