@@ -145,12 +145,23 @@ class FirewallHandler:
         if self.allow_hostproxy:
             flags |= FLAG_HOSTPROXY
             hp_ip, hp_port = self.stack.gateway_ip(), self.hostproxy_port
+        # Intra-network bypass (FW_R_INTRA_NET): sibling services on the
+        # sandbox bridge are reachable without a rule, like the reference's
+        # IntraNetworkBypass (firewall_test.go:398).  Degrade to no-bypass
+        # if the network is not inspectable (policy stays fail-closed).
+        net_ip, net_prefix = "0.0.0.0", 0
+        try:
+            net_ip, net_prefix = self.stack.network_cidr()
+        except (ClawkerError, KeyError, IndexError, TypeError, ValueError) as e:
+            log.warning("intra-net bypass disabled: %s", e)
         return ContainerPolicy(
             envoy_ip=self.stack.envoy_ip(),
             dns_ip=self.stack.gate.host if self.stack.gate else self.stack.gateway_ip(),
             hostproxy_ip=hp_ip,
             hostproxy_port=hp_port,
             flags=flags,
+            net_ip=net_ip,
+            net_prefix=net_prefix,
         )
 
     def register_on(self, admin) -> None:

@@ -94,11 +94,14 @@ def decide(maps: FirewallMaps, cgroup_id: int, dst_ip: str, dst_port: int,
             and dst_port == pol.hostproxy_port):
         return Verdict(Action.ALLOW, Reason.HOSTPROXY)
 
-    if pol.net_prefix and _in_cidr(dst_ip, pol.net_ip, pol.net_prefix):
+    if (pol.net_prefix and dst_ip not in (pol.dns_ip, pol.hostproxy_ip)
+            and _in_cidr(dst_ip, pol.net_ip, pol.net_prefix)):
         # intra-network bypass: sibling services on the sandbox bridge
         # (CP, otel-collector, project listeners) are reachable without
         # rules -- the network is clawker-managed (reference e2e:
-        # firewall_test.go:398 IntraNetworkBypass)
+        # firewall_test.go:398 IntraNetworkBypass).  The gateway (= the
+        # host, where the gate/hostproxy live) is NOT a sibling: non-proxy
+        # host ports stay blocked (firewall_test.go:497).
         return Verdict(Action.ALLOW, Reason.INTRA_NET)
 
     dns = maps.lookup_dns(dst_ip)

@@ -78,6 +78,16 @@ class FirewallStack:
         """Gateway = .1: where host daemons (DNS gate, hostproxy) listen."""
         return self.engine.network_static_ip(consts.NETWORK_NAME, 1)
 
+    def network_cidr(self) -> tuple[str, int]:
+        """(network_address, prefix_len) of the sandbox bridge -- the
+        CIDR the kernel's intra-network bypass admits (sibling services
+        on the bridge are reachable without a rule: firewall_test.go:398)."""
+        import ipaddress
+
+        subnet = self.network()["IPAM"]["Config"][0]["Subnet"]
+        net = ipaddress.ip_network(subnet)
+        return str(net.network_address), net.prefixlen
+
     # ------------------------------------------------------------- render
 
     def render(self, rules: list[EgressRule]) -> EnvoyBundle:

@@ -1,9 +1,11 @@
 """``python -m clawker_tpu.parity`` -- print the reference parity scorecard.
 
-Runs the 22 scenarios from :mod:`clawker_tpu.parity.scenarios` against
-the virtual-internet World + the real FirewallHandler and prints one
-line per scenario plus the ``N/22 PASS`` headline BASELINE.md's
-firewall-parity metric is scored on.  Exit code 0 only on a full pass.
+Runs the 22 e2e scenarios from :mod:`clawker_tpu.parity.scenarios` plus
+the 30-technique capture-graded adversarial corpus
+(:mod:`clawker_tpu.parity.redteam`) against the virtual-internet World +
+the real FirewallHandler, and prints the ``N/22 PASS`` + ``M/30
+techniques / K captures`` headlines BASELINE.md's firewall-parity metric
+is scored on.  Exit code 0 only on a full pass.
 
 ``--json`` emits the machine-readable scorecard instead.
 """
@@ -17,6 +19,7 @@ import tempfile
 import time
 from pathlib import Path
 
+from .redteam import run_corpus
 from .scenarios import SCENARIOS, run_all
 
 
@@ -31,23 +34,35 @@ def main(argv: list[str] | None = None) -> int:
         base = Path(args.workdir)
         base.mkdir(parents=True, exist_ok=True)
         rows = run_all(base)
+        red = run_corpus(base / "redteam")
     else:
         with tempfile.TemporaryDirectory(prefix="clawker-parity-") as td:
             rows = run_all(Path(td))
+            red = run_corpus(Path(td) / "redteam")
     wall_s = time.monotonic() - t0
     passed = sum(1 for r in rows if r["pass"])
+    all_ok = passed == len(rows) and red["passed"] == red["total"] \
+        and red["captures"] == 0
 
     if args.json:
         print(json.dumps({"passed": passed, "total": len(rows),
-                          "wall_s": round(wall_s, 3), "scenarios": rows}))
-        return 0 if passed == len(rows) else 1
+                          "wall_s": round(wall_s, 3), "scenarios": rows,
+                          "redteam": red}))
+        return 0 if all_ok else 1
 
+    print("e2e scenarios (reference test/e2e/firewall_test.go):")
     for r in rows:
         mark = "PASS" if r["pass"] else "FAIL"
         detail = "" if r["pass"] else f"  {r['evidence'].get('error', '')}"
         print(f"  [{mark}] {r['name']:<40} {r['ms']:>6} ms{detail}")
-    print(f"\n{passed}/{len(rows)} PASS  ({wall_s:.1f}s)")
-    return 0 if passed == len(rows) else 1
+    print(f"\n{passed}/{len(rows)} PASS")
+    print("\nadversarial corpus (reference test/adversarial, capture-graded):")
+    for t in red["techniques"]:
+        mark = "PASS" if t["pass"] else "FAIL"
+        print(f"  [{mark}] {t['technique']:<34} {t['detail'][:80]}")
+    print(f"\n{red['passed']}/{red['total']} techniques contained, "
+          f"{red['captures']} captures  (total {wall_s:.1f}s)")
+    return 0 if all_ok else 1
 
 
 if __name__ == "__main__":

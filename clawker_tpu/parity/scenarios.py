@@ -329,6 +329,13 @@ def s_intra_network_bypass(tmp: Path) -> dict:
               f"intra-net service unreachable: {res.err or res.code}")
         check(not w.curl("https://example.com").ok,
               "external domain open alongside CIDR bypass")
+        # the gateway (= the host) is excluded from the bypass: a non-proxy
+        # host port stays blocked (firewall_test.go:497)
+        try:
+            w.open_tcp(DNS_IP, 9999)
+            raise ScenarioFailure("CIDR bypass covered a host port")
+        except EgressBlocked:
+            pass
         return {"code": res.code}
     finally:
         w.close()

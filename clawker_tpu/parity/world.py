@@ -315,18 +315,25 @@ class World:
             return None
         return self.endpoints.get((vip, port))
 
+    def _record_upstream(self, qname: str) -> str:
+        """A query escaped to 'internet DNS': log it, and report attacker
+        zones to the capture DB (DNS-label exfil is observable traffic)."""
+        qname = qname.lower().rstrip(".")
+        self.upstream_queries.append(qname)
+        for zone in self.attacker_zones:
+            if qname == zone or qname.endswith("." + zone):
+                self.attacker.record_dns(qname)
+                break
+        return qname
+
     def _world_dns_forward(self, data: bytes, resolvers, *, tcp: bool):
         """Upstream resolver stand-in: answers from the world DNS table,
-        records every query the gate let out (attacker zones report to
-        the capture DB -- DNS-label exfil is observable traffic)."""
+        records every query the gate let out."""
         try:
             q = parse_query(data)
         except Exception:
             return None
-        self.upstream_queries.append(q.qname)
-        for zone in self.attacker_zones:
-            if q.qname == zone or q.qname.endswith("." + zone):
-                self.attacker.record_dns(q.qname)
+        self._record_upstream(q.qname)
         ip = self.dns_table.get(q.qname)
         if ip is None:
             # upstream: NXDOMAIN-shaped reply
@@ -368,6 +375,11 @@ class World:
         # ALLOW: direct to the destination the world knows
         if ip.startswith("127."):
             return socket.create_connection((ip, port), timeout=5.0)
+        if ip == ENVOY_IP and port in self.envoy.port_map:
+            # dialing the proxy chokepoint directly: the kernel allows it
+            # (Envoy's SNI default-deny is the enforcement surface there)
+            return socket.create_connection(
+                ("127.0.0.1", self.envoy.port_map[port]), timeout=5.0)
         if ip == HOSTPROXY_IP and port == HOSTPROXY_PORT and self.hostproxy:
             return socket.create_connection(
                 ("127.0.0.1", self.hostproxy.bound_port), timeout=5.0)
@@ -389,17 +401,24 @@ class World:
         elif v.action is Action.REDIRECT:
             target = ("127.0.0.1",
                       self.envoy.port_map.get(v.redirect_port, 1))
+        elif (ip, port) == (DNS_IP, 53):
+            # explicitly resolver-directed traffic lands on the real gate
+            target = ("127.0.0.1", self.gate.bound_port)
+        elif ip in {self.dns_table.get(z) for z in self.attacker_zones}:
+            # ANY port on attacker infrastructure captures: an allowed
+            # datagram that reaches the attacker's address is an escape
+            # regardless of which port the C2 listens on.
+            target = self.attacker_udp
+        elif port == 53:
+            # allowed direct :53 to a non-gate resolver = the query
+            # reached "internet DNS" unfiltered; the upstream resolver
+            # stand-in sees (and, for attacker zones, captures) it.
+            self._world_dns_forward(payload, (), tcp=False)
+            return
         else:
-            vip_ep = self.endpoints.get((ip, port))
-            if vip_ep is None:
-                if (ip, port) == (DNS_IP, 53):
-                    target = ("127.0.0.1", self.gate.bound_port)
-                else:
-                    return  # datagram into the void
-            else:
-                target = vip_ep
-            if ip in {self.dns_table.get(z) for z in self.attacker_zones}:
-                target = self.attacker_udp
+            target = self.endpoints.get((ip, port))
+            if target is None:
+                return  # datagram into the void
         with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
             s.sendto(payload, target)
 
@@ -421,8 +440,10 @@ class World:
         if v.action is Action.DENY:
             return -1, []
         if v.action is Action.ALLOW:
-            self.upstream_queries.append(name.lower().rstrip("."))
-            ip = self.dns_table.get(name.lower().rstrip("."))
+            # un-gated resolution: the query reaches upstream internet DNS
+            # directly (and attacker zones observe it)
+            qname = self._record_upstream(name)
+            ip = self.dns_table.get(qname)
             return (0, [ip]) if ip else (3, [])
         from ..firewall.dnsgate import _encode_name
         hdr = struct.pack(">HHHHHH", 0x2222, 0x0100, 1, 0, 0, 0)

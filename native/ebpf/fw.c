@@ -238,8 +238,13 @@ static __always_inline int fw_decide(const struct fw_container *pol, __u64 cg,
 	/* 6b. intra-network bypass: sibling services on the clawker-managed
 	 * bridge (CP, otel-collector, project listeners) need no rules.
 	 * dst/net_ip are network byte order; build the mask in host order
-	 * and compare in host order so the prefix counts leading bits. */
-	if (pol->net_prefix > 0 && pol->net_prefix <= 32) {
+	 * and compare in host order so the prefix counts leading bits.
+	 * The gateway (= the host: where the DNS gate and host proxy live)
+	 * is NOT a sibling -- the reference blocks non-proxy host ports even
+	 * with the CIDR bypass live (firewall_test.go:497), so host daemons
+	 * stay reachable only through steps 4 and 6 above. */
+	if (pol->net_prefix > 0 && pol->net_prefix <= 32 &&
+	    dst != pol->dns_ip && dst != pol->hostproxy_ip) {
 		__u32 mask = pol->net_prefix == 32
 				     ? 0xffffffff
 				     : ~(0xffffffffu >> pol->net_prefix);

