@@ -61,6 +61,29 @@ def monitor_status(f: Factory, fmt):
         click.echo(f"{r.get('Service', r.get('Name', '?'))}\t{r.get('State', '?')}")
 
 
+@monitor_group.command("units")
+@pass_factory
+def monitor_units(f: Factory):
+    """List monitoring units: discovered (floor + loose) and seeded.
+
+    Reference: `clawker monitor extensions` over the units ledger
+    (internal/monitor/ledger.go)."""
+    from ..monitor.ledger import Ledger
+    from ..monitor.unit import discover_units
+
+    stack = MonitorStack(f.config)
+    units = discover_units(stack.unit_roots())
+    ledger = Ledger(stack.dir)
+    for name, unit in sorted(units.items()):
+        seeded = ledger.units.get(name)
+        state = "seeded" if seeded and seeded.content_hash == unit.content_hash() \
+            else ("stale" if seeded else "unseeded")
+        lanes = ",".join(l.index for l in unit.manifest.logs)
+        click.echo(f"{name}\t{state}\t{lanes}\t{unit.manifest.description}")
+    if not units:
+        click.echo("no monitoring units discovered")
+
+
 @monitor_group.command("egress")
 @click.option("--tail", type=int, default=20, help="Last N egress decisions.")
 @click.option("--deny-only", is_flag=True, help="Only DENY verdicts.")

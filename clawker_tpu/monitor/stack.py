@@ -44,8 +44,53 @@ class MonitorError(ClawkerError):
     pass
 
 
-def render_otel_config(s) -> str:
-    """OTLP (grpc+http) -> OpenSearch log indices + Prometheus metrics."""
+def render_otel_config(s, lanes: dict[str, list[str]] | None = None) -> str:
+    """OTLP (grpc+http) -> OpenSearch log indices + Prometheus metrics.
+
+    ``lanes`` maps index -> service.name values routed into it (base
+    lanes + monitoring-unit lanes); everything unrouted lands in
+    clawker-otlp.  Lane/service names pass the unit grammar (lowercase/
+    digits/hyphens), so interpolating them into OTTL conditions cannot
+    inject (unit.py index-name rule)."""
+    lanes = lanes or {}
+    exporters: dict = {
+        "opensearch/default": {
+            "http": {"endpoint": "http://opensearch:9200"},
+            "logs_index": "clawker-otlp",
+        },
+        "prometheus": {"endpoint": "0.0.0.0:8889"},
+        "debug": {"verbosity": "basic"},
+    }
+    pipelines: dict = {
+        "metrics": {"receivers": ["otlp"],
+                    "processors": ["transform/metrics", "batch"],
+                    "exporters": ["prometheus"]},
+        "traces": {"receivers": ["otlp"], "processors": ["batch"],
+                   "exporters": ["debug"]},
+    }
+    routing_table = []
+    for index in sorted(lanes):
+        exporters[f"opensearch/{index}"] = {
+            "http": {"endpoint": "http://opensearch:9200"},
+            "logs_index": index,
+        }
+        pipelines[f"logs/{index}"] = {
+            "receivers": ["routing"], "processors": ["batch"],
+            "exporters": [f"opensearch/{index}"]}
+        cond = " or ".join(
+            f'resource.attributes["service.name"] == "{svc}"'
+            for svc in sorted(lanes[index]))
+        # the condition rides INSIDE the OTTL statement -- a separate
+        # `condition` key is rejected by the pinned collector's strict
+        # config decoding (and a bare route() would match everything)
+        routing_table.append(
+            {"statement": f"route() where {cond}",
+             "pipelines": [f"logs/{index}"]})
+    pipelines["logs/default"] = {"receivers": ["routing"],
+                                 "processors": ["batch"],
+                                 "exporters": ["opensearch/default"]}
+    pipelines["logs/in"] = {"receivers": ["otlp"], "processors": [],
+                            "exporters": ["routing"]}
     cfg = {
         "receivers": {
             "otlp": {
@@ -53,6 +98,12 @@ def render_otel_config(s) -> str:
                     "grpc": {"endpoint": f"0.0.0.0:{s.otlp_grpc_port}"},
                     "http": {"endpoint": "0.0.0.0:4318"},
                 }
+            }
+        },
+        "connectors": {
+            "routing": {
+                "default_pipelines": ["logs/default"],
+                "table": routing_table,
             }
         },
         "processors": {
@@ -70,25 +121,8 @@ def render_otel_config(s) -> str:
                 }]
             },
         },
-        "exporters": {
-            "opensearch/logs": {
-                "http": {"endpoint": "http://opensearch:9200"},
-                "logs_index": "clawker-otlp",
-            },
-            "prometheus": {"endpoint": "0.0.0.0:8889"},
-            "debug": {"verbosity": "basic"},
-        },
-        "service": {
-            "pipelines": {
-                "logs": {"receivers": ["otlp"], "processors": ["batch"],
-                         "exporters": ["opensearch/logs"]},
-                "metrics": {"receivers": ["otlp"],
-                            "processors": ["transform/metrics", "batch"],
-                            "exporters": ["prometheus"]},
-                "traces": {"receivers": ["otlp"], "processors": ["batch"],
-                           "exporters": ["debug"]},
-            }
-        },
+        "exporters": exporters,
+        "service": {"pipelines": pipelines},
     }
     import yaml
 
@@ -110,30 +144,61 @@ def render_prometheus_config(s) -> str:
 
 
 def render_bootstrap_script() -> str:
-    """One-shot curl seeding: index templates for every log index."""
-    lines = ["#!/bin/sh", "set -e",
-             "until curl -fsS http://opensearch:9200 >/dev/null; do sleep 2; done"]
-    for index in LOG_INDICES:
-        template = json.dumps({
-            "index_patterns": [f"{index}*"],
-            "template": {
-                "settings": {"number_of_replicas": 0},
-                "mappings": {
-                    "properties": {
-                        "@timestamp": {"type": "date"},
-                        "severity": {"type": "keyword"},
-                        "service": {"type": "keyword"},
-                        "message": {"type": "text"},
-                    }
-                },
-            },
-        })
-        lines.append(
-            "curl -fsS -X PUT -H 'Content-Type: application/json' "
-            f"http://opensearch:9200/_index_template/{index} -d '{template}'"
-        )
-    lines.append("echo 'clawker monitor bootstrap complete'")
-    return "\n".join(lines) + "\n"
+    """One-shot seeding: plain directory loops over the mounted
+    opensearch-bootstrap tree (base corpus + unit overlays apply the
+    same way -- that is the point of the shared layout).
+
+    Reference: internal/monitor/templates/opensearch-bootstrap/
+    bootstrap.sh.tmpl semantics."""
+    return r"""#!/bin/sh
+set -e
+B=/bootstrap
+OS=http://opensearch:9200
+DASH=http://opensearch-dashboards:5601
+H='Content-Type: application/json'
+
+until curl -fsS "$OS" >/dev/null; do sleep 2; done
+
+for f in "$B"/component-templates/*.json; do
+  [ -e "$f" ] || continue
+  n=$(basename "$f" .json)
+  curl -fsS -X PUT -H "$H" "$OS/_component_template/$n" --data-binary @"$f" >/dev/null
+  echo "component-template $n"
+done
+
+for f in "$B"/index-templates/*.json; do
+  [ -e "$f" ] || continue
+  n=$(basename "$f" .json)
+  curl -fsS -X PUT -H "$H" "$OS/_index_template/$n" --data-binary @"$f" >/dev/null
+  echo "index-template $n"
+done
+
+for f in "$B"/ingest-pipelines/*.json; do
+  [ -e "$f" ] || continue
+  n=$(basename "$f" .json)
+  curl -fsS -X PUT -H "$H" "$OS/_ingest/pipeline/$n" --data-binary @"$f" >/dev/null
+  echo "ingest-pipeline $n"
+done
+
+# ISM is a plugin: degrade (bare OSS images run without retention)
+for f in "$B"/ism-policies/*.json; do
+  [ -e "$f" ] || continue
+  n=$(basename "$f" .json)
+  curl -fsS -X PUT -H "$H" "$OS/_plugins/_ism/policies/$n" --data-binary @"$f" >/dev/null \
+    && echo "ism-policy $n" || echo "ism-policy $n skipped (plugin unavailable)"
+done
+
+# saved objects import needs Dashboards, which boots after OpenSearch
+until curl -fsS "$DASH/api/status" >/dev/null; do sleep 2; done
+for f in "$B"/saved-objects/*.ndjson; do
+  [ -e "$f" ] || continue
+  curl -fsS -X POST "$DASH/api/saved_objects/_import?overwrite=true" \
+    -H 'osd-xsrf: true' --form file=@"$f" >/dev/null
+  echo "saved-objects $(basename "$f")"
+done
+
+echo 'clawker monitor bootstrap complete'
+"""
 
 
 def render_compose(s) -> str:
@@ -162,8 +227,9 @@ def render_compose(s) -> str:
         "opensearch-bootstrap": {
             "image": "curlimages/curl:8.8.0",
             "entrypoint": ["/bin/sh", "/bootstrap.sh"],
-            "volumes": ["./bootstrap.sh:/bootstrap.sh:ro"],
-            "depends_on": ["opensearch"],
+            "volumes": ["./bootstrap.sh:/bootstrap.sh:ro",
+                        "./opensearch-bootstrap:/bootstrap:ro"],
+            "depends_on": ["opensearch", "opensearch-dashboards"],
             "restart": "no",
         },
         "opensearch-dashboards": {
@@ -198,11 +264,73 @@ class MonitorStack:
 
     # ------------------------------------------------------------ render
 
+    def unit_roots(self) -> list:
+        """Unit discovery roots: embedded floor, then the host's loose
+        extension dir (later wins on name)."""
+        from ..bundle.resolver import FLOOR_DIR
+
+        return [FLOOR_DIR / "monitoring",
+                self.cfg.data_dir / "monitoring-units"]
+
     def render(self) -> Path:
+        from . import corpus
+        from .ledger import Ledger
+        from .unit import UnitError, discover_units, materialize
+
         s = self.cfg.settings.monitoring
         self.dir.mkdir(parents=True, exist_ok=True)
+
+        # bootstrap tree: base corpus + monitoring-unit overlays, then
+        # record every seeded unit in the ledger (collision = refusal)
+        tree = self.dir / "opensearch-bootstrap"
+        if tree.exists():
+            import shutil
+
+            shutil.rmtree(tree)
+        corpus.write_bootstrap_tree(tree)
+        floor, loose = self.unit_roots()
+        units = discover_units([floor, loose])
+        ledger = Ledger(self.dir)
+        # units removed from the host are pruned: unit roots are
+        # host-global, so an undiscovered name has no owner left and a
+        # stale record would block its name forever
+        for gone in set(ledger.units) - set(units):
+            del ledger.units[gone]
+        lanes: dict[str, list[str]] = {}
+        lane_owner: dict[str, str] = {}      # index -> unit
+        svc_owner: dict[str, str] = {}       # service.name -> unit
+        retention_lanes: dict[str, list[str]] = {}  # token -> indices
+        for name, unit in sorted(units.items()):
+            source = "floor" if unit.root.is_relative_to(floor) else str(unit.root)
+            ledger.seed(unit, source=source)
+            materialize(unit, tree)
+            for lane in unit.manifest.logs:
+                if lane.index in lane_owner:
+                    raise UnitError(
+                        f"monitoring units {lane_owner[lane.index]!r} and "
+                        f"{name!r} both claim index {lane.index!r}")
+                lane_owner[lane.index] = name
+                for svc in lane.service_names:
+                    if svc in svc_owner:
+                        raise UnitError(
+                            f"monitoring units {svc_owner[svc]!r} and "
+                            f"{name!r} both claim service {svc!r} -- logs "
+                            "would be double-routed")
+                    svc_owner[svc] = name
+                lanes[lane.index] = list(lane.service_names)
+                retention_lanes.setdefault(lane.retention, []).append(lane.index)
+        ledger.save()
+        # per-retention ISM policies for unit lanes (the declared tokens
+        # must actually rotate the indices, not just pass validation)
+        for token, indices in sorted(retention_lanes.items()):
+            pol = corpus.ism_policy(
+                sorted(f"{i}*" for i in indices),
+                age=corpus.RETENTIONS[token])
+            (tree / "ism-policies" / f"clawker-units-{token}.json").write_text(
+                json.dumps(pol, indent=1, sort_keys=True))
+
         (self.dir / "compose.yaml").write_text(render_compose(s))
-        (self.dir / "otel-config.yaml").write_text(render_otel_config(s))
+        (self.dir / "otel-config.yaml").write_text(render_otel_config(s, lanes))
         (self.dir / "prometheus.yaml").write_text(render_prometheus_config(s))
         (self.dir / "bootstrap.sh").write_text(render_bootstrap_script())
         return self.dir
@@ -230,6 +358,13 @@ class MonitorStack:
         res = self.runner("down", "--volumes")
         if res.returncode != 0:
             raise MonitorError(f"monitor down failed: {res.stderr.strip()[:500]}")
+        # --volumes deletes every seeded object with the data volume, so
+        # the ledger must reset too -- it is the documented way out of a
+        # SeedCollision (ledger.py), and a stale record would otherwise
+        # block the colliding name forever
+        from .ledger import LEDGER_FILE
+
+        (self.dir / LEDGER_FILE).unlink(missing_ok=True)
 
     def status(self) -> list[dict]:
         res = self.runner("ps", "--format", "json")

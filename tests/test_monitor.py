@@ -55,21 +55,61 @@ def test_compose_service_set(cfg):
     assert render_compose(cfg.settings.monitoring) == render_compose(cfg.settings.monitoring)
 
 
-def test_bootstrap_seeds_every_index():
+def test_bootstrap_script_loops_over_tree():
+    """Seeding is plain directory loops over the mounted tree: base
+    corpus and unit overlays apply identically."""
     script = render_bootstrap_script()
-    for index in LOG_INDICES:
-        assert f"_index_template/{index}" in script
-    assert "clawker-ebpf-egress" in LOG_INDICES  # the kernel lane exists
+    for surface in ("_component_template", "_index_template",
+                    "_ingest/pipeline", "_plugins/_ism/policies",
+                    "saved_objects/_import"):
+        assert surface in script
+    assert "osd-xsrf" in script  # dashboards import header
 
 
 def test_render_writes_stack_dir(cfg):
     stack = MonitorStack(cfg)
     d = stack.render()
-    for f in ("compose.yaml", "otel-config.yaml", "prometheus.yaml", "bootstrap.sh"):
+    for f in ("compose.yaml", "otel-config.yaml", "prometheus.yaml",
+              "bootstrap.sh", "units-ledger.yaml"):
         assert (d / f).exists(), f
+    # the bootstrap tree carries the full corpus + the claude-code unit
+    tree = d / "opensearch-bootstrap"
+    for index in LOG_INDICES[1:]:  # clawker-otlp has no template (catch-all)
+        assert (tree / "index-templates" / f"{index}.json").exists() or \
+            index == "claude-code"
+    assert (tree / "index-templates" / "claude-code.json").exists()
+    assert (tree / "component-templates" / "clawker-common.json").exists()
+    assert (tree / "ingest-pipelines" / "netlogger-normalize.json").exists()
+    assert (tree / "ism-policies" / "clawker-retention.json").exists()
+    assert (tree / "saved-objects" / "clawker.ndjson").exists()
+    assert (tree / "saved-objects" / "claude-code.ndjson").exists()
     otel = yaml.safe_load((d / "otel-config.yaml").read_text())
-    assert "logs" in otel["service"]["pipelines"]
+    # claude-code telemetry routed to its own index by service.name; the
+    # condition rides inside the OTTL statement (a separate `condition`
+    # key is rejected by the pinned collector)
+    assert "logs/claude-code" in otel["service"]["pipelines"]
     assert "transform/metrics" in otel["processors"]
+    table = otel["connectors"]["routing"]["table"]
+    assert all(set(row) == {"statement", "pipelines"} for row in table)
+    assert any(row["statement"].startswith("route() where ")
+               and "claude-code" in row["statement"] for row in table)
+    # declared lane retentions produce real ISM policies for unit indices
+    ism = json.loads(
+        (tree / "ism-policies" / "clawker-units-default.json").read_text())
+    assert ism["policy"]["ism_template"][0]["index_patterns"] == ["claude-code*"]
+
+
+def test_down_resets_units_ledger(cfg):
+    from clawker_tpu.monitor.ledger import LEDGER_FILE
+
+    runner = FakeCompose()
+    stack = MonitorStack(cfg, runner=runner)
+    stack.render()
+    assert (stack.dir / LEDGER_FILE).exists()
+    stack.down()
+    # --volumes deleted every seeded object, so the ledger resets too
+    # (the documented SeedCollision escape hatch)
+    assert not (stack.dir / LEDGER_FILE).exists()
 
 
 # ---------------------------------------------------------------- lifecycle

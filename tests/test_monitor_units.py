@@ -1,0 +1,189 @@
+"""Monitoring unit system + seeded-units ledger.
+
+Parity bar: internal/monitor/unit.go (manifest/lane/tree validation,
+index-name grammar, reserved lanes) and ledger.go (SeededUnit records,
+cross-source collision refusal).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from clawker_tpu.monitor.corpus import (
+    index_templates,
+    ingest_pipelines,
+    ism_policy,
+    saved_objects,
+    write_bootstrap_tree,
+)
+from clawker_tpu.monitor.ledger import Ledger, SeedCollision
+from clawker_tpu.monitor.unit import (
+    UnitError,
+    discover_units,
+    load_unit,
+    materialize,
+)
+
+
+def make_unit(root, name="synthetic", index="synthetic", extra=""):
+    d = root / name
+    (d / "index-templates").mkdir(parents=True)
+    (d / "monitoring.yaml").write_text(
+        f"name: {name}\n"
+        "description: test unit\n"
+        "logs:\n"
+        f"  - index: {index}\n"
+        f"    service_names: [{index}-svc]\n"
+        "    retention: short\n" + extra)
+    (d / "index-templates" / f"{index}.json").write_text(
+        json.dumps({"index_patterns": [index], "template": {}}))
+    return d
+
+
+# ------------------------------------------------------------------ corpus
+
+def test_corpus_templates_compose_common():
+    for name, tmpl in index_templates().items():
+        assert tmpl["composed_of"] == ["clawker-common"], name
+        assert tmpl["template"]["settings"]["index"]["final_pipeline"] == \
+            "envelope-normalize", name
+
+
+def test_corpus_pipelines_mark_failures():
+    for name, pipe in ingest_pipelines().items():
+        fields = [p["set"]["field"] for p in pipe["on_failure"]]
+        assert "_normalize_failed" in fields, name
+
+
+def test_ism_policy_deletes_after_age():
+    pol = ism_policy(["clawker-*"], age="2d")["policy"]
+    hot = next(s for s in pol["states"] if s["name"] == "hot")
+    assert hot["transitions"][0]["conditions"]["min_index_age"] == "2d"
+    assert pol["ism_template"][0]["index_patterns"] == ["clawker-*"]
+
+
+def test_saved_objects_include_dashboard_with_resolvable_panels():
+    objs = saved_objects()
+    by_id = {o["id"]: o for o in objs}
+    dash = by_id["clawker-egress"]
+    for ref in dash["references"]:
+        assert ref["id"] in by_id, f"dashboard references missing {ref['id']}"
+
+
+def test_write_bootstrap_tree_layout(tmp_path):
+    written = write_bootstrap_tree(tmp_path)
+    rels = {str(p.relative_to(tmp_path)) for p in written}
+    assert "component-templates/clawker-common.json" in rels
+    assert "ism-policies/clawker-retention.json" in rels
+    assert "saved-objects/clawker.ndjson" in rels
+    for p in written:
+        if p.suffix == ".json":
+            json.loads(p.read_text())  # every artifact parses
+
+
+# ------------------------------------------------------------------- units
+
+def test_load_floor_claude_code_unit():
+    from clawker_tpu.bundle.resolver import FLOOR_DIR
+
+    unit = load_unit("claude-code", FLOOR_DIR / "monitoring" / "claude-code")
+    assert [l.index for l in unit.manifest.logs] == ["claude-code"]
+    files = {p.name for p in unit.artifact_files()}
+    assert {"claude-code.json", "claude-code-normalize.json",
+            "claude-code.ndjson"} <= files
+    assert unit.content_hash()
+
+
+def test_unit_rejects_reserved_and_bad_indices(tmp_path):
+    d = make_unit(tmp_path, index="clawker-cli")
+    with pytest.raises(UnitError, match="reserved"):
+        load_unit("synthetic", d)
+    d2 = make_unit(tmp_path / "x", name="synthetic", index="Bad_Index")
+    with pytest.raises(UnitError, match="not a valid"):
+        load_unit("synthetic", d2)
+
+
+def test_unit_rejects_unknown_dirs_and_bad_json(tmp_path):
+    d = make_unit(tmp_path)
+    (d / "weird-dir").mkdir()
+    with pytest.raises(UnitError, match="unknown artifact dir"):
+        load_unit("synthetic", d)
+    (d / "weird-dir").rmdir()
+    (d / "index-templates" / "broken.json").write_text("{nope")
+    with pytest.raises(UnitError, match="bad artifact"):
+        load_unit("synthetic", d)
+
+
+def test_unit_name_manifest_agreement(tmp_path):
+    d = make_unit(tmp_path, name="alpha")
+    with pytest.raises(UnitError, match="must agree"):
+        load_unit("beta", d)
+
+
+def test_materialize_overlays(tmp_path):
+    d = make_unit(tmp_path / "units")
+    unit = load_unit("synthetic", d)
+    tree = tmp_path / "tree"
+    write_bootstrap_tree(tree)
+    materialize(unit, tree)
+    assert (tree / "index-templates" / "synthetic.json").exists()
+    # base corpus intact
+    assert (tree / "index-templates" / "clawker-cli.json").exists()
+
+
+def test_materialize_refuses_base_corpus_clobber(tmp_path):
+    """A unit shipping a same-named artifact with different content must
+    be refused, never silently override cluster-wide infrastructure."""
+    d = make_unit(tmp_path / "units")
+    (d / "ingest-pipelines").mkdir()
+    (d / "ingest-pipelines" / "envelope-normalize.json").write_text(
+        json.dumps({"processors": []}))
+    unit = load_unit("synthetic", d)
+    tree = tmp_path / "tree"
+    write_bootstrap_tree(tree)
+    with pytest.raises(UnitError, match="collides"):
+        materialize(unit, tree)
+
+
+def test_lane_entries_must_be_mappings(tmp_path):
+    d = tmp_path / "bad"
+    (d / "index-templates").mkdir(parents=True)
+    (d / "monitoring.yaml").write_text(
+        "name: bad\nlogs:\n  - bad\n")
+    with pytest.raises(UnitError, match="must be a mapping"):
+        load_unit("bad", d)
+
+
+def test_discover_units_later_roots_win(tmp_path):
+    a, b = tmp_path / "a", tmp_path / "b"
+    make_unit(a, name="dup", index="one")
+    make_unit(b, name="dup", index="two")
+    units = discover_units([a, b])
+    assert [l.index for l in units["dup"].manifest.logs] == ["two"]
+
+
+# ------------------------------------------------------------------ ledger
+
+def test_ledger_roundtrip_and_collision(tmp_path):
+    d1 = make_unit(tmp_path / "src1", name="shared")
+    d2 = make_unit(tmp_path / "src2", name="shared",
+                   extra="  - index: other\n    service_names: [other-svc]\n")
+    u1 = load_unit("shared", d1)
+    u2 = load_unit("shared", d2)
+
+    led = Ledger(tmp_path / "monitor")
+    led.seed(u1, source=str(d1))
+    led.save()
+
+    # same source, changed content: update in place
+    led2 = Ledger(tmp_path / "monitor")
+    led2.seed(u1, source=str(d1))
+
+    # different source, different content: refused with the actionable hint
+    with pytest.raises(SeedCollision, match="cluster-wide namespace"):
+        led2.seed(u2, source=str(d2))
+
+    # different source, SAME content: harmless, allowed
+    led2.seed(u1, source="floor")
