@@ -142,6 +142,7 @@ class Engine:
 
     def __init__(self, api):
         self.api = api
+        self._builder = None  # lazy: probes the daemon once (buildkit.py)
 
     # ------------------------------------------------------------ helpers
 
@@ -318,7 +319,11 @@ class Engine:
         pull: bool = False,
         no_cache: bool = False,
     ) -> Iterator[dict]:
-        return self.api.image_build(
+        from .buildkit import Builder
+
+        if self._builder is None:
+            self._builder = Builder(self.api)
+        return self._builder.build(
             context_tar,
             tags=tags,
             labels=self._managed_labels(labels),

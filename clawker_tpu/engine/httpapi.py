@@ -454,6 +454,8 @@ class HTTPDockerAPI:
         target: str = "",
         pull: bool = False,
         no_cache: bool = False,
+        version: str = "1",
+        buildid: str = "",
     ) -> Iterator[dict]:
         q: dict[str, Any] = {
             "dockerfile": dockerfile,
@@ -464,6 +466,12 @@ class HTTPDockerAPI:
         }
         if target:
             q["target"] = target
+        if version == "2":
+            # BuildKit lane: progress arrives as aux trace records
+            # (engine/buildkit.py decodes them)
+            q["version"] = "2"
+            if buildid:
+                q["buildid"] = buildid
         url = self._url("/build", q)
         # t= repeats per tag; urlencode can't repeat via dict, append manually
         for t in tags:
@@ -503,6 +511,15 @@ class HTTPDockerAPI:
                 conn.close()
 
         return gen()
+
+    def image_build_buildkit(self, context_tar: bytes, **kw) -> Iterator[dict]:
+        """BuildKit lane: same request with version=2 (the aux trace
+        records are decoded by engine/buildkit.py)."""
+        return self.image_build(context_tar, version="2", **kw)
+
+    def build_cancel(self, buildid: str) -> None:
+        """Cancel an in-flight BuildKit build by its buildid."""
+        self._request("POST", self._url("/build/cancel", {"id": buildid}))
 
     def image_pull(self, ref: str) -> Iterator[dict]:
         if ":" in ref.rsplit("/", 1)[-1]:

@@ -65,6 +65,8 @@ class BuildProgressView:
             key = self._bk_keys.get(num)
             if rest.startswith("DONE") and key:
                 self.tree.update(key, "done")
+            elif rest.startswith("CACHED") and key:
+                self.tree.update(key, "done", "cached")
             elif rest.startswith("ERROR") and key:
                 self.tree.update(key, "failed", rest)
             elif key is None and not rest.startswith(("CACHED", "DONE", "ERROR")):

@@ -1,0 +1,234 @@
+"""BuildKit build lane: wire codec, trace rendering, probe + fallback.
+
+Parity bar: pkg/whail/buildkit/{builder,solve,progress}.go -- the
+capability probe, the session/solve progress semantics (vertex events
+out of the trace), and the legacy fallback -- driven over recorded
+version=2 transcripts produced by the same codec (encode in the fake,
+decode in the engine: a disagreement fails loudly).
+"""
+
+from __future__ import annotations
+
+import base64
+
+import pytest
+
+from clawker_tpu.engine.bkproto import (
+    StatusResponse,
+    Vertex,
+    VertexLog,
+    VertexStatus,
+    WireError,
+    decode_status,
+    encode_status,
+    parse_fields,
+)
+from clawker_tpu.engine.buildkit import Builder, TraceRenderer, decode_stream
+from clawker_tpu.engine.drivers import FakeDriver
+
+
+# ------------------------------------------------------------- wire codec
+
+def test_codec_roundtrip():
+    resp = StatusResponse(
+        vertexes=[
+            Vertex(digest="sha256:aa", name="[1/3] FROM python:3.12",
+                   inputs=["sha256:bb"], started=10.5, completed=12.25),
+            Vertex(digest="sha256:cc", name="[2/3] RUN pip install",
+                   cached=True),
+            Vertex(digest="sha256:dd", name="[3/3] COPY . .",
+                   started=12.5, error="boom"),
+        ],
+        statuses=[VertexStatus(id="extracting", vertex="sha256:aa",
+                               current=512, total=2048)],
+        logs=[VertexLog(vertex="sha256:dd", stream=2, msg=b"err line\n")],
+    )
+    got = decode_status(encode_status(resp))
+    assert [v.digest for v in got.vertexes] == ["sha256:aa", "sha256:cc",
+                                                "sha256:dd"]
+    v0, v1, v2 = got.vertexes
+    assert v0.inputs == ["sha256:bb"]
+    assert v0.started == pytest.approx(10.5) and v0.completed == pytest.approx(12.25)
+    assert v1.cached is True
+    assert v2.error == "boom"
+    assert got.statuses[0].current == 512 and got.statuses[0].total == 2048
+    assert got.logs[0].msg == b"err line\n" and got.logs[0].stream == 2
+
+
+def test_codec_rejects_truncated():
+    raw = encode_status(StatusResponse(vertexes=[Vertex(digest="sha256:aa")]))
+    with pytest.raises(WireError):
+        parse_fields(raw[:-2])
+
+
+def test_codec_skips_unknown_fields_gracefully():
+    """Forward compat: extra fields the decoder does not know are
+    carried by the generic parse without breaking typed extraction."""
+    from clawker_tpu.engine.bkproto import emit_field
+
+    vertex = emit_field(1, "sha256:aa") + emit_field(3, "step") \
+        + emit_field(15, "future-field")
+    got = decode_status(emit_field(1, vertex))
+    assert got.vertexes[0].digest == "sha256:aa"
+    assert got.vertexes[0].name == "step"
+
+
+# --------------------------------------------------------- trace renderer
+
+def test_renderer_numbers_and_lifecycle():
+    r = TraceRenderer()
+    lines = [e["stream"] for e in r.render(StatusResponse(vertexes=[
+        Vertex(digest="d1", name="[internal] load", started=1.0)]))]
+    assert lines == ["#1 [internal] load\n"]
+    lines = [e["stream"] for e in r.render(StatusResponse(
+        vertexes=[Vertex(digest="d1", name="[internal] load",
+                         started=1.0, completed=1.5),
+                  Vertex(digest="d2", name="[1/2] FROM scratch", cached=True)],
+        logs=[VertexLog(vertex="d1", msg=b"line a\nline b\n")]))]
+    assert lines == ["#1 DONE 0.5s\n", "#2 [1/2] FROM scratch\n",
+                     "#2 CACHED\n", "#1 line a\n", "#1 line b\n"]
+    # CACHED marks the buildview node done (cache hits must not spin)
+    from clawker_tpu.ui.buildview import BuildProgressView
+    from clawker_tpu.ui.iostreams import IOStreams
+    from clawker_tpu.ui.progress import ProgressTree
+
+    streams, _, _, _ = IOStreams.test()
+    tree = ProgressTree(streams)
+    view = BuildProgressView(tree)
+    view.stage("s")
+    for line in ["#7 [2/2] COPY . .", "#7 CACHED"]:
+        view.line(line)
+    node = next(n for n in tree._nodes.values() if "COPY" in n.label)
+    assert node.state == "done"
+    # error vertices render once
+    lines = [e["stream"] for e in r.render(StatusResponse(vertexes=[
+        Vertex(digest="d3", name="[2/2] RUN false", started=2.0,
+               error="exit 1")]))]
+    assert lines == ["#3 [2/2] RUN false\n", "#3 ERROR exit 1\n"]
+
+
+def test_decode_stream_passthrough_and_trace():
+    resp = StatusResponse(vertexes=[Vertex(digest="d1", name="x", started=1.0)])
+    raw = [
+        {"stream": "classic line\n"},
+        {"id": "moby.buildkit.trace",
+         "aux": base64.b64encode(encode_status(resp)).decode()},
+        {"id": "moby.buildkit.trace", "aux": "!!!not-base64"},  # skipped
+        {"aux": {"ID": "sha256:final"}},
+    ]
+    out = list(decode_stream(iter(raw)))
+    assert out[0] == {"stream": "classic line\n"}
+    assert out[1] == {"stream": "#1 x\n"}
+    assert out[-1] == {"aux": {"ID": "sha256:final"}}
+
+
+# ------------------------------------------------------ probe + fallback
+
+def test_probe_prefers_buildkit_and_decodes_transcript():
+    drv = FakeDriver()
+    drv.api.builder_version = "2"
+    eng = drv.engine()
+    events = list(eng.build_image(b"tar", tags=["t:1"]))
+    streams = "".join(e.get("stream", "") for e in events)
+    assert "#1 [internal] load build definition" in streams
+    assert "#2 hello from buildkit" in streams
+    assert "#2 DONE" in streams
+    assert any("aux" in e and "ID" in e.get("aux", {}) for e in events)
+    assert any(c[0] == "image_build_buildkit" for c in drv.api.calls)
+    assert drv.api.images.get("t:1") is not None
+
+
+def test_legacy_daemon_uses_legacy_lane():
+    drv = FakeDriver()  # builder_version defaults to "1"
+    eng = drv.engine()
+    events = list(eng.build_image(b"tar", tags=["t:1"]))
+    assert any("Step 1/1" in e.get("stream", "") for e in events)
+    assert not any(c[0] == "image_build_buildkit" for c in drv.api.calls)
+
+
+def test_buildkit_refusal_falls_back_to_legacy_and_is_remembered():
+    drv = FakeDriver()
+    drv.api.builder_version = "2"
+    drv.api.buildkit_refuse = True
+    eng = drv.engine()
+    events = list(eng.build_image(b"tar", tags=["t:1"]))
+    assert any("Step 1/1" in e.get("stream", "") for e in events)
+    assert drv.api.images.get("t:1") is not None
+    # the refusal sticks: the context tar is uploaded eagerly, so the
+    # doomed lane must not be retried (double upload) on the next build
+    list(eng.build_image(b"tar", tags=["t:2"]))
+    assert sum(1 for c in drv.api.calls
+               if c[0] == "image_build_buildkit") == 1
+
+
+def test_type_confused_trace_skipped_not_fatal():
+    """A base64-valid but type-confused trace record (message field
+    arriving as varint) degrades to a skipped record."""
+    from clawker_tpu.engine.bkproto import emit_field
+
+    # Vertex field 5 (Timestamp message) as a varint instead of bytes
+    bad_vertex = emit_field(1, "sha256:aa") + bytes([5 << 3]) + b"\x2a"
+    raw = [{"id": "moby.buildkit.trace",
+            "aux": base64.b64encode(emit_field(1, bad_vertex)).decode()},
+           {"stream": "still alive\n"}]
+    out = list(decode_stream(iter(raw)))
+    assert out == [{"stream": "still alive\n"}]
+
+
+def test_truncated_fixed_fields_error():
+    from clawker_tpu.engine.bkproto import WireError, parse_fields
+
+    with pytest.raises(WireError):
+        parse_fields(bytes([1 << 3 | 1]) + b"\x01\x02")  # fixed64, 2 bytes
+    with pytest.raises(WireError):
+        parse_fields(bytes([1 << 3 | 5]) + b"\x01")      # fixed32, 1 byte
+
+
+def test_cancel_uses_last_buildid():
+    from clawker_tpu.engine.buildkit import Builder
+
+    class Api:
+        def __init__(self):
+            self.cancelled = []
+
+        def info(self):
+            return {"BuilderVersion": "2"}
+
+        def image_build_buildkit(self, tar, *, buildid="", **kw):
+            self.bid = buildid
+            return iter(())
+
+        def build_cancel(self, buildid):
+            self.cancelled.append(buildid)
+
+    api = Api()
+    b = Builder(api)
+    list(b.build(b"tar", tags=["t:1"]))
+    assert b.last_buildid == api.bid != ""
+    b.cancel()
+    assert api.cancelled == [api.bid]
+
+
+# ----------------------------------------------------------- buildview fit
+
+def test_vertex_lines_feed_buildview_tree():
+    """The rendered lines drive ui/buildview's existing #N handling."""
+    from clawker_tpu.ui.buildview import BuildProgressView
+    from clawker_tpu.ui.iostreams import IOStreams
+    from clawker_tpu.ui.progress import ProgressTree
+
+    drv = FakeDriver()
+    drv.api.builder_version = "2"
+    eng = drv.engine()
+    streams, _, _, _ = IOStreams.test()
+    tree = ProgressTree(streams)
+    view = BuildProgressView(tree)
+    view.stage("base image")
+    for ev in eng.build_image(b"tar", tags=["t:1"]):
+        if ev.get("stream"):
+            view.line(ev["stream"])
+    view.done()
+    states = {n.label: n.state for n in tree._nodes.values()}
+    assert any("load build definition" in label and state == "done"
+               for label, state in states.items())
+    assert any("FROM scratch" in label for label in states)
