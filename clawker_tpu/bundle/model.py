@@ -32,6 +32,9 @@ class Harness:
     env: dict[str, str] = field(default_factory=dict)
     egress: list[EgressRule] = field(default_factory=list)  # required domains
     files: list[str] = field(default_factory=list)      # extra files copied into image
+    # create-time host->container config staging directives, interpreted
+    # by clawker_tpu.containerfs (raw tree; schema lives there)
+    staging: dict = field(default_factory=dict)
     source_dir: Path | None = None                      # where files resolve from
     tier: str = ""                                      # floor | installed | loose
 

@@ -91,6 +91,9 @@ SUPERVISOR_PATH = "/usr/local/bin/clawker-supervisord"  # native PID 1
 SUPERVISOR_SOCKET = "/run/clawker/supervisor.sock"
 AGENTD_PYZ_PATH = "/usr/local/lib/clawker-agentd.pyz"   # session daemon zipapp
 WORKSPACE_DIR = "/workspace"
+CONTAINER_HOME = "/home/agent"   # agent user's home (staging dests are
+#                                  home-relative, workspace/strategy mounts
+#                                  config/history volumes under it)
 CA_CERT_PATH = "/usr/local/share/ca-certificates/clawker-firewall-ca.crt"
 # Container-side host-proxy scripts (reference: internal/hostproxy/internals
 # host-open.sh + git-credential-clawker.sh, baked in by the bundler)

@@ -155,9 +155,39 @@ class AgentRuntime:
                 f"(container {name}); use --replace or `clawker start`"
             )
         mounts.seed(self.engine, cid)
+        self._seed_harness_config(cid, harness, root)
         if self.bootstrap:
             self.bootstrap(cid, project, opts.agent)
         return cid
+
+    def _seed_harness_config(self, cid: str, harness: str, root: Path) -> None:
+        """Stage host harness state into the config volume per the harness
+        bundle's staging manifest (containerfs; reference
+        container_create.go:1907 initConfigVolume).  A host with zero
+        harness state, or no staging manifest, degrades to a no-op."""
+        from .. import containerfs
+        from ..bundle.resolver import Resolver
+        from ..errors import NotFoundError
+
+        try:
+            h = Resolver(self.cfg).harness(harness or "claude")
+        except NotFoundError:
+            return
+        staging = containerfs.Staging.from_raw(h.staging)
+        if not staging.copy:
+            return
+        sdir, cleanup = containerfs.prepare_config(
+            staging,
+            container_home=consts.CONTAINER_HOME,
+            container_work=consts.WORKSPACE_DIR,
+            host_project_root=str(root),
+        )
+        try:
+            tar = containerfs.staging_tar(sdir)
+            if tar:
+                self.engine.put_archive(cid, consts.CONTAINER_HOME, tar)
+        finally:
+            cleanup()
 
     def _build_env(self, project: str, opts: CreateOptions) -> dict[str, str]:
         """Create-time env (reference: buildCreateTimeEnv

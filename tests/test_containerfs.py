@@ -1,0 +1,266 @@
+"""containerfs: host harness-config staging for container injection.
+
+Parity bar: internal/containerfs/containerfs.go semantics -- src
+expansion (~, $VAR, ${VAR:-fallback}, glob), missing-source soft skip
+(the keyring/fresh-machine degradation contract), workspace guard, JSON
+key allowlist, per-file skips, JSON path rewrites, and the create-path
+seeding of the config volume.
+"""
+
+from __future__ import annotations
+
+import json
+import tarfile
+import io
+
+import pytest
+
+from clawker_tpu import containerfs
+from clawker_tpu.containerfs import (
+    CopySpec,
+    JsonRewrite,
+    Staging,
+    StagingError,
+    expand_host_path,
+    prepare_config,
+    prepare_hook_tar,
+    resolve_host_mount_source,
+    staging_tar,
+)
+
+HOME = "/home/agent"
+WORK = "/workspace"
+
+
+def prep(staging, root="/nonexistent-project"):
+    return prepare_config(staging, container_home=HOME, container_work=WORK,
+                          host_project_root=root)
+
+
+# ----------------------------------------------------------- expansion
+
+def test_expand_host_path(monkeypatch, tmp_path):
+    monkeypatch.setenv("XDIR", str(tmp_path))
+    monkeypatch.delenv("NOPE", raising=False)
+    assert expand_host_path("$XDIR/a") == f"{tmp_path}/a"
+    assert expand_host_path("${XDIR}/a") == f"{tmp_path}/a"
+    assert expand_host_path("${NOPE:-/fallback}/a") == "/fallback/a"
+    assert expand_host_path("~").startswith("/")
+
+
+def test_resolve_host_mount_source(tmp_path):
+    d = tmp_path / "dir"
+    d.mkdir()
+    assert resolve_host_mount_source(str(d)) == (str(d), True)
+    assert resolve_host_mount_source(str(tmp_path / "missing")) == ("", False)
+    f = tmp_path / "file"
+    f.write_text("x")
+    with pytest.raises(StagingError):
+        resolve_host_mount_source(str(f))
+
+
+# ------------------------------------------------------------- staging
+
+def test_missing_source_soft_skips(tmp_path):
+    """Fresh machine / no keyring / no ~/.claude: staging must degrade
+    to an empty mirror, never error."""
+    staging = Staging(copy=[
+        CopySpec(src=str(tmp_path / "nope" / "settings.json"),
+                 dest=".claude/settings.json"),
+        CopySpec(src=str(tmp_path / "gone"), dest=".claude/agents"),
+    ])
+    sdir, cleanup = prep(staging)
+    try:
+        assert list(sdir.rglob("*")) == []
+        assert staging_tar(sdir) == b"" or not tarfile.open(
+            fileobj=io.BytesIO(staging_tar(sdir))).getnames()
+    finally:
+        cleanup()
+
+
+def test_json_key_allowlist(tmp_path):
+    src = tmp_path / "settings.json"
+    src.write_text(json.dumps({
+        "enabledPlugins": {"a": True},
+        "apiKey": "SECRET",
+        "hostPath": "/Users/someone",
+    }))
+    staging = Staging(copy=[CopySpec(
+        src=str(src), dest=".claude/settings.json",
+        json_keys=["enabledPlugins"])])
+    sdir, cleanup = prep(staging)
+    try:
+        staged = json.loads((sdir / ".claude/settings.json").read_text())
+        assert staged == {"enabledPlugins": {"a": True}}
+        assert "SECRET" not in (sdir / ".claude/settings.json").read_text()
+    finally:
+        cleanup()
+
+
+def test_dir_copy_with_skip_and_rewrites(tmp_path, monkeypatch):
+    plugins = tmp_path / "plugins"
+    plugins.mkdir()
+    host_home = str(tmp_path)
+    monkeypatch.setenv("HOME", host_home)
+    (plugins / "installed-plugins.json").write_text(json.dumps({
+        "plugins": [{"installPath": f"{host_home}/.claude/plugins/x",
+                     "projectPath": "/Users/someone/repo"}]}))
+    (plugins / "install-counts-cache.json").write_text("{}")
+    (plugins / "keep.txt").write_text("k")
+    staging = Staging(copy=[CopySpec(
+        src=str(plugins), dest=".claude/plugins",
+        skip=["install-counts-cache.json"],
+        json_rewrites=[
+            JsonRewrite(file="installed-plugins.json", key="installPath",
+                        rewrite="prefix-swap"),
+            JsonRewrite(file="installed-plugins.json", key="projectPath",
+                        rewrite="replace-with-workdir"),
+        ])])
+    sdir, cleanup = prep(staging)
+    try:
+        out = sdir / ".claude/plugins"
+        assert (out / "keep.txt").exists()
+        assert not (out / "install-counts-cache.json").exists()
+        data = json.loads((out / "installed-plugins.json").read_text())
+        assert data["plugins"][0]["installPath"] == \
+            f"{HOME}/.claude/plugins/x"
+        assert data["plugins"][0]["projectPath"] == WORK
+    finally:
+        cleanup()
+
+
+def test_workspace_guard(tmp_path):
+    ws = tmp_path / "repo"
+    ws.mkdir()
+    (ws / "inside.txt").write_text("x")
+    staging = Staging(copy=[CopySpec(src=str(ws / "inside.txt"),
+                                     dest=".claude/x")])
+    with pytest.raises(StagingError, match="workspace"):
+        prep(staging, root=str(ws))
+
+
+def test_glob_lands_each_match_under_dest(tmp_path):
+    for n in ("a.md", "b.md"):
+        (tmp_path / n).write_text(n)
+    staging = Staging(copy=[CopySpec(src=str(tmp_path / "*.md"),
+                                     dest=".claude/docs")])
+    sdir, cleanup = prep(staging)
+    try:
+        assert sorted(p.name for p in (sdir / ".claude/docs").iterdir()) == \
+            ["a.md", "b.md"]
+    finally:
+        cleanup()
+
+
+def test_dest_must_be_home_relative(tmp_path):
+    f = tmp_path / "f"
+    f.write_text("x")
+    for dest in ("../escape", "", ".claude/../../../../etc/evil"):
+        with pytest.raises(StagingError):
+            prep(Staging(copy=[CopySpec(src=str(f), dest=dest)]))
+    # '..'-prefixed NAMES are legitimate, only path segments are not
+    sdir, cleanup = prep(Staging(copy=[CopySpec(src=str(f), dest="..foo")]))
+    cleanup()
+
+
+def test_symlinks_never_dereferenced(tmp_path):
+    """A staged tree linking to host secrets must not leak them."""
+    secret = tmp_path / ".credentials.json"
+    secret.write_text('{"token": "SECRET"}')
+    plugins = tmp_path / "plugins"
+    plugins.mkdir()
+    (plugins / "creds").symlink_to(secret)
+    (plugins / "ok.txt").write_text("fine")
+    sdir, cleanup = prep(Staging(copy=[CopySpec(src=str(plugins),
+                                                dest=".claude/plugins")]))
+    try:
+        out = sdir / ".claude/plugins"
+        assert (out / "ok.txt").exists()
+        assert not (out / "creds").exists()
+    finally:
+        cleanup()
+
+
+def test_empty_mirror_tar_is_empty(tmp_path):
+    empty = tmp_path / "mirror"
+    empty.mkdir()
+    assert staging_tar(empty) == b""
+
+
+# --------------------------------------------------------------- packing
+
+def test_staging_tar_extracts_at_home(tmp_path):
+    staging = tmp_path / "mirror"
+    (staging / ".claude").mkdir(parents=True)
+    (staging / ".claude" / "CLAUDE.md").write_text("hi")
+    tar = staging_tar(staging, uid=1001, gid=1002)
+    tf = tarfile.open(fileobj=io.BytesIO(tar))
+    member = tf.getmember(".claude/CLAUDE.md")
+    assert member.uid == 1001 and member.gid == 1002
+    assert tf.extractfile(member).read() == b"hi"
+
+
+def test_prepare_hook_tar_wraps_script():
+    tar = prepare_hook_tar("/bin/sh", "echo hi", "post-init")
+    tf = tarfile.open(fileobj=io.BytesIO(tar))
+    body = tf.extractfile(".clawker/post-init.sh").read().decode()
+    assert body.startswith("#!/bin/sh\nset -e\n")
+    assert "echo hi" in body
+    assert tf.getmember(".clawker/post-init.sh").mode == 0o755
+    # empty script -> no-op wrapper, still delivered
+    tar2 = prepare_hook_tar("/bin/sh", "", "post-init")
+    assert tarfile.open(fileobj=io.BytesIO(tar2)).getnames()
+
+
+# ----------------------------------------------------------- create path
+
+def test_create_seeds_config_volume_from_harness_staging(tmp_path, monkeypatch):
+    """The run path stages host harness state into the container via
+    put_archive at the container home (reference initConfigVolume)."""
+    from clawker_tpu import consts
+    from clawker_tpu.config import load_config
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.runtime.orchestrate import AgentRuntime, CreateOptions
+    from clawker_tpu.testenv import TestEnv
+
+    claude_dir = tmp_path / "claude-home"
+    claude_dir.mkdir()
+    (claude_dir / "CLAUDE.md").write_text("my global memory")
+    monkeypatch.setenv("CLAUDE_CONFIG_DIR", str(claude_dir))
+
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: cfsproj\n")
+        cfg = load_config(proj)
+        drv = FakeDriver()
+        drv.api.add_image("clawker-cfsproj:default")
+        rt = AgentRuntime(drv.engine(), cfg)
+        cid = rt.create(CreateOptions(agent="dev", workspace_mode="snapshot"))
+        c = drv.api.containers[cid]
+        tar_bytes = c.archives.get(consts.CONTAINER_HOME)
+        assert tar_bytes, "config staging tar was not delivered"
+        tf = tarfile.open(fileobj=io.BytesIO(tar_bytes))
+        assert tf.extractfile(".claude/CLAUDE.md").read() == b"my global memory"
+
+
+def test_create_with_no_host_state_still_works(tmp_path, monkeypatch):
+    """keyring-absent / fresh-host degradation: create succeeds and just
+    delivers nothing."""
+    from clawker_tpu import consts
+    from clawker_tpu.config import load_config
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.runtime.orchestrate import AgentRuntime, CreateOptions
+    from clawker_tpu.testenv import TestEnv
+
+    monkeypatch.setenv("CLAUDE_CONFIG_DIR", str(tmp_path / "nothing-here"))
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: cfsproj\n")
+        cfg = load_config(proj)
+        drv = FakeDriver()
+        drv.api.add_image("clawker-cfsproj:default")
+        rt = AgentRuntime(drv.engine(), cfg)
+        cid = rt.create(CreateOptions(agent="dev", workspace_mode="snapshot"))
+        assert drv.api.containers[cid].state == "created"
