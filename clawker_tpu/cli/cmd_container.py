@@ -195,6 +195,9 @@ def kill_cmd(f: Factory, names, signal):
 @pass_factory
 def rm_cmd(f: Factory, names, force, volumes):
     """Remove agent containers."""
+    what = ", ".join(names) + (" (and volumes)" if volumes else "")
+    if not f.confirm_destructive(f"Remove {what}?", skip=force):
+        raise SystemExit(1)
     for n in names:
         f.engine().remove_container(_resolve_ref(f, n), force=force, volumes=volumes)
         click.echo(n)

@@ -67,14 +67,22 @@ def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
         click.echo(line, err=True)
 
     sched = LoopScheduler(f.config, f.driver, spec, on_event=on_event)
+    feed = None
     if live:
         # BASELINE config 4: the shared monitor TUI over the fan-out, with
-        # the netlogger's egress stream as a ticker when it exists
+        # EVERY worker's egress stream merged into the ticker (remote
+        # workers tail their jsonl back over the SSH mux)
+        from ..fleet.egress_tail import EgressFeed
         from ..ui.dashboard import LoopDashboard
 
+        feed = EgressFeed()
+        local_log = f.config.logs_dir / "ebpf-egress.jsonl"
+        for w in f.driver.workers():
+            feed.add_worker(w, local_path=local_log)
         dashboard = LoopDashboard(
             f.streams, sched,
-            egress_path=f.config.logs_dir / "ebpf-egress.jsonl",
+            egress_path=local_log,
+            egress_feed=feed,
         )
     signal.signal(signal.SIGINT, lambda *_: sched.stop())
     signal.signal(signal.SIGTERM, lambda *_: sched.stop())
@@ -84,11 +92,15 @@ def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
         err=True,
     )
     sched.start()
-    if dashboard is not None:
-        with dashboard:
+    try:
+        if dashboard is not None:
+            with dashboard:
+                loops = sched.run()
+        else:
             loops = sched.run()
-    else:
-        loops = sched.run()
+    finally:
+        if feed is not None:
+            feed.stop()
     if not keep:
         sched.cleanup(remove_containers=True)
     if as_json:

@@ -46,10 +46,14 @@ def project_list(f: Factory, fmt):
 
 @project_group.command("remove")
 @click.argument("name")
+@click.option("--yes", "-y", is_flag=True, help="Skip the confirmation prompt.")
 @pass_factory
-def project_remove(f: Factory, name):
+def project_remove(f: Factory, name, yes):
     from ..project.manager import ProjectManager
 
+    if not f.confirm_destructive(
+            f"Remove project {name!r} from the registry?", skip=yes):
+        raise SystemExit(1)
     ProjectManager(f.config).remove(name)
     click.echo(name)
 
@@ -88,6 +92,8 @@ def worktree_list(f: Factory):
 def worktree_remove(f: Factory, name, force):
     from ..project.manager import ProjectManager
 
+    if not f.confirm_destructive(f"Remove worktree {name!r}?", skip=force):
+        raise SystemExit(1)
     pm = ProjectManager(f.config)
     pm.remove_worktree(f.config.project_name(), name, force=force)
     click.echo(name)

@@ -39,6 +39,10 @@ def volume_ls(f: Factory, fmt):
 @click.option("--force", "-f", is_flag=True)
 @pass_factory
 def volume_rm(f: Factory, names, force):
+    if not f.confirm_destructive(
+            f"Remove volume(s) {', '.join(names)}? Data is not recoverable.",
+            skip=force):
+        raise SystemExit(1)
     for n in names:
         f.engine().remove_volume(n, force=force)
         click.echo(n)

@@ -43,6 +43,17 @@ class Factory:
 
         return Prompter(self.streams)
 
+    def confirm_destructive(self, message: str, *, skip: bool = False) -> bool:
+        """Gate for destructive verbs (container rm, project remove, ...).
+
+        ``skip`` (a --force/--yes flag) bypasses; non-interactive runs
+        proceed (scripts must not hang on a prompt they cannot answer --
+        reference prompter is TTY-only); an interactive decline aborts.
+        Reference: internal/prompter confirm flows (SURVEY.md 2.4)."""
+        if skip or not self.streams.can_prompt():
+            return True
+        return self.prompter.confirm(message, default=False)
+
     @functools.cached_property
     def config(self) -> Config:
         if self._config_override is not None:

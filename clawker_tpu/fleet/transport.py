@@ -63,6 +63,12 @@ class Runner:
         return subprocess.Popen(argv, stdout=subprocess.DEVNULL,
                                 stderr=subprocess.DEVNULL)
 
+    def spawn_piped(self, argv: list[str]) -> subprocess.Popen:
+        """Long-lived stream whose stdout the caller consumes (egress
+        tails riding the SSH mux -- fleet/egress_tail.py)."""
+        return subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL)
+
 
 class FakeRunner(Runner):
     """Scripted transcripts: remote command string -> (rc, out).
@@ -72,8 +78,11 @@ class FakeRunner(Runner):
     only state what they care about.  Every invocation is recorded.
     """
 
-    def __init__(self, script: dict[str, tuple[int, str]] | None = None):
+    def __init__(self, script: dict[str, tuple[int, str]] | None = None,
+                 stream_script: dict[str, list[str]] | None = None):
         self.script = dict(script or {})
+        # needle -> lines a spawn_piped stream yields before EOF
+        self.stream_script = dict(stream_script or {})
         self.calls: list[list[str]] = []
         self.pushed: dict[str, bytes] = {}   # remote path -> tar bytes
         self.spawned: list[list[str]] = []
@@ -96,6 +105,31 @@ class FakeRunner(Runner):
         class _P:
             def poll(self):
                 return None
+
+            def terminate(self):
+                pass
+
+            def wait(self, timeout=None):
+                return 0
+
+        return _P()
+
+    def spawn_piped(self, argv):
+        import io as _io
+
+        self.spawned.append(list(argv))
+        joined = " ".join(argv)
+        lines: list[str] = []
+        for needle, out in self.stream_script.items():
+            if needle in joined:
+                lines = out
+        body = "".join(l + "\n" for l in lines).encode()
+
+        class _P:
+            stdout = _io.BytesIO(body)
+
+            def poll(self):
+                return 0
 
             def terminate(self):
                 pass

@@ -211,7 +211,13 @@ class Store(Generic[T]):
         layer = self.layers[idx]
         with self._lock:
             with file_lock(layer.path):
-                tree = layer.read() or {}
+                original = (layer.path.read_text(encoding="utf-8")
+                            if layer.path.exists() else "")
+                tree = (yaml.safe_load(original) if original else None) or {}
+                if not isinstance(tree, dict):
+                    raise ValueError(
+                        f"layer {layer.name} ({layer.path}): top level must "
+                        "be a mapping")
                 tree = self._migrate(tree)
                 if replace is not None:
                     tree = copy.deepcopy(replace)
@@ -219,7 +225,17 @@ class Store(Generic[T]):
                     fn(tree)
                 if self._version > 1:
                     tree["_v"] = self._version
-                text = yaml.safe_dump(tree, sort_keys=False, default_flow_style=False)
+                # comment-preserving surgical patch first; a change the
+                # editor cannot express (or that fails its re-parse
+                # verification) falls back to a full re-dump
+                from .yamledit import apply_edits
+
+                text = apply_edits(original, tree) if original else None
+                if text is None:
+                    text = yaml.safe_dump(tree, sort_keys=False,
+                                          default_flow_style=False)
+                elif text and not text.endswith("\n"):
+                    text += "\n"
                 atomic_write(layer.path, text)
             self._snap = None  # invalidate snapshot; next read re-merges
 

@@ -1,0 +1,226 @@
+"""Comment-preserving YAML edits: surgical line patches, verified.
+
+The reference stores YAML as yaml.Node trees, so provenance-routed
+writes keep comments and ordering byte-for-byte.  PyYAML has no node
+round-trip, so this module patches the original TEXT instead: locate the
+mapping line for a dotted path by an indentation scan, replace/insert/
+delete just those lines, and VERIFY the result re-parses to exactly the
+intended tree.  Anything not surgically expressible (list interiors,
+flow mappings, anchors, multi-line scalars...) returns None and the
+caller falls back to a full re-dump -- correctness never depends on this
+module, only comment survival does.
+
+Round-3 verdict weak #6: storage destroyed YAML comments on every
+provenance-routed write (store.py safe_load round-trip).
+"""
+
+from __future__ import annotations
+
+import re
+
+import yaml
+
+_KEY_LINE = re.compile(r"^(\s*)([A-Za-z0-9_.\-\"']+)\s*:(.*)$")
+
+
+def _render_scalar(value) -> str:
+    """One-line YAML rendering of a scalar/short value."""
+    text = yaml.safe_dump(value, default_flow_style=True, width=10**6).strip()
+    if text.endswith("\n..."):
+        text = text[:-4].strip()
+    return text
+
+
+def _render_block(key: str, value, indent: int) -> list[str]:
+    """Render ``key: value`` as indented block lines."""
+    pad = " " * indent
+    if isinstance(value, (dict, list)) and value:
+        body = yaml.safe_dump({key: value}, default_flow_style=False,
+                              sort_keys=False)
+        return [pad + line if line.strip() else line
+                for line in body.rstrip("\n").split("\n")]
+    return [f"{pad}{key}: {_render_scalar(value)}"]
+
+
+class _Doc:
+    """Indentation-indexed view of a YAML mapping document."""
+
+    def __init__(self, text: str):
+        self.lines = text.split("\n")
+        # path -> (line_no, indent, inline_rest)
+        self.index: dict[tuple[str, ...], tuple[int, int, str]] = {}
+        self.ok = self._scan()
+
+    def _scan(self) -> bool:
+        stack: list[tuple[int, str]] = []   # (indent, key)
+        for i, line in enumerate(self.lines):
+            stripped = line.strip()
+            if not stripped or stripped.startswith("#"):
+                continue
+            if stripped.startswith("- "):
+                continue  # list items are never edit targets; keys under
+                #           them would need sequence tracking -> bail there
+            m = _KEY_LINE.match(line)
+            if m is None:
+                # multi-line scalar bodies etc.: tolerated as long as no
+                # edit lands inside them (verification catches otherwise)
+                continue
+            indent = len(m.group(1))
+            key = m.group(2).strip("\"'")
+            while stack and stack[-1][0] >= indent:
+                stack.pop()
+            stack.append((indent, key))
+            path = tuple(k for _, k in stack)
+            if path in self.index:
+                return False  # duplicate key path: ambiguous target
+            self.index[path] = (i, indent, m.group(3))
+        return True
+
+    def subtree_end(self, line_no: int, indent: int) -> int:
+        """Last line (exclusive) of the block owned by the key line."""
+        j = line_no + 1
+        last_content = line_no + 1
+        while j < len(self.lines):
+            s = self.lines[j].strip()
+            if s and not s.startswith("#"):
+                cur = len(self.lines[j]) - len(self.lines[j].lstrip())
+                if cur <= indent:
+                    break
+                last_content = j + 1
+            j += 1
+        return last_content
+
+
+def _diff(before, after, prefix=()) -> list[tuple[str, tuple, object]]:
+    """(op, path, value) edits turning ``before`` into ``after`` where op
+    is set/del.  Non-dict containers diff as whole-value sets."""
+    out: list[tuple[str, tuple, object]] = []
+    if not isinstance(before, dict) or not isinstance(after, dict):
+        if before != after:
+            out.append(("set", prefix, after))
+        return out
+    for key in before:
+        if key not in after:
+            out.append(("del", prefix + (key,), None))
+    for key, val in after.items():
+        if key not in before:
+            out.append(("set", prefix + (key,), val))
+        elif before[key] != val:
+            out.extend(_diff(before[key], val, prefix + (key,)))
+    return out
+
+
+def apply_edits(text: str, after: dict) -> str | None:
+    """Patch ``text`` so it parses to ``after``, keeping comments and
+    ordering.  None when the change is not surgically expressible (the
+    caller re-dumps)."""
+    try:
+        before = yaml.safe_load(text) or {}
+    except yaml.YAMLError:
+        return None
+    if not isinstance(before, dict):
+        return None
+    edits = _diff(before, after)
+    if not edits:
+        return text
+    lines_text = text
+    for op, path, value in sorted(edits, key=lambda e: len(e[1]), reverse=True):
+        doc = _Doc(lines_text)
+        if not doc.ok:
+            return None
+        patched = _apply_one(doc, op, path, value)
+        if patched is None:
+            return None
+        lines_text = patched
+    try:
+        if yaml.safe_load(lines_text) != after:
+            return None
+    except yaml.YAMLError:
+        return None
+    return lines_text
+
+
+def _apply_one(doc: _Doc, op: str, path: tuple, value) -> str | None:
+    spath = tuple(str(p) for p in path)
+    hit = doc.index.get(spath)
+    if op == "del":
+        if hit is None:
+            return None
+        line_no, indent, _ = hit
+        end = doc.subtree_end(line_no, indent)
+        out = doc.lines[:line_no] + doc.lines[end:]
+        # deleting the last child leaves `parent:` parsing as null, not
+        # the empty mapping the tree holds: pin it to `parent: {}`
+        parent = spath[:-1]
+        if parent and not any(
+                p[:len(parent)] == parent and p != spath and len(p) > len(parent)
+                for p in doc.index):
+            pline, pindent, prest = doc.index[parent]
+            if not prest.strip() or prest.strip().startswith("#"):
+                comment = f"  {prest.strip()}" if prest.strip() else ""
+                out[pline] = " " * pindent + f"{parent[-1]}: {{}}" + comment
+        return "\n".join(out)
+    # set
+    if hit is not None:
+        line_no, indent, rest = hit
+        if isinstance(value, (dict, list)) and value:
+            # replacing a whole block: delete + re-insert rendered block
+            end = doc.subtree_end(line_no, indent)
+            block = _render_block(spath[-1], value, indent)
+            return "\n".join(doc.lines[:line_no] + block + doc.lines[end:])
+        # scalar in place: keep any trailing comment on the line
+        comment = ""
+        m = re.search(r"\s#(?![^\"']*[\"'][^#]*$).*$", rest)
+        if m and not rest.strip().startswith("#"):
+            comment = m.group(0)
+        elif rest.strip().startswith("#"):
+            comment = "  " + rest.strip()
+        new_line = (" " * indent + f"{spath[-1]}: {_render_scalar(value)}"
+                    + comment)
+        end = doc.subtree_end(line_no, indent)
+        if end > line_no + 1:
+            # key owned a nested block: replace the whole block
+            return "\n".join(doc.lines[:line_no] + [new_line] + doc.lines[end:])
+        return "\n".join(doc.lines[:line_no] + [new_line] + doc.lines[line_no + 1:])
+    # new key: insert under the deepest existing ancestor.  The suffix
+    # below the ancestor nests into one rendered block.
+    for depth in range(len(spath) - 1, -1, -1):
+        anc = spath[:depth]
+        suffix = spath[depth:]
+        nested = _nest(suffix[1:], value)
+        if not anc:
+            body = _render_block(suffix[0], nested, 0)
+            out = doc.lines[:]
+            while out and not out[-1].strip():
+                out.pop()
+            return "\n".join(out + body)
+        hit = doc.index.get(anc)
+        if hit is None:
+            continue
+        line_no, indent, rest = hit
+        if rest.strip() and not rest.strip().startswith("#"):
+            return None  # ancestor holds an inline value: not expressible
+        child_indent = _child_indent(doc, line_no, indent)
+        end = doc.subtree_end(line_no, indent)
+        body = _render_block(suffix[0], nested, child_indent)
+        return "\n".join(doc.lines[:end] + body + doc.lines[end:])
+    return None
+
+
+def _nest(keys: tuple, value):
+    for key in reversed(keys):
+        value = {key: value}
+    return value
+
+
+def _child_indent(doc: _Doc, line_no: int, indent: int) -> int:
+    """Indent of the key's existing children, or indent+2."""
+    for j in range(line_no + 1, len(doc.lines)):
+        s = doc.lines[j].strip()
+        if not s or s.startswith("#"):
+            continue
+        cur = len(doc.lines[j]) - len(doc.lines[j].lstrip())
+        if cur <= indent:
+            break
+        return cur
+    return indent + 2

@@ -1,0 +1,120 @@
+"""Multi-worker egress feed: the remote loop ticker gap (r3 weak #5).
+
+Local workers tail the laptop jsonl; remote workers ride `tail -F` over
+the SSH mux (FakeRunner stream transcript); records merge into one
+bounded feed tagged by worker id, which the dashboard ticker renders.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+from clawker_tpu.consts import TPU_SSH_MUX_DIR
+from clawker_tpu.fleet.egress_tail import REMOTE_EGRESS_LOG, EgressFeed
+from clawker_tpu.fleet.transport import FakeRunner, SSHTransport
+
+
+def wait_for(cond, timeout=5.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_local_tail_streams_appended_records(tmp_path):
+    log = tmp_path / "ebpf-egress.jsonl"
+    log.write_text(json.dumps({"verdict": "deny", "dst": "1.2.3.4"}) + "\n")
+    feed = EgressFeed()
+    feed.add_local("local-0", log)
+    try:
+        assert wait_for(lambda: len(feed.tail()) == 1)
+        assert feed.tail()[0]["worker"] == "local-0"
+        with log.open("a") as fh:
+            fh.write(json.dumps({"verdict": "allow", "dst": "5.6.7.8"}) + "\n")
+        assert wait_for(lambda: len(feed.tail()) == 2)
+        assert feed.tail()[1]["dst"] == "5.6.7.8"
+    finally:
+        feed.stop()
+
+
+def test_partial_line_not_consumed(tmp_path):
+    """A record split mid-write must surface once completed, not be
+    dropped in halves."""
+    log = tmp_path / "egress.jsonl"
+    rec = json.dumps({"verdict": "deny", "dst": "4.4.4.4"})
+    log.write_text(rec[:10])  # flush boundary mid-record
+    feed = EgressFeed()
+    feed.add_local("w", log)
+    try:
+        time.sleep(0.7)
+        assert feed.tail() == []
+        with log.open("a") as fh:
+            fh.write(rec[10:] + "\n")
+        assert wait_for(lambda: len(feed.tail()) == 1)
+        assert feed.tail()[0]["dst"] == "4.4.4.4"
+    finally:
+        feed.stop()
+
+
+def test_remote_tail_rides_ssh_mux(tmp_path):
+    from clawker_tpu.config.schema import TPUSettings
+
+    records = [json.dumps({"verdict": "deny", "dst": "9.9.9.9",
+                           "dst_port": 443})]
+    runner = FakeRunner(stream_script={"tail -n +1 -F": records})
+    transport = SSHTransport(TPUSettings(), "w1.example", 0,
+                             mux_dir=tmp_path / "mux", runner=runner)
+    feed = EgressFeed()
+    feed.add_remote("tpu-1", transport)
+    try:
+        assert wait_for(lambda: len(feed.tail()) == 1)
+        rec = feed.tail()[0]
+        assert rec["worker"] == "tpu-1" and rec["dst"] == "9.9.9.9"
+        # the spawned command tails the WORKER-side XDG path over ssh
+        spawned = " ".join(runner.spawned[0])
+        assert "ssh" in spawned and REMOTE_EGRESS_LOG in spawned
+    finally:
+        feed.stop()
+
+
+def test_add_worker_dispatches_on_transport(tmp_path):
+    """Fake (local) workers use the file tail; an engine carrying a
+    transport attribute rides the remote lane."""
+    from clawker_tpu.engine.drivers import FakeDriver
+
+    drv = FakeDriver(n_workers=2)
+    log = tmp_path / "egress.jsonl"
+    log.write_text(json.dumps({"verdict": "deny", "dst": "1.1.1.1"}) + "\n")
+    feed = EgressFeed()
+    for w in drv.workers():
+        feed.add_worker(w, local_path=log)
+    try:
+        # both local workers tail the same file; dedupe is not the goal,
+        # attribution is
+        assert wait_for(lambda: len(feed.tail()) >= 2)
+        assert {r["worker"] for r in feed.tail()} == {"fake-0", "fake-1"}
+    finally:
+        feed.stop()
+
+
+def test_dashboard_renders_feed_with_worker_tags(tmp_path):
+    from clawker_tpu.ui.dashboard import LoopDashboard
+    from clawker_tpu.ui.iostreams import IOStreams
+
+    class _Sched:
+        loop_id = "abc123"
+
+        def status(self):
+            return []
+
+    feed = EgressFeed()
+    feed._push("tpu-3", json.dumps({"verdict": "deny", "dst": "8.8.8.8",
+                                    "dst_port": 53}))
+    streams, _, _, _ = IOStreams.test()
+    dash = LoopDashboard(streams, _Sched(), egress_feed=feed)
+    lines = "\n".join(dash._frame_lines())
+    assert "[tpu-3]" in lines and "deny" in lines and "8.8.8.8" in lines

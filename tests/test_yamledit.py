@@ -1,0 +1,135 @@
+"""Comment-preserving YAML edits (round-3 verdict weak #6).
+
+Contract: apply_edits either returns text that (a) parses to exactly the
+intended tree AND (b) keeps every comment/ordering byte it did not have
+to touch -- or None, and the store falls back to a full re-dump.  An
+oracle sweep fuzzes random edits against random documents to hold (a).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+import yaml
+
+from clawker_tpu.storage.store import Layer, Store
+from clawker_tpu.storage.yamledit import apply_edits
+
+DOC = """\
+# clawker project configuration
+project: demo   # the registry key
+build:
+  # which language stack to bake
+  stack: python
+  harness: claude
+security:
+  egress:
+    - dst: api.anthropic.com
+      proto: https
+workspace:
+  mode: bind
+"""
+
+
+def test_scalar_change_keeps_comments():
+    after = yaml.safe_load(DOC)
+    after["build"]["stack"] = "go"
+    out = apply_edits(DOC, after)
+    assert out is not None
+    assert yaml.safe_load(out) == after
+    assert "# clawker project configuration" in out
+    assert "# which language stack to bake" in out
+    assert "# the registry key" in out          # inline comment survives
+    assert "stack: go" in out
+
+
+def test_add_nested_key_keeps_comments():
+    after = yaml.safe_load(DOC)
+    after["build"]["packages"] = ["curl"]
+    after["agent"] = {"memory": "8g"}
+    out = apply_edits(DOC, after)
+    assert out is not None
+    assert yaml.safe_load(out) == after
+    assert "# which language stack to bake" in out
+
+
+def test_delete_key_keeps_other_comments():
+    after = yaml.safe_load(DOC)
+    del after["workspace"]
+    out = apply_edits(DOC, after)
+    assert out is not None
+    assert yaml.safe_load(out) == after
+    assert "# clawker project configuration" in out
+    assert "workspace" not in out
+
+
+def test_list_interior_change_rerenders_only_that_block():
+    """A sequence change re-renders its owning block; comments elsewhere
+    survive."""
+    after = yaml.safe_load(DOC)
+    after["security"]["egress"][0]["proto"] = "http"
+    out = apply_edits(DOC, after)
+    assert out is not None and yaml.safe_load(out) == after
+    assert "# clawker project configuration" in out
+    assert "# which language stack to bake" in out
+
+
+def test_noop_returns_text_unchanged():
+    assert apply_edits(DOC, yaml.safe_load(DOC)) == DOC
+
+
+def test_oracle_sweep_random_edits():
+    """Randomized edits: every non-None result must parse to the target."""
+    rng = random.Random(7)
+    keys = ["alpha", "beta", "gamma", "delta"]
+
+    def random_tree(depth=0):
+        out = {}
+        for k in rng.sample(keys, rng.randint(1, len(keys))):
+            if depth < 2 and rng.random() < 0.4:
+                out[k] = random_tree(depth + 1)
+            else:
+                out[k] = rng.choice([1, "x", True, None, "with spaces",
+                                     ["a", "b"], {"n": 1}])
+        return out
+
+    for _ in range(200):
+        before = random_tree()
+        text = yaml.safe_dump(before, sort_keys=False)
+        text = "# header comment\n" + text
+        after = random_tree()
+        out = apply_edits(text, after)
+        if out is not None:
+            assert yaml.safe_load(out) == after, f"{text!r} -> {out!r}"
+
+
+def test_store_set_preserves_comments(tmp_path):
+    p = tmp_path / "clawker.yaml"
+    p.write_text(DOC)
+    store = Store([Layer("project", p)])
+    store.set("build.stack", "rust")
+    text = p.read_text()
+    assert "# which language stack to bake" in text
+    assert "stack: rust" in text
+    assert store.get("build.stack") == "rust"
+
+
+def test_store_unset_preserves_comments(tmp_path):
+    p = tmp_path / "clawker.yaml"
+    p.write_text(DOC)
+    store = Store([Layer("project", p)])
+    store.unset("workspace.mode")
+    text = p.read_text()
+    assert "# clawker project configuration" in text
+    assert "mode: bind" not in text
+
+
+def test_store_fallback_still_correct(tmp_path):
+    """A list-interior write loses comments but never data."""
+    p = tmp_path / "clawker.yaml"
+    p.write_text(DOC)
+    store = Store([Layer("project", p)])
+    store.set("security.egress", [{"dst": "x.com", "proto": "https"}])
+    assert store.get("security.egress")[0]["dst"] == "x.com"
+    assert yaml.safe_load(p.read_text())["project"] == "demo"
