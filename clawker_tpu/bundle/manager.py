@@ -176,6 +176,90 @@ class BundleManager:
             raise NotFoundError(f"bundle {namespace}/{name} not installed")
         shutil.rmtree(dest)
 
+    # ----------------------------------------------------------------- gc
+
+    def _referenced_components(self) -> set[str]:
+        """Component names any registered project declares (build.harness
+        / build.stack); floor defaults are implicitly live everywhere."""
+        from ..config import load_config
+        from ..errors import ClawkerError
+        from ..project.manager import ProjectManager
+
+        refs: set[str] = set()
+        try:
+            projects = ProjectManager(self.cfg).list_projects()
+        except ClawkerError:
+            return refs
+        for rec in projects:
+            try:
+                pcfg = load_config(Path(rec.root))
+            except (ClawkerError, OSError):
+                continue
+            if pcfg.project is None:
+                continue
+            # unset fields resolve to the build defaults (bundler/build.py)
+            # -- an installed bundle shadowing "python"/"claude" is live
+            refs.add(pcfg.project.build.harness or "claude")
+            refs.add(pcfg.project.build.stack or "python")
+        return refs
+
+    def gc(self, *, apply: bool = False,
+           grace_s: float = 7 * 86400) -> dict:
+        """Prune installed bundles (reference internal/bundle/gc.go):
+
+        - crashed-swap leftovers (``.X.installing`` / ``.X.old``) always
+          qualify;
+        - an install older than ``grace_s`` whose components no
+          registered project declares qualifies as unreferenced.
+
+        Dry-run by default: ``apply=True`` deletes.  Returns the report
+        {"leftovers", "unreferenced", "removed"}.
+        """
+        refs = self._referenced_components()
+        leftovers: list[Path] = []
+        unreferenced: list[InstalledBundle] = []
+        root = self.cfg.bundles_dir
+        if root.is_dir():
+            for ns in sorted(root.iterdir()):
+                if not ns.is_dir():
+                    continue
+                for b in sorted(ns.iterdir()):
+                    if b.is_dir() and b.name.startswith("."):
+                        leftovers.append(b)
+        now = time.time()
+        for inst in self.list_installed():
+            # a lost/corrupt receipt must not bypass the grace period:
+            # fall back to the install dir's mtime
+            installed_at = inst.installed_at
+            if not installed_at:
+                try:
+                    installed_at = inst.path.stat().st_mtime
+                except OSError:
+                    installed_at = now
+            if now - installed_at < grace_s:
+                continue
+            if inst.components.get("monitoring"):
+                # monitoring units are host-global (discovered by monitor
+                # render, not declared per-project): never unreferenced
+                continue
+            provided = {n for names in inst.components.values() for n in names}
+            if provided and provided & refs:
+                continue
+            unreferenced.append(inst)
+        removed: list[str] = []
+        if apply:
+            for path in leftovers:
+                shutil.rmtree(path, ignore_errors=True)
+                removed.append(str(path))
+            for inst in unreferenced:
+                shutil.rmtree(inst.path, ignore_errors=True)
+                removed.append(f"{inst.namespace}/{inst.name}")
+        return {
+            "leftovers": [str(p) for p in leftovers],
+            "unreferenced": [f"{i.namespace}/{i.name}" for i in unreferenced],
+            "removed": removed,
+        }
+
     # ----------------------------------------------------------- validate
 
     def validate_tree(self, root: Path) -> list[str]:

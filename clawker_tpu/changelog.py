@@ -1,0 +1,54 @@
+"""Changelog teaser: one-line what's-new shown once per version.
+
+Reference: internal/changelog (release-notes teaser after the update
+check, SURVEY.md 2.4).  Zero-egress design: the teaser reads the
+CHANGELOG.md shipped with the package, and the state store remembers the
+last version whose entry was shown -- each upgrade surfaces its top
+entry exactly once, then stays quiet.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from . import __version__
+from .state import StateStore
+
+_HEADING = re.compile(r"^##\s*\[?v?(?P<ver>\d+[^\]\s]*)\]?")
+
+CHANGELOG_PATH = Path(__file__).parent.parent / "CHANGELOG.md"
+
+
+def parse_changelog(text: str) -> list[tuple[str, list[str]]]:
+    """[(version, entry_lines)] in file order (newest first by
+    convention)."""
+    out: list[tuple[str, list[str]]] = []
+    current: list[str] | None = None
+    for line in text.splitlines():
+        m = _HEADING.match(line)
+        if m:
+            current = []
+            out.append((m.group("ver"), current))
+        elif current is not None and line.strip():
+            current.append(line.strip())
+    return out
+
+
+def teaser(*, state: StateStore | None = None,
+           path: Path | None = None, version: str = __version__) -> str:
+    """One line about the RUNNING version's entry, shown once."""
+    state = state or StateStore()
+    if state.get("changelog_seen") == version:
+        return ""
+    path = path or CHANGELOG_PATH
+    try:
+        entries = parse_changelog(path.read_text(encoding="utf-8"))
+    except OSError:
+        return ""
+    lines = next((body for ver, body in entries if ver == version), None)
+    state.set("changelog_seen", version)  # quiet even when no entry exists
+    if not lines:
+        return ""
+    first = lines[0].lstrip("-* ").strip()
+    return f"what's new in {version}: {first}"

@@ -67,5 +67,28 @@ def bundle_remove(f: Factory, spec):
     click.echo(f"removed {ns}/{name}")
 
 
+@bundle_group.command("prune")
+@click.option("--apply", is_flag=True,
+              help="Actually delete (default: dry-run report).")
+@click.option("--grace-days", type=float, default=7.0, show_default=True,
+              help="Installs younger than this never qualify.")
+@pass_factory
+def bundle_prune(f: Factory, apply, grace_days):
+    """GC installed bundles: crashed-swap leftovers + installs no
+    registered project references (reference internal/bundle/gc.go)."""
+    report = BundleManager(f.config).gc(apply=apply,
+                                        grace_s=grace_days * 86400)
+    for p in report["leftovers"]:
+        click.echo(f"leftover\t{p}")
+    for b in report["unreferenced"]:
+        click.echo(f"unreferenced\t{b}")
+    if apply:
+        click.echo(f"removed {len(report['removed'])}")
+    elif report["leftovers"] or report["unreferenced"]:
+        click.echo("dry-run: pass --apply to delete")
+    else:
+        click.echo("nothing to prune")
+
+
 def register(root: click.Group) -> None:
     root.add_command(bundle_group)

@@ -36,6 +36,10 @@ def _resolve_ref(f: Factory, name_or_agent: str) -> str:
 @click.option("--agent", "-a", default=None, help="Agent name (default: project config).")
 @click.option("--image", default="@", show_default=True, help="Image ('@' = project image).")
 @click.option("--env", "-e", multiple=True, help="KEY=VALUE (repeatable).")
+@click.option("--env-file", "env_files", multiple=True,
+              type=click.Path(exists=True),
+              help="Read KEY=VALUE pairs from a dotenv file (repeatable; "
+                   "--env wins on conflicts).")
 @click.option("--workspace", type=click.Choice(["bind", "snapshot"]), default=None)
 @click.option("--replace", is_flag=True, help="Replace an existing agent container.")
 @click.option("--detach", "-d", is_flag=True, help="Start without attaching.")
@@ -43,11 +47,12 @@ def _resolve_ref(f: Factory, name_or_agent: str) -> str:
 @click.option("--worktree", default="", help="Run in the named git worktree.")
 @click.argument("cmd", nargs=-1)
 @pass_factory
-def run_cmd(f: Factory, agent, image, env, workspace, replace, detach, no_tty, worktree, cmd):
+def run_cmd(f: Factory, agent, image, env, env_files, workspace, replace,
+            detach, no_tty, worktree, cmd):
     """Create an agent container and attach to it (create+start+attach)."""
     cfg = f.config
     agent = agent or (cfg.project.agent.default if cfg.project else "dev")
-    envd = dict(e.split("=", 1) if "=" in e else (e, "") for e in env)
+    envd = _assemble_env(env, env_files)
     opts = CreateOptions(
         agent=agent,
         image=image,
@@ -85,18 +90,31 @@ def container_group():
     """Manage agent containers."""
 
 
+def _assemble_env(env: tuple, env_files: tuple) -> dict[str, str]:
+    """dotenv files first (in order), explicit --env pairs win."""
+    from ..util.dotenv import parse_file
+
+    out: dict[str, str] = {}
+    for path in env_files:
+        out.update(parse_file(path))
+    out.update(dict(e.split("=", 1) if "=" in e else (e, "") for e in env))
+    return out
+
+
 @container_group.command("create")
 @click.option("--agent", "-a", default=None)
 @click.option("--image", default="@")
 @click.option("--env", "-e", multiple=True)
+@click.option("--env-file", "env_files", multiple=True,
+              type=click.Path(exists=True))
 @click.option("--replace", is_flag=True)
 @click.argument("cmd", nargs=-1)
 @pass_factory
-def create_cmd(f: Factory, agent, image, env, replace, cmd):
+def create_cmd(f: Factory, agent, image, env, env_files, replace, cmd):
     """Create an agent container without starting it."""
     cfg = f.config
     agent = agent or (cfg.project.agent.default if cfg.project else "dev")
-    envd = dict(e.split("=", 1) if "=" in e else (e, "") for e in env)
+    envd = _assemble_env(env, env_files)
     f.runtime().create(
         CreateOptions(agent=agent, image=image, cmd=list(cmd), env=envd, replace=replace)
     )

@@ -50,6 +50,11 @@ def gen_docs(out):
 
     written = generate_cli_reference(root_cli, Path(out))
     click.echo(f"wrote {len(written)} pages under {out}")
+    from ..docs import generate_json_schemas
+
+    schemas = generate_json_schemas(Path(out).parent / "schemas")
+    click.echo(f"wrote {len(schemas)} JSON schemas under "
+               f"{Path(out).parent / 'schemas'}")
 
 
 def register(cli: click.Group) -> None:

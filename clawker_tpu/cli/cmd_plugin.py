@@ -1,0 +1,83 @@
+"""plugin verbs (alias: skill): manage agent skills across harnesses.
+
+Parity reference: internal/cmd/plugin -- NewCmdPlugin (alias skill),
+install/show/remove lanes (SURVEY.md 2.4 command groups).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import click
+
+from .factory import Factory
+
+pass_factory = click.make_pass_decorator(Factory)
+
+
+@click.group("plugin")
+def plugin_group():
+    """Manage the agent-skills plugin across host harnesses."""
+
+
+@plugin_group.command("install")
+@click.option("--source", required=True, type=click.Path(exists=True),
+              help="Plugin source directory (skills tree or bundle).")
+@click.option("--harness", default="claude", show_default=True)
+@pass_factory
+def plugin_install(f: Factory, source, harness):
+    """Copy the source's skills into the harness skills directory."""
+    from ..plugin import install
+
+    names = install(Path(source), harness=harness)
+    for n in names:
+        click.echo(f"installed {n}")
+
+
+@plugin_group.command("remove")
+@click.option("--source", required=True, type=click.Path(exists=True),
+              help="Plugin source (enumerates which skills to delete).")
+@click.option("--harness", default="claude", show_default=True)
+@click.option("--yes", "-y", is_flag=True)
+@pass_factory
+def plugin_remove(f: Factory, source, harness, yes):
+    """Remove exactly the skills the source provides."""
+    from ..plugin import remove
+
+    if not f.confirm_destructive(
+            f"Remove this source's skills from the {harness} harness?",
+            skip=yes):
+        raise SystemExit(1)
+    for n in remove(Path(source), harness=harness):
+        click.echo(f"removed {n}")
+
+
+@plugin_group.command("show")
+@click.option("--harness", default="claude", show_default=True)
+@pass_factory
+def plugin_show(f: Factory, harness):
+    """Print the manual install commands for a harness."""
+    from ..plugin import show
+
+    click.echo(show(harness))
+
+
+@plugin_group.command("list")
+@click.option("--harness", default="claude", show_default=True)
+@pass_factory
+def plugin_list(f: Factory, harness):
+    """List skills currently installed for a harness."""
+    from ..plugin import discover_skills, skills_dir
+
+    root = skills_dir(harness)
+    if not root.is_dir():
+        click.echo(f"no skills directory at {root}")
+        return
+    for s in discover_skills(root):
+        click.echo(f"{s.name}\t{s.description}")
+
+
+def register(cli: click.Group) -> None:
+    cli.add_command(plugin_group)
+    # reference alias: `clawker skill` == `clawker plugin`
+    cli.add_command(plugin_group, "skill")

@@ -44,6 +44,20 @@ def project_list(f: Factory, fmt):
         click.echo(f"{p.name}\t{p.root}\t{len(p.worktrees)} worktrees")
 
 
+@project_group.command("edit")
+@pass_factory
+def project_edit(f: Factory):
+    """Interactively browse + edit project config fields (reference
+    internal/config/storeui/project)."""
+    from ..storeui import EditError, run_editor
+
+    store = f.config.project_store_ref
+    if store is None:
+        raise EditError("no project config found (run `clawker init` first)")
+    n = run_editor(store, f.streams)
+    click.echo(f"{n} field(s) changed")
+
+
 @project_group.command("remove")
 @click.argument("name")
 @click.option("--yes", "-y", is_flag=True, help="Skip the confirmation prompt.")

@@ -70,6 +70,17 @@ def settings_get(f: Factory, path):
     click.echo(json.dumps(val) if not isinstance(val, str) else val)
 
 
+@settings_group.command("edit")
+@pass_factory
+def settings_edit(f: Factory):
+    """Interactively browse + edit settings fields (reflection-driven,
+    reference internal/storeui)."""
+    from ..storeui import run_editor
+
+    n = run_editor(f.config.settings_store_ref, f.streams)
+    click.echo(f"{n} field(s) changed")
+
+
 @settings_group.command("set")
 @click.argument("path")
 @click.argument("value")

@@ -59,9 +59,50 @@ def cli(ctx: click.Context, verbose: bool) -> None:
         ctx.obj = Factory()
 
 
+def _start_notices() -> "object | None":
+    """Kick off the update check + changelog teaser CONCURRENTLY with the
+    command (reference: internal/clawker cmd.go:79-120 background
+    notification goroutines).  Returns the thread, or None when notices
+    are disabled.  The probe must never delay the user: the collector at
+    command end waits at most a beat, and a missed fetch just retries on
+    a later run (the TTL cache absorbs the cost)."""
+    import os
+    import sys
+    import threading
+
+    if not sys.stderr.isatty() or os.environ.get("CLAWKER_TPU_NO_NOTICES"):
+        return None
+    lines: list[str] = []
+
+    def probe() -> None:
+        try:
+            from ..changelog import teaser
+            from ..state import check_for_update
+
+            lines.extend(l for l in (check_for_update(), teaser()) if l)
+        except Exception:  # noqa: BLE001 - notices never break a command
+            pass
+
+    t = threading.Thread(target=probe, name="notices", daemon=True)
+    t.lines = lines  # type: ignore[attr-defined]
+    t.start()
+    return t
+
+
+def _finish_notices(t) -> None:
+    if t is None:
+        return
+    t.join(0.3)
+    if not t.is_alive():
+        for line in t.lines:
+            click.echo(line, err=True)
+
+
 def main(argv: list[str] | None = None) -> int:
+    notices = _start_notices()
     try:
         cli.main(args=argv, standalone_mode=False)
+        _finish_notices(notices)
         return 0
     except click.exceptions.Exit as e:
         return e.exit_code
@@ -100,6 +141,7 @@ def register_commands() -> None:
         cmd_loop,
         cmd_monitor,
         cmd_network,
+        cmd_plugin,
         cmd_project,
         cmd_settings,
         cmd_volume,
@@ -118,6 +160,7 @@ def register_commands() -> None:
     cmd_monitor.register(cli)
     cmd_network.register(cli)
     cmd_project.register(cli)
+    cmd_plugin.register(cli)
     cmd_settings.register(cli)
     cmd_volume.register(cli)
 

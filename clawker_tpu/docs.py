@@ -69,3 +69,66 @@ def generate_cli_reference(root: click.Group, out_dir: Path) -> list[Path]:
     (out_dir / "README.md").write_text("\n".join(index) + "\n")
     written.append(out_dir / "README.md")
     return written
+
+
+# --------------------------------------------------------------- schemas
+
+def _schema_for(ft, descriptions: dict | None = None) -> dict:
+    """Dataclass/typing tree -> JSON Schema fragment."""
+    import dataclasses
+    from typing import get_args, get_origin, get_type_hints
+
+    origin = get_origin(ft)
+    if dataclasses.is_dataclass(ft):
+        hints = get_type_hints(ft)
+        props = {}
+        for f in dataclasses.fields(ft):
+            sub = _schema_for(hints[f.name])
+            if f.default is not dataclasses.MISSING:
+                sub["default"] = f.default
+            props[f.name] = sub
+        out = {"type": "object", "properties": props,
+               "additionalProperties": False}
+        doc = (ft.__doc__ or "").strip().split("\n")[0]
+        if doc:
+            out["description"] = doc
+        return out
+    if origin is list:
+        (elem,) = get_args(ft)
+        return {"type": "array", "items": _schema_for(elem)}
+    if origin is dict:
+        _, vt = get_args(ft)
+        return {"type": "object", "additionalProperties": _schema_for(vt)}
+    if ft is str:
+        return {"type": "string"}
+    if ft is bool:
+        return {"type": "boolean"}
+    if ft is int:
+        return {"type": "integer"}
+    if ft is float:
+        return {"type": "number"}
+    return {}
+
+
+def generate_json_schemas(out_dir: Path) -> list[Path]:
+    """Editor schemas for clawker.yaml + settings.yaml (reference:
+    internal/docs JSON schema gen -> docs/schemas/*.json)."""
+    import json
+
+    from .config.schema import ProjectConfig, Settings
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    written = []
+    for name, cls in (("clawker.yaml", ProjectConfig),
+                      ("settings.yaml", Settings)):
+        schema = {
+            "$schema": "http://json-schema.org/draft-07/schema#",
+            "$id": f"https://clawker-tpu.dev/schemas/{name}.json",
+            "title": name,
+            **_schema_for(cls),
+        }
+        path = out_dir / f"{name.replace('.yaml', '')}.schema.json"
+        path.write_text(json.dumps(schema, indent=2, sort_keys=True) + "\n")
+        written.append(path)
+    return written

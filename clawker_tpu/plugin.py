@@ -1,0 +1,133 @@
+"""Agent-skills plugin management across host harnesses.
+
+The clawker-support plugin is a directory of skills (each skill a
+directory with a SKILL.md).  The claude harness consumes skills from
+``${CLAUDE_CONFIG_DIR:-~/.claude}/skills``; other harnesses declare
+their own native skills directory.  ``install`` copies a plugin
+source's skills into the harness skills dir, ``remove`` deletes exactly
+the skills that source provides, ``show`` prints the manual commands.
+
+Zero-egress adaptation of the reference lanes: the reference fetches
+the marketplace over git (plugin/shared/copy.go FetchPluginSkills);
+here the source is a local directory (an installed bundle, a checkout
+of the marketplace, or any skills tree).  The traversal guard is the
+same contract (ErrSourceTraversal): a skill name that escapes the
+skills dir is refused.
+
+Reference: internal/cmd/plugin (install/show/remove, shared/copy.go).
+"""
+
+from __future__ import annotations
+
+import shutil
+from dataclasses import dataclass
+from pathlib import Path
+
+from .containerfs import expand_host_path
+from .errors import ClawkerError
+
+# harness -> native skills directory (host side)
+HARNESS_SKILLS_DIRS = {
+    "claude": "${CLAUDE_CONFIG_DIR:-~/.claude}/skills",
+    "codex": "${CODEX_HOME:-~/.codex}/skills",
+}
+
+
+class PluginError(ClawkerError):
+    pass
+
+
+@dataclass
+class Skill:
+    name: str
+    path: Path
+    description: str = ""
+
+
+def skills_dir(harness: str) -> Path:
+    spec = HARNESS_SKILLS_DIRS.get(harness)
+    if spec is None:
+        raise PluginError(
+            f"harness {harness!r} has no skills lane (want one of "
+            f"{sorted(HARNESS_SKILLS_DIRS)})")
+    return Path(expand_host_path(spec))
+
+
+def discover_skills(source: Path) -> list[Skill]:
+    """Skills in a plugin source: every dir holding a SKILL.md (either
+    at the source root or under a ``skills/`` subdir)."""
+    source = Path(source)
+    roots = [source / "skills", source]
+    for root in roots:
+        if not root.is_dir():
+            continue
+        found = []
+        for entry in sorted(root.iterdir()):
+            if entry.is_dir() and (entry / "SKILL.md").is_file():
+                head = (entry / "SKILL.md").read_text(
+                    encoding="utf-8", errors="replace").strip().splitlines()
+                desc = head[0].lstrip("# ").strip() if head else ""
+                found.append(Skill(name=entry.name, path=entry,
+                                   description=desc))
+        if found:
+            return found
+    return []
+
+
+def _guard(dest_root: Path, name: str) -> Path:
+    """The traversal guard: a skill name must resolve INSIDE the skills
+    dir (reference ErrSourceTraversal)."""
+    dest = (dest_root / name).resolve()
+    if dest_root.resolve() not in dest.parents:
+        raise PluginError(
+            f"skill name {name!r} escapes the skills directory")
+    return dest
+
+
+def install(source: Path, *, harness: str = "claude") -> list[str]:
+    skills = discover_skills(source)
+    if not skills:
+        raise PluginError(f"{source}: no skills found (dirs with SKILL.md)")
+    dest_root = skills_dir(harness)
+    dest_root.mkdir(parents=True, exist_ok=True)
+    installed = []
+    for skill in skills:
+        dest = _guard(dest_root, skill.name)
+        src = skill.path.resolve()
+        if src == dest or dest in src.parents or src == dest_root.resolve():
+            # installing the skills dir onto itself would rmtree the
+            # source before copying it -- permanent skill loss
+            raise PluginError(
+                f"source {skill.path} is already inside the {harness} "
+                "skills directory; nothing to install")
+        if dest.exists():
+            shutil.rmtree(dest)
+        shutil.copytree(src, dest,
+                        ignore=shutil.ignore_patterns(".git"))
+        installed.append(skill.name)
+    return installed
+
+
+def remove(source: Path, *, harness: str = "claude") -> list[str]:
+    """Delete exactly the skills the source provides (enumerate first,
+    like the reference's fetch-to-enumerate remove lane)."""
+    skills = discover_skills(source)
+    if not skills:
+        raise PluginError(f"{source}: no skills found to enumerate removal")
+    dest_root = skills_dir(harness)
+    removed = []
+    for skill in skills:
+        dest = _guard(dest_root, skill.name)
+        if dest.is_dir():
+            shutil.rmtree(dest)
+            removed.append(skill.name)
+    return removed
+
+
+def show(harness: str = "claude") -> str:
+    """Manual install commands per harness (reference show lane)."""
+    if harness == "claude":
+        return ("claude plugin marketplace add <marketplace>\n"
+                "claude plugin install clawker-support")
+    return (f"copy each skill directory into {HARNESS_SKILLS_DIRS.get(harness, '?')}"
+            f" (clawker plugin install --source <dir> --harness {harness})")

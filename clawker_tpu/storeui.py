@@ -1,0 +1,177 @@
+"""Reflection-driven store field editor: ``settings edit`` / ``project edit``.
+
+Walks the store's typed schema (dataclass tree) into a flat list of
+dotted fields with current values and provenance, then drives an
+interactive select -> edit -> save loop over the Prompter.  Writes are
+provenance-routed through the Store (so they land in the layer that owns
+the key -- or an explicitly chosen layer) and ride the comment-preserving
+YAML editor.
+
+Parity reference: internal/storeui + internal/config/storeui
+(reflection-driven TUI editing of Store[T] fields with per-layer save
+targeting, SURVEY.md 2.4) -- re-derived as a prompter flow instead of a
+BubbleTea browser.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import get_args, get_origin, get_type_hints
+
+from .errors import ClawkerError
+from .storage import Store
+from .ui.iostreams import IOStreams
+from .ui.prompter import Prompter, PromptError
+
+
+class EditError(ClawkerError):
+    pass
+
+
+@dataclass
+class FieldSpec:
+    path: str               # dotted
+    type: type              # leaf python type (str/int/float/bool/list/dict)
+    value: object
+    provenance: str         # layer name(s) the value came from, "" = default
+
+
+def _leaf_type(ft) -> type | None:
+    """Editable leaf type, or None for nested dataclasses."""
+    if dataclasses.is_dataclass(ft):
+        return None
+    origin = get_origin(ft)
+    if origin is list:
+        (elem,) = get_args(ft)
+        return None if dataclasses.is_dataclass(elem) else list
+    if origin is dict:
+        return dict
+    if ft in (str, int, float, bool):
+        return ft
+    return str
+
+
+def field_specs(store: Store) -> list[FieldSpec]:
+    """Flat editable fields from the store's typed view."""
+    typed = store.typed()
+    if typed is None or not dataclasses.is_dataclass(typed):
+        raise EditError("store has no typed schema to edit")
+    out: list[FieldSpec] = []
+
+    def walk(obj, prefix: str) -> None:
+        hints = get_type_hints(type(obj))
+        for f in dataclasses.fields(obj):
+            path = f"{prefix}{f.name}"
+            val = getattr(obj, f.name)
+            leaf = _leaf_type(hints[f.name])
+            if leaf is None and dataclasses.is_dataclass(val):
+                walk(val, path + ".")
+                continue
+            if leaf is None:
+                continue  # list-of-dataclass (egress rules...): dedicated verbs
+            prov = ",".join(store.provenance_of(path))
+            out.append(FieldSpec(path=path, type=leaf, value=val,
+                                 provenance=prov))
+
+    walk(typed, "")
+    return out
+
+
+def coerce(spec: FieldSpec, raw: str):
+    raw = raw.strip()
+    if spec.type is bool:
+        if raw.lower() in ("true", "yes", "y", "1", "on"):
+            return True
+        if raw.lower() in ("false", "no", "n", "0", "off"):
+            return False
+        raise EditError(f"{spec.path}: want true/false, got {raw!r}")
+    if spec.type is int:
+        try:
+            return int(raw)
+        except ValueError:
+            raise EditError(f"{spec.path}: want an integer, got {raw!r}")
+    if spec.type is float:
+        try:
+            return float(raw)
+        except ValueError:
+            raise EditError(f"{spec.path}: want a number, got {raw!r}")
+    if spec.type is list:
+        if raw in ("", "[]"):
+            return []
+        return [x.strip() for x in raw.split(",") if x.strip()]
+    if spec.type is dict:
+        if raw in ("", "{}"):
+            return {}
+        out = {}
+        for pair in raw.split(","):
+            if not pair.strip():
+                continue
+            if "=" not in pair:
+                raise EditError(f"{spec.path}: want K=V[,K=V...], got {raw!r}")
+            k, v = pair.split("=", 1)
+            out[k.strip()] = v.strip()
+        return out
+    return raw
+
+
+def _fmt(val) -> str:
+    """Display form (field listing)."""
+    if isinstance(val, list):
+        return ",".join(map(str, val)) or "[]"
+    if isinstance(val, dict):
+        return ",".join(f"{k}={v}" for k, v in val.items()) or "{}"
+    return repr(val)
+
+
+def _raw(spec: FieldSpec) -> str:
+    """Editable form: MUST round-trip through coerce back to the same
+    value, so accepting the prompt default is a no-op (a repr default
+    would write quote-wrapped strings into the store)."""
+    v = spec.value
+    if spec.type is bool:
+        return "true" if v else "false"
+    if spec.type is list:
+        return ",".join(map(str, v)) if v else "[]"
+    if spec.type is dict:
+        return ",".join(f"{k}={val}" for k, val in v.items()) if v else "{}"
+    return "" if v is None else str(v)
+
+
+def run_editor(store: Store, streams: IOStreams, *,
+               layer: str | None = None,
+               prompter: Prompter | None = None) -> int:
+    """Interactive loop; returns the number of fields changed."""
+    prompter = prompter or Prompter(streams)
+    if not streams.can_prompt():
+        raise EditError(
+            "interactive editor needs a TTY; use `set <path> <value>`")
+    changed = 0
+    while True:
+        specs = field_specs(store)
+        options = [
+            f"{s.path} = {_fmt(s.value)}"
+            + (f"  ({s.provenance})" if s.provenance else "")
+            for s in specs
+        ] + ["done"]
+        try:
+            idx = prompter.select("Edit which field?", options,
+                                  default=len(options) - 1)
+        except PromptError:
+            break
+        if idx >= len(specs):
+            break
+        spec = specs[idx]
+        try:
+            raw = prompter.string(
+                f"{spec.path} ({spec.type.__name__})", default=_raw(spec))
+            value = coerce(spec, raw)
+        except (PromptError, EditError) as e:
+            streams.eprintln(str(e))
+            continue
+        if value == spec.value:
+            continue
+        store.set(spec.path, value, layer=layer)
+        changed += 1
+        streams.eprintln(f"set {spec.path} = {_fmt(value)}")
+    return changed

@@ -1,0 +1,173 @@
+"""Parity odds-and-ends: dotenv, JSON schemas, bundle GC, changelog.
+
+Reference bars: internal/dotenv (godotenv semantics), internal/docs
+(JSON schema gen), internal/bundle/gc.go, internal/changelog.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from clawker_tpu.util.dotenv import DotenvError, parse, parse_file
+
+
+# ------------------------------------------------------------------ dotenv
+
+def test_dotenv_basic_and_comments():
+    env = parse(
+        "# comment\n"
+        "FOO=bar\n"
+        "export BAZ=qux\n"
+        "\n"
+        "TRAILING=value # note\n",
+        lookup=lambda k: None)
+    assert env == {"FOO": "bar", "BAZ": "qux", "TRAILING": "value"}
+
+
+def test_dotenv_quoting():
+    env = parse(
+        'DQ="line1\\nline2 # not a comment"\n'
+        "SQ='literal $FOO \\n'\n"
+        'ESCQ="say \\"hi\\""\n'
+        'PASS="pa\\$\\$wd"\n',
+        lookup=lambda k: None)
+    assert env["DQ"] == "line1\nline2 # not a comment"
+    assert env["SQ"] == "literal $FOO \\n"
+    assert env["ESCQ"] == 'say "hi"'
+    assert env["PASS"] == "pa$$wd"  # \\$ stays literal, never expands
+
+
+def test_dotenv_expansion_prefers_file_then_lookup():
+    env = parse(
+        "A=1\n"
+        "B=${A}2\n"
+        "C=$OUTSIDE/x\n"
+        "D=${MISSING}end\n",
+        lookup={"OUTSIDE": "/ext"}.get)
+    assert env == {"A": "1", "B": "12", "C": "/ext/x", "D": "end"}
+
+
+def test_dotenv_errors():
+    with pytest.raises(DotenvError):
+        parse("not a pair\n")
+    with pytest.raises(DotenvError):
+        parse('X="unterminated\n')
+    with pytest.raises(DotenvError):
+        parse_file("/nonexistent/.env")
+
+
+def test_dotenv_file_and_cli_merge(tmp_path):
+    envf = tmp_path / ".env"
+    envf.write_text("FROM_FILE=1\nSHARED=file\n")
+    from clawker_tpu.cli.cmd_container import _assemble_env
+
+    merged = _assemble_env(("SHARED=cli", "ONLY=x"), (str(envf),))
+    assert merged == {"FROM_FILE": "1", "SHARED": "cli", "ONLY": "x"}
+
+
+# ----------------------------------------------------------------- schemas
+
+def test_json_schemas_cover_config_surface(tmp_path):
+    from clawker_tpu.docs import generate_json_schemas
+
+    written = generate_json_schemas(tmp_path)
+    names = {p.name for p in written}
+    assert names == {"clawker.schema.json", "settings.schema.json"}
+    proj = json.loads((tmp_path / "clawker.schema.json").read_text())
+    assert set(proj["properties"]) >= {"project", "build", "security",
+                                       "workspace", "agent"}
+    egress = (proj["properties"]["security"]["properties"]["egress"])
+    assert egress["type"] == "array"
+    rule = egress["items"]["properties"]
+    assert {"dst", "proto", "port", "action", "path_rules"} <= set(rule)
+    settings = json.loads((tmp_path / "settings.schema.json").read_text())
+    assert "firewall" in settings["properties"]
+    # deterministic regeneration
+    again = generate_json_schemas(tmp_path)
+    assert json.loads(again[0].read_text()) == json.loads(written[0].read_text())
+
+
+# ---------------------------------------------------------------- bundle gc
+
+def make_bundle(root, name="b1"):
+    d = root / "harnesses" / name
+    d.mkdir(parents=True)
+    (d / "harness.yaml").write_text(
+        f"name: {name}\ncmd: [run]\n")
+    return root
+
+
+def test_bundle_gc_dry_run_and_apply(tmp_path):
+    from clawker_tpu.bundle.manager import BundleManager
+    from clawker_tpu.config import load_config
+    from clawker_tpu.testenv import TestEnv
+
+    with TestEnv() as tenv:
+        proj = tenv.base / "p"
+        proj.mkdir()
+        (proj / ".clawker.yaml").write_text("project: gcproj\n")
+        cfg = load_config(proj)
+        mgr = BundleManager(cfg)
+        src = make_bundle(tmp_path / "src", "orphanharness")
+        inst = mgr.install(str(src), name="orphan")
+        # crashed-swap leftover
+        leftover = cfg.bundles_dir / "local" / ".old.installing"
+        leftover.mkdir(parents=True)
+        # young install: protected by grace
+        rep = mgr.gc()
+        assert rep["unreferenced"] == [] and len(rep["leftovers"]) == 1
+        # age it past grace: now unreferenced (no project declares it)
+        rep = mgr.gc(grace_s=0)
+        assert rep["unreferenced"] == ["local/orphan"]
+        assert rep["removed"] == []           # dry-run
+        assert inst.path.is_dir()
+        rep = mgr.gc(apply=True, grace_s=0)
+        assert "local/orphan" in rep["removed"]
+        assert not inst.path.exists()
+        assert not leftover.exists()
+
+
+def test_bundle_gc_keeps_referenced(tmp_path):
+    from clawker_tpu.bundle.manager import BundleManager
+    from clawker_tpu.config import load_config
+    from clawker_tpu.project.manager import ProjectManager
+    from clawker_tpu.testenv import TestEnv
+
+    with TestEnv() as tenv:
+        proj = tenv.base / "p"
+        proj.mkdir()
+        (proj / ".clawker.yaml").write_text(
+            "project: gcproj\nbuild:\n  harness: specialharness\n")
+        cfg = load_config(proj)
+        ProjectManager(cfg).register_current()
+        mgr = BundleManager(cfg)
+        src = make_bundle(tmp_path / "src", "specialharness")
+        mgr.install(str(src), name="keepme")
+        rep = mgr.gc(grace_s=0)
+        assert rep["unreferenced"] == []
+
+
+# --------------------------------------------------------------- changelog
+
+def test_changelog_teaser_shows_once(tmp_path):
+    from clawker_tpu.changelog import parse_changelog, teaser
+    from clawker_tpu.state import StateStore
+
+    log = tmp_path / "CHANGELOG.md"
+    log.write_text(
+        "# Changelog\n\n"
+        "## [0.2.0]\n\n- Future entry\n\n"
+        "## [0.1.0]\n\n- First release: parity scorecard\n- more\n")
+    entries = parse_changelog(log.read_text())
+    assert [v for v, _ in entries] == ["0.2.0", "0.1.0"]
+
+    state = StateStore(tmp_path / "state.json")
+    line = teaser(state=state, path=log, version="0.1.0")
+    assert "what's new in 0.1.0" in line and "First release" in line
+    # second invocation: quiet
+    assert teaser(state=state, path=log, version="0.1.0") == ""
+    # unknown version: quiet, but marks seen
+    assert teaser(state=state, path=log, version="9.9.9") == ""

@@ -1,0 +1,174 @@
+"""Plugin (skills) management + reflection-driven store editor.
+
+Reference bars: internal/cmd/plugin (install/show/remove lanes with the
+ErrSourceTraversal guard), internal/storeui (Store[T] field editing),
+clawker-plugin/ + clawker-test-bundle/ example fixtures.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu.plugin import (
+    PluginError,
+    discover_skills,
+    install,
+    remove,
+    show,
+    skills_dir,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+EXAMPLE_PLUGIN = REPO / "examples" / "clawker-plugin"
+EXAMPLE_BUNDLE = REPO / "examples" / "clawker-test-bundle"
+
+
+# ------------------------------------------------------------------ plugin
+
+def test_example_plugin_discovers_skills():
+    skills = discover_skills(EXAMPLE_PLUGIN)
+    assert [s.name for s in skills] == ["hello-skill"]
+    assert "hello" in skills[0].description
+
+
+def test_install_and_remove_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("CLAUDE_CONFIG_DIR", str(tmp_path / "claude"))
+    installed = install(EXAMPLE_PLUGIN, harness="claude")
+    assert installed == ["hello-skill"]
+    dest = skills_dir("claude") / "hello-skill"
+    assert (dest / "SKILL.md").is_file()
+    removed = remove(EXAMPLE_PLUGIN, harness="claude")
+    assert removed == ["hello-skill"]
+    assert not dest.exists()
+
+
+def test_traversal_guard(tmp_path, monkeypatch):
+    monkeypatch.setenv("CLAUDE_CONFIG_DIR", str(tmp_path / "claude"))
+    evil = tmp_path / "evil-src"
+    (evil / "skills" / "ok").mkdir(parents=True)
+    (evil / "skills" / "ok" / "SKILL.md").write_text("# ok")
+    from clawker_tpu import plugin as plugin_mod
+
+    skills = plugin_mod.discover_skills(evil)
+    skills[0].name = "../../escape"
+    with pytest.raises(PluginError, match="escapes"):
+        plugin_mod._guard(skills_dir("claude"), skills[0].name)
+
+
+def test_install_refuses_source_inside_skills_dir(tmp_path, monkeypatch):
+    """Installing the skills dir onto itself must refuse, not rmtree the
+    source before copying it (permanent skill loss)."""
+    monkeypatch.setenv("CLAUDE_CONFIG_DIR", str(tmp_path / "claude"))
+    install(EXAMPLE_PLUGIN, harness="claude")
+    sd = skills_dir("claude")
+    with pytest.raises(PluginError, match="already inside"):
+        install(sd, harness="claude")
+    assert (sd / "hello-skill" / "SKILL.md").is_file()  # still intact
+
+
+def test_storeui_default_roundtrips():
+    """Accepting the prompt default must be a no-op for every type."""
+    from clawker_tpu.storeui import FieldSpec, _raw, coerce
+
+    for t, v in ((str, "ubuntu:24.04"), (int, 8080), (float, 1.5),
+                 (bool, True), (list, []), (list, ["a", "b"]),
+                 (dict, {}), (dict, {"K": "1"})):
+        spec = FieldSpec("x", t, v, "")
+        assert coerce(spec, _raw(spec)) == v, (t, v)
+
+
+def test_unknown_harness_and_empty_source(tmp_path):
+    with pytest.raises(PluginError, match="no skills lane"):
+        skills_dir("unknown-harness")
+    with pytest.raises(PluginError, match="no skills found"):
+        install(tmp_path)
+    assert "claude plugin install" in show("claude")
+
+
+def test_example_bundle_installs(tmp_path):
+    """The shipped example bundle is a valid installable fixture."""
+    from clawker_tpu.bundle.manager import BundleManager
+    from clawker_tpu.config import load_config
+    from clawker_tpu.testenv import TestEnv
+
+    with TestEnv() as tenv:
+        proj = tenv.base / "p"
+        proj.mkdir()
+        (proj / ".clawker.yaml").write_text("project: exproj\n")
+        cfg = load_config(proj)
+        inst = BundleManager(cfg).install(str(EXAMPLE_BUNDLE),
+                                          name="test-bundle")
+        assert inst.components["harness"] == ["echo"]
+        assert inst.components["stack"] == ["minimal"]
+        assert inst.components["monitoring"] == ["echo-unit"]
+        # its monitoring unit passes the unit validator
+        from clawker_tpu.monitor.unit import load_unit
+
+        unit = load_unit("echo-unit",
+                         inst.path / "monitoring" / "echo-unit")
+        assert [l.index for l in unit.manifest.logs] == ["echo-harness"]
+
+
+# ----------------------------------------------------------------- storeui
+
+def make_settings_store(tmp_path):
+    from clawker_tpu.config.config import settings_store
+
+    cfgdir = tmp_path / "config"
+    cfgdir.mkdir(parents=True, exist_ok=True)
+    return settings_store(cfgdir)
+
+
+def test_field_specs_flatten_with_provenance(tmp_path):
+    from clawker_tpu.storeui import field_specs
+
+    store = make_settings_store(tmp_path)
+    store.set("firewall.enable", True)
+    specs = {s.path: s for s in field_specs(store)}
+    assert "firewall.enable" in specs
+    assert specs["firewall.enable"].value is True
+    assert specs["firewall.enable"].provenance  # written layer shows
+    assert specs["monitoring.opensearch_port"].type is int
+
+
+def test_coerce_types():
+    from clawker_tpu.storeui import EditError, FieldSpec, coerce
+
+    assert coerce(FieldSpec("x", bool, False, ""), "yes") is True
+    assert coerce(FieldSpec("x", int, 0, ""), "8080") == 8080
+    assert coerce(FieldSpec("x", list, [], ""), "a, b") == ["a", "b"]
+    assert coerce(FieldSpec("x", dict, {}, ""), "K=1,L=2") == {"K": "1", "L": "2"}
+    with pytest.raises(EditError):
+        coerce(FieldSpec("x", bool, False, ""), "maybe")
+    with pytest.raises(EditError):
+        coerce(FieldSpec("x", int, 0, ""), "NaNish")
+
+
+def test_run_editor_drives_store(tmp_path):
+    """Scripted TTY session: pick a field, type a value, done."""
+    from clawker_tpu.storeui import field_specs, run_editor
+    from clawker_tpu.ui.iostreams import IOStreams
+
+    store = make_settings_store(tmp_path)
+    specs = field_specs(store)
+    idx = next(i for i, s in enumerate(specs)
+               if s.path == "firewall.enable") + 1
+    streams, fin, fout, ferr = IOStreams.test(
+        stdin_data=f"{idx}\ntrue\n\n")
+    for stream in (streams.stdin, streams.stdout, streams.stderr):
+        stream.isatty = lambda: True  # force the TTY probes
+    changed = run_editor(store, streams)
+    assert changed == 1
+    assert store.get("firewall.enable") is True
+
+
+def test_run_editor_refuses_without_tty(tmp_path):
+    from clawker_tpu.storeui import EditError, run_editor
+    from clawker_tpu.ui.iostreams import IOStreams
+
+    store = make_settings_store(tmp_path)
+    streams, *_ = IOStreams.test()
+    with pytest.raises(EditError, match="TTY"):
+        run_editor(store, streams)
