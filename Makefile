@@ -3,8 +3,8 @@
 
 PY ?= python
 
-.PHONY: all test test-fast bench native ebpf-check docs docs-check \
-        adversarial graft clean
+.PHONY: all test test-fast test-e2e parity bench native ebpf-check \
+        docs docs-check adversarial graft clean
 
 all: native test
 
@@ -13,6 +13,14 @@ test:
 
 test-fast:
 	$(PY) -m pytest tests/ -q -x -m "not slow"
+
+# Real-daemon e2e (reference test/e2e): needs a running dockerd.
+test-e2e:
+	CLAWKER_TPU_E2E=1 $(PY) -m pytest tests/e2e -q
+
+# The 22-scenario + 30-technique firewall parity scorecard.
+parity:
+	$(PY) -m clawker_tpu.parity
 
 bench:
 	$(PY) bench.py

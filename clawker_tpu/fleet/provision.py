@@ -151,6 +151,15 @@ def build_plan(*, with_firewall: bool = True, with_cp: bool = True) -> list[Step
                  "&& exit 0; sleep 1; done; exit 1",
                  timeout=60.0),
         ]
+    # real-daemon smoke: the worker carries dockerd, so the e2e suite
+    # (tests/e2e, reference test/e2e harness) actually runs here -- the
+    # one place a real daemon exists in the fleet
+    steps.append(Step(
+        "e2e-smoke",
+        f"cd {REMOTE_ROOT}/src && CLAWKER_TPU_E2E=1 "
+        "python3 -m pytest tests/e2e -q",
+        optional=True, timeout=300.0,
+    ))
     return steps
 
 
@@ -168,6 +177,10 @@ def payload_tar(repo_root: Path, *, monitor: bool = False) -> bytes:
         tf.add(str(repo_root / "clawker_tpu"), arcname="src/clawker_tpu",
                filter=_clean)
         tf.add(str(repo_root / "native"), arcname="src/native", filter=_clean)
+        e2e = repo_root / "tests" / "e2e"
+        if e2e.is_dir():
+            # the worker is where a real daemon lives: ship the e2e suite
+            tf.add(str(e2e), arcname="src/tests/e2e", filter=_clean)
         unit = systemd_unit(monitor=monitor).encode()
         ti = tarfile.TarInfo("clawker-cp.service")
         ti.size = len(unit)

@@ -1,0 +1,120 @@
+"""Real-daemon e2e harness: isolated-XDG CLI subprocess factory.
+
+Parity reference: test/e2e/harness (factory.go:95 NewIsolatedFS, Run
+:368, RunInContainer :417, ExecInContainer :425, leak guards
+EnsureNoControlPlane :35 / cleanupTestEnvironment :200) -- the same two
+seams the reference uses: unit tests ride the in-process fake, e2e rides
+ONE real local daemon.
+
+The suite self-gates: it runs only when CLAWKER_TPU_E2E=1 AND a Docker
+socket answers ping, so laptop/CI runs without a daemon skip cleanly
+while provisioned TPU-VM workers (which carry dockerd) exercise the real
+path.  Every harness tears its containers down and asserts nothing
+leaked.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from dataclasses import dataclass
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+E2E_ENV = "CLAWKER_TPU_E2E"
+BASE_IMAGE = os.environ.get("CLAWKER_TPU_E2E_IMAGE", "busybox:latest")
+
+
+def docker_available() -> bool:
+    if os.environ.get(E2E_ENV) != "1":
+        return False
+    sock = Path(os.environ.get("DOCKER_HOST", "/var/run/docker.sock")
+                .removeprefix("unix://"))
+    if not sock.exists():
+        return False
+    try:
+        from clawker_tpu.engine.drivers.local import LocalDriver
+
+        return LocalDriver().engine().ping()
+    except Exception:  # noqa: BLE001 - any failure = not available
+        return False
+
+
+@dataclass
+class RunResult:
+    code: int
+    stdout: str
+    stderr: str
+
+    @property
+    def ok(self) -> bool:
+        return self.code == 0
+
+
+class E2E:
+    """One isolated clawker installation against the real local daemon."""
+
+    def __init__(self, project: str = "e2eproj"):
+        self.base = Path(tempfile.mkdtemp(prefix="clawker-e2e-"))
+        self.project = project
+        self.proj_dir = self.base / "proj"
+        self.proj_dir.mkdir()
+        (self.proj_dir / ".clawker.yaml").write_text(
+            f"project: {project}\n")
+        self.env = dict(os.environ)
+        for k in ("CONFIG", "DATA", "STATE", "CACHE"):
+            d = self.base / k.lower()
+            d.mkdir()
+            self.env[f"CLAWKER_TPU_{k}_DIR"] = str(d)
+        self.env["CLAWKER_TPU_DRIVER"] = "local"
+        self.env["CLAWKER_TPU_NO_NOTICES"] = "1"
+        self.env["PYTHONPATH"] = str(REPO)
+
+    def run(self, *argv: str, timeout: float = 120.0,
+            input_text: str = "") -> RunResult:
+        """The clawker CLI as a real subprocess (reference Run :368)."""
+        res = subprocess.run(
+            [sys.executable, "-m", "clawker_tpu", *argv],
+            cwd=self.proj_dir, env=self.env, capture_output=True,
+            text=True, timeout=timeout, input=input_text or None)
+        return RunResult(res.returncode, res.stdout, res.stderr)
+
+    def must(self, *argv: str, **kw) -> RunResult:
+        res = self.run(*argv, **kw)
+        assert res.ok, (f"clawker {' '.join(argv)} failed rc={res.code}\n"
+                        f"stdout: {res.stdout}\nstderr: {res.stderr}")
+        return res
+
+    # --------------------------------------------------------- leak guard
+
+    def managed_containers(self) -> list[dict]:
+        from clawker_tpu.engine.drivers.local import LocalDriver
+
+        eng = LocalDriver().engine()
+        return [c for c in eng.list_containers(all=True)
+                if self.project in (c.get("Names") or [""])[0]]
+
+    def cleanup(self) -> None:
+        """Remove every container this installation created; assert the
+        daemon is clean afterwards (reference cleanupTestEnvironment)."""
+        from clawker_tpu.engine.drivers.local import LocalDriver
+
+        eng = LocalDriver().engine()
+        for c in self.managed_containers():
+            try:
+                eng.remove_container(c["Id"], force=True, volumes=True)
+            except Exception:  # noqa: BLE001
+                pass
+        leaked = self.managed_containers()
+        shutil.rmtree(self.base, ignore_errors=True)
+        assert not leaked, f"containers leaked: {leaked}"
+
+    def __enter__(self) -> "E2E":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.cleanup()

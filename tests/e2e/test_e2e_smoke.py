@@ -1,0 +1,48 @@
+"""Real-daemon smoke suite (reference test/e2e minimum slice).
+
+Gated: runs only with CLAWKER_TPU_E2E=1 + an answering Docker daemon
+(provisioned TPU-VM workers); skips cleanly everywhere else.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from .harness import BASE_IMAGE, E2E, docker_available
+
+pytestmark = pytest.mark.skipif(
+    not docker_available(),
+    reason="real-daemon e2e: set CLAWKER_TPU_E2E=1 with a running dockerd")
+
+
+@pytest.fixture()
+def h():
+    with E2E() as harness:
+        yield harness
+
+
+def test_help_and_ps_empty(h):
+    assert "clawker" in h.must("--help").stdout
+    res = h.must("ps")
+    assert h.project not in res.stdout
+
+
+def test_create_start_logs_stop_rm(h):
+    h.must("container", "create", "--agent", "dev", "--image", BASE_IMAGE,
+           "sh", "-c", "echo e2e-hello; sleep 30")
+    h.must("start", "dev")
+    ps = h.must("ps")
+    assert h.project in ps.stdout
+    logs = h.must("logs", "dev")
+    assert "e2e-hello" in logs.stdout + logs.stderr
+    h.must("stop", "dev")
+    h.must("rm", "--force", "dev")
+    assert h.managed_containers() == []
+
+
+def test_attached_run_exit_code_propagates(h):
+    res = h.run("run", "--agent", "ec", "--image", BASE_IMAGE,
+                "--no-tty", "--workspace", "snapshot",
+                "sh", "-c", "exit 7")
+    assert res.code == 7, (res.stdout, res.stderr)
+    h.must("rm", "--force", "ec")

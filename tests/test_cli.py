@@ -90,6 +90,24 @@ def test_init_scaffold(tenv, tmp_path):
     assert res.exit_code != 0  # already exists
 
 
+def test_init_wizard_drives_choices(tenv, tmp_path):
+    """Interactive init runs the wizard: name, stack, harness, mode
+    (reference tui wizard).  Scripted TTY session picks snapshot mode."""
+    from clawker_tpu.cli.cmd_init import _wizard
+
+    from clawker_tpu.ui.iostreams import IOStreams
+
+    factory = Factory(cwd=tmp_path, driver=FakeDriver())
+    streams, *_ = IOStreams.test(stdin_data="wiz proj\n\n\n2\n")
+    for s in (streams.stdin, streams.stdout, streams.stderr):
+        s.isatty = lambda: True  # isolated buffers, never real stdio
+    factory.__dict__["streams"] = streams  # pre-seed the cached property
+    name, stack, harness, mode = _wizard(factory, "", "python")
+    assert name == "wiz-proj"
+    assert stack == "python" and harness == "claude"
+    assert mode == "snapshot"
+
+
 def test_volume_ls_after_run(env):
     runner, factory, api, _ = env
     invoke(runner, factory, "run", "--detach")
