@@ -20,6 +20,22 @@ from .daemon import ControlPlaneDaemon, CPConfig
 def main() -> int:
     logsetup.setup(os.environ.get("CLAWKER_TPU_CP_LOG", "info"))
     cfg = load_config()
+    # per-subsystem OTLP lanes (controlplane/otel): the CP's own logs
+    # ship on the clawkercp lane, the netlogger rides the ebpf-egress
+    # lane (SAME lane set, so an mTLS collector's infra certs cover
+    # both); https collectors get per-subsystem client certs.
+    # Best-effort: no collector, no lanes, no failed connects.
+    lanes = {}
+    try:
+        from .otel import build_lanes
+
+        lanes = build_lanes(cfg)
+        if "clawkercp" in lanes:
+            import logging
+
+            logging.getLogger().addHandler(lanes["clawkercp"].handler())
+    except Exception as e:  # noqa: BLE001 - telemetry never blocks boot
+        logsetup.get("cp").warning("otel lanes unavailable: %s", e)
     driver = get_driver(cfg.settings, override=os.environ.get("CLAWKER_TPU_DRIVER", ""))
     cp = cfg.settings.control_plane
     firewall = None
@@ -42,20 +58,15 @@ def main() -> int:
             from ..monitor.netlogger import NetLogger, handler_resolvers
 
             rc, rz = handler_resolvers(firewall)
-            mon = cfg.settings.monitoring
-            # CLAWKER_TPU_OTLP: worker CPs ship through the SSH -R tunnel
-            # on worker loopback (fleet/channels.py binds it; the systemd
-            # unit sets the env only when provisioned with monitoring);
-            # locally the collector listens on loopback directly.
-            otlp = os.environ.get("CLAWKER_TPU_OTLP", "") or (
-                f"http://127.0.0.1:{consts.OTLP_HTTP_PORT}"
-                if mon.enable else "")
+            # the egress stream rides its OWN subsystem lane from the
+            # shared lane set (carries the infra client cert when the
+            # collector terminates mTLS) -- one endpoint policy, one PKI
             netlogger = NetLogger(
                 firewall.maps,
                 out_path=cfg.logs_dir / "ebpf-egress.jsonl",
                 resolve_cgroup=rc,
                 resolve_zone=rz,
-                otlp_endpoint=otlp,
+                lane=lanes.get("ebpf-egress"),
             )
     daemon = ControlPlaneDaemon(
         CPConfig(

@@ -161,6 +161,11 @@ def generate_domain_cert(ca: CA, domain: str) -> CertPair:
     return _issue(ca, names[0], dns_names=names, server=True)
 
 
+def generate_client_cert(ca: CA, common_name: str) -> CertPair:
+    """Client-auth-only leaf (infra subsystems dialing mTLS collectors)."""
+    return _issue(ca, common_name, dns_names=[common_name], client=True)
+
+
 def generate_agent_cert(ca: CA, agent_full_name: str) -> CertPair:
     """Per-agent leaf for the agentd mTLS listener (CN = project.agent)."""
     return _issue(ca, agent_full_name, dns_names=[agent_full_name], server=True, client=True)

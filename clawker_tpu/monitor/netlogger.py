@@ -18,7 +18,6 @@ import json
 import threading
 import time
 from pathlib import Path
-from urllib import request as urlrequest
 
 from .. import logsetup
 from ..firewall.maps import FirewallMaps
@@ -36,6 +35,9 @@ class NetLogger:
         resolve_cgroup=None,          # cgroup_id -> container name ("" unknown)
         resolve_zone=None,            # zone_hash -> apex ("" unknown)
         otlp_endpoint: str = "",      # http://host:4318 -- optional lane
+        lane=None,                    # controlplane.otel.OtlpLane (carries
+        #                               the mTLS material when the
+        #                               collector requires client certs)
         poll_s: float = 1.0,
     ):
         self.maps = maps
@@ -45,6 +47,9 @@ class NetLogger:
         self.otlp_endpoint = otlp_endpoint.rstrip("/")
         self.poll_s = poll_s
         self.emitted = 0
+        self._lane = lane
+        if self._lane is not None:
+            self.otlp_endpoint = self._lane.endpoint
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -80,31 +85,13 @@ class NetLogger:
         return len(records)
 
     def _ship_otlp(self, records: list[dict]) -> None:
-        """OTLP/HTTP logs payload (resource = ebpf-egress service)."""
-        body = json.dumps({
-            "resourceLogs": [{
-                "resource": {"attributes": [{
-                    "key": "service.name",
-                    "value": {"stringValue": "ebpf-egress"},
-                }]},
-                "scopeLogs": [{
-                    "logRecords": [{
-                        "timeUnixNano": str(time.time_ns()),
-                        "severityText": ("WARN" if rec["verdict"] == "DENY"
-                                         else "INFO"),
-                        "body": {"stringValue": json.dumps(rec)},
-                    } for rec in records]
-                }],
-            }]
-        }).encode()
-        req = urlrequest.Request(
-            f"{self.otlp_endpoint}/v1/logs", data=body,
-            headers={"Content-Type": "application/json"}, method="POST",
-        )
-        try:
-            urlrequest.urlopen(req, timeout=5).close()
-        except OSError as e:
-            log.debug("otlp ship failed (collector down?): %s", e)
+        """Ship on the ebpf-egress subsystem lane (controlplane/otel)."""
+        from ..controlplane.otel import OtlpLane
+
+        if self._lane is None:
+            self._lane = OtlpLane(self.otlp_endpoint, "ebpf-egress")
+        self._lane.ship(records, severity_of=lambda rec: (
+            "WARN" if rec.get("verdict") == "DENY" else "INFO"))
 
     # ------------------------------------------------------------ lifecycle
 

@@ -1,0 +1,225 @@
+"""Per-subsystem OTLP lanes over mTLS (reference controlplane/otel +
+otelcerts/infracerts): payload shape, client-cert authentication against
+a real TLS collector requiring client certs, logging-handler batching,
+and netlogger's delegation to the shared lane.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu.controlplane.otel import (
+    OtlpLane,
+    build_lanes,
+    mint_infra_cert,
+    otlp_logs_payload,
+)
+from clawker_tpu.firewall import pki
+
+
+class Collector:
+    """Tiny OTLP/HTTP sink; optionally TLS with REQUIRED client certs."""
+
+    def __init__(self, tmp: Path, *, mtls: bool):
+        self.bodies: list[dict] = []
+        col = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                col.bodies.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.srv = HTTPServer(("127.0.0.1", 0), H)
+        self.scheme = "http"
+        if mtls:
+            ca = pki.ensure_ca(tmp / "pki")
+            pair = pki._issue(ca, "127.0.0.1", dns_names=["localhost"],
+                              server=True)
+            (tmp / "srv.crt").write_bytes(pair.cert_pem)
+            (tmp / "srv.key").write_bytes(pair.key_pem)
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(str(tmp / "srv.crt"), str(tmp / "srv.key"))
+            ctx.verify_mode = ssl.CERT_REQUIRED   # client cert or refuse
+            ctx.load_verify_locations(cadata=ca.cert_pem.decode())
+            self.srv.socket = ctx.wrap_socket(self.srv.socket,
+                                              server_side=True)
+            self.scheme = "https"
+        self.port = self.srv.server_address[1]
+        self.t = threading.Thread(target=self.srv.serve_forever,
+                                  kwargs={"poll_interval": 0.05}, daemon=True)
+        self.t.start()
+
+    @property
+    def endpoint(self) -> str:
+        # the TLS server cert carries the "localhost" SAN
+        host = "localhost" if self.scheme == "https" else "127.0.0.1"
+        return f"{self.scheme}://{host}:{self.port}"
+
+    def stop(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+        self.t.join(2)
+
+
+def test_payload_shape():
+    body = json.loads(otlp_logs_payload(
+        "clawker-dnsgate", [{"qname": "x.com", "verdict": "NXDOMAIN"}],
+        severity_of=lambda r: "WARN"))
+    rl = body["resourceLogs"][0]
+    attrs = {a["key"]: a["value"]["stringValue"]
+             for a in rl["resource"]["attributes"]}
+    assert attrs["service.name"] == "clawker-dnsgate"
+    rec = rl["scopeLogs"][0]["logRecords"][0]
+    assert rec["severityText"] == "WARN"
+    assert "x.com" in rec["body"]["stringValue"]
+
+
+def test_plain_http_lane_ships(tmp_path):
+    col = Collector(tmp_path, mtls=False)
+    try:
+        lane = OtlpLane(col.endpoint, "clawkercp")
+        assert lane.ship([{"message": "boot"}]) is True
+        assert col.bodies and "clawkercp" in json.dumps(col.bodies[0])
+    finally:
+        col.stop()
+
+
+def test_mtls_lane_requires_client_cert(tmp_path):
+    col = Collector(tmp_path, mtls=True)
+    try:
+        cert, key, ca = mint_infra_cert(tmp_path / "pki", "clawkercp")
+        # without a client cert: the collector refuses the handshake
+        bare = OtlpLane(col.endpoint, "clawkercp", ca=ca)
+        assert bare.ship([{"message": "nope"}]) is False
+        assert col.bodies == []
+        # with the per-subsystem infra cert: accepted
+        lane = OtlpLane(col.endpoint, "clawkercp",
+                        client_cert=cert, client_key=key, ca=ca)
+        assert lane.ship([{"message": "hello"}]) is True
+        assert len(col.bodies) == 1
+    finally:
+        col.stop()
+
+
+def test_mint_infra_cert_is_stable(tmp_path):
+    c1 = mint_infra_cert(tmp_path / "pki", "ebpf-egress")
+    c2 = mint_infra_cert(tmp_path / "pki", "ebpf-egress")
+    assert c1 == c2
+    assert c1[0].read_bytes() == c2[0].read_bytes()  # minted once
+    other = mint_infra_cert(tmp_path / "pki", "clawkercp")
+    assert other[0] != c1[0]
+
+
+def _wait(cond, timeout=5.0):
+    import time as _t
+
+    t0 = _t.monotonic()
+    while _t.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        _t.sleep(0.02)
+    return False
+
+
+def test_logging_handler_batches_off_caller_thread(tmp_path):
+    """emit never does network I/O on the logging thread: the batch
+    ships from the handler's pump thread when full, and a sub-batch
+    buffer ships after flush_s on a quiet logger."""
+    col = Collector(tmp_path, mtls=False)
+    try:
+        lane = OtlpLane(col.endpoint, "clawkercp")
+        h = lane.handler(batch=3, flush_s=0.2)
+        logger = logging.getLogger("test.otel.lane")
+        logger.setLevel(logging.INFO)
+        logger.addHandler(h)
+        try:
+            logger.info("one")
+            logger.info("two")
+            logger.info("three")          # batch full -> pump ships
+            assert _wait(lambda: len(col.bodies) == 1)
+            recs = col.bodies[0]["resourceLogs"][0]["scopeLogs"][0]["logRecords"]
+            assert len(recs) == 3
+            logger.info("quiet-period straggler")   # below batch size
+            assert _wait(lambda: len(col.bodies) == 2)  # flush_s timer
+        finally:
+            logger.removeHandler(h)
+            h.close()
+    finally:
+        col.stop()
+
+
+def test_netlogger_accepts_prebuilt_mtls_lane(tmp_path):
+    """The CP hands the netlogger its lane from the shared lane set, so
+    mTLS material covers the egress stream too."""
+    from clawker_tpu.firewall.maps import FakeMaps
+    from clawker_tpu.firewall.model import Action, EgressEvent, Reason
+    from clawker_tpu.monitor.netlogger import NetLogger
+
+    col = Collector(tmp_path, mtls=True)
+    try:
+        cert, key, ca = mint_infra_cert(tmp_path / "pki", "ebpf-egress")
+        lane = OtlpLane(col.endpoint, "ebpf-egress",
+                        client_cert=cert, client_key=key, ca=ca)
+        maps = FakeMaps()
+        nl = NetLogger(maps, out_path=tmp_path / "egress.jsonl", lane=lane)
+        maps.emit_event(EgressEvent(
+            ts_ns=1, cgroup_id=1, dst_ip="1.2.3.4", dst_port=443,
+            zone_hash=0, verdict=Action.DENY, proto=6,
+            reason=Reason.NO_DNS_ENTRY))
+        nl.drain_once()
+        assert col.bodies and "ebpf-egress" in json.dumps(col.bodies[0])
+    finally:
+        col.stop()
+
+
+def test_netlogger_rides_the_lane(tmp_path):
+    from clawker_tpu.firewall.maps import FakeMaps
+    from clawker_tpu.firewall.model import Action, EgressEvent, Reason
+    from clawker_tpu.monitor.netlogger import NetLogger
+
+    col = Collector(tmp_path, mtls=False)
+    try:
+        maps = FakeMaps()
+        nl = NetLogger(maps, out_path=tmp_path / "egress.jsonl",
+                       otlp_endpoint=col.endpoint)
+        maps.emit_event(EgressEvent(
+            ts_ns=1, cgroup_id=1, dst_ip="1.2.3.4", dst_port=443,
+            zone_hash=0, verdict=Action.DENY, proto=6,
+            reason=Reason.NO_DNS_ENTRY))
+        nl.drain_once()
+        assert col.bodies, "netlogger did not ship on the lane"
+        assert "ebpf-egress" in json.dumps(col.bodies[0])
+    finally:
+        col.stop()
+
+
+def test_build_lanes_gating(tmp_path, monkeypatch):
+    from clawker_tpu.config import load_config
+    from clawker_tpu.testenv import TestEnv
+
+    with TestEnv() as tenv:
+        proj = tenv.base / "p"
+        proj.mkdir()
+        (proj / ".clawker.yaml").write_text("project: otelproj\n")
+        cfg = load_config(proj)
+        monkeypatch.delenv("CLAWKER_TPU_OTLP", raising=False)
+        assert build_lanes(cfg) == {}        # no collector, no lanes
+        monkeypatch.setenv("CLAWKER_TPU_OTLP", "http://127.0.0.1:1")
+        lanes = build_lanes(cfg)
+        assert set(lanes) == {"clawkercp", "ebpf-egress", "clawker-dnsgate"}
+        monkeypatch.setenv("CLAWKER_TPU_OTLP", "https://127.0.0.1:1")
+        lanes = build_lanes(cfg)             # https: infra certs minted
+        assert (cfg.data_dir / "pki" / "infra" / "clawkercp.crt").exists()
