@@ -18,10 +18,13 @@ import time
 from dataclasses import dataclass
 from pathlib import Path
 
+from .. import logsetup
 from ..config import Config
 from ..errors import ClawkerError, NotFoundError
 from .model import MANIFESTS, load_component_dir
 from .resolver import KIND_DIRS
+
+log = logsetup.get("bundle.manager")
 
 RECEIPT = ".clawker-bundle-receipt.json"
 
@@ -38,6 +41,7 @@ class InstalledBundle:
     source: str
     installed_at: float
     components: dict[str, list[str]]
+    commit: str = ""       # git sources: the installed revision
 
 
 class BundleManager:
@@ -49,6 +53,9 @@ class BundleManager:
     def install(self, source: str, *, namespace: str = "local", name: str = "") -> InstalledBundle:
         src = Path(source)
         if src.is_dir():
+            # the receipt must survive a cwd change: auto-update re-reads
+            # it from arbitrary working directories later
+            source = str(src.resolve())
             bundle_name = name or src.name
             staged = self._stage_copy(src)
         elif "://" in source or source.endswith(".git") or source.startswith("git@"):
@@ -70,6 +77,9 @@ class BundleManager:
                 "installed_at": time.time(),
                 "components": comps,
             }
+            if getattr(self, "_last_clone_commit", ""):
+                receipt["commit"] = self._last_clone_commit
+                self._last_clone_commit = ""
             (staged / RECEIPT).write_text(json.dumps(receipt, indent=2))
             # land next to dest first (staging may be on another filesystem,
             # making move non-atomic); only then swap out any old install
@@ -110,15 +120,23 @@ class BundleManager:
         shutil.copytree(src, staged, symlinks=False)
         return staged
 
-    def _stage_clone(self, url: str) -> Path:
+    def _stage_clone(self, url: str, *, timeout: float = 120.0) -> Path:
         staged = self._staging_dir() / f"stage-{int(time.time() * 1e6)}"
-        res = subprocess.run(
-            ["git", "clone", "--depth", "1", url, str(staged)],
-            capture_output=True,
-            text=True,
-        )
+        try:
+            res = subprocess.run(
+                ["git", "clone", "--depth", "1", url, str(staged)],
+                capture_output=True,
+                text=True,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            shutil.rmtree(staged, ignore_errors=True)
+            raise BundleError(f"git clone {url}: timed out after {timeout:.0f}s")
         if res.returncode != 0:
             raise BundleError(f"git clone {url} failed: {res.stderr.strip()}")
+        rev = subprocess.run(["git", "-C", str(staged), "rev-parse", "HEAD"],
+                             capture_output=True, text=True, timeout=30)
+        self._last_clone_commit = rev.stdout.strip() if rev.returncode == 0 else ""
         shutil.rmtree(staged / ".git", ignore_errors=True)
         for p in staged.rglob("*"):
             if p.is_symlink():
@@ -166,6 +184,7 @@ class BundleManager:
                         source=receipt.get("source", ""),
                         installed_at=receipt.get("installed_at", 0.0),
                         components=receipt.get("components") or self._scan(b),
+                        commit=receipt.get("commit", ""),
                     )
                 )
         return out
@@ -175,6 +194,70 @@ class BundleManager:
         if not dest.is_dir():
             raise NotFoundError(f"bundle {namespace}/{name} not installed")
         shutil.rmtree(dest)
+
+    # ---------------------------------------------------------- auto-update
+
+    @staticmethod
+    def _tree_hash(root: Path) -> str:
+        import hashlib
+
+        h = hashlib.sha256()
+        for p in sorted(root.rglob("*")):
+            if p.name == RECEIPT or not p.is_file():
+                continue
+            h.update(str(p.relative_to(root)).encode())
+            h.update(p.read_bytes())
+        return h.hexdigest()[:16]
+
+    def auto_update_check(self, *, state=None, ttl_s: float = 86400.0) -> list[str]:
+        """TTL-gated refresh of installed bundles (reference
+        cmdutil.RunBundleAutoUpdate on the run path + bundle
+        AutoUpdateCheck): local-dir sources re-install when their content
+        drifted from the installed copy; git sources re-fetch.  Every
+        failure is a soft skip -- an offline host must still run agents.
+        Returns the ``ns/name`` list that was updated."""
+        from ..state import StateStore
+
+        state = state or StateStore()
+        now = time.time()
+        last = float(state.get("bundle_auto_update") or 0.0)
+        if now - last < ttl_s:
+            return []
+        state.set("bundle_auto_update", now)
+        updated: list[str] = []
+        for inst in self.list_installed():
+            src = inst.source
+            if not src:
+                continue
+            try:
+                if Path(src).is_dir():
+                    if self._tree_hash(Path(src)) == self._tree_hash(inst.path):
+                        continue
+                elif inst.commit:
+                    # git source: cheap drift probe before any clone; an
+                    # unreachable remote (or unchanged HEAD) skips the
+                    # re-install entirely
+                    head = self._ls_remote_head(src)
+                    if not head or head == inst.commit:
+                        continue
+                self.install(src, namespace=inst.namespace, name=inst.name)
+                updated.append(f"{inst.namespace}/{inst.name}")
+            except (BundleError, OSError, subprocess.TimeoutExpired) as e:
+                log.debug("bundle auto-update %s/%s skipped: %s",
+                          inst.namespace, inst.name, e)
+        return updated
+
+    @staticmethod
+    def _ls_remote_head(url: str, *, timeout: float = 10.0) -> str:
+        try:
+            res = subprocess.run(["git", "ls-remote", url, "HEAD"],
+                                 capture_output=True, text=True,
+                                 timeout=timeout)
+        except (OSError, subprocess.TimeoutExpired):
+            return ""
+        if res.returncode != 0 or not res.stdout.strip():
+            return ""
+        return res.stdout.split()[0]
 
     # ----------------------------------------------------------------- gc
 

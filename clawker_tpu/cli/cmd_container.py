@@ -51,6 +51,15 @@ def run_cmd(f: Factory, agent, image, env, env_files, workspace, replace,
             detach, no_tty, worktree, cmd):
     """Create an agent container and attach to it (create+start+attach)."""
     cfg = f.config
+    # TTL-gated bundle refresh before resolving images/harnesses
+    # (reference cmdutil.RunBundleAutoUpdate, run.go:166); soft-fails
+    try:
+        from ..bundle.manager import BundleManager
+
+        for ref in BundleManager(cfg).auto_update_check():
+            click.echo(f"bundle updated: {ref}", err=True)
+    except Exception:  # noqa: BLE001 - never block a run on bundle refresh
+        pass
     agent = agent or (cfg.project.agent.default if cfg.project else "dev")
     envd = _assemble_env(env, env_files)
     opts = CreateOptions(

@@ -58,6 +58,13 @@ def render_otel_config(s, lanes: dict[str, list[str]] | None = None) -> str:
             "http": {"endpoint": "http://opensearch:9200"},
             "logs_index": "clawker-otlp",
         },
+        # harness OTLP traces land in the SS4O traces dataset (reference:
+        # MONITORING-REFERENCE.md:5 -- Claude Code traces -> SS4O
+        # traces/clawker), queryable from the Dashboards Observability UI
+        "opensearch/traces": {
+            "http": {"endpoint": "http://opensearch:9200"},
+            "dataset": "clawker",
+        },
         "prometheus": {"endpoint": "0.0.0.0:8889"},
         "debug": {"verbosity": "basic"},
     }
@@ -66,7 +73,7 @@ def render_otel_config(s, lanes: dict[str, list[str]] | None = None) -> str:
                     "processors": ["transform/metrics", "batch"],
                     "exporters": ["prometheus"]},
         "traces": {"receivers": ["otlp"], "processors": ["batch"],
-                   "exporters": ["debug"]},
+                   "exporters": ["opensearch/traces"]},
     }
     routing_table = []
     for index in sorted(lanes):

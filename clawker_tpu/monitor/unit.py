@@ -45,9 +45,13 @@ _INDEX_RE = re.compile(r"^[a-z0-9][a-z0-9-]{0,62}$")
 _NAME_RE = re.compile(r"^[a-z0-9][a-z0-9-]{0,62}$")
 
 # Base lanes are cluster infrastructure: a unit may not claim them.
+# "default" and "traces" are reserved too: lane exporters are named
+# opensearch/{index} in the collector config, and those two names are
+# the fixed default-logs and spans exporters (monitor/stack.py) -- a
+# lane by either name would silently clobber them.
 RESERVED_INDICES = frozenset({
     "clawker-otlp", "clawker-cli", "clawkercp", "clawker-envoy",
-    "clawker-dnsgate", "clawker-ebpf-egress",
+    "clawker-dnsgate", "clawker-ebpf-egress", "default", "traces",
 })
 
 

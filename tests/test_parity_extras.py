@@ -150,6 +150,41 @@ def test_bundle_gc_keeps_referenced(tmp_path):
         assert rep["unreferenced"] == []
 
 
+def test_bundle_auto_update_refreshes_drifted_source(tmp_path):
+    from clawker_tpu.bundle.manager import BundleManager
+    from clawker_tpu.config import load_config
+    from clawker_tpu.state import StateStore
+    from clawker_tpu.testenv import TestEnv
+
+    with TestEnv() as tenv:
+        proj = tenv.base / "p"
+        proj.mkdir()
+        (proj / ".clawker.yaml").write_text("project: auproj\n")
+        cfg = load_config(proj)
+        mgr = BundleManager(cfg)
+        src = make_bundle(tmp_path / "src", "harn")
+        mgr.install(str(src), name="au")
+        state = StateStore(tmp_path / "state.json")
+        # fresh install, unchanged source: TTL consumed, nothing updated
+        assert mgr.auto_update_check(state=state, ttl_s=0) == []
+        # source drifts: next check re-installs
+        (src / "harnesses" / "harn" / "harness.yaml").write_text(
+            "name: harn\ncmd: [run, --new]\n")
+        assert mgr.auto_update_check(state=state, ttl_s=0) == ["local/au"]
+        installed = cfg.bundles_dir / "local" / "au"
+        assert "--new" in (installed / "harnesses" / "harn"
+                           / "harness.yaml").read_text()
+        # TTL gates: an immediate re-check is a no-op
+        (src / "harnesses" / "harn" / "harness.yaml").write_text(
+            "name: harn\ncmd: [run, --newer]\n")
+        assert mgr.auto_update_check(state=state, ttl_s=9999) == []
+        # a vanished source soft-skips (offline host still runs)
+        import shutil as _sh
+
+        _sh.rmtree(src)
+        assert mgr.auto_update_check(state=state, ttl_s=0) == []
+
+
 # --------------------------------------------------------------- changelog
 
 def test_changelog_teaser_shows_once(tmp_path):
