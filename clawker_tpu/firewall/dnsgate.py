@@ -269,9 +269,12 @@ class DnsGate:
         self.host, self.port = host, port
         self.bound_port = 0
         self.stats = GateStats()
-        self._udp: socketserver.ThreadingUDPServer | None = None
+        self._stats_lock = threading.Lock()
+        self._udp_sock = None
         self._tcp: socketserver.ThreadingTCPServer | None = None
         self._threads: list[threading.Thread] = []
+        self._stop_evt = threading.Event()
+        self._pool = None  # upstream/internal worker pool (start() builds)
 
     def set_policy(self, policy: ZonePolicy) -> None:
         """Atomic zone swap on rule reload (no restart)."""
@@ -280,15 +283,60 @@ class DnsGate:
 
     # ----------------------------------------------------------- serving
 
+    def _udp_loop(self) -> None:
+        """Inline fast path + pooled slow path.
+
+        The previous ThreadingUDPServer spawned a thread PER DATAGRAM
+        (~100us before any work).  Now: parse + policy-match ONCE on the
+        receive thread; queries answerable from pure memory (denied /
+        unknown zones -- notably ALL deny-verdict attack traffic) reply
+        inline at wire speed, while anything that may block (upstream
+        forwards, internal lookups hitting the engine API) rides the
+        pool so one slow resolver or daemon can never stall the deny
+        path.  Per-packet failures are isolated: nothing may kill the
+        sole receive thread."""
+        sock = self._udp_sock
+        while not self._stop_evt.is_set():
+            try:
+                data, addr = sock.recvfrom(8192)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            pool = self._pool
+            try:
+                q = parse_query(data)
+            except DnsWireError:
+                continue
+            except Exception as e:  # noqa: BLE001 - receive thread survives
+                log.error("dnsgate: parse failed: %s", e)
+                continue
+            try:
+                zone = self._match(q)
+                fast = zone is None or zone.deny
+                if fast or pool is None:
+                    self._answer_udp(sock, data, addr, (q, zone))
+                else:
+                    pool.submit(self._answer_udp, sock, data, addr, (q, zone))
+            except RuntimeError:
+                return  # pool torn down mid-drain: we are stopping
+            except Exception as e:  # noqa: BLE001 - isolate per packet
+                log.error("dnsgate: packet handling failed: %s", e)
+
+    def _answer_udp(self, sock, data: bytes, addr, parsed) -> None:
+        try:
+            reply = self.serve_packet(data, _parsed=parsed)
+            if reply:
+                sock.sendto(reply, addr)
+        except OSError:
+            pass
+        except Exception as e:  # noqa: BLE001 - per-request isolation,
+            # like socketserver.handle_error: log and keep serving
+            log.error("dnsgate: serve failed for %s: %s",
+                      parsed[0].qname if parsed else "?", e)
+
     def start(self) -> None:
         gate = self
-
-        class _Udp(socketserver.BaseRequestHandler):
-            def handle(self):
-                data, sock = self.request
-                reply = gate.serve_packet(data)
-                if reply:
-                    sock.sendto(reply, self.client_address)
 
         class _Tcp(socketserver.BaseRequestHandler):
             def handle(self):
@@ -309,38 +357,71 @@ class DnsGate:
                 except OSError:
                     pass
 
-        socketserver.ThreadingUDPServer.allow_reuse_address = True
-        socketserver.ThreadingTCPServer.allow_reuse_address = True
-        self._udp = socketserver.ThreadingUDPServer((self.host, self.port), _Udp)
-        self.bound_port = self._udp.server_address[1]
-        self._tcp = socketserver.ThreadingTCPServer((self.host, self.bound_port), _Tcp)
-        for name, srv in (("dnsgate-udp", self._udp), ("dnsgate-tcp", self._tcp)):
-            # tight poll: stop() should not stall a CP drain for the
-            # default 0.5s-per-server serve_forever poll interval
-            t = threading.Thread(
-                target=srv.serve_forever, kwargs={"poll_interval": 0.05},
-                name=name, daemon=True)
-            t.start()
-            self._threads.append(t)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._stop_evt.clear()
+        # bind-order discipline: everything that can FAIL (UDP bind on a
+        # taken port, the TCP server on the UDP-chosen ephemeral) happens
+        # before anything that must be torn down on failure
+        udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        udp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            udp.bind((self.host, self.port))
+            udp.settimeout(0.1)   # shutdown poll
+            self.bound_port = udp.getsockname()[1]
+            socketserver.ThreadingTCPServer.allow_reuse_address = True
+            self._tcp = socketserver.ThreadingTCPServer(
+                (self.host, self.bound_port), _Tcp)
+        except OSError:
+            udp.close()
+            self.bound_port = 0
+            raise
+        self._udp_sock = udp
+        self._pool = ThreadPoolExecutor(max_workers=8,
+                                        thread_name_prefix="dnsgate-fwd")
+        t = threading.Thread(target=self._udp_loop, name="dnsgate-udp",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        # tight poll: stop() should not stall a CP drain for the default
+        # 0.5s serve_forever poll interval
+        t2 = threading.Thread(
+            target=self._tcp.serve_forever, kwargs={"poll_interval": 0.05},
+            name="dnsgate-tcp", daemon=True)
+        t2.start()
+        self._threads.append(t2)
         log.info("dns gate listening on %s:%d", self.host, self.bound_port)
 
     def stop(self) -> None:
-        for srv in (self._udp, self._tcp):
-            if srv is not None:
-                srv.shutdown()
-                srv.server_close()
+        self._stop_evt.set()
+        if self._udp_sock is not None:
+            try:
+                self._udp_sock.close()
+            except OSError:
+                pass
+            self._udp_sock = None
+        if self._tcp is not None:
+            self._tcp.shutdown()
+            self._tcp.server_close()
+            self._tcp = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
         for t in self._threads:
             t.join(2.0)
         self._threads.clear()
 
     # ------------------------------------------------------------ policy
 
-    def serve_packet(self, data: bytes, *, tcp: bool = False) -> bytes | None:
-        try:
-            q = parse_query(data)
-        except DnsWireError:
-            return None
-        self.stats.queries += 1
+    def _tick(self, field: str, n: int = 1) -> None:
+        # += on an attribute is a non-atomic read-modify-write; counters
+        # are bumped from the receive thread AND pool workers
+        with self._stats_lock:
+            setattr(self.stats, field, getattr(self.stats, field) + n)
+
+    def _match(self, q: "Question") -> Zone | None:
+        """Zone verdict for one parsed question (single-label fallback
+        included)."""
         with self._policy_lock:
             zone = self.policy.match(q.qname)
         if zone is None and "." not in q.qname.strip(".") and (
@@ -353,17 +434,33 @@ class DnsGate:
             # no internal plumbing keep the authoritative NXDOMAIN.
             zone = Zone(apex=q.qname.strip(".").lower(), wildcard=False,
                         internal=True)
+        return zone
+
+    def serve_packet(self, data: bytes, *, tcp: bool = False,
+                     _parsed=None) -> bytes | None:
+        """``_parsed``: (question, zone) when the receive loop already
+        classified the packet -- parse + policy-match run once per
+        datagram, not twice."""
+        if _parsed is not None:
+            q, zone = _parsed
+        else:
+            try:
+                q = parse_query(data)
+            except DnsWireError:
+                return None
+            zone = self._match(q)
+        self._tick("queries")
         if zone is None or zone.deny:
-            self.stats.refused += 1
+            self._tick("refused")
             return synthesize(q, RCODE_NXDOMAIN)
         if q.qtype == QTYPE_AAAA:
             # v4-only data plane (internal zones included): empty answer
             # steers dual-stack clients to A records instead of letting
             # them dial native v6 that connect6 would deny
-            self.stats.allowed += 1
+            self._tick("allowed")
             return synthesize(q, RCODE_NOERROR)
         if zone.internal:
-            self.stats.internal += 1
+            self._tick("internal")
             if self.internal_lookup is not None:
                 if q.qtype != QTYPE_A:
                     # only A is answerable from the container inventory;
@@ -380,7 +477,7 @@ class DnsGate:
                 now = int(time.time())
                 self.maps.cache_dns(
                     ip, DnsEntry(zone_hash=zone.hash, expires_unix=now + TTL_MIN_S))
-                self.stats.cached_ips += 1
+                self._tick("cached_ips")
                 return synthesize_a(q, ip, ttl=TTL_MIN_S)
             if self.internal_resolver is None:
                 return synthesize(q, RCODE_SERVFAIL)
@@ -389,10 +486,10 @@ class DnsGate:
                 return synthesize(q, RCODE_SERVFAIL)
             self._cache_answers(reply, zone)
             return reply
-        self.stats.allowed += 1
+        self._tick("allowed")
         reply = self._forward(data, self.upstreams, tcp=tcp)
         if reply is None:
-            self.stats.upstream_errors += 1
+            self._tick("upstream_errors")
             return synthesize(q, RCODE_SERVFAIL)
         self._cache_answers(reply, zone)
         return reply
@@ -402,7 +499,7 @@ class DnsGate:
         for ip, ttl in parse_a_records(reply):
             ttl = max(TTL_MIN_S, min(TTL_MAX_S, ttl))
             self.maps.cache_dns(ip, DnsEntry(zone_hash=zone.hash, expires_unix=now + ttl))
-            self.stats.cached_ips += 1
+            self._tick("cached_ips")
 
     def _forward(self, data: bytes, resolvers: tuple[str, ...], *, tcp: bool) -> bytes | None:
         for resolver in resolvers:

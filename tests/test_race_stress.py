@@ -1,0 +1,191 @@
+"""Concurrency stress: the Python analogue of the reference's
+`go test -race` tier (SURVEY.md 5 race detection).
+
+CPython has no race detector, so the shared-state surfaces are hammered
+from many threads while invariants are asserted: no exceptions escape,
+counts reconcile, snapshots stay internally consistent, and the
+data-plane swap (rules reload during traffic) never produces a torn
+read.  These tests fail on real lock bugs (dropped locks turn into
+KeyErrors/duplicate applies/ torn dicts under this load).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from clawker_tpu.config.schema import EgressRule
+
+THREADS = 8
+ROUNDS = 200
+
+
+def hammer(fn, *, threads=THREADS, rounds=ROUNDS):
+    """Run fn(thread_index, round_index) from N threads; surface every
+    exception."""
+    errors: list[BaseException] = []
+    barrier = threading.Barrier(threads)
+
+    def work(ti):
+        try:
+            barrier.wait(5)
+            for ri in range(rounds):
+                fn(ti, ri)
+        except BaseException as e:  # noqa: BLE001 - collected for assert
+            errors.append(e)
+
+    ts = [threading.Thread(target=work, args=(i,)) for i in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert not errors, errors[:3]
+
+
+def test_action_queue_serializes_mutations(tmp_path):
+    """Concurrent rule mutations through the queue end in a consistent
+    store: every add applied exactly once, no lost updates."""
+    from clawker_tpu.firewall.queue import ActionQueue
+    from clawker_tpu.firewall.rules import RulesStore
+
+    store = RulesStore(tmp_path / "rules.yaml")
+    queue = ActionQueue()
+    applied = []
+
+    def one(ti, ri):
+        if ri % 10 == 0:
+            dst = f"d{ti}-{ri}.example.com"
+            queue.run(lambda d=dst: applied.append(
+                store.add([EgressRule(dst=d)])))
+        else:
+            queue.run(store.load)
+
+    try:
+        hammer(one, rounds=100)
+    finally:
+        queue.close()
+    added = {r.dst for batch in applied for r in batch}
+    assert added == {r.dst for r in store.load()}
+    assert len(added) == THREADS * 10
+
+
+def test_store_snapshot_never_torn(tmp_path):
+    """Readers racing provenance-routed writers always see a parseable,
+    internally consistent snapshot (atomic temp+rename + lock-free
+    snapshot reads)."""
+    from clawker_tpu.storage.store import Layer, Store
+
+    p = tmp_path / "settings.yaml"
+    p.write_text("monitoring:\n  opensearch_port: 9200\n")
+    store = Store([Layer("user", p)])
+
+    def one(ti, ri):
+        if ti % 2 == 0:
+            store.set(f"slot{ti}.value", ri)
+        else:
+            raw = store.raw()
+            # a torn write would surface as a half-merged tree here
+            assert isinstance(raw, dict)
+            assert raw["monitoring"]["opensearch_port"] == 9200
+
+    hammer(one, rounds=60)
+    for ti in range(0, THREADS, 2):
+        assert store.get(f"slot{ti}.value") == 59
+
+
+def test_pubsub_concurrent_publish_subscribe():
+    """Publishers racing subscribe/unsubscribe: no deadlock, every
+    subscriber sees an ordered (possibly drop-oldest-bounded) stream."""
+    from clawker_tpu.controlplane.pubsub import Topic
+
+    topic = Topic("stress")
+    seen: dict[int, list] = {i: [] for i in range(THREADS)}
+
+    def one(ti, ri):
+        if ti < THREADS // 2:
+            topic.publish((ti, ri))
+        else:
+            sub = topic.subscribe(f"s{ti}-{ri}")
+            ev = sub.get(timeout=0.005)
+            if ev is not None:
+                seen[ti].append(ev)
+            sub.close()
+
+    hammer(one, rounds=80)
+    # monotone sequence numbers within every consumer's view
+    for evs in seen.values():
+        seqs = [e.seq for e in evs]
+        assert seqs == sorted(seqs)
+    assert topic.subscriber_count() == 0
+
+
+def test_maps_churn_vs_policy_decisions():
+    """Verdict reads racing enroll/bypass/dns churn: decide() must never
+    raise or return an inconsistent verdict object."""
+    from clawker_tpu.firewall import policy
+    from clawker_tpu.firewall.hashes import zone_hash
+    from clawker_tpu.firewall.maps import DnsEntry, FakeMaps
+    from clawker_tpu.firewall.model import (
+        FLAG_ENFORCE,
+        Action,
+        ContainerPolicy,
+    )
+
+    maps = FakeMaps()
+    pol = ContainerPolicy(envoy_ip="10.0.0.2", dns_ip="10.0.0.1",
+                          hostproxy_ip="10.0.0.1", hostproxy_port=18374,
+                          flags=FLAG_ENFORCE)
+    maps.enroll(7, pol)
+    zh = zone_hash("example.com")
+
+    def one(ti, ri):
+        if ti == 0:
+            maps.enroll(7, pol) if ri % 2 else maps.unenroll(7)
+        elif ti == 1:
+            maps.set_bypass(7, int(time.time()) + 5) if ri % 2 \
+                else maps.clear_bypass(7)
+        elif ti == 2:
+            maps.cache_dns("93.184.216.34",
+                           DnsEntry(zone_hash=zh, expires_unix=2**40))
+            maps.expire_dns()
+        else:
+            v = policy.connect4(maps, 7, "93.184.216.34", 443,
+                                sock_cookie=ti * 1000 + ri)
+            assert isinstance(v.action, Action)
+
+    hammer(one)
+
+
+def test_dnsgate_queries_during_policy_swaps(tmp_path):
+    """Live traffic racing set_policy reloads: every reply is a valid
+    DNS message with a verdict from ONE coherent policy (never a tear)."""
+    from clawker_tpu.firewall.dnsgate import DnsGate, ZonePolicy, _encode_name
+    from clawker_tpu.firewall.maps import FakeMaps
+
+    allow = ZonePolicy.from_rules([EgressRule(dst="*.example.com")])
+    deny = ZonePolicy.from_rules([])
+    gate = DnsGate(allow, FakeMaps(), host="127.0.0.1", port=0)
+    gate._forward = lambda data, resolvers, tcp=False: None
+    gate.start()
+    query = (struct.pack(">HHHHHH", 7, 0x0100, 1, 0, 0, 0)
+             + _encode_name("a.example.com") + struct.pack(">HH", 1, 1))
+    try:
+        def one(ti, ri):
+            if ti == 0:
+                gate.set_policy(allow if ri % 2 else deny)
+                return
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.settimeout(2.0)
+                s.sendto(query, ("127.0.0.1", gate.bound_port))
+                reply = s.recv(512)
+            rcode = struct.unpack(">H", reply[2:4])[0] & 0xF
+            assert rcode in (0, 2, 3)   # NOERROR/SERVFAIL/NXDOMAIN only
+
+        hammer(one, rounds=60)
+        assert gate.stats.queries >= (THREADS - 1) * 60
+    finally:
+        gate.stop()
