@@ -58,15 +58,21 @@ class _Pipe:
 class FakeProcessIO:
     """Handles given to a simulated container process."""
 
-    def __init__(self, stdin: _Pipe, stdout: _Pipe, kill_event: threading.Event):
+    def __init__(self, stdin: _Pipe, stdout: _Pipe, kill_event: threading.Event,
+                 log_buf: bytearray | None = None):
         self._stdin = stdin
         self._stdout = stdout
+        self._log_buf = log_buf
         self.kill_event = kill_event
 
     def read_stdin(self, timeout: float | None = 5.0) -> bytes:
         return self._stdin.read(timeout)
 
     def write_stdout(self, data: bytes) -> None:
+        # daemons capture container stdout in the log ring whether or not
+        # anyone is attached -- so does the fake (container_logs serves it)
+        if self._log_buf is not None:
+            self._log_buf.extend(data)
         self._stdout.write(data)
 
     def wait_for_kill(self, timeout: float | None = None) -> bool:
@@ -152,6 +158,7 @@ class FakeContainer:
     exited: threading.Event = field(default_factory=threading.Event)
     ip: str = ""
     networks: dict[str, str] = field(default_factory=dict)  # net -> ip
+    log_buf: bytearray = field(default_factory=bytearray)  # captured stdout
 
     @property
     def labels(self) -> dict[str, str]:
@@ -329,7 +336,7 @@ class FakeDockerAPI:
             c.ip = c.networks.get("bridge", "") or self._next_ip()
 
         def run() -> None:
-            io = FakeProcessIO(c.stdin, c.stdout, c.kill_event)
+            io = FakeProcessIO(c.stdin, c.stdout, c.kill_event, c.log_buf)
             try:
                 code = c.behavior(io)
             except Exception:
@@ -437,8 +444,30 @@ class FakeDockerAPI:
 
     def container_logs(self, cid: str, *, follow: bool = False, tail: str = "all") -> Iterator[bytes]:
         self._record("container_logs", cid)
-        self._find(cid)
-        return iter(())
+        c = self._find(cid)
+        if follow:
+            # stream-until-exit semantics collapse to: wait, then snapshot
+            c.exited.wait(10.0)
+        elif c.state == "running" and not c.log_buf:
+            # a just-started behavior may not have written yet; give the
+            # simulated process one beat, like a daemon's log ring would
+            c.exited.wait(0.5)
+        body = bytes(c.log_buf)
+        if tail != "all":
+            try:
+                lines = body.splitlines(keepends=True)
+                body = b"".join(lines[-int(tail):])
+            except ValueError:
+                pass
+        if not body:
+            return iter(())
+        if not (c.config.get("Tty") or False):
+            # non-TTY log bodies are stdcopy-framed by real daemons;
+            # Engine.logs() demuxes, so unframed bytes would corrupt
+            import struct as _struct
+
+            body = b"\x01\x00\x00\x00" + _struct.pack(">I", len(body)) + body
+        return iter([body])
 
     def put_archive(self, cid: str, path: str, tar_bytes: bytes) -> None:
         self._record("put_archive", cid, path)

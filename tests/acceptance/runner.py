@@ -1,0 +1,132 @@
+"""CLI-transcript acceptance runner (the reference's testscript tier).
+
+Each ``*.txt`` script in this directory is an end-user session: commands
+plus expectations, executed in-process against ONE isolated installation
+(fresh XDG dirs + fake daemon), so whole CLI flows are pinned the way
+the reference pins them with testscript -- without needing Docker.
+
+Directives:
+  # comment                 ignored
+  > KEY=VALUE               set env for the rest of the script
+  $ <argv>                  run the clawker CLI (shlex-split)
+  ? N                       previous command must exit N (default: 0)
+  ~ text                    previous output must contain text
+  ! text                    previous output must NOT contain text
+
+Expectations bind to the most recent ``$``; a command with no explicit
+``?`` must exit 0.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from click.testing import CliRunner
+
+from clawker_tpu import consts
+from clawker_tpu.cli.factory import Factory
+from clawker_tpu.cli.root import cli
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.testenv import TestEnv
+
+SCRIPTS_DIR = Path(__file__).parent
+
+
+def scripts() -> list[Path]:
+    return sorted(SCRIPTS_DIR.glob("*.txt"))
+
+
+@dataclass
+class _Last:
+    line: str = ""
+    code: int = 0
+    output: str = ""
+    checked_exit: bool = False
+
+
+@dataclass
+class Session:
+    tmp_path: Path
+    driver: FakeDriver = field(default_factory=lambda: FakeDriver(n_workers=2))
+
+    def __post_init__(self):
+        for api in self.driver.apis:
+            api.add_image("envoyproxy/envoy:v1.30.2")
+            for ref in ("clawker-demo:default", "clawker-accproj:default"):
+                api.add_image(ref)
+                api.set_behavior(ref, exit_behavior(b"agent done\n", 0))
+        # CP-less acceptance sessions: firewall verbs ride the in-process
+        # monitor-mode handler (no pinned kernel maps on the test host)
+        cfg_dir = Path(os.environ[consts.ENV_CONFIG_DIR])
+        (cfg_dir / "settings.yaml").write_text(
+            "firewall:\n  default_deny: false\n")
+        self.proj = self.tmp_path / "proj"
+        self.proj.mkdir(exist_ok=True)
+        self.factory = Factory(cwd=self.proj, driver=self.driver)
+        self.runner = CliRunner()
+
+    def run(self, argv: list[str]) -> tuple[int, str]:
+        res = self.runner.invoke(cli, argv, obj=self.factory)
+        out = res.output
+        if res.exception is not None and not isinstance(
+                res.exception, SystemExit):
+            out += f"\n[exception] {res.exception!r}"
+        return res.exit_code, out
+
+
+def run_script(path: Path, tmp_path: Path) -> None:
+    with TestEnv():
+        session = Session(tmp_path)
+        last = _Last()
+        saved: dict[str, str | None] = {}
+        try:
+            for lineno, raw in enumerate(path.read_text().splitlines(), 1):
+                line = raw.strip()
+                where = f"{path.name}:{lineno}"
+                if not line or line.startswith("#"):
+                    continue
+                tag, _, rest = line.partition(" ")
+                rest = rest.strip()
+                if tag == ">":
+                    key, _, val = rest.partition("=")
+                    saved.setdefault(key, os.environ.get(key))
+                    os.environ[key] = val
+                elif tag == "$":
+                    _settle(last, where)
+                    code, out = session.run(shlex.split(rest))
+                    last = _Last(line=f"{where}: $ {rest}", code=code,
+                                 output=out)
+                elif tag == "?":
+                    assert last.code == int(rest), (
+                        f"{last.line}\nexpected exit {rest}, got {last.code}\n"
+                        f"output:\n{last.output}")
+                    last.checked_exit = True
+                elif tag == "~":
+                    assert rest in last.output, (
+                        f"{last.line}\nexpected output to contain {rest!r}\n"
+                        f"output:\n{last.output}")
+                elif tag == "!":
+                    assert rest not in last.output, (
+                        f"{last.line}\noutput must NOT contain {rest!r}\n"
+                        f"output:\n{last.output}")
+                else:
+                    raise AssertionError(f"{where}: unknown directive {tag!r}")
+            _settle(last, f"{path.name}:EOF")
+        finally:
+            for key, val in saved.items():
+                if val is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = val
+
+
+def _settle(last: _Last, where: str) -> None:
+    """A command with no explicit `?` must have exited 0."""
+    if last.line and not last.checked_exit:
+        assert last.code == 0, (
+            f"{last.line}\nexpected exit 0, got {last.code}\n"
+            f"output:\n{last.output}")

@@ -1,0 +1,12 @@
+"""Parametrized executor for the CLI-transcript scripts."""
+
+from __future__ import annotations
+
+import pytest
+
+from .runner import run_script, scripts
+
+
+@pytest.mark.parametrize("script", scripts(), ids=lambda p: p.stem)
+def test_script(script, tmp_path):
+    run_script(script, tmp_path)
