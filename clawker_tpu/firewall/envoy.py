@@ -568,3 +568,66 @@ def generate_envoy_config(
         tcp_ports=tcp_ports,
         mitm_domains=sorted(set(mitm_domains)),
     )
+
+
+# ----------------------------------------------------------------- validate
+
+def validate_bundle(bundle: EnvoyBundle) -> list[str]:
+    """Structural validation of a generated bootstrap; [] when clean.
+
+    The real Envoy NACKs an invalid bootstrap -- which, on a reload,
+    means a full egress outage.  This is the pre-swap gate (reference
+    envoy_validate.go): a rule mutation producing an invalid config must
+    fail the RPC and leave the old data plane running.
+    """
+    errs: list[str] = []
+    try:
+        cfg = yaml.safe_load(bundle.config_yaml)
+    except yaml.YAMLError as e:
+        return [f"bootstrap does not parse: {e}"]
+    res = (cfg or {}).get("static_resources") or {}
+    clusters = {c.get("name") for c in res.get("clusters") or []}
+    listeners = res.get("listeners") or []
+
+    ports: set[int] = set()
+    seen_sni: set[str] = set()
+    for listener in listeners:
+        port = (listener.get("address", {}).get("socket_address", {})
+                .get("port_value"))
+        if port in ports:
+            errs.append(f"duplicate listener port {port}")
+        ports.add(port)
+        for chain in listener.get("filter_chains") or []:
+            for name in (chain.get("filter_chain_match", {})
+                         .get("server_names") or []):
+                if name in seen_sni:
+                    errs.append(f"duplicate SNI {name!r} across chains "
+                                "(Envoy NACK)")
+                seen_sni.add(name)
+            for f in chain.get("filters") or []:
+                tc = f.get("typed_config") or {}
+                cluster = tc.get("cluster")
+                if cluster and cluster not in clusters:
+                    errs.append(
+                        f"filter references unknown cluster {cluster!r}")
+                rc = tc.get("route_config") or {}
+                for vh in rc.get("virtual_hosts") or []:
+                    if not vh.get("domains"):
+                        errs.append(f"virtual host {vh.get('name')!r} "
+                                    "matches no domains")
+                    for route in vh.get("routes") or []:
+                        dst = (route.get("route") or {}).get("cluster")
+                        if dst and dst not in clusters:
+                            errs.append(
+                                f"route references unknown cluster {dst!r}")
+                        if "route" not in route and \
+                                "direct_response" not in route:
+                            errs.append("route with neither cluster nor "
+                                        "direct_response")
+    # every kernel-advertised TCP lane must have a listener behind it
+    for key, port in bundle.tcp_ports.items():
+        if port not in ports:
+            errs.append(f"rule {key}: kernel lane port {port} has no "
+                        "listener (kernel would redirect into a refused "
+                        "connect)")
+    return errs

@@ -126,7 +126,13 @@ class FirewallHandler:
     def _sync_data_plane(self) -> dict:
         """Render Envoy + gate + kernel routes from the effective rules.
         The one function every rule mutation funnels through, so proxy,
-        gate and kernel can never disagree."""
+        gate and kernel can never disagree.
+
+        stack.render validates the bootstrap it is about to deploy
+        BEFORE writing it (an invalid config reaching a real Envoy is a
+        NACK -- a full egress outage on reload), so a bad rule set fails
+        here and the old data plane stays up (reference
+        envoy_validate.go)."""
         rules = self.effective_rules()
         bundle = self.stack.ensure_running(rules)
         table = policy_mod.build_routes(
@@ -331,8 +337,16 @@ class FirewallHandler:
             raise ClawkerError(str(e)) from e
 
         def act():
+            snapshot = self.rules_store.load()
             added = self.rules_store.add(new)
-            counts = self._sync_data_plane()
+            try:
+                counts = self._sync_data_plane()
+            except ClawkerError:
+                # refused swap (e.g. invalid bootstrap): the poison rule
+                # must not stay persisted, or every later sync -- and the
+                # next daemon init -- would re-render the same failure
+                self.rules_store.replace(snapshot)
+                raise
             return {"added": [r.key() for r in added], **counts}
         return self.queue.run(act)
 
@@ -340,9 +354,16 @@ class FirewallHandler:
         key = str(req.get("key") or "")
 
         def act():
+            snapshot = self.rules_store.load()
             removed = self.rules_store.remove(key)
-            counts = self._sync_data_plane() if removed else {}
-            return {"removed": removed, **counts}
+            if not removed:
+                return {"removed": False}
+            try:
+                counts = self._sync_data_plane()
+            except ClawkerError:
+                self.rules_store.replace(snapshot)  # see add_rules
+                raise
+            return {"removed": True, **counts}
         return self.queue.run(act)
 
     def list_rules(self, req: dict) -> dict:

@@ -144,6 +144,13 @@ class RulesStore:
                 self._save(list(have.values()))
             return changed
 
+    def replace(self, rules: list[EgressRule]) -> None:
+        """Overwrite the stored set (mutation rollback after a refused
+        data-plane swap -- a poison rule must not stay persisted and
+        wedge every later sync)."""
+        with self._lock:
+            self._save(list(rules))
+
     def remove(self, key: str) -> bool:
         with self._lock:
             rules = self.load()

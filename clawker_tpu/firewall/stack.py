@@ -91,8 +91,20 @@ class FirewallStack:
     # ------------------------------------------------------------- render
 
     def render(self, rules: list[EgressRule]) -> EnvoyBundle:
-        """Config + certs on disk; returns the bundle (listener ports)."""
+        """Config + certs on disk; returns the bundle (listener ports).
+
+        The EXACT artifact about to be deployed is validated before the
+        write: an invalid bootstrap reaching a real Envoy is a NACK (=
+        full egress outage on reload), so the caller's mutation fails
+        while the previous config keeps serving (envoy_validate.go)."""
+        from .envoy import validate_bundle
+
         bundle = generate_envoy_config(rules, cert_dir=ENVOY_CONF_MOUNT + "/certs")
+        errs = validate_bundle(bundle)
+        if errs:
+            raise ClawkerError(
+                "refusing data-plane swap; generated Envoy bootstrap is "
+                "invalid: " + "; ".join(errs[:4]))
         self.conf_dir.mkdir(parents=True, exist_ok=True)
         (self.conf_dir / "envoy.yaml").write_text(bundle.config_yaml)
         certs = self.conf_dir / "certs"

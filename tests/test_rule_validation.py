@@ -234,3 +234,78 @@ def test_collision_noop_reports_unchanged(tmp_path):
     store = RulesStore(tmp_path / "rules.yaml")
     store.add([EgressRule(dst="example.com")])
     assert store.add([EgressRule(dst="example.com")]) == []
+
+
+# ----------------------------------------------------- bootstrap validation
+
+def test_validate_bundle_clean_for_real_rule_sets(tmp_path):
+    from clawker_tpu.firewall.envoy import generate_envoy_config, validate_bundle
+
+    rules = [
+        EgressRule(dst="*.example.com", proto="https"),
+        EgressRule(dst="example.com", proto="https",
+                   path_rules=[PathRule(path="/v1", action="allow")],
+                   path_default="deny"),
+        EgressRule(dst="plain.example.net", proto="http"),
+        EgressRule(dst="github.com", proto="ssh", port=22),
+        EgressRule(dst="www.example.com", action="deny"),
+    ]
+    bundle = generate_envoy_config(rules, cert_dir=str(tmp_path))
+    assert validate_bundle(bundle) == []
+
+
+def test_validate_bundle_catches_torn_configs(tmp_path):
+    """Hand-broken bootstraps surface named errors (the pre-swap gate)."""
+    import yaml as _yaml
+
+    from clawker_tpu.firewall.envoy import (
+        EnvoyBundle,
+        generate_envoy_config,
+        validate_bundle,
+    )
+
+    bundle = generate_envoy_config(
+        [EgressRule(dst="example.com", proto="https")],
+        cert_dir=str(tmp_path))
+    cfg = _yaml.safe_load(bundle.config_yaml)
+    # route to a cluster that does not exist
+    cfg["static_resources"]["clusters"] = []
+    broken = EnvoyBundle(config_yaml=_yaml.safe_dump(cfg),
+                         tcp_ports=bundle.tcp_ports)
+    errs = validate_bundle(broken)
+    assert any("unknown cluster" in e for e in errs)
+    # kernel lane pointing at a listener that is not in the config
+    broken2 = EnvoyBundle(config_yaml=bundle.config_yaml,
+                          tcp_ports={"x.com:tcp:9000": 10099})
+    assert any("no listener" in e for e in validate_bundle(broken2))
+    # unparseable yaml
+    assert validate_bundle(EnvoyBundle(config_yaml=":\n  - ["))
+
+
+def test_sync_data_plane_refuses_invalid_bootstrap(tmp_path, monkeypatch):
+    """A mutation producing an invalid bootstrap fails the RPC and keeps
+    the old data plane running."""
+    from clawker_tpu.errors import ClawkerError
+    from clawker_tpu.firewall import envoy as envoy_mod
+    from clawker_tpu.parity.scenarios import _HandlerRig
+
+    rig = _HandlerRig(tmp_path)
+    try:
+        rig.handler.init({})
+        before = rig.handler.status({})
+        stored_before = {r.key() for r in rig.handler.rules_store.load()}
+        real = envoy_mod.validate_bundle
+        monkeypatch.setattr(envoy_mod, "validate_bundle",
+                            lambda b: ["synthetic validation failure"])
+        with pytest.raises(ClawkerError, match="refusing data-plane swap"):
+            rig.handler.add_rules({"rules": [{"dst": "new.example.com"}]})
+        monkeypatch.setattr(envoy_mod, "validate_bundle", real)
+        after = rig.handler.status({})
+        assert after["stack"]["running"] is True
+        assert after["routes"] == before["routes"]
+        # the poison rule did NOT stay persisted: later mutations work
+        assert {r.key() for r in rig.handler.rules_store.load()} == stored_before
+        res = rig.handler.add_rules({"rules": [{"dst": "ok.example.com"}]})
+        assert res["added"] == ["ok.example.com:https:443"]
+    finally:
+        rig.close()
