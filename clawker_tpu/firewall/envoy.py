@@ -299,7 +299,16 @@ def _mitm_chain(rule: EgressRule, cert_dir: str) -> dict:
                     "name": f"paths_{apex.replace('.', '_')}",
                     "virtual_hosts": [{
                         "name": apex,
-                        "domains": ["*"],
+                        # scoped to the rule's zone, NEVER "*": on a
+                        # wildcard chain the DFP cluster resolves the
+                        # request :authority, so a catch-all vhost would
+                        # let Host: attacker.example smuggle through an
+                        # allowed-SNI handshake to arbitrary upstreams
+                        # (found by the sni-host-mismatch red-team probe)
+                        "domains": sorted(
+                            [apex, f"{apex}:*"]
+                            + ([f"*.{apex}", f"*.{apex}:*"]
+                               if wildcard else [])),
                         "routes": routes,
                         # path_default decides the catch-all: 403 or forward
                     }],
@@ -371,13 +380,19 @@ def _http_listener(rules: list[EgressRule], port: int) -> dict:
     """
     vhosts = []
     any_wildcard = False
+    # exact rules own the bare apex: a coexisting wildcard vhost also
+    # claiming it is (a) an Envoy NACK ("only unique values for domains")
+    # and (b) a path-policy bypass via Host routing
+    exact_http = {r.dst for r in rules if not r.dst.startswith("*.")}
     for rule in rules:
         wildcard = rule.dst.startswith("*.")
         apex = rule.dst[2:] if wildcard else rule.dst
         domains = [apex, f"{apex}:*"]
         if wildcard:
             any_wildcard = True
-            domains += [f"*.{apex}", f"*.{apex}:*"]
+            domains = ([f"*.{apex}", f"*.{apex}:*"]
+                       if apex in exact_http else
+                       domains + [f"*.{apex}", f"*.{apex}:*"])
             cluster = DFP_CLUSTER_PLAIN
         else:
             cluster = _cluster_name(apex, rule.effective_port(), tls=False)
@@ -447,6 +462,16 @@ def generate_envoy_config(
             chain["filter_chain_match"]["server_names"] = [
                 n for n in chain["filter_chain_match"]["server_names"]
                 if n != apex_]
+            # the HCM vhost must cede the apex too: with only the SNI
+            # ceded, Host: apex through a subdomain handshake would still
+            # route via the wildcard rule's (laxer) path policy,
+            # bypassing the exact rule's restrictions
+            for f in chain.get("filters", []):
+                rc = (f.get("typed_config") or {}).get("route_config")
+                for vh in (rc or {}).get("virtual_hosts", []):
+                    vh["domains"] = [
+                        d for d in vh["domains"]
+                        if d not in (apex_, f"{apex_}:*")]
         return chain
     tls_chains: list[dict] = []
     clusters: dict[str, dict] = {}
@@ -611,10 +636,18 @@ def validate_bundle(bundle: EnvoyBundle) -> list[str]:
                     errs.append(
                         f"filter references unknown cluster {cluster!r}")
                 rc = tc.get("route_config") or {}
+                seen_domains: set[str] = set()
                 for vh in rc.get("virtual_hosts") or []:
                     if not vh.get("domains"):
                         errs.append(f"virtual host {vh.get('name')!r} "
                                     "matches no domains")
+                    for d in vh.get("domains") or []:
+                        if d in seen_domains:
+                            errs.append(
+                                f"duplicate vhost domain {d!r} in "
+                                f"{rc.get('name')!r} (Envoy NACK: only "
+                                "unique domain values are permitted)")
+                        seen_domains.add(d)
                     for route in vh.get("routes") or []:
                         dst = (route.get("route") or {}).get("cluster")
                         if dst and dst not in clusters:

@@ -128,26 +128,34 @@ def normalize_path(raw: str) -> tuple[str, bool]:
 
 
 def _host_matches(pattern: str, host: str) -> bool:
-    """Envoy virtual-host domain match (exact, *.suffix, host:*)."""
+    """Envoy virtual-host domain match (exact, *.suffix, host:*).
+
+    Faithful to Envoy: ``*.example.com`` matches subdomains ONLY, never
+    the bare apex -- configs that want the apex list it explicitly.  (An
+    apex-matching wildcard here once masked a Host-smuggling bypass the
+    generator had already fixed.)"""
     pattern, host = pattern.lower(), host.lower()
     if pattern.endswith(":*"):
         return _host_matches(pattern[:-2], host.rsplit(":", 1)[0])
     host = host.rsplit(":", 1)[0] if ":" in host else host
     if pattern.startswith("*."):
-        return host == pattern[2:] or host.endswith(pattern[1:])
+        return host.endswith(pattern[1:])
     if pattern == "*":
         return True
     return host == pattern
 
 
 def _sni_matches(server_names: list[str], sni: str | None) -> bool:
+    """filter_chain_match server_names, Envoy-faithful: a ``*.`` entry
+    matches subdomains only (the generator lists the apex explicitly
+    when a wildcard rule admits it)."""
     if sni is None:
         return False
     sni = sni.lower().rstrip(".")
     for name in server_names:
         name = name.lower()
         if name.startswith("*."):
-            if sni == name[2:] or sni.endswith(name[1:]):
+            if sni.endswith(name[1:]):
                 return True
         elif sni == name:
             return True

