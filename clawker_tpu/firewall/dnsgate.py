@@ -48,6 +48,33 @@ TTL_MIN_S = 30       # floor so the kernel cache outlives immediate reuse
 TTL_MAX_S = 3600
 UPSTREAM_TIMEOUT_S = 2.5
 
+# DNS-rebinding guard (dnsmasq --stop-dns-rebind / unbound
+# private-address semantics): an EXTERNAL allowed zone answering with a
+# local/reserved address would poison the kernel's ip->zone cache into
+# allowing direct connects to loopback, the bridge, link-local metadata
+# services (169.254.169.254), or RFC1918 space.  Answers carrying any
+# such record are treated as hostile and refused outright -- legitimate
+# public domains do not mix public and private records.  (TEST-NET
+# ranges are deliberately NOT listed: they are reserved-but-unroutable,
+# and the parity World uses them as its virtual internet.)
+_REBIND_NETS: tuple[tuple[int, int], ...] = tuple(
+    (int.from_bytes(socket.inet_aton(net), "big"),
+     (0xFFFFFFFF << (32 - prefix)) & 0xFFFFFFFF)
+    for net, prefix in (
+        ("0.0.0.0", 8), ("10.0.0.0", 8), ("100.64.0.0", 10),
+        ("127.0.0.0", 8), ("169.254.0.0", 16), ("172.16.0.0", 12),
+        ("192.168.0.0", 16), ("198.18.0.0", 15), ("224.0.0.0", 3),
+    )
+)
+
+
+def is_rebind_ip(ip: str) -> bool:
+    try:
+        n = int.from_bytes(socket.inet_aton(ip), "big")
+    except OSError:
+        return True  # unparseable rdata: never cache or relay
+    return any((n & mask) == net for net, mask in _REBIND_NETS)
+
 
 # --------------------------------------------------------------------------
 # wire codec (only what the gate needs)
@@ -486,17 +513,34 @@ class DnsGate:
                 return synthesize(q, RCODE_SERVFAIL)
             self._cache_answers(reply, zone)
             return reply
-        self._tick("allowed")
         reply = self._forward(data, self.upstreams, tcp=tcp)
         if reply is None:
+            self._tick("allowed")
             self._tick("upstream_errors")
             return synthesize(q, RCODE_SERVFAIL)
-        self._cache_answers(reply, zone)
+        records = parse_a_records(reply)
+        rebound = [ip for ip, _ in records if is_rebind_ip(ip)]
+        if rebound:
+            # rebinding answer: refusing the whole response is the only
+            # safe verdict -- relaying it would hand the client a local
+            # address, caching it would open a kernel route to it
+            log.warning("dns rebind refused: %s -> %s", q.qname, rebound)
+            self._tick("refused")
+            return synthesize(q, RCODE_NXDOMAIN)
+        self._tick("allowed")
+        self._cache_answers(records, zone)
         return reply
 
-    def _cache_answers(self, reply: bytes, zone: Zone) -> None:
+    def _cache_answers(self, records_or_reply, zone: Zone) -> None:
+        records = (parse_a_records(records_or_reply)
+                   if isinstance(records_or_reply, (bytes, bytearray))
+                   else records_or_reply)
         now = int(time.time())
-        for ip, ttl in parse_a_records(reply):
+        for ip, ttl in records:
+            if is_rebind_ip(ip) and not zone.internal:
+                # defense in depth behind the refusal above; INTERNAL
+                # zones legitimately resolve to private bridge addresses
+                continue
             ttl = max(TTL_MIN_S, min(TTL_MAX_S, ttl))
             self.maps.cache_dns(ip, DnsEntry(zone_hash=zone.hash, expires_unix=now + ttl))
             self._tick("cached_ips")

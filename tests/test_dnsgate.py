@@ -314,3 +314,44 @@ def test_policy_hot_swap():
     gate.set_policy(ZonePolicy.from_rules([EgressRule(dst="*.other.net")]))
     reply = gate.serve_packet(make_query("api.example.com"))
     assert struct.unpack(">H", reply[2:4])[0] & 0xF == RCODE_NXDOMAIN
+
+
+def test_rebind_guard_refuses_private_answers(tmp_path):
+    """DNS-rebinding guard: an external allowed zone answering with
+    loopback/link-local/RFC1918 addresses is refused outright and never
+    cached (dnsmasq --stop-dns-rebind semantics); internal zones keep
+    their private answers."""
+    import struct as _struct
+
+    from clawker_tpu.config.schema import EgressRule
+    from clawker_tpu.firewall.dnsgate import (
+        DnsGate,
+        ZonePolicy,
+        _encode_name,
+        is_rebind_ip,
+        synthesize_a,
+        parse_query,
+    )
+    from clawker_tpu.firewall.maps import FakeMaps
+
+    for ip in ("127.0.0.1", "10.1.2.3", "169.254.169.254", "192.168.1.1",
+               "172.16.0.9", "100.64.0.1", "0.0.0.0", "224.0.0.1"):
+        assert is_rebind_ip(ip), ip
+    for ip in ("93.184.216.34", "198.51.100.10", "8.8.8.8"):
+        assert not is_rebind_ip(ip), ip
+
+    maps = FakeMaps()
+    gate = DnsGate(ZonePolicy.from_rules([EgressRule(dst="*.example.com")]),
+                   maps, host="127.0.0.1", port=0)
+    query = (_struct.pack(">HHHHHH", 9, 0x0100, 1, 0, 0, 0)
+             + _encode_name("meta.example.com") + _struct.pack(">HH", 1, 1))
+
+    def hostile_forward(data, resolvers, tcp=False):
+        return synthesize_a(parse_query(data), "169.254.169.254", ttl=300)
+
+    gate._forward = hostile_forward
+    reply = gate.serve_packet(query)
+    rcode = _struct.unpack(">H", reply[2:4])[0] & 0xF
+    assert rcode == 3                      # refused, not relayed
+    assert maps.dns_entries() == {}        # and never cached
+    assert gate.stats.refused == 1

@@ -13,18 +13,18 @@ from clawker_tpu.parity.redteam import TECHNIQUES, build_world, run_corpus
 
 
 def test_corpus_covers_thirty_techniques():
-    assert len(TECHNIQUES) == 33  # 30 reference classes + 3 beyond
+    assert len(TECHNIQUES) == 35  # 30 reference classes + 5 beyond
     names = [n for n, _ in TECHNIQUES]
-    assert len(set(names)) == 33
+    assert len(set(names)) == 35
 
 
 def test_zero_captures(tmp_path):
     report = run_corpus(tmp_path)
-    assert report["total"] == 33
+    assert report["total"] == 35
     failing = [t for t in report["techniques"] if not t["pass"]]
     assert report["captures"] == 0 and not failing, (
         f"escapes: {failing}\ncaptures: {report['capture_rows']}")
-    assert report["passed"] == 33
+    assert report["passed"] == 35
 
 
 def test_instrument_detects_escapes(tmp_path):

@@ -418,3 +418,28 @@ def test_validate_bundle_flags_duplicate_vhost_domains(tmp_path):
     broken = EnvoyBundle(config_yaml=_yaml.safe_dump(cfg),
                          tcp_ports=bundle.tcp_ports)
     assert any("duplicate vhost domain" in e for e in validate_bundle(broken))
+
+
+def test_same_dst_multi_port_http_rules_render_unique_vhosts(tmp_path):
+    """Several http rules for one dst at different ports share the
+    listener: domains must stay unique (port-qualified), and the rule
+    set must pass the pre-swap gate."""
+    import yaml as _yaml
+
+    from clawker_tpu.firewall.envoy import generate_envoy_config, validate_bundle
+
+    rules = [EgressRule(dst="example.com", proto="http", port=80),
+             EgressRule(dst="example.com", proto="http", port=8080),
+             EgressRule(dst="*.wild.example.net", proto="http", port=80),
+             EgressRule(dst="*.wild.example.net", proto="http", port=3000)]
+    bundle = generate_envoy_config(rules, cert_dir=str(tmp_path))
+    assert validate_bundle(bundle) == []
+    cfg = _yaml.safe_load(bundle.config_yaml)
+    (http,) = [l for l in cfg["static_resources"]["listeners"]
+               if l["name"].startswith("http_")]
+    hcm = http["filter_chains"][0]["filters"][0]["typed_config"]
+    domains = [d for vh in hcm["route_config"]["virtual_hosts"]
+               for d in vh["domains"]]
+    assert len(domains) == len(set(domains))
+    assert "example.com" in domains           # bare name: lowest port
+    assert "example.com:8080" in domains      # qualified: the other lane
