@@ -40,6 +40,7 @@ class ContainerSpec:
     memory: str = ""
     nano_cpus: int = 0
     restart_policy: str = ""                                 # e.g. "on-failure:3"
+    dns: list[str] = field(default_factory=list)             # resolver override
     extra_hosts: list[str] = field(default_factory=list)     # "host:ip"
     mount_docker_socket: bool = False
     stop_signal: str = ""
@@ -71,6 +72,8 @@ class ContainerSpec:
             host_config["RestartPolicy"] = rp
         if self.extra_hosts:
             host_config["ExtraHosts"] = list(self.extra_hosts)
+        if self.dns:
+            host_config["Dns"] = list(self.dns)
         if self.init:
             host_config["Init"] = True
         cfg: dict[str, Any] = {

@@ -126,7 +126,21 @@ class FirewallStack:
             if f.is_file():
                 h.update(f.name.encode())
                 h.update(f.read_bytes())
+        # spec-level knobs drift the container too: an already-deployed
+        # proxy whose envoy.yaml is unchanged must still recreate when
+        # e.g. its resolver pinning changes (upgrade path)
+        h.update(repr(self._envoy_dns()).encode())
         return h.hexdigest()[:16]
+
+    def _envoy_dns(self) -> list[str]:
+        """Resolver override for the proxy container: pin to the gate
+        ONLY when the gate actually serves gateway:53 (production
+        placement) -- a loopback/ephemeral gate (monitor fallback, unit
+        tests) is unreachable from the container netns, and pinning
+        there would black-hole all upstream resolution."""
+        if self.dns_port == consts.DNS_PORT and not self.dns_host:
+            return [self.gateway_ip()]
+        return []
 
     # ------------------------------------------------------------- envoy
 
@@ -159,6 +173,11 @@ class FirewallStack:
             network=consts.NETWORK_NAME,
             static_ip=self.envoy_ip(),
             restart_policy="on-failure:3",
+            # the proxy's OWN upstream resolution (LOGICAL_DNS clusters,
+            # dynamic-forward-proxy caches) must go through the gate too:
+            # a daemon-default resolver here would let a rebinding answer
+            # bypass the gate's guard on the second resolution
+            dns=self._envoy_dns(),
         )
         cid = self.engine.create_container(name, spec)
         self.engine.start_container(cid)
@@ -207,8 +226,11 @@ class FirewallStack:
 
     def ensure_running(self, rules: list[EgressRule]) -> EnvoyBundle:
         bundle = self.render(rules)
-        self.ensure_envoy()
+        # gate first: the proxy container's only configured resolver may
+        # be the gate, so it must be listening before Envoy boots and
+        # fires its startup LOGICAL_DNS/DFP resolutions
         self.ensure_gate(rules)
+        self.ensure_envoy()
         return bundle
 
     def reload(self, rules: list[EgressRule]) -> EnvoyBundle:

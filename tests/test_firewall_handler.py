@@ -649,3 +649,27 @@ def test_run_path_bootstrap_hooks_monitor_mode(tmp_path):
             if handler.stack.gate is not None:
                 handler.stack.gate.stop()
             del lifecycle._local_handlers[cfg_key]
+
+
+def test_envoy_container_resolves_through_the_gate(env):
+    """The proxy's own upstream resolution (LOGICAL_DNS / DFP) must ride
+    the gate in production placement -- a daemon-default resolver would
+    let a rebinding answer bypass the guard on the proxy's second
+    resolution.  A loopback/ephemeral gate (this test env, monitor
+    fallback) is unreachable from the container netns, so pinning there
+    would black-hole resolution: no override then."""
+    cfg, driver, maps, handler = env
+    handler.init({})
+    info = driver.engine().inspect_container(consts.ENVOY_CONTAINER)
+    # test env: gate on loopback ephemeral -> no resolver pinning
+    assert not info["HostConfig"].get("Dns")
+    # production placement: gate on gateway:53 -> pinned, and the knob
+    # feeds the drift sha so upgrades recreate the container
+    stack = handler.stack
+    sha_loopback = stack.config_sha()
+    stack.dns_host, stack.dns_port = "", consts.DNS_PORT
+    try:
+        assert stack._envoy_dns() == [stack.gateway_ip()]
+        assert stack.config_sha() != sha_loopback
+    finally:
+        stack.dns_host, stack.dns_port = "127.0.0.1", 0
