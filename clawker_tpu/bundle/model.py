@@ -10,6 +10,7 @@ Parity reference: internal/bundle/assets harness.yaml + stack bundles
 
 from __future__ import annotations
 
+import copy
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -96,16 +97,41 @@ MANIFESTS = {
 }
 
 
+# mtime-keyed parse cache: component resolution runs on every container
+# create (harness staging), and re-parsing an unchanged manifest costs
+# more than the rest of the create path combined
+_manifest_cache: dict[tuple[str, int, int], dict] = {}
+
+
+def _load_manifest(mf: Path) -> dict:
+    try:
+        st = mf.stat()
+        key = (str(mf), st.st_mtime_ns, st.st_size)
+    except OSError as e:
+        raise ConfigError(f"{mf}: unreadable: {e}") from e
+    cached = _manifest_cache.get(key)
+    if cached is None:
+        try:
+            cached = yaml.safe_load(mf.read_text()) or {}
+        except OSError as e:
+            raise ConfigError(f"{mf}: unreadable: {e}") from e
+        except yaml.YAMLError as e:
+            raise ConfigError(f"{mf}: invalid yaml: {e}") from e
+        if len(_manifest_cache) > 256:
+            _manifest_cache.clear()
+        _manifest_cache[key] = cached
+    # deep copy: from_dict/__post_init__ may normalize nested values in
+    # place, and the cache must stay pristine
+    return copy.deepcopy(cached)
+
+
 def load_component_dir(kind: str, path: Path, *, tier: str = "loose"):
     """Load one component of ``kind`` from a directory."""
     manifest_name, cls = MANIFESTS[kind]
     mf = path / manifest_name
     if not mf.is_file():
         raise ConfigError(f"{path}: no {manifest_name}")
-    try:
-        raw = yaml.safe_load(mf.read_text()) or {}
-    except yaml.YAMLError as e:
-        raise ConfigError(f"{mf}: invalid yaml: {e}") from e
+    raw = _load_manifest(mf)
     comp = from_dict(cls, raw)
     comp.source_dir = path
     comp.tier = tier
