@@ -209,7 +209,8 @@ class BundleManager:
             h.update(p.read_bytes())
         return h.hexdigest()[:16]
 
-    def auto_update_check(self, *, state=None, ttl_s: float = 86400.0) -> list[str]:
+    def auto_update_check(self, *, state=None, ttl_s: float = 86400.0,
+                          errors: list[tuple[str, str]] | None = None) -> list[str]:
         """TTL-gated refresh of installed bundles (reference
         cmdutil.RunBundleAutoUpdate on the run path + bundle
         AutoUpdateCheck): local-dir sources re-install when their content
@@ -243,6 +244,12 @@ class BundleManager:
                 self.install(src, namespace=inst.namespace, name=inst.name)
                 updated.append(f"{inst.namespace}/{inst.name}")
             except (BundleError, OSError, subprocess.TimeoutExpired) as e:
+                # background runs soft-skip (an offline host must still
+                # run agents); an explicit `bundle update` passes
+                # ``errors`` so failures surface instead of reading as
+                # "all current"
+                if errors is not None:
+                    errors.append((f"{inst.namespace}/{inst.name}", str(e)))
                 log.debug("bundle auto-update %s/%s skipped: %s",
                           inst.namespace, inst.name, e)
         return updated

@@ -55,16 +55,57 @@ def bundle_validate(f: Factory, path):
     click.echo("ok")
 
 
+def _parse_spec(spec: str) -> tuple[str, str]:
+    """``namespace/name`` (default namespace: local)."""
+    ns, _, name = spec.partition("/")
+    if not name:
+        ns, name = "local", ns
+    return ns, name
+
+
 @bundle_group.command("remove")
 @click.argument("spec")
 @pass_factory
 def bundle_remove(f: Factory, spec):
     """Remove an installed bundle (namespace/name)."""
-    ns, _, name = spec.partition("/")
-    if not name:
-        ns, name = "local", ns
+    ns, name = _parse_spec(spec)
     BundleManager(f.config).remove(ns, name)
     click.echo(f"removed {ns}/{name}")
+
+
+@bundle_group.command("update")
+@click.argument("spec", required=False)
+@pass_factory
+def bundle_update(f: Factory, spec):
+    """Re-install bundles from their recorded sources.
+
+    With SPEC (namespace/name), updates that one bundle; without, runs
+    the drift-checked refresh over every install (what `run` does on its
+    daily TTL, forced now)."""
+    mgr = BundleManager(f.config)
+    if spec:
+        ns, name = _parse_spec(spec)
+        match = [b for b in mgr.list_installed()
+                 if b.namespace == ns and b.name == name]
+        if not match:
+            raise click.ClickException(f"bundle {ns}/{name} not installed")
+        (inst,) = match
+        if not inst.source:
+            raise click.ClickException(
+                f"bundle {ns}/{name} has no recorded source")
+        mgr.install(inst.source, namespace=ns, name=name)
+        click.echo(f"updated {ns}/{name} from {inst.source}")
+        return
+    errors: list[tuple[str, str]] = []
+    updated = mgr.auto_update_check(ttl_s=0, errors=errors)  # forced
+    for ref in updated:
+        click.echo(f"updated {ref}")
+    for ref, err in errors:
+        click.echo(f"update failed: {ref}: {err}", err=True)
+    if not updated and not errors:
+        click.echo("all bundles current")
+    if errors:
+        raise SystemExit(1)
 
 
 @bundle_group.command("prune")
