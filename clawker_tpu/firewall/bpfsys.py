@@ -80,10 +80,15 @@ def obj_get(pin_path: str | Path) -> int:
 
 
 class BpfMap:
-    """One pinned map: fixed key/value sizes, bytes in / bytes out."""
+    """One BPF map: fixed key/value sizes, bytes in / bytes out.  Opened
+    from a pin path, or wrapped around an already-live fd (the assembled
+    in-process loader, fwprogs.FwKernel, hands fds straight over)."""
 
-    def __init__(self, pin_path: Path, key_size: int, value_size: int):
-        self.fd = obj_get(pin_path)
+    def __init__(self, pin_path: Path | None, key_size: int, value_size: int,
+                 *, fd: int | None = None):
+        if fd is None and pin_path is None:
+            raise BpfError("BpfMap needs a pin_path or an fd")
+        self.fd = fd if fd is not None else obj_get(pin_path)
         self.key_size = key_size
         self.value_size = value_size
 

@@ -1,0 +1,285 @@
+#!/usr/bin/env python3
+"""bpfgate.py - the real-kernel BPF gate: verify, attach, enforce, pin.
+
+Produces the committed evidence artifact (BPFGATE_r{N}.txt) that the
+nine firewall programs are REAL kernel programs, not host-compiled
+twins:
+
+  1. assembles every program (clawker_tpu/firewall/fwprogs.py) and loads
+     it through the in-kernel verifier, capturing the full transcript;
+  2. runs a negative control (an out-of-bounds map deref) to show the
+     verifier actually rejects bad programs in this environment;
+  3. attaches to a scratch cgroup-v2 dir and grades enforcement with
+     real probe processes: EPERM on deny, redirects landing on real
+     listeners, reverse-NAT visible in recvfrom/getpeername;
+  4. pins the live maps into bpffs and round-trips a lookup through
+     bpfsys.PinnedMaps (the DNS-gate data path).
+
+Exit 0 only if every stage passes.  Run as:
+    python scripts/bpfgate.py --out BPFGATE_r05.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import platform
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from clawker_tpu.firewall import bpfkern  # noqa: E402
+from clawker_tpu.firewall.model import (  # noqa: E402
+    Action, ContainerPolicy, DnsEntry, FLAG_ENFORCE, PROTO_TCP, Reason,
+    RouteKey, RouteVal,
+)
+
+FAILURES: list[str] = []
+
+
+def section(out, title):
+    out.write(f"\n{'=' * 72}\n{title}\n{'=' * 72}\n")
+
+
+def check(out, name, ok, detail=""):
+    mark = "PASS" if ok else "FAIL"
+    out.write(f"[{mark}] {name}{(' -- ' + detail) if detail else ''}\n")
+    if not ok:
+        FAILURES.append(name)
+
+
+def stage_verifier(out):
+    from clawker_tpu.firewall.fwprogs import FwKernel
+
+    section(out, "STAGE 1: kernel verifier transcripts (9 programs)")
+    kern = FwKernel(log_level=1)
+    for name, p in kern.progs.items():
+        out.write(f"\n--- {name}: {p.insn_count} insns, "
+                  f"sha256={p.sha256} ---\n")
+        out.write(p.verifier_log.rstrip() + "\n")
+        check(out, f"verifier accepted {name}",
+              p.fd > 0 and "processed" in p.verifier_log)
+    # one full instruction-by-instruction walk (log_level=2) so the
+    # transcript shows the verifier actually stepping our bytecode
+    from clawker_tpu.firewall.fwprogs import PROGRAM_SPECS
+
+    name, ptype, atype, build = next(s for s in PROGRAM_SPECS
+                                     if s[0] == "fw_sock_create")
+    code = build(kern.maps).assemble()
+    fd, log = bpfkern.prog_load(ptype, code, expected_attach_type=atype,
+                                name=name, log_level=2, log_size=1 << 22)
+    os.close(fd)
+    lines = log.splitlines()
+    out.write(f"\n--- {name}: full verifier walk (log_level=2, "
+              f"{len(lines)} lines) ---\n")
+    shown = lines if len(lines) <= 400 else lines[:300] + [
+        f"... [{len(lines) - 360} lines elided] ..."] + lines[-60:]
+    out.write("\n".join(shown) + "\n")
+    check(out, "log_level=2 walk captured", len(lines) > 50)
+    return kern
+
+
+def stage_negative_control(out):
+    from clawker_tpu.firewall.bpfasm import Asm, R0, R1, R2, R10
+    from clawker_tpu.firewall.bpfasm import FN_map_lookup_elem
+
+    section(out, "STAGE 2: negative control (verifier must reject OOB deref)")
+    fd = bpfkern.map_create(bpfkern.BPF_MAP_TYPE_HASH, 8, 8, 4, "negctl")
+    a = Asm("negctl")
+    a.st_imm("dw", R10, -8, 0)
+    a.ld_map_fd(R1, fd)
+    a.mov_reg(R2, R10)
+    a.alu64_imm("add", R2, -8)
+    a.call(FN_map_lookup_elem)
+    a.j_imm("jeq", R0, 0, "out")
+    a.ldx("dw", R1, R0, 64)  # 8-byte value, read at +64: out of bounds
+    a.label("out")
+    a.ret_imm(1)
+    try:
+        bpfkern.prog_load(bpfkern.BPF_PROG_TYPE_CGROUP_SOCK, a.assemble(),
+                          expected_attach_type=bpfkern.BPF_CGROUP_INET_SOCK_CREATE,
+                          name="negctl")
+        check(out, "verifier rejected the broken program", False,
+              "load unexpectedly succeeded")
+    except bpfkern.VerifierError as e:
+        tail = "\n".join(e.log.strip().splitlines()[-6:])
+        out.write(tail + "\n")
+        check(out, "verifier rejected the broken program",
+              "invalid access to map value" in e.log)
+    finally:
+        os.close(fd)
+
+
+def stage_enforcement(out):
+    from clawker_tpu.firewall.bpflive import (
+        LiveSandbox, TcpEcho, UdpResponder, probe_raw_socket,
+        probe_tcp_connect, probe_tcp_connect6, probe_udp_exchange,
+    )
+
+    section(out, "STAGE 3: live enforcement (real cgroup, real sockets)")
+    with LiveSandbox("bpfgate") as sb:
+        out.write(f"scratch cgroup: {sb.cg_dir} (id {sb.cgroup_id})\n")
+        envoy = TcpEcho()
+        envoy.start()
+        gate = None
+        try:
+            gate = UdpResponder(port=53)
+            gate.start()
+        except OSError as e:
+            out.write(f"[SKIP] DNS redirect grade: cannot bind "
+                      f"127.0.0.1:53 ({e}) -- verdict class ungraded\n")
+        try:
+            sb.enroll(ContainerPolicy(envoy_ip="127.0.0.1", dns_ip="127.0.0.1",
+                                      flags=FLAG_ENFORCE))
+            r = sb.run_in_cgroup(probe_tcp_connect, "127.0.0.1", envoy.port, 1.0)
+            check(out, "loopback TCP allowed", r["result"] == "connected",
+                  str(r))
+            r = sb.run_in_cgroup(probe_tcp_connect, "10.99.0.1", 443, 1.0)
+            check(out, "unresolved ip-literal TCP denied with EPERM",
+                  r["result"] == "eperm", str(r))
+            if gate is not None:
+                r = sb.run_in_cgroup(probe_udp_exchange, "8.8.8.8", 53,
+                                     b"ping", 1.0)
+                check(out, "DNS redirected to gate + reverse-NAT on reply",
+                      r.get("result") == "reply" and r.get("src") == ["8.8.8.8", 53],
+                      str(r))
+            z = 0xC1A0
+            sb.maps.cache_dns("93.184.216.34",
+                              DnsEntry(z, int(time.time()) + 600))
+            sb.maps.sync_routes({RouteKey(z, 443, PROTO_TCP):
+                                 RouteVal(Action.REDIRECT, "127.0.0.1",
+                                          envoy.port)})
+            r = sb.run_in_cgroup(probe_tcp_connect, "93.184.216.34", 443, 1.0)
+            check(out, "route REDIRECT lands on proxy, getpeername rewritten",
+                  r["result"] == "connected" and r.get("peer") == ["93.184.216.34", 443],
+                  str(r))
+            r = sb.run_in_cgroup(probe_raw_socket)
+            check(out, "SOCK_RAW denied inside the cgroup",
+                  r["result"] == "eperm", str(r))
+            check(out, "SOCK_RAW fine outside the cgroup",
+                  probe_raw_socket()["result"] == "created")
+            r = sb.run_in_cgroup(probe_tcp_connect6, "2001:db8::1", 443, 1.0)
+            check(out, "native IPv6 denied", r["result"] == "eperm", str(r))
+            sb.maps.set_bypass(sb.cgroup_id, time.time() + 30)
+            r = sb.run_in_cgroup(probe_tcp_connect, "10.99.0.1", 443, 0.4)
+            check(out, "bypass dead-man opens egress", r["result"] != "eperm",
+                  str(r))
+            sb.maps.set_bypass(sb.cgroup_id, time.time() - 1)
+            r = sb.run_in_cgroup(probe_tcp_connect, "10.99.0.1", 443, 1.0)
+            check(out, "expired bypass re-encloses and self-deletes",
+                  r["result"] == "eperm" and sb.maps.bypass_entries() == {},
+                  str(r))
+            evs = sb.maps.drain_events(4096)
+            out.write("\nringbuf events observed:\n")
+            for e in evs:
+                out.write(f"  {e.verdict.name:<12} {e.reason.name:<13} "
+                          f"{e.dst_ip}:{e.dst_port} proto={e.proto} "
+                          f"cg={e.cgroup_id}\n")
+            need = {(Action.DENY, Reason.NO_DNS_ENTRY),
+                    (Action.REDIRECT, Reason.ROUTE),
+                    (Action.DENY, Reason.RAW_SOCKET),
+                    (Action.DENY, Reason.IPV6),
+                    (Action.ALLOW, Reason.BYPASS)}
+            if gate is not None:
+                need.add((Action.REDIRECT_DNS, Reason.DNS))
+            got = {(e.verdict, e.reason) for e in evs}
+            check(out, "ringbuf carries every graded verdict class",
+                  need <= got, f"missing {need - got}")
+        finally:
+            envoy.stop()
+            if gate is not None:
+                gate.stop()
+
+
+def stage_pins(out, kern):
+    section(out, "STAGE 4: bpffs pins + bpfsys.PinnedMaps round-trip")
+    bpffs = Path("/sys/fs/bpf")
+    if not bpffs.is_dir():
+        check(out, "bpffs available", False, "/sys/fs/bpf missing")
+        return
+    if not any("bpf" in ln.split()[2:3] for ln in open("/proc/mounts")):
+        subprocess.run(["mount", "-t", "bpf", "bpf", str(bpffs)], check=False)
+    pin_dir = bpffs / f"clawker-gate-{os.getpid()}"
+    pin_dir.mkdir(exist_ok=True)
+    try:
+        from clawker_tpu.firewall.maps import (
+            ALL_MAPS, MAP_BYPASS, MAP_CONTAINERS, MAP_DNS_CACHE, MAP_EVENTS,
+            MAP_RATELIMIT, MAP_ROUTES, MAP_TCP_FLOWS, MAP_UDP_FLOWS,
+        )
+
+        fd_by_name = {
+            MAP_CONTAINERS: kern.maps.containers, MAP_BYPASS: kern.maps.bypass,
+            MAP_DNS_CACHE: kern.maps.dns_cache, MAP_ROUTES: kern.maps.routes,
+            MAP_UDP_FLOWS: kern.maps.udp_flows, MAP_TCP_FLOWS: kern.maps.tcp_flows,
+            MAP_EVENTS: kern.maps.events, MAP_RATELIMIT: kern.maps.ratelimit,
+        }
+        for name in ALL_MAPS:
+            bpfkern.obj_pin(fd_by_name[name], pin_dir / name)
+        check(out, "all 8 maps pinned", True, str(pin_dir))
+        from clawker_tpu.firewall.bpfsys import PinnedMaps
+
+        pm = PinnedMaps(pin_dir)
+        pm.cache_dns("198.51.100.77", DnsEntry(0xBEEF, int(time.time()) + 60))
+        got = pm.lookup_dns("198.51.100.77")
+        check(out, "PinnedMaps round-trip over real pins",
+              got is not None and got.zone_hash == 0xBEEF)
+        pm.close()
+    finally:
+        for name in list(os.listdir(pin_dir)):
+            try:
+                os.unlink(pin_dir / name)
+            except OSError:
+                pass
+        try:
+            pin_dir.rmdir()
+        except OSError:
+            pass
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None, help="write transcript to file")
+    args = ap.parse_args()
+    out = open(args.out, "w") if args.out else sys.stdout
+
+    out.write("clawker-tpu BPF gate transcript\n")
+    out.write(f"generated: {time.strftime('%Y-%m-%dT%H:%M:%S%z')}\n")
+    out.write(f"kernel: {platform.release()} machine: {platform.machine()}\n")
+    src = Path(__file__).resolve().parent.parent / "clawker_tpu/firewall/fwprogs.py"
+    out.write(f"fwprogs.py sha256: {hashlib.sha256(src.read_bytes()).hexdigest()}\n")
+
+    if not bpfkern.kernel_available():
+        out.write("\nFAIL: bpf(2) or cgroup-v2 unavailable -- this gate "
+                  "requires a real kernel.\n")
+        if args.out:
+            out.close()
+        return 2
+
+    kern = stage_verifier(out)
+    try:
+        stage_negative_control(out)
+        stage_enforcement(out)
+        stage_pins(out, kern)
+    finally:
+        kern.close()
+
+    section(out, "RESULT")
+    if FAILURES:
+        out.write(f"FAILED ({len(FAILURES)}): {FAILURES}\n")
+        rc = 1
+    else:
+        out.write("ALL STAGES PASSED: programs verified by the kernel, "
+                  "enforcement graded on real sockets, pins round-tripped.\n")
+        rc = 0
+    if args.out:
+        out.close()
+        print(f"bpfgate: {'FAIL' if rc else 'OK'} -> {args.out}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,29 +1,53 @@
 #!/bin/sh
-# check_bpf.sh - the BPF artifact gate.
+# check_bpf.sh - the BPF gate.
 #
-# Fails the build if fw.c stops compiling to a BPF object.  Run wherever
-# clang exists: TPU-VM provisioning runs it before `fwctl load` (see
-# clawker_tpu/fleet/provision.py), and CI images with clang run it on
-# every change to native/ebpf.  On machines without clang (the dev tree)
-# it reports SKIP and exits 0 after running the host-side gates instead:
-# the gcc syntax check, the userspace harness suite (the REAL fw.c logic
-# under test -- tests/test_fw_kernel.py) and the fwctl mock suite.
+# Strongest gate first: if this kernel accepts bpf(2) PROG_LOAD (root on
+# any Linux with cgroup-v2), run the REAL gate -- scripts/bpfgate.py
+# assembles the nine programs (clawker_tpu/firewall/fwprogs.py), loads
+# them through the in-kernel verifier, attaches to a scratch cgroup and
+# grades enforcement with real sockets.  A verifier rejection or a
+# mis-graded socket FAILS the build here; there is no skip on a capable
+# kernel.
 #
-# The verifier proper only runs at `fwctl load` on a real kernel; this
-# script is the strongest pre-kernel gate each environment supports.
+# Fallbacks, in order of decreasing strength:
+#   - clang present: compile fw.c -> BPF object (bytecode exists, no
+#     verifier run).
+#   - neither: host-side gates only (gcc syntax check, the userspace
+#     harness differential suite, fwctl mock suite) and report SKIP.
 set -e
 
 here="$(cd "$(dirname "$0")/.." && pwd)"
 ebpf="$here/native/ebpf"
 
+if (cd "$here" && python3 -c "
+import sys
+try:
+    from clawker_tpu.firewall.bpfkern import kernel_available
+    sys.exit(0 if kernel_available() else 1)
+except Exception:
+    sys.exit(1)
+"); then
+    echo "check_bpf: kernel accepts PROG_LOAD -- running the real gate"
+    (cd "$here" && python3 scripts/bpfgate.py)
+    # the real gate grades the assembled programs; the C twin that
+    # `fwctl load` ships is a separate artifact and keeps its own gate
+    if command -v clang >/dev/null 2>&1; then
+        make -C "$ebpf" build/fw.o CLANG="$(command -v clang)"
+    else
+        make -C "$ebpf" check
+    fi
+    echo "check_bpf: OK (verifier + live enforcement + C-twin gate)"
+    exit 0
+fi
+
 if command -v clang >/dev/null 2>&1; then
     # Only the BPF object: fwctl additionally needs libbpf-dev, which a
     # clang-only image may not have (fw.c deliberately builds without it).
-    echo "check_bpf: clang found -- compiling fw.c -> BPF object"
+    echo "check_bpf: no bpf(2), clang found -- compiling fw.c -> BPF object"
     make -C "$ebpf" build/fw.o CLANG="$(command -v clang)"
     echo "check_bpf: OK ($ebpf/build/fw.o)"
 else
-    echo "check_bpf: clang not present -- running host-side gates"
+    echo "check_bpf: no bpf(2), no clang -- running host-side gates"
     make -C "$ebpf" check harness fwctl-mock
     if command -v python >/dev/null 2>&1 && python -c "import pytest" 2>/dev/null; then
         (cd "$here" && python -m pytest tests/test_fw_kernel.py tests/test_fwctl.py -q)

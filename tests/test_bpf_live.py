@@ -1,0 +1,259 @@
+"""Live-kernel firewall tests: the real verifier and real sockets.
+
+Skip-gated on bpf(2) + cgroup-v2 availability (bpfkern.kernel_available)
+so the suite stays green on unprivileged hosts; where the gate opens,
+every assertion here is against actual kernel behavior -- the programs
+assembled by fwprogs.py, verified by the in-kernel verifier, attached to
+a scratch cgroup, and graded by what probe children's syscalls return.
+
+This is the round-5 answer to "all parity verdicts rest on a host-gcc
+twin": the same decision table the twin tests (tests/test_fw_kernel.py
+differential suite) is exercised here with zero simulation.
+
+Parity reference: test/e2e/firewall_test.go blockedDomainConnectivity /
+allowedDomainConnectivity / dnsRedirection / ipv6Blocked etc. -- same
+observables, kernel-enforced.
+"""
+
+import socket
+import time
+
+import pytest
+
+from clawker_tpu.firewall import bpfkern
+from clawker_tpu.firewall.model import (
+    Action,
+    ContainerPolicy,
+    DnsEntry,
+    FLAG_ENFORCE,
+    FLAG_HOSTPROXY,
+    PROTO_TCP,
+    PROTO_UDP,
+    Reason,
+    RouteKey,
+    RouteVal,
+)
+
+pytestmark = pytest.mark.skipif(
+    not bpfkern.kernel_available(),
+    reason="bpf(2) PROG_LOAD or writable cgroup-v2 unavailable",
+)
+
+
+@pytest.fixture(scope="module")
+def sandbox():
+    from clawker_tpu.firewall.bpflive import LiveSandbox
+
+    sb = LiveSandbox("clawker-pytest")
+    yield sb
+    sb.close()
+
+
+@pytest.fixture()
+def enrolled(sandbox):
+    """Enforcing policy with loopback gate/proxy; fresh maps per test."""
+    pol = ContainerPolicy(envoy_ip="127.0.0.1", dns_ip="127.0.0.1",
+                          flags=FLAG_ENFORCE)
+    sandbox.enroll(pol)
+    yield sandbox
+    sandbox.maps.flush_all()
+    sandbox.maps.drain_events(4096)
+
+
+def _tcp(sb, ip, port, timeout=1.0):
+    from clawker_tpu.firewall.bpflive import probe_tcp_connect
+
+    return sb.run_in_cgroup(probe_tcp_connect, ip, port, timeout)
+
+
+class TestVerifier:
+    def test_all_nine_programs_pass_the_kernel_verifier(self, sandbox):
+        assert len(sandbox.kern.progs) == 9
+        for name, p in sandbox.kern.progs.items():
+            assert p.fd > 0, name
+            assert "processed" in p.verifier_log, f"{name}: no verifier transcript"
+
+    def test_verifier_rejects_a_broken_program(self):
+        """Negative control: the gate is real -- an out-of-bounds map
+        value deref must be rejected with a transcript."""
+        from clawker_tpu.firewall.bpfasm import Asm, R0, R1, R2, R10
+        from clawker_tpu.firewall.bpfasm import FN_map_lookup_elem
+
+        fd = bpfkern.map_create(bpfkern.BPF_MAP_TYPE_HASH, 8, 8, 4, "tiny")
+        a = Asm("bad")
+        a.st_imm("dw", R10, -8, 0)
+        a.ld_map_fd(R1, fd)
+        a.mov_reg(R2, R10)
+        a.alu64_imm("add", R2, -8)
+        a.call(FN_map_lookup_elem)
+        a.j_imm("jeq", R0, 0, "out")
+        a.ldx("dw", R1, R0, 64)  # value is 8 bytes; read at +64 is OOB
+        a.label("out")
+        a.ret_imm(1)
+        with pytest.raises(bpfkern.VerifierError) as ei:
+            bpfkern.prog_load(
+                bpfkern.BPF_PROG_TYPE_CGROUP_SOCK, a.assemble(),
+                expected_attach_type=bpfkern.BPF_CGROUP_INET_SOCK_CREATE)
+        assert "invalid access to map value" in ei.value.log
+
+
+class TestEnforcement:
+    def test_unenrolled_cgroup_passes_through(self, sandbox):
+        sandbox.maps.flush_all()
+        from clawker_tpu.firewall.bpflive import probe_raw_socket
+
+        assert sandbox.run_in_cgroup(probe_raw_socket)["result"] == "created"
+
+    def test_loopback_always_allowed(self, enrolled):
+        from clawker_tpu.firewall.bpflive import TcpEcho
+
+        srv = TcpEcho()
+        srv.start()
+        try:
+            assert _tcp(enrolled, "127.0.0.1", srv.port)["result"] == "connected"
+        finally:
+            srv.stop()
+
+    def test_ip_literal_denied_with_eperm(self, enrolled):
+        r = _tcp(enrolled, "10.99.0.1", 443)
+        assert r["result"] == "eperm"
+        evs = enrolled.maps.drain_events()
+        assert any(e.verdict is Action.DENY and e.reason is Reason.NO_DNS_ENTRY
+                   and e.dst_ip == "10.99.0.1" and e.dst_port == 443
+                   for e in evs)
+
+    def test_monitor_mode_allows_and_logs(self, sandbox):
+        sandbox.enroll(ContainerPolicy(envoy_ip="127.0.0.1",
+                                       dns_ip="127.0.0.1", flags=0))
+        r = _tcp(sandbox, "10.99.0.2", 443, timeout=0.5)
+        assert r["result"] != "eperm"
+        evs = sandbox.maps.drain_events()
+        assert any(e.reason is Reason.MONITOR for e in evs)
+        sandbox.maps.flush_all()
+
+    def test_route_deny_beats_resolution(self, enrolled):
+        z = 0x5151
+        enrolled.maps.cache_dns("203.0.113.7", DnsEntry(z, int(time.time()) + 300))
+        enrolled.maps.sync_routes({RouteKey(z, 0, PROTO_TCP): RouteVal(Action.DENY)})
+        assert _tcp(enrolled, "203.0.113.7", 8443)["result"] == "eperm"
+        evs = enrolled.maps.drain_events()
+        assert any(e.verdict is Action.DENY and e.reason is Reason.ROUTE
+                   for e in evs)
+
+    def test_redirect_lands_on_proxy_and_getpeername_lies(self, enrolled):
+        from clawker_tpu.firewall.bpflive import TcpEcho
+
+        srv = TcpEcho()
+        srv.start()
+        z = 0x6262
+        enrolled.maps.cache_dns("93.184.216.34",
+                                DnsEntry(z, int(time.time()) + 300))
+        enrolled.maps.sync_routes({
+            RouteKey(z, 443, PROTO_TCP):
+                RouteVal(Action.REDIRECT, "127.0.0.1", srv.port)})
+        try:
+            r = _tcp(enrolled, "93.184.216.34", 443)
+            # connected to the local proxy double, yet getpeername reports
+            # the destination the app aimed at (fw_getpeername4 rewrite)
+            assert r["result"] == "connected"
+            assert r["peer"] == ["93.184.216.34", 443]
+        finally:
+            srv.stop()
+
+    def test_dns_redirect_and_reverse_nat(self, enrolled):
+        from clawker_tpu.firewall.bpflive import UdpResponder, probe_udp_exchange
+
+        try:
+            gate = UdpResponder(port=53)
+        except OSError:
+            pytest.skip("port 53 unavailable")
+        gate.start()
+        try:
+            r = enrolled.run_in_cgroup(probe_udp_exchange, "8.8.8.8", 53)
+            assert r["result"] == "reply"
+            # reply actually came from 127.0.0.1:53, but recvmsg4
+            # reverse-NAT presents the original destination
+            assert r["src"] == ["8.8.8.8", 53]
+            assert gate.received == [b"ping"]
+        finally:
+            gate.stop()
+
+    def test_udp_literal_denied(self, enrolled):
+        from clawker_tpu.firewall.bpflive import probe_udp_exchange
+
+        r = enrolled.run_in_cgroup(probe_udp_exchange, "10.99.0.3", 9999)
+        assert r["result"] == "eperm"
+
+    def test_raw_socket_denied_only_inside(self, enrolled):
+        from clawker_tpu.firewall.bpflive import probe_raw_socket
+
+        assert enrolled.run_in_cgroup(probe_raw_socket)["result"] == "eperm"
+        assert probe_raw_socket()["result"] == "created"
+        evs = enrolled.maps.drain_events()
+        assert any(e.reason is Reason.RAW_SOCKET for e in evs)
+
+    def test_native_ipv6_denied_v4mapped_follows_v4(self, enrolled):
+        from clawker_tpu.firewall.bpflive import TcpEcho, probe_tcp_connect6
+
+        assert enrolled.run_in_cgroup(
+            probe_tcp_connect6, "2001:db8::1", 443)["result"] == "eperm"
+        evs = enrolled.maps.drain_events()
+        assert any(e.reason is Reason.IPV6 for e in evs)
+        # v4-mapped loopback rides the v4 decision: allowed
+        srv = TcpEcho()
+        srv.start()
+        try:
+            r = enrolled.run_in_cgroup(
+                probe_tcp_connect6, "::ffff:127.0.0.1", srv.port)
+            assert r["result"] == "connected"
+        finally:
+            srv.stop()
+
+    def test_bypass_deadline_opens_then_recloses(self, enrolled):
+        enrolled.maps.set_bypass(enrolled.cgroup_id, time.time() + 30)
+        assert _tcp(enrolled, "10.99.0.1", 443, 0.3)["result"] != "eperm"
+        enrolled.maps.clear_bypass(enrolled.cgroup_id)
+        assert _tcp(enrolled, "10.99.0.1", 443)["result"] == "eperm"
+
+    def test_expired_bypass_is_deleted_in_kernel(self, enrolled):
+        """The dead-man: an expired entry denies AND is GC'd by the
+        program itself on first touch (fw.c:75-87) -- no userspace timer."""
+        enrolled.maps.set_bypass(enrolled.cgroup_id, time.time() - 1)
+        assert _tcp(enrolled, "10.99.0.1", 443)["result"] == "eperm"
+        assert enrolled.maps.bypass_entries() == {}
+
+    def test_hostproxy_allowance_is_port_scoped(self, enrolled):
+        # 127.0.0.0/8 is always allowed, so give the hostproxy a
+        # non-loopback address to isolate step 6
+        from clawker_tpu.firewall.bpflive import probe_udp_exchange
+
+        enrolled.enroll(ContainerPolicy(
+            envoy_ip="192.0.2.1", dns_ip="192.0.2.2",
+            hostproxy_ip="192.0.2.3", hostproxy_port=18374,
+            flags=FLAG_ENFORCE | FLAG_HOSTPROXY))
+        ok = enrolled.run_in_cgroup(probe_udp_exchange, "192.0.2.3", 18374, b"x", 0.2)
+        assert ok["result"] in ("sent-no-reply", "reply")  # allowed to send
+        bad = enrolled.run_in_cgroup(probe_udp_exchange, "192.0.2.3", 18999, b"x", 0.2)
+        assert bad["result"] == "eperm"
+
+    def test_intra_net_bypass_excludes_gateway(self, enrolled):
+        from clawker_tpu.firewall.bpflive import probe_udp_exchange
+
+        enrolled.enroll(ContainerPolicy(
+            envoy_ip="192.0.2.1", dns_ip="198.51.100.1",
+            flags=FLAG_ENFORCE, net_ip="198.51.100.0", net_prefix=24))
+        sib = enrolled.run_in_cgroup(probe_udp_exchange, "198.51.100.9", 4317, b"x", 0.2)
+        assert sib["result"] in ("sent-no-reply", "reply")
+        # the gate itself is NOT a sibling for non-DNS ports
+        gw = enrolled.run_in_cgroup(probe_udp_exchange, "198.51.100.1", 8080, b"x", 0.2)
+        assert gw["result"] == "eperm"
+
+    def test_events_carry_cgroup_and_zone(self, enrolled):
+        z = 0x7777
+        enrolled.maps.cache_dns("203.0.113.9", DnsEntry(z, int(time.time()) + 300))
+        enrolled.maps.sync_routes({RouteKey(z, 0, PROTO_TCP): RouteVal(Action.DENY)})
+        _tcp(enrolled, "203.0.113.9", 443)
+        evs = enrolled.maps.drain_events()
+        route_evs = [e for e in evs if e.reason is Reason.ROUTE]
+        assert route_evs and route_evs[0].cgroup_id == enrolled.cgroup_id
+        assert route_evs[0].zone_hash == z

@@ -1,0 +1,124 @@
+"""Assembler-level tests: run everywhere, no bpf(2) needed.
+
+The encoding pins follow Documentation/bpf/standardization/
+instruction-set.rst; the program-shape pins keep the nine builders
+assembling (fwprogs.py) even on hosts where the kernel gate
+(tests/test_bpf_live.py) skips.
+"""
+
+import struct
+
+import pytest
+
+from clawker_tpu.firewall import fwprogs
+from clawker_tpu.firewall.bpfasm import (
+    Asm, AsmError, R0, R1, R2, R10, FN_map_lookup_elem,
+)
+
+
+def _units(code: bytes):
+    return [code[i:i + 8] for i in range(0, len(code), 8)]
+
+
+def test_mov_exit_encoding():
+    a = Asm("t")
+    a.ret_imm(0)
+    u = _units(a.assemble())
+    # mov64 r0, 0  ->  opcode 0xb7, regs 0, imm 0
+    assert u[0] == bytes.fromhex("b700000000000000")
+    # exit -> 0x95
+    assert u[1] == bytes.fromhex("9500000000000000")
+
+
+def test_ldx_stx_encoding():
+    a = Asm("t")
+    a.ldx("w", R1, R10, -88)
+    a.stx("dw", R10, -8, R1)
+    u = _units(a.assemble())
+    op, regs, off, imm = struct.unpack("<BBhi", u[0])
+    assert op == 0x61 and regs == (10 << 4 | 1) and off == -88
+    op, regs, off, imm = struct.unpack("<BBhi", u[1])
+    assert op == 0x7B and regs == (1 << 4 | 10) and off == -8
+
+
+def test_ld_map_fd_is_two_units_with_pseudo_src():
+    a = Asm("t")
+    a.ld_map_fd(R1, 42)
+    u = _units(a.assemble())
+    assert len(u) == 2
+    op, regs, off, imm = struct.unpack("<BBhi", u[0])
+    assert op == 0x18 and regs == (1 << 4 | 1) and imm == 42
+    assert u[1] == b"\x00" * 8
+
+
+def test_jump_offsets_resolve_over_ld_imm64():
+    # the ld_imm64 pair counts as two instruction units for jump offsets
+    a = Asm("t")
+    a.j_imm("jeq", R0, 0, "end")   # idx 0
+    a.ld_map_fd(R1, 7)             # idx 1,2
+    a.mov_imm(R2, 1)               # idx 3
+    a.label("end")                 # idx 4
+    a.exit_()
+    u = _units(a.assemble())
+    _, _, off, _ = struct.unpack("<BBhi", u[0])
+    assert off == 3  # 4 - 0 - 1
+
+
+def test_backward_jump_negative_offset():
+    a = Asm("t")
+    a.label("top")
+    a.mov_imm(R0, 1)
+    a.jmp("top")
+    u = _units(a.assemble())
+    _, _, off, _ = struct.unpack("<BBhi", u[1])
+    assert off == -2
+
+
+def test_negative_imm_wraps_to_signed():
+    a = Asm("t")
+    a.mov32_imm(R0, 0xFFFFFFFF)
+    u = _units(a.assemble())
+    _, _, _, imm = struct.unpack("<BBhi", u[0])
+    assert imm == -1
+
+
+def test_undefined_label_raises():
+    a = Asm("t")
+    a.jmp("nowhere")
+    with pytest.raises(AsmError):
+        a.assemble()
+
+
+def test_duplicate_label_raises():
+    a = Asm("t")
+    a.label("x")
+    with pytest.raises(AsmError):
+        a.label("x")
+
+
+def test_endian_be_encoding():
+    a = Asm("t")
+    a.endian_be(R1, 32)
+    op, regs, off, imm = struct.unpack("<BBhi", a.assemble())
+    assert op == 0xDC and regs == 1 and imm == 32
+
+
+def test_all_nine_programs_assemble():
+    """Builders produce nonempty streams against arbitrary fds; the
+    call helper appears in every program (they all consult maps)."""
+    m = fwprogs.FwMapFds(*range(3, 11))
+    for name, ptype, atype, build in fwprogs.PROGRAM_SPECS:
+        asm = build(m)
+        code = asm.assemble()
+        assert len(code) % 8 == 0 and len(code) > 0, name
+        assert asm.insn_count == len(code) // 8
+        lookups = [u for u in _units(code)
+                   if struct.unpack("<BBhi", u)[0] == 0x85
+                   and struct.unpack("<BBhi", u)[3] == FN_map_lookup_elem]
+        assert lookups, f"{name} never looks up a map"
+
+
+def test_programs_are_deterministic():
+    m = fwprogs.FwMapFds(*range(3, 11))
+    for name, _, _, build in fwprogs.PROGRAM_SPECS:
+        assert build(m).assemble() == build(m).assemble(), name
