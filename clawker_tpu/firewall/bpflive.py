@@ -89,7 +89,12 @@ class LiveSandbox:
         os.close(r)
         os.waitpid(pid, 0)
         raw = b"".join(chunks)
-        return json.loads(raw) if raw else None
+        out = json.loads(raw) if raw else {"error": "child died silently"}
+        if isinstance(out, dict) and "error" in out and "result" not in out:
+            # callers key on "result"; a child-side failure must grade as
+            # a FAIL line, not crash the harness mid-transcript
+            out["result"] = "child-error"
+        return out
 
     def close(self) -> None:
         if self.maps is not None:
@@ -190,7 +195,12 @@ def probe_tcp_connect6(ip6: str, port: int, timeout: float = 1.0) -> dict:
 
 
 class TcpEcho(threading.Thread):
-    """One-shot TCP acceptor standing in for an Envoy listener."""
+    """One-shot TCP acceptor standing in for an Envoy listener.
+
+    The serve loop polls with a timeout: a blocking accept() would keep
+    the kernel-side file (and the bound port) alive past close() until
+    the syscall returned -- close(2) does not cancel in-flight blocking
+    syscalls -- which leaks the port to the next binder."""
 
     def __init__(self, ip: str = "127.0.0.1", port: int = 0):
         super().__init__(daemon=True)
@@ -198,51 +208,79 @@ class TcpEcho(threading.Thread):
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((ip, port))
         self.sock.listen(8)
+        self.sock.settimeout(0.1)
         self.port = self.sock.getsockname()[1]
         self.accepted = 0
+        self._stopping = threading.Event()
 
     def run(self) -> None:
-        try:
-            while True:
+        while not self._stopping.is_set():
+            try:
                 conn, _ = self.sock.accept()
-                self.accepted += 1
-                conn.close()
-        except OSError:
-            pass
-
-    def stop(self) -> None:
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            self.accepted += 1
+            conn.close()
         try:
             self.sock.close()
         except OSError:
             pass
 
+    def stop(self) -> None:
+        self._stopping.set()
+        if self.is_alive():
+            self.join(timeout=2.0)
+        else:  # never started: close inline
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
 
 class UdpResponder(threading.Thread):
-    """One-shot UDP responder standing in for the DNS gate listener."""
+    """One-shot UDP responder standing in for the DNS gate listener.
+    Polls with a timeout for the same port-leak reason as TcpEcho."""
 
     def __init__(self, ip: str = "127.0.0.1", port: int = 0,
                  reply: bytes = b"gate-reply"):
         super().__init__(daemon=True)
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.sock.bind((ip, port))
+        self.sock.settimeout(0.1)
         self.port = self.sock.getsockname()[1]
         self.reply = reply
         self.received: list[bytes] = []
+        self._stopping = threading.Event()
 
     def run(self) -> None:
-        try:
-            while True:
+        while not self._stopping.is_set():
+            try:
                 data, src = self.sock.recvfrom(2048)
-                self.received.append(data)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            self.received.append(data)
+            try:
                 self.sock.sendto(self.reply, src)
-        except OSError:
-            pass
-
-    def stop(self) -> None:
+            except OSError:
+                break
         try:
             self.sock.close()
         except OSError:
             pass
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self.is_alive():
+            self.join(timeout=2.0)
+        else:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
 
 
 def wait_for(cond, timeout: float = 2.0, interval: float = 0.02) -> bool:

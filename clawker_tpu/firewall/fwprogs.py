@@ -106,12 +106,14 @@ class FwMapFds:
     ratelimit: int
 
     def close(self) -> None:
-        for fd in self.__dict__.values():
+        for name, fd in list(self.__dict__.items()):
             if isinstance(fd, int) and fd >= 0:
                 try:
                     os.close(fd)
                 except OSError:
                     pass
+                setattr(self, name, -1)  # idempotent: never re-close a
+                # number the OS may have reallocated
 
 
 def create_maps() -> FwMapFds:
@@ -712,10 +714,12 @@ class FwKernel:
     def close(self) -> None:
         self.detach_all()
         for p in self.progs.values():
-            try:
-                os.close(p.fd)
-            except OSError:
-                pass
+            if p.fd >= 0:
+                try:
+                    os.close(p.fd)
+                except OSError:
+                    pass
+                p.fd = -1
         self.progs.clear()
         self.maps.close()
 

@@ -59,9 +59,28 @@ def main(argv: list[str] | None = None) -> int:
     print("\nadversarial corpus (reference test/adversarial, capture-graded):")
     for t in red["techniques"]:
         mark = "PASS" if t["pass"] else "FAIL"
-        print(f"  [{mark}] {t['technique']:<34} {t['detail'][:80]}")
+        tag = {"socket": "sock", "twin": "twin", "mixed": "mix "}[t["grading"]]
+        print(f"  [{mark}] ({tag}) {t['technique']:<34} {t['detail'][:72]}")
+        kr = t.get("kernel_regrade")
+        if kr is not None:
+            kmark = ("SKIP" if kr.get("skipped")
+                     else "PASS" if kr["pass"] else "FAIL")
+            print(f"         [kernel {kmark}] {kr['detail'][:68]}")
     print(f"\n{red['passed']}/{red['total']} techniques contained, "
           f"{red['captures']} captures  (total {wall_s:.1f}s)")
+    print("grading: (sock) observed on real sockets in the World; "
+          "(twin) kernel-twin verdict with synthesized capture; "
+          "(mix) twin verdict gating a socket drive.")
+    if red.get("kernel_regrade_available"):
+        print(f"kernel regrade: twin/mixed techniques re-graded on the REAL "
+              f"kernel (verifier-loaded programs, scratch cgroup): "
+              f"{', '.join(red['kernel_regraded'])}")
+    elif red.get("kernel_regrade_error"):
+        print(f"kernel regrade: CRASHED ({red['kernel_regrade_error']}); "
+              "twin rows retain twin fidelity.")
+    else:
+        print("kernel regrade: unavailable on this host (bpf(2)/cgroup-v2); "
+              "twin rows retain twin fidelity.")
     return 0 if all_ok else 1
 
 

@@ -47,3 +47,33 @@ def test_instrument_detects_escapes(tmp_path):
         assert w.attacker.store.count() >= 2
     finally:
         w.close()
+
+
+def test_grading_classification_is_total():
+    from clawker_tpu.parity.redteam import MIXED_GRADED, TWIN_GRADED, grading_of
+
+    names = {n for n, _ in TECHNIQUES}
+    assert TWIN_GRADED <= names and MIXED_GRADED <= names
+    assert not TWIN_GRADED & MIXED_GRADED
+    for n in names:
+        assert grading_of(n) in ("socket", "twin", "mixed")
+    # the corpus is predominantly socket-graded; twin rows are the
+    # explicit, named exceptions
+    assert sum(1 for n in names if grading_of(n) == "socket") >= 28
+
+
+def test_kernel_regrade_covers_every_twin_technique():
+    """Where bpf(2) works, each twin/mixed technique that has a real
+    syscall representation gets a kernel verdict (VERDICT r4 weak #7)."""
+    import pytest
+
+    from clawker_tpu.firewall import bpfkern
+    from clawker_tpu.parity.redteam import TWIN_GRADED, kernel_regrade
+
+    if not bpfkern.kernel_available():
+        pytest.skip("bpf(2)/cgroup-v2 unavailable")
+    graded = kernel_regrade("regr-test")
+    assert graded is not None
+    for name in TWIN_GRADED | {"12-v4mapped-attacker"}:
+        assert name in graded, f"{name} not kernel-regraded"
+        assert graded[name]["pass"], graded[name]
