@@ -79,6 +79,26 @@ def train_step(
     return new, loss
 
 
+def denoise_step(
+    params: AnomalyParams, x: jax.Array, key: jax.Array,
+    lr: float = 1e-3, sigma: float = 0.25,
+) -> tuple[AnomalyParams, jax.Array]:
+    """One denoising SGD step: reconstruct the CLEAN window from a noised
+    input.  With small fleets (few windows) a plain autoencoder has
+    enough capacity to memorize the anomalies it is supposed to flag;
+    the denoising objective forces it to learn the fleet manifold
+    instead, so off-manifold windows keep a high reconstruction error.
+    Same jit/pjit shape as train_step (noise is elementwise, fused)."""
+    noisy = x + sigma * jax.random.normal(key, x.shape, x.dtype)
+
+    def loss_fn(p: AnomalyParams) -> jax.Array:
+        return jnp.mean(jnp.square(_reconstruct(p, noisy) - x))
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    new = AnomalyParams(*(p - lr * g for p, g in zip(params, grads)))
+    return new, loss
+
+
 # ----------------------------------------------------------------- sharding
 
 def fleet_mesh(n_devices: int | None = None) -> Mesh:

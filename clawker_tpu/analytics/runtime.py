@@ -1,0 +1,314 @@
+"""Anomaly runtime: train-on-the-fleet, score, and watch.
+
+Glue between the host-side featurizer (features.py) and the TPU model
+(anomaly.py): ``score_windows`` fits the autoencoder on the window set
+(the fleet's behavior is its own normal profile -- self-supervised) and
+returns per-window reconstruction-error scores normalized as robust
+z-scores; ``AnomalyWatch`` re-scores an egress jsonl on an interval for
+the loop dashboard / scheduler without blocking their render paths.
+
+jax is imported lazily inside functions so the CLI, scheduler and
+dashboard stay importable (and fast) on hosts without an accelerator;
+``jax_available()`` gates callers.
+
+Parity reference: net-new (VERDICT r4 task 2 / __graft_entry__
+contract: "the fleet-telemetry anomaly model used by `clawker monitor
+anomalies` and the loop scheduler").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from . import features as F
+
+TRAIN_STEPS = 120
+ANOMALY_Z = 3.5          # robust z-score threshold for "anomalous"
+
+
+def jax_available() -> bool:
+    try:
+        import jax  # noqa: F401
+
+        return True
+    except Exception:  # noqa: BLE001 - any import failure means "no"
+        return False
+
+
+@dataclass
+class ScoreReport:
+    keys: list[F.WindowKey]
+    raw: np.ndarray          # per-window reconstruction error
+    z: np.ndarray            # robust z-score of raw
+    agents: list[F.AgentScore]   # per-agent fold of z
+    train_steps: int
+    train_ms: float
+    score_ms: float
+    device: str
+
+
+def _robust_z(raw: np.ndarray) -> np.ndarray:
+    """Median/MAD z-scores: a few hot windows must not drag the scale."""
+    if raw.size == 0:
+        return raw
+    med = float(np.median(raw))
+    mad = float(np.median(np.abs(raw - med)))
+    scale = 1.4826 * mad if mad > 0 else (float(raw.std()) or 1.0)
+    return (raw - med) / scale
+
+
+_PAD_BUCKET = 128    # rows padded up to a multiple of this: stable jit shapes
+_jit_cache: dict = {}
+
+
+def _standardize(X: np.ndarray) -> np.ndarray:
+    """Zero-mean/unit-var per feature over the window set, so the
+    reconstruction error weights dimensions evenly."""
+    mu = X.mean(axis=0) if len(X) else np.zeros(X.shape[1], np.float32)
+    sd = X.std(axis=0) if len(X) else np.ones(X.shape[1], np.float32)
+    sd = np.where(sd < 1e-6, 1.0, sd).astype(np.float32)
+    return ((X - mu) / sd).astype(np.float32)
+
+
+def _jitted():
+    """Module-level jitted fit/score: one compilation per input shape,
+    shared by every AnomalyWatch poll and CLI run in the process."""
+    if "fit" not in _jit_cache:
+        import jax
+
+        from . import anomaly
+
+        def fit(params, x, noise_keys, lr):
+            def body(p, key):
+                p, loss = anomaly.denoise_step(p, x, key, lr=lr)
+                return p, loss
+
+            return jax.lax.scan(body, params, noise_keys)
+
+        _jit_cache["fit"] = jax.jit(fit)
+        _jit_cache["score"] = jax.jit(anomaly.score)
+    return _jit_cache["fit"], _jit_cache["score"]
+
+
+def _fit_and_score(X: np.ndarray, *, train_steps: int, lr: float, seed: int):
+    """-> (raw_scores[n], params, x_padded, timings).  Rows are padded by
+    edge-replication up to _PAD_BUCKET multiples so a growing stream
+    reuses compilations; padded scores are sliced off."""
+    import jax
+    import jax.numpy as jnp
+
+    n = len(X)
+    padded = max(_PAD_BUCKET, -(-n // _PAD_BUCKET) * _PAD_BUCKET)
+    Xn = _standardize(X)
+    if padded != n:
+        pad = Xn[np.arange(padded - n) % max(n, 1)] if n else np.zeros(
+            (padded, X.shape[1]), np.float32)
+        Xn = np.concatenate([Xn, pad], axis=0)
+
+    fit, score_fn = _jitted()
+    params = anomaly_init(seed)
+    x = jnp.asarray(Xn)
+    noise_keys = jax.random.split(jax.random.key(seed + 1), train_steps)
+
+    t0 = time.perf_counter()
+    params, losses = fit(params, x, noise_keys, lr)
+    jax.block_until_ready(losses)
+    train_ms = (time.perf_counter() - t0) * 1000.0
+
+    t0 = time.perf_counter()
+    raw = np.asarray(score_fn(params, x))[:n]
+    score_ms = (time.perf_counter() - t0) * 1000.0
+    dev = next(iter(x.devices()), None) if hasattr(x, "devices") else None
+    return raw, params, x, {"train_ms": train_ms, "score_ms": score_ms,
+                            "device": str(dev) if dev else "unknown"}
+
+
+def anomaly_init(seed: int):
+    import jax
+
+    from . import anomaly
+
+    return anomaly.init_params(jax.random.key(seed))
+
+
+def score_windows(X: np.ndarray, keys: list[F.WindowKey], *,
+                  train_steps: int = TRAIN_STEPS, lr: float = 1e-2,
+                  seed: int = 0) -> ScoreReport:
+    """Fit on all windows (denoising objective), score all windows."""
+    raw, _, _, t = _fit_and_score(X, train_steps=train_steps, lr=lr, seed=seed)
+    z = _robust_z(raw)
+    return ScoreReport(
+        keys=keys, raw=raw, z=z, agents=F.summarize(keys, z),
+        train_steps=train_steps, train_ms=t["train_ms"],
+        score_ms=t["score_ms"], device=t["device"],
+    )
+
+
+def bench_lane(records: list[dict], *, train_steps: int = 100,
+               reps: int = 20) -> dict:
+    """Featurize + fit + steady-state score timing for bench.py: the
+    SAME pipeline `monitor anomalies` and AnomalyWatch run (denoising
+    fit), so the bench cannot drift from the product path."""
+    import jax
+
+    t0 = time.perf_counter()
+    keys, X = F.featurize(records)
+    featurize_ms = (time.perf_counter() - t0) * 1000.0
+    raw, params, x, t = _fit_and_score(X, train_steps=train_steps,
+                                       lr=1e-2, seed=0)
+    _, score_fn = _jitted()
+    jax.block_until_ready(score_fn(params, x))   # warm
+    steps = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(score_fn(params, x))
+        steps.append(time.perf_counter() - t0)
+    steps.sort()
+    return {
+        "windows": len(keys),
+        "featurize_ms": round(featurize_ms, 1),
+        "train_ms": round(t["train_ms"], 1),
+        "train_steps": train_steps,
+        "score_step_us": round(steps[len(steps) // 2] * 1e6, 1),
+        "device": t["device"],
+    }
+
+
+def score_file(path: str | Path, *, window_s: int = F.WINDOW_S,
+               train_steps: int = TRAIN_STEPS) -> ScoreReport | None:
+    """Featurize + score one egress jsonl; None when it yields no windows."""
+    keys, X = F.featurize(F.load_jsonl(path), window_s=window_s)
+    if not keys:
+        return None
+    return score_windows(X, keys, train_steps=train_steps)
+
+
+class AnomalyWatch:
+    """Background re-scorer for the loop dashboard / scheduler.
+
+    Tails the egress jsonl incrementally (byte offset remembered across
+    polls; cost is O(new bytes), with a bounded record window), keeps
+    the latest per-agent z-scores, and records which agents cross
+    ANOMALY_Z.  All the render path touches is a dict under a lock.
+    """
+
+    MAX_RECORDS = 100_000
+
+    def __init__(self, egress_path: Path, *, interval_s: float = 15.0,
+                 window_s: int = F.WINDOW_S, train_steps: int = 60,
+                 on_anomaly=None):
+        import collections
+
+        self.egress_path = Path(egress_path)
+        self.interval_s = interval_s
+        self.window_s = window_s
+        self.train_steps = train_steps
+        self.on_anomaly = on_anomaly or (lambda agent, z: None)
+        self._records: collections.deque = collections.deque(
+            maxlen=self.MAX_RECORDS)
+        self._offset = 0
+        self._carry = b""
+        self._scores: dict[str, F.AgentScore] = {}
+        self._flagged: set[str] = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.last_error = ""
+
+    # ------------------------------------------------------------- surface
+
+    def scores(self) -> dict[str, F.AgentScore]:
+        with self._lock:
+            return dict(self._scores)
+
+    def score_for(self, agent_or_container: str) -> F.AgentScore | None:
+        """Match loop agent names against container-named score rows.
+        Container names are dot-separated (``clawker.<proj>.<agent>``),
+        so match whole segments -- 'loop-1' must never pick up
+        'clawker.p.loop-10'."""
+        if not agent_or_container:
+            return None
+        with self._lock:
+            hit = self._scores.get(agent_or_container)
+            if hit is not None:
+                return hit
+            for name, sc in self._scores.items():
+                if agent_or_container in name.split("."):
+                    return sc
+        return None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _tail_new_records(self) -> None:
+        """Read bytes past the remembered offset; reset on truncation."""
+        try:
+            size = self.egress_path.stat().st_size
+        except OSError:
+            return
+        if size < self._offset:      # rotated/truncated: start over
+            self._offset = 0
+            self._carry = b""
+            self._records.clear()
+        if size == self._offset:
+            return
+        try:
+            with open(self.egress_path, "rb") as f:
+                f.seek(self._offset)
+                chunk = f.read(size - self._offset)
+        except OSError:
+            return
+        self._offset += len(chunk)
+        data = self._carry + chunk
+        lines = data.split(b"\n")
+        self._carry = lines.pop()    # possibly-partial last line
+        import json as _json
+
+        for line in lines:
+            try:
+                rec = _json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                self._records.append(rec)
+
+    def refresh_once(self) -> int:
+        """Synchronous tail + re-score; returns number of scored windows."""
+        try:
+            self._tail_new_records()
+            if not self._records:
+                return 0
+            keys, X = F.featurize(self._records, window_s=self.window_s)
+            if not keys:
+                return 0
+            rep = score_windows(X, keys, train_steps=self.train_steps)
+        except Exception as e:  # noqa: BLE001 - watcher must not die
+            self.last_error = f"{e.__class__.__name__}: {e}"
+            return 0
+        with self._lock:
+            self._scores = {a.agent: a for a in rep.agents}
+            newly = [a for a in rep.agents
+                     if a.latest >= ANOMALY_Z and a.agent not in self._flagged]
+            self._flagged.update(a.agent for a in newly)
+        for a in newly:
+            self.on_anomaly(a.agent, a.latest)
+        return len(rep.keys)
+
+    def start(self) -> "AnomalyWatch":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="anomaly-watch", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.refresh_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(2.0)

@@ -68,6 +68,18 @@ def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
 
     sched = LoopScheduler(f.config, f.driver, spec, on_event=on_event)
     feed = None
+    watch = None
+    # fleet anomaly scoring rides along whenever the accelerator runtime
+    # is importable: scores land in the dashboard's ANOM-Z column, the
+    # status JSON, and as scheduler events past the threshold
+    try:
+        from ..analytics import runtime as art
+    except ImportError:      # numpy-less host: the loop still runs
+        art = None
+    if art is not None and art.jax_available():
+        watch = art.AnomalyWatch(f.config.logs_dir / "ebpf-egress.jsonl")
+        sched.attach_anomaly_watch(watch)
+        watch.start()
     if live:
         # BASELINE config 4: the shared monitor TUI over the fan-out, with
         # EVERY worker's egress stream merged into the ticker (remote
@@ -101,6 +113,8 @@ def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
     finally:
         if feed is not None:
             feed.stop()
+        if watch is not None:
+            watch.stop()
     if not keep:
         sched.cleanup(remove_containers=True)
     if as_json:

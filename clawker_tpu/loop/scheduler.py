@@ -94,7 +94,25 @@ class LoopScheduler:
         self.loop_id = ids.short_id()
         self.loops: list[AgentLoop] = []
         self.on_event = on_event or (lambda agent, event, detail="": None)
+        self.anomaly_watch = None
         self._stop = threading.Event()
+
+    def attach_anomaly_watch(self, watch) -> None:
+        """Surface fleet anomaly scores (analytics.runtime.AnomalyWatch)
+        in status() and as scheduler events when an agent crosses the
+        threshold.  Optional: the loop runs identically without it."""
+        self.anomaly_watch = watch
+
+        def emit(container: str, z: float) -> None:
+            # score rows are keyed by CONTAINER name (netlogger field);
+            # events must carry the loop agent name like every other
+            # scheduler event, so map back via dot segments
+            segments = container.split(".")
+            agent = next((l.agent for l in self.loops if l.agent in segments),
+                         container)
+            self.on_event(agent, "anomaly", f"egress z-score {z:.1f}")
+
+        watch.on_anomaly = emit
 
     # -------------------------------------------------------------- set up
 
@@ -281,7 +299,15 @@ class LoopScheduler:
             self.on_event(loop.agent, "stopped")
 
     def status(self) -> list[dict]:
-        return [l.summary() for l in self.loops]
+        out = []
+        for l in self.loops:
+            row = l.summary()
+            if self.anomaly_watch is not None:
+                sc = self.anomaly_watch.score_for(l.agent)
+                if sc is not None:
+                    row["anomaly_z"] = round(sc.latest, 2)
+            out.append(row)
+        return out
 
     def cleanup(self, *, remove_containers: bool = False) -> None:
         for loop in self.loops:

@@ -26,6 +26,19 @@ EVENT_TICKER = 6     # recent scheduler events shown
 EGRESS_TICKER = 5    # recent egress decisions shown
 
 
+def _anomaly_threshold() -> float:
+    """Single source: analytics.runtime.ANOMALY_Z.  An ANOM-Z column only
+    appears when the analytics runtime produced scores, so the import
+    succeeds whenever the value is needed; the fallback keeps the
+    dashboard render path crash-free regardless."""
+    try:
+        from ..analytics.runtime import ANOMALY_Z
+
+        return ANOMALY_Z
+    except ImportError:
+        return 3.5
+
+
 def tail_jsonl(path: Path, max_lines: int = 64) -> list[dict]:
     """Last records of a jsonl file (netlogger's ebpf-egress.jsonl)."""
     try:
@@ -78,23 +91,34 @@ class LoopDashboard:
         cs = self.streams.colors()
         width = self.streams.terminal_width()
         sched = self.scheduler
+        status = sched.status()
+        has_anom = any("anomaly_z" in s for s in status)
         rows = []
-        for s in sched.status():
+        for s in status:
             codes = ",".join(map(str, s.get("exit_codes", []))) or "-"
-            rows.append([
+            row = [
                 s["agent"], s["worker"], cs.status(s["status"]),
                 str(s["iteration"]), codes,
-            ])
+            ]
+            if has_anom:
+                z = s.get("anomaly_z")
+                if z is None:
+                    row.append("-")
+                else:
+                    cell = f"{z:.1f}"
+                    row.append(cs.red(cell) if z >= _anomaly_threshold()
+                               else cell)
+            rows.append(row)
         elapsed = time.monotonic() - self.started
-        running = sum(1 for s in sched.status() if s["status"] == "running")
+        running = sum(1 for s in status if s["status"] == "running")
         head = (cs.bold(f"loop {sched.loop_id}")
                 + cs.gray(f"  {running}/{len(rows)} running"
                           f"  {elapsed:5.0f}s"))
         lines = [head, ""]
-        lines += render_table(
-            ["AGENT", "WORKER", "STATUS", "ITER", "EXITS"], rows,
-            max_width=width,
-        ).splitlines()
+        headers = ["AGENT", "WORKER", "STATUS", "ITER", "EXITS"]
+        if has_anom:
+            headers.append("ANOM-Z")
+        lines += render_table(headers, rows, max_width=width).splitlines()
 
         with self._lock:
             recent = list(self.events)[-EVENT_TICKER:]

@@ -1,0 +1,270 @@
+"""The productized anomaly lane: featurizer -> scorer -> verb -> loop.
+
+Covers VERDICT r4 task 2: the TPU compute must be reachable from the
+product -- `clawker monitor anomalies` over a recorded event file, the
+AnomalyWatch surface the scheduler/dashboard consume, and the feature
+ABI between the netlogger stream and the model.
+
+(The model itself -- shardings, train step, mesh -- is covered by
+tests/test_analytics.py; this file is the product wiring.)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from clawker_tpu.analytics import features as F
+from clawker_tpu.analytics import runtime as art
+
+
+def _rec(ts, agent="clawker.loop-0", verdict="ALLOW", reason="ROUTE",
+         ip="198.51.100.9", port=443, proto=6, zone="example.com"):
+    return {"@timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts)),
+            "service": "ebpf-egress", "container": agent, "dst_ip": ip,
+            "dst_port": port, "proto": proto, "verdict": verdict,
+            "reason": reason, "zone": zone}
+
+
+BASE = 1_700_000_000 - 1_700_000_000 % 60  # window-aligned
+
+
+class TestFeaturizer:
+    def test_window_grouping_and_shape(self):
+        recs = [_rec(BASE + i) for i in range(10)]
+        recs += [_rec(BASE + 61), _rec(BASE + 65, agent="clawker.loop-1")]
+        keys, X = F.featurize(recs)
+        assert X.shape == (len(keys), F.FEATURES) == (3, 32)
+        assert [(k.agent, k.start_unix - BASE) for k in keys] == [
+            ("clawker.loop-0", 0), ("clawker.loop-0", 60),
+            ("clawker.loop-1", 60)]
+
+    def test_feature_semantics(self):
+        recs = [_rec(BASE, verdict="DENY", reason="NO_DNS_ENTRY"),
+                _rec(BASE + 1), _rec(BASE + 1, port=53, proto=17)]
+        _, X = F.featurize(recs)
+        v = X[0]
+        assert v[0] == pytest.approx(np.log1p(3))
+        assert v[2] == pytest.approx(np.log1p(1))        # DENY count
+        assert v[5] == pytest.approx(1 / 3)              # deny ratio
+        assert v[27] == pytest.approx(np.log1p(1))       # port 53
+        assert v[23] == pytest.approx(np.log1p(1))       # udp
+        assert 0 < v[29] <= 1                            # burstiness
+
+    def test_feature_abi_matches_model(self):
+        from clawker_tpu.analytics import anomaly
+
+        assert F.FEATURES == anomaly.FEATURES == 32
+
+    def test_malformed_records_skipped(self):
+        keys, X = F.featurize([{"no": "timestamp"}, {"@timestamp": "garbage"}])
+        assert keys == [] and X.shape == (0, 32)
+
+    def test_load_jsonl_tolerates_partial_lines(self, tmp_path):
+        p = tmp_path / "egress.jsonl"
+        p.write_text(json.dumps(_rec(BASE)) + "\n{broken\n"
+                     + json.dumps(_rec(BASE + 1)) + "\n")
+        assert len(F.load_jsonl(p)) == 2
+
+
+class TestScorer:
+    def _stream(self, tmp_path, *, hot_agent=False):
+        recs = []
+        for a in range(4):
+            for w in range(6):
+                for i in range(12):
+                    recs.append(_rec(BASE + w * 60 + i * 3,
+                                     agent=f"clawker.loop-{a}",
+                                     ip=f"198.51.100.{a * 20 + i}"))
+        if hot_agent:
+            # one agent suddenly sprays denies at many hosts on odd ports
+            for i in range(55):
+                recs.append(_rec(BASE + 5 * 60 + i % 59, agent="clawker.loop-3",
+                                 verdict="DENY", reason="NO_DNS_ENTRY",
+                                 ip=f"203.0.113.{i}", port=4444 + i,
+                                 zone=""))
+        p = tmp_path / "egress.jsonl"
+        p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        return p
+
+    def test_score_file_reports_agents_and_device(self, tmp_path):
+        rep = art.score_file(self._stream(tmp_path), train_steps=40)
+        assert rep is not None
+        assert {a.agent for a in rep.agents} == {
+            f"clawker.loop-{i}" for i in range(4)}
+        assert rep.raw.shape == (len(rep.keys),)
+        assert rep.device and rep.train_ms > 0
+
+    def test_exfil_burst_scores_hottest(self, tmp_path):
+        rep = art.score_file(self._stream(tmp_path, hot_agent=True),
+                             train_steps=40)
+        by = {a.agent: a for a in rep.agents}
+        hot = by["clawker.loop-3"]
+        cold_peaks = [a.peak for a in rep.agents if a.agent != hot.agent]
+        assert hot.peak > max(cold_peaks), (
+            f"burst window not hottest: {[(a.agent, a.peak) for a in rep.agents]}")
+
+    def test_empty_file_scores_none(self, tmp_path):
+        p = tmp_path / "empty.jsonl"
+        p.write_text("")
+        assert art.score_file(p) is None
+
+    def test_watch_surfaces_scores_and_flags(self, tmp_path):
+        p = self._stream(tmp_path, hot_agent=True)
+        fired = []
+        watch = art.AnomalyWatch(p, train_steps=40,
+                                 on_anomaly=lambda a, z: fired.append((a, z)))
+        n = watch.refresh_once()
+        assert n > 0
+        assert watch.score_for("clawker.loop-2") is not None
+        assert watch.score_for("loop-2") is not None       # substring match
+        assert watch.score_for("nope") is None
+        # flagging is threshold-dependent; the surface must be consistent
+        for agent, z in fired:
+            assert watch.scores()[agent].latest >= art.ANOMALY_Z
+
+
+class TestSchedulerWiring:
+    def test_status_carries_anomaly_z(self, tmp_path):
+        from clawker_tpu import consts
+        from clawker_tpu.config import load_config
+        from clawker_tpu.engine.drivers import FakeDriver
+        from clawker_tpu.engine.fake import exit_behavior
+        from clawker_tpu.loop import LoopScheduler, LoopSpec
+        from clawker_tpu.testenv import TestEnv
+
+        with TestEnv() as tenv:
+            proj = tenv.base / "proj"
+            proj.mkdir()
+            (proj / consts.PROJECT_FLAT_FORM).write_text("project: anomwire\n")
+            cfg = load_config(proj)
+            drv = FakeDriver()
+            drv.api.add_image("clawker-anomwire:default")
+            drv.api.set_behavior("clawker-anomwire:default",
+                                 exit_behavior(b"done\n", 0))
+            sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=1,
+                                                     agent_prefix="loop"))
+            sched.start()
+            # netlogger records carry CONTAINER names, which embed the
+            # agent name -- score_for matches by substring
+            stream = tmp_path / "egress.jsonl"
+            recs = []
+            for loop in sched.loops:
+                for i in range(30):
+                    recs.append(_rec(BASE + i * 2,
+                                     agent=f"clawker.anomwire.{loop.agent}"))
+            stream.write_text("".join(json.dumps(r) + "\n" for r in recs))
+            watch = art.AnomalyWatch(stream, train_steps=30)
+            sched.attach_anomaly_watch(watch)
+            watch.refresh_once()
+            sched.run(poll_s=0.02)
+            rows = sched.status()
+            assert all("anomaly_z" in r for r in rows), rows
+            sched.cleanup(remove_containers=True)
+
+
+class TestAnomaliesVerb:
+    def _invoke(self, tmp_path, stream, *args):
+        from click.testing import CliRunner
+
+        from clawker_tpu.cli.factory import Factory
+        from clawker_tpu.cli.root import cli
+        from clawker_tpu.engine.drivers import FakeDriver
+        from clawker_tpu.testenv import TestEnv
+
+        with TestEnv() as tenv:
+            proj = tenv.base / "proj"
+            tenv.make_project(proj, "project: anomverb\n")
+            factory = Factory(cwd=proj, driver=FakeDriver())
+            return CliRunner().invoke(
+                cli, ["monitor", "anomalies", "--input", str(stream),
+                      "--train-steps", "30", *args],
+                obj=factory, catch_exceptions=False)
+
+    def _stream(self, tmp_path):
+        recs = []
+        for a in range(3):
+            for i in range(40):
+                recs.append(_rec(BASE + i * 3, agent=f"clawker.loop-{a}"))
+        p = tmp_path / "egress.jsonl"
+        p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        return p
+
+    def test_table_output(self, tmp_path):
+        res = self._invoke(tmp_path, self._stream(tmp_path))
+        assert res.exit_code == 0, res.output
+        assert "AGENT" in res.output and "clawker.loop-0" in res.output
+        assert "windows scored on" in res.output
+
+    def test_json_output(self, tmp_path):
+        res = self._invoke(tmp_path, self._stream(tmp_path), "--format", "json")
+        assert res.exit_code == 0, res.output
+        doc = json.loads(res.output)
+        assert doc["windows"] > 0 and len(doc["agents"]) == 3
+        assert all("latest_z" in a for a in doc["agents"])
+
+    def test_missing_stream_exits_1(self, tmp_path):
+        res = self._invoke(tmp_path, tmp_path / "nope.jsonl")
+        assert res.exit_code == 1
+        assert "no scorable egress windows" in res.output
+
+    def test_threshold_exit_code(self, tmp_path):
+        # threshold below every score -> exit 2 (anomaly found)
+        res = self._invoke(tmp_path, self._stream(tmp_path),
+                           "--threshold", "-999")
+        assert res.exit_code == 2
+
+
+class TestWatchIncrementalTail:
+    def test_appends_are_picked_up_and_offset_advances(self, tmp_path):
+        p = tmp_path / "egress.jsonl"
+        p.write_text("".join(json.dumps(_rec(BASE + i)) + "\n"
+                             for i in range(20)))
+        watch = art.AnomalyWatch(p, train_steps=10)
+        assert watch.refresh_once() == 1          # one window
+        off = watch._offset
+        assert off == p.stat().st_size
+        with open(p, "a") as f:
+            for i in range(20):
+                f.write(json.dumps(_rec(BASE + 120 + i)) + "\n")
+        assert watch.refresh_once() == 2          # old + new window
+        assert watch._offset > off
+
+    def test_partial_line_is_carried_not_dropped(self, tmp_path):
+        p = tmp_path / "egress.jsonl"
+        full = json.dumps(_rec(BASE))
+        p.write_text(full + "\n" + json.dumps(_rec(BASE + 1))[:10])
+        watch = art.AnomalyWatch(p, train_steps=10)
+        watch.refresh_once()
+        assert len(watch._records) == 1
+        with open(p, "a") as f:
+            f.write(json.dumps(_rec(BASE + 1))[10:] + "\n")
+        watch.refresh_once()
+        assert len(watch._records) == 2           # completed line counted
+
+    def test_truncation_resets(self, tmp_path):
+        p = tmp_path / "egress.jsonl"
+        p.write_text("".join(json.dumps(_rec(BASE + i)) + "\n"
+                             for i in range(30)))
+        watch = art.AnomalyWatch(p, train_steps=10)
+        watch.refresh_once()
+        p.write_text(json.dumps(_rec(BASE + 300)) + "\n")  # rotated
+        watch.refresh_once()
+        assert len(watch._records) == 1
+
+    def test_score_for_segment_boundaries(self, tmp_path):
+        p = tmp_path / "egress.jsonl"
+        recs = []
+        for agent in ("clawker.p.loop-x-10", "clawker.p.loop-x-1"):
+            for i in range(20):
+                recs.append(_rec(BASE + i, agent=agent))
+        p.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        watch = art.AnomalyWatch(p, train_steps=10)
+        watch.refresh_once()
+        # 'loop-x-1' must resolve to its own row, never loop-x-10's
+        sc = watch.score_for("loop-x-1")
+        assert sc is not None and sc.agent == "clawker.p.loop-x-1"
+        assert watch.score_for("loop-x-10").agent == "clawker.p.loop-x-10"
