@@ -93,13 +93,15 @@ def featurize(records: Iterable[dict], *, window_s: int = WINDOW_S,
     Returns (keys, X[n, FEATURES]) sorted by (agent, window start).  Rows
     are deterministic for a given record set.
     """
-    buckets: dict[WindowKey, list[dict]] = {}
+    # buckets carry (ts, rec) so _vectorize never re-parses timestamps
+    # (the strptime is the dominant host-side cost at watch scale)
+    buckets: dict[WindowKey, list[tuple[int, dict]]] = {}
     for rec in records:
         ts = parse_ts(rec.get("@timestamp", ""))
         if not ts:
             continue
         key = WindowKey(_agent_of(rec), ts - ts % window_s)
-        buckets.setdefault(key, []).append(rec)
+        buckets.setdefault(key, []).append((ts, rec))
 
     keys = sorted(buckets, key=lambda k: (k.agent, k.start_unix))
     X = np.zeros((len(keys), FEATURES), np.float32)
@@ -108,7 +110,8 @@ def featurize(records: Iterable[dict], *, window_s: int = WINDOW_S,
     return keys, X
 
 
-def _vectorize(recs: list[dict], window_s: int) -> np.ndarray:
+def _vectorize(pairs: list[tuple[int, dict]], window_s: int) -> np.ndarray:
+    recs = [rec for _, rec in pairs]
     v = np.zeros(FEATURES, np.float32)
     total = len(recs)
     v[0] = np.log1p(total)
@@ -137,9 +140,8 @@ def _vectorize(recs: list[dict], window_s: int) -> np.ndarray:
     v[27] = np.log1p(ports.count(53))
     v[28] = np.log1p(ports.count(443))
 
-    seconds = [parse_ts(r.get("@timestamp", "")) for r in recs]
     per_sec: dict[int, int] = {}
-    for s in seconds:
+    for s, _ in pairs:
         per_sec[s] = per_sec.get(s, 0) + 1
     if total:
         v[29] = max(per_sec.values()) / total

@@ -201,7 +201,7 @@ class AnomalyWatch:
 
     def __init__(self, egress_path: Path, *, interval_s: float = 15.0,
                  window_s: int = F.WINDOW_S, train_steps: int = 60,
-                 on_anomaly=None):
+                 on_anomaly=None, on_error=None):
         import collections
 
         self.egress_path = Path(egress_path)
@@ -209,6 +209,7 @@ class AnomalyWatch:
         self.window_s = window_s
         self.train_steps = train_steps
         self.on_anomaly = on_anomaly or (lambda agent, z: None)
+        self.on_error = on_error or (lambda msg: None)
         self._records: collections.deque = collections.deque(
             maxlen=self.MAX_RECORDS)
         self._offset = 0
@@ -287,7 +288,10 @@ class AnomalyWatch:
                 return 0
             rep = score_windows(X, keys, train_steps=self.train_steps)
         except Exception as e:  # noqa: BLE001 - watcher must not die
-            self.last_error = f"{e.__class__.__name__}: {e}"
+            msg = f"{e.__class__.__name__}: {e}"
+            if msg != self.last_error:   # surface each distinct failure once
+                self.last_error = msg
+                self.on_error(msg)
             return 0
         with self._lock:
             self._scores = {a.agent: a for a in rep.agents}

@@ -234,12 +234,15 @@ class BundleManager:
                 if Path(src).is_dir():
                     if self._tree_hash(Path(src)) == self._tree_hash(inst.path):
                         continue
-                elif inst.commit:
+                else:
                     # git source: cheap drift probe before any clone; an
                     # unreachable remote (or unchanged HEAD) skips the
-                    # re-install entirely
+                    # re-install entirely.  A commit-less receipt (bundle
+                    # installed before commits were recorded) still
+                    # probes: one re-install backfills the commit instead
+                    # of re-cloning on every TTL expiry forever.
                     head = self._ls_remote_head(src)
-                    if not head or head == inst.commit:
+                    if not head or (inst.commit and head == inst.commit):
                         continue
                 self.install(src, namespace=inst.namespace, name=inst.name)
                 updated.append(f"{inst.namespace}/{inst.name}")

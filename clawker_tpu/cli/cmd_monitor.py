@@ -115,8 +115,9 @@ def monitor_egress(f: Factory, tail, deny_only):
 @monitor_group.command("anomalies")
 @click.option("--input", "input_path", type=click.Path(),
               default=None, help="Egress jsonl (default: logs dir stream).")
-@click.option("--window", type=int, default=60, help="Window seconds.")
-@click.option("--train-steps", type=int, default=120,
+@click.option("--window", type=click.IntRange(min=1), default=60,
+              help="Window seconds.")
+@click.option("--train-steps", type=click.IntRange(min=1), default=120,
               help="Autoencoder fit steps before scoring.")
 @click.option("--top", type=int, default=0, help="Only the N hottest agents.")
 @click.option("--threshold", type=float, default=None,
@@ -133,8 +134,12 @@ def monitor_anomalies(f: Factory, input_path, window, train_steps, top,
     them, and reports reconstruction-error z-scores: the fleet's own
     behavior is the normal profile, agents that deviate surface first.
     """
-    from ..analytics import runtime as art
-
+    try:
+        from ..analytics import runtime as art
+    except ImportError:
+        click.echo("anomalies: analytics runtime unavailable on this host "
+                   "(numpy missing)", err=True)
+        raise SystemExit(1)
     if not art.jax_available():
         click.echo("anomalies: jax unavailable on this host -- the scoring "
                    "lane needs an accelerator runtime (cpu works)", err=True)

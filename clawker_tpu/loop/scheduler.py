@@ -113,6 +113,9 @@ class LoopScheduler:
             self.on_event(agent, "anomaly", f"egress z-score {z:.1f}")
 
         watch.on_anomaly = emit
+        # a broken scorer must not fail silently behind stale scores
+        watch.on_error = lambda msg: self.on_event(
+            "scheduler", "anomaly_watch_error", msg)
 
     # -------------------------------------------------------------- set up
 

@@ -19,6 +19,7 @@ Reference: internal/cmd/plugin (install/show/remove, shared/copy.go).
 
 from __future__ import annotations
 
+import os
 import shutil
 from dataclasses import dataclass
 from pathlib import Path
@@ -93,6 +94,11 @@ def install(source: Path, *, harness: str = "claude") -> list[str]:
     installed = []
     for skill in skills:
         dest = _guard(dest_root, skill.name)
+        if skill.path.is_symlink():
+            # a skill dir that IS a symlink would dereference into an
+            # arbitrary host tree -- same exfil path as in-tree links;
+            # skip it rather than fail the whole plugin
+            continue
         src = skill.path.resolve()
         if src == dest or dest in src.parents or src == dest_root.resolve():
             # installing the skills dir onto itself would rmtree the
@@ -102,10 +108,20 @@ def install(source: Path, *, harness: str = "claude") -> list[str]:
                 "skills directory; nothing to install")
         if dest.exists():
             shutil.rmtree(dest)
-        shutil.copytree(src, dest,
-                        ignore=shutil.ignore_patterns(".git"))
+        # never dereference symlinks in a third-party tree: a link to
+        # ~/.ssh/id_rsa would copy the credential INTO the skills dir,
+        # from where harness-config staging can carry it into agent
+        # containers (same refusal as containerfs._copy_tree)
+        shutil.copytree(src, dest, ignore=_ignore_git_and_symlinks)
         installed.append(skill.name)
     return installed
+
+
+def _ignore_git_and_symlinks(dirpath: str, names: list[str]) -> set[str]:
+    skip = {n for n in names if n == ".git"}
+    skip |= {n for n in names
+             if os.path.islink(os.path.join(dirpath, n))}
+    return skip
 
 
 def remove(source: Path, *, harness: str = "claude") -> list[str]:

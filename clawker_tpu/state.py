@@ -20,7 +20,7 @@ from urllib import request as urlrequest
 
 from . import __version__, consts, logsetup
 from .util import xdg
-from .util.fs import atomic_write
+from .util.fs import atomic_write, file_lock
 
 log = logsetup.get("state")
 
@@ -44,16 +44,23 @@ class StateStore:
         return self._load().get(key, default)
 
     def set(self, key: str, value) -> None:
-        data = self._load()
-        data[key] = value
+        # locked read-modify-write: the background notices thread and
+        # command-path writers (e.g. the bundle auto-update TTL stamp)
+        # update different keys concurrently; an unlocked RMW would let
+        # one writer silently drop the other's key (lost update)
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        atomic_write(self.path, json.dumps(data, indent=1).encode())
+        with file_lock(self.path):   # file_lock appends its own .lock
+            data = self._load()
+            data[key] = value
+            atomic_write(self.path, json.dumps(data, indent=1).encode())
 
     def delete(self, key: str) -> None:
-        data = self._load()
-        if key in data:
-            del data[key]
-            atomic_write(self.path, json.dumps(data, indent=1).encode())
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        with file_lock(self.path):
+            data = self._load()
+            if key in data:
+                del data[key]
+                atomic_write(self.path, json.dumps(data, indent=1).encode())
 
 
 def _default_fetch(timeout: float = 3.0) -> str:

@@ -76,9 +76,13 @@ def parse(text: str, *, lookup: Callable[[str], str | None] | None = None,
             if end < 0:
                 raise DotenvError(f"{source}:{lineno}: unterminated double quote")
             # \$ must survive as a literal dollar: protect it BEFORE
-            # expansion or pa\$\$wd would expand the unescaped "$wd"
+            # anything else or pa\$\$wd would expand the unescaped "$wd".
+            # Escapes are processed on the LITERAL source text, and only
+            # THEN variables expand -- godotenv order: a referenced var
+            # whose value contains a literal backslash sequence (e.g.
+            # "\\n") must come through verbatim, not escape-processed.
             inner = rest[1:end].replace("\\$", "\x00")
-            value = _unescape(_expand(inner, out, lookup)).replace("\x00", "$")
+            value = _expand(_unescape(inner), out, lookup).replace("\x00", "$")
         elif rest.startswith("'"):
             end = rest.find("'", 1)
             if end < 0:

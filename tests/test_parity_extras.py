@@ -206,3 +206,63 @@ def test_changelog_teaser_shows_once(tmp_path):
     assert teaser(state=state, path=log, version="0.1.0") == ""
     # unknown version: quiet, but marks seen
     assert teaser(state=state, path=log, version="9.9.9") == ""
+
+
+def test_dotenv_expanded_values_keep_literal_escapes():
+    """godotenv order: escapes process the SOURCE text only; a referenced
+    variable whose value contains a literal backslash-n must come through
+    verbatim (ADVICE r4: unescape-then-expand)."""
+    out = parse('A="x\\ny"\nB="ref: $A"\n',
+                lookup={"RAW": "path\\nwith\\tliterals"}.get)
+    assert out["A"] == "x\ny"
+    assert out["B"] == "ref: x\ny"
+    out = parse('C="$RAW"\n', lookup={"RAW": "path\\nwith\\tliterals"}.get)
+    # the lookup value's backslashes are DATA, not escapes
+    assert out["C"] == "path\\nwith\\tliterals"
+    # \$ still blocks expansion
+    out = parse('D="pa\\$\\$wd"\n', lookup=lambda n: "BOOM")
+    assert out["D"] == "pa$$wd"
+
+
+def test_bundle_auto_update_backfills_commitless_git_receipt(tmp_path):
+    """A git receipt with no commit must probe ls-remote and re-install
+    ONCE to backfill -- not fall through to an unconditional daily
+    re-clone (ADVICE r4)."""
+    import json as _json
+
+    from clawker_tpu.bundle.manager import RECEIPT, BundleManager
+    from clawker_tpu.config import load_config
+    from clawker_tpu.state import StateStore
+    from clawker_tpu.testenv import TestEnv
+
+    with TestEnv() as tenv:
+        proj = tenv.base / "p"
+        proj.mkdir()
+        (proj / ".clawker.yaml").write_text("project: aucommit\n")
+        cfg = load_config(proj)
+        mgr = BundleManager(cfg)
+        src = make_bundle(tmp_path / "src", "harn")
+        inst = mgr.install(str(src), name="au")
+        # rewrite the receipt: pretend a git source, commit-less
+        rp = inst.path / RECEIPT
+        receipt = _json.loads(rp.read_text())
+        receipt["source"] = "https://example.invalid/repo.git"
+        receipt.pop("commit", None)
+        rp.write_text(_json.dumps(receipt))
+
+        calls = []
+        mgr._ls_remote_head = lambda url: "abc123"
+        mgr.install = lambda *a, **k: calls.append((a, k))
+        state = StateStore(tmp_path / "state.json")
+        assert mgr.auto_update_check(state=state, ttl_s=0) == ["local/au"]
+        assert len(calls) == 1          # one backfill re-install
+        # unreachable remote: soft-skip, no re-install
+        mgr._ls_remote_head = lambda url: ""
+        mgr.auto_update_check(state=state, ttl_s=0)
+        assert len(calls) == 1
+        # commit recorded and matching: skip
+        receipt["commit"] = "abc123"
+        rp.write_text(_json.dumps(receipt))
+        mgr._ls_remote_head = lambda url: "abc123"
+        assert mgr.auto_update_check(state=state, ttl_s=0) == []
+        assert len(calls) == 1

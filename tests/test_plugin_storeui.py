@@ -172,3 +172,41 @@ def test_run_editor_refuses_without_tty(tmp_path):
     streams, *_ = IOStreams.test()
     with pytest.raises(EditError, match="TTY"):
         run_editor(store, streams)
+
+
+def test_install_skips_symlinks_in_third_party_trees(tmp_path, monkeypatch):
+    """A plugin source containing a symlink (e.g. to ~/.ssh/id_rsa) must
+    not copy the link target into the skills dir (ADVICE r4 medium;
+    same refusal as containerfs._copy_tree)."""
+    monkeypatch.setenv("CLAUDE_CONFIG_DIR", str(tmp_path / "claude"))
+    secret = tmp_path / "id_rsa"
+    secret.write_text("PRIVATE KEY MATERIAL")
+    src = tmp_path / "evil-plugin"
+    sk = src / "skills" / "innocent"
+    sk.mkdir(parents=True)
+    (sk / "SKILL.md").write_text("# innocent")
+    (sk / "stolen").symlink_to(secret)
+    (sk / "linkdir").symlink_to(tmp_path)   # dir symlink: worse
+    installed = install(src, harness="claude")
+    assert installed == ["innocent"]
+    dest = skills_dir("claude") / "innocent"
+    assert (dest / "SKILL.md").is_file()
+    assert not (dest / "stolen").exists()
+    assert not (dest / "linkdir").exists()
+
+
+def test_install_skips_skill_dir_that_is_a_symlink(tmp_path, monkeypatch):
+    monkeypatch.setenv("CLAUDE_CONFIG_DIR", str(tmp_path / "claude"))
+    foreign = tmp_path / "foreign-tree"
+    foreign.mkdir()
+    (foreign / "SKILL.md").write_text("# foreign")
+    (foreign / "cred.pem").write_text("SECRET")
+    src = tmp_path / "plug"
+    (src / "skills").mkdir(parents=True)
+    real = src / "skills" / "genuine"
+    real.mkdir()
+    (real / "SKILL.md").write_text("# genuine")
+    (src / "skills" / "linked").symlink_to(foreign)
+    installed = install(src, harness="claude")
+    assert installed == ["genuine"]
+    assert not (skills_dir("claude") / "linked").exists()

@@ -67,3 +67,27 @@ def test_update_check_offline_is_quiet(store):
     # the failure is cached too: no per-command retries
     assert check_for_update(state=store, fetch=fetch, now=2.0) == ""
     assert len(calls) == 1
+
+
+def test_concurrent_set_loses_no_updates(tmp_path):
+    """ADVICE r4: set() is a locked read-modify-write -- concurrent
+    writers (notices thread vs command path) must not drop keys."""
+    import threading
+
+    from clawker_tpu.state import StateStore
+
+    store = StateStore(tmp_path / "cli-state.json")
+    n = 30
+
+    def writer(prefix):
+        for i in range(n):
+            store.set(f"{prefix}-{i}", i)
+
+    threads = [threading.Thread(target=writer, args=(p,)) for p in "abcd"]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for p in "abcd":
+        for i in range(n):
+            assert store.get(f"{p}-{i}") == i, f"lost update {p}-{i}"
