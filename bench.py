@@ -34,19 +34,27 @@ import time
 from pathlib import Path
 
 
-def bench_cold_start(iters: int = 40) -> float:
+def bench_cold_start(iters: int = 40) -> tuple[float, dict[str, float]]:
+    """-> (p50 seconds, mean per-stage milliseconds).
+
+    Stages come from the in-tree phase stopwatch (util/phases) wired
+    through factory config load and the orchestrator's create/start
+    path, so the breakdown attributes the SAME run the headline times.
+    """
     from click.testing import CliRunner
 
     from clawker_tpu.cli.factory import Factory
     from clawker_tpu.cli.root import cli
     from clawker_tpu.engine.drivers import FakeDriver
     from clawker_tpu.testenv import TestEnv
+    from clawker_tpu.util import phases
 
     samples: list[float] = []
     with TestEnv() as tenv:
         proj = tenv.base / "proj"
         tenv.make_project(proj, "project: bench\n")
         runner = CliRunner()
+        phases.enable()
         for i in range(iters):
             driver = FakeDriver()
             driver.api.add_image("clawker-bench:default")
@@ -61,7 +69,12 @@ def bench_cold_start(iters: int = 40) -> float:
             dt = time.perf_counter() - t0
             assert res.exit_code == 0, res.output
             samples.append(dt)
-    return statistics.median(samples)
+        stage_totals = phases.disable()
+    stages = {name: round(total * 1000.0 / iters, 3)
+              for name, total in sorted(stage_totals.items())}
+    stages["other"] = round(
+        statistics.mean(samples) * 1000 - sum(stages.values()), 3)
+    return statistics.median(samples), stages
 
 
 def bench_parity() -> tuple[float, int, int]:
@@ -214,8 +227,31 @@ def bench_anomaly() -> dict:
     return art.bench_lane(synth_egress_records())
 
 
+def previous_round_p50() -> float:
+    """The newest committed BENCH_r*.json's headline value (ms), or 0."""
+    import re
+
+    best = (0, 0.0)
+    for p in Path(__file__).resolve().parent.glob("BENCH_r*.json"):
+        m = re.match(r"BENCH_r(\d+)\.json$", p.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(p.read_text())
+            # driver wrapper format: the bench line lives in "tail"
+            if "value" not in doc and "tail" in doc:
+                doc = json.loads(doc["tail"])
+            val = float(doc.get("value", 0.0))
+        except (OSError, ValueError):
+            continue
+        rnd = int(m.group(1))
+        if rnd > best[0] and val > 0:
+            best = (rnd, val)
+    return best[1]
+
+
 def main() -> None:
-    p50_s = bench_cold_start()
+    p50_s, stages = bench_cold_start()
     parity_wall, parity_passed, parity_total = bench_parity()
     decisions = bench_policy_oracle()
     qps = bench_dnsgate_qps()
@@ -241,17 +277,25 @@ def main() -> None:
              5000.0 / max(anom["score_step_us"], 1e-9), 1),
          "detail": anom},
     ]
-    print(
-        json.dumps(
-            {
-                "metric": "agent_cold_start_framework_p50",
-                "value": round(p50_s * 1000, 2),
-                "unit": "ms",
-                "vs_baseline": round(budget_s / p50_s, 1),
-                "extra": extra,
-            }
-        )
-    )
+    prev_ms = previous_round_p50()
+    cur_ms = round(p50_s * 1000, 2)
+    regressed = bool(prev_ms) and cur_ms > prev_ms * 1.15
+    doc = {
+        "metric": "agent_cold_start_framework_p50",
+        "value": cur_ms,
+        "unit": "ms",
+        "vs_baseline": round(budget_s / p50_s, 1),
+        "stages_ms": stages,
+        "prev_round_ms": prev_ms,
+        "extra": extra,
+    }
+    if regressed:
+        # the round-4 verdict's regression gate: >15% p50 creep vs the
+        # committed previous round fails the bench run loudly
+        doc["regression"] = f"p50 {cur_ms}ms > 1.15 x prev {prev_ms}ms"
+    print(json.dumps(doc))
+    if regressed:
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":

@@ -293,6 +293,7 @@ class AnomalyWatch:
                 self.last_error = msg
                 self.on_error(msg)
             return 0
+        self.last_error = ""   # recovered: a recurring failure re-fires
         with self._lock:
             self._scores = {a.agent: a for a in rep.agents}
             newly = [a for a in rep.agents

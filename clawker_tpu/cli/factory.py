@@ -58,7 +58,10 @@ class Factory:
     def config(self) -> Config:
         if self._config_override is not None:
             return self._config_override
-        return load_config(self.cwd)
+        from ..util import phases
+
+        with phases.phase("config_load"):
+            return load_config(self.cwd)
 
     @functools.cached_property
     def driver(self) -> RuntimeDriver:

@@ -12,7 +12,15 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass, field, fields, is_dataclass
+from functools import lru_cache
 from typing import Any, get_args, get_origin, get_type_hints
+
+
+@lru_cache(maxsize=None)
+def _hints(cls) -> dict:
+    """get_type_hints per dataclass, cached: the schema classes are
+    static, and hint resolution dominated config-load time."""
+    return get_type_hints(cls)
 
 
 # --------------------------------------------------------------------------
@@ -25,7 +33,7 @@ def from_dict(cls, data: Any):
         return cls()
     if not isinstance(data, dict):
         raise TypeError(f"{cls.__name__}: expected mapping, got {type(data).__name__}")
-    hints = get_type_hints(cls)
+    hints = _hints(cls)
     kwargs = {}
     for f in fields(cls):
         if f.name not in data:

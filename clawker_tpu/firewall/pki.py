@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import datetime
 from dataclasses import dataclass
+from functools import cached_property
 from pathlib import Path
 
 from cryptography import x509
@@ -36,11 +37,14 @@ class CA:
     cert_pem: bytes
     key_pem: bytes
 
-    @property
+    # parsed forms are cached per CA object: the identity mint path
+    # touches .key/.cert on every agent create, and PEM parsing was a
+    # measurable share of cold-start (bench stage: bootstrap)
+    @cached_property
     def cert(self) -> x509.Certificate:
         return x509.load_pem_x509_certificate(self.cert_pem)
 
-    @property
+    @cached_property
     def key(self) -> ec.EllipticCurvePrivateKey:
         k = serialization.load_pem_private_key(self.key_pem, password=None)
         assert isinstance(k, ec.EllipticCurvePrivateKey)
@@ -93,16 +97,38 @@ def generate_ca(common_name: str = "clawker-tpu firewall CA") -> CA:
     return CA(cert_pem=cert.public_bytes(serialization.Encoding.PEM), key_pem=_key_pem(key))
 
 
+_CA_CACHE: dict[tuple, CA] = {}
+
+
 def ensure_ca(pki_dir: Path) -> CA:
-    """Load the CA from ``pki_dir``, generating it on first use."""
+    """Load the CA from ``pki_dir``, generating it on first use.
+
+    Process-cached by (path, mtimes): repeated creates in one process
+    (loop fan-out, bench) reuse the same CA object -- and its parsed
+    key/cert -- while rotate_ca's unlink+rewrite changes the mtime
+    signature and naturally invalidates."""
     cert_p, key_p = pki_dir / CA_CERT, pki_dir / CA_KEY
+    try:
+        sig = (str(pki_dir), cert_p.stat().st_mtime_ns, key_p.stat().st_mtime_ns)
+    except OSError:
+        sig = None
+    if sig is not None:
+        hit = _CA_CACHE.get(sig)
+        if hit is not None:
+            return hit
     if cert_p.is_file() and key_p.is_file():
-        return CA(cert_pem=cert_p.read_bytes(), key_pem=key_p.read_bytes())
-    pki_dir.mkdir(parents=True, exist_ok=True)
-    ca = generate_ca()
-    cert_p.write_bytes(ca.cert_pem)
-    key_p.write_bytes(ca.key_pem)
-    key_p.chmod(0o600)
+        ca = CA(cert_pem=cert_p.read_bytes(), key_pem=key_p.read_bytes())
+    else:
+        pki_dir.mkdir(parents=True, exist_ok=True)
+        ca = generate_ca()
+        cert_p.write_bytes(ca.cert_pem)
+        key_p.write_bytes(ca.key_pem)
+        key_p.chmod(0o600)
+        sig = (str(pki_dir), cert_p.stat().st_mtime_ns, key_p.stat().st_mtime_ns)
+    if sig is not None:
+        if len(_CA_CACHE) > 64:
+            _CA_CACHE.clear()
+        _CA_CACHE[sig] = ca
     return ca
 
 
@@ -111,6 +137,9 @@ def rotate_ca(pki_dir: Path) -> CA:
     for f in (pki_dir / CA_CERT, pki_dir / CA_KEY):
         if f.exists():
             f.unlink()
+    # never trust mtime granularity across a rotation: a same-tick
+    # rewrite must not let ensure_ca return the retired root
+    _CA_CACHE.clear()
     return ensure_ca(pki_dir)
 
 

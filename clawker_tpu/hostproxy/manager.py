@@ -40,7 +40,9 @@ def running(cfg: Config) -> bool:
 
 def ensure_running(cfg: Config) -> None:
     try:
-        _spec(cfg).ensure_running(log=log)
+        # pre-start hot path: a proxy proven healthy in the last few
+        # seconds is not re-probed for every agent create
+        _spec(cfg).ensure_running(log=log, probe_ttl_s=3.0)
     except DaemonError as e:
         raise HostProxyError(str(e)) from None
 

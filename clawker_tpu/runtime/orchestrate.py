@@ -18,6 +18,7 @@ from .. import consts
 from ..config import Config
 from ..engine.api import ContainerSpec, Engine
 from ..errors import ConflictError
+from ..util import phases
 from . import attach as attach_mod
 from .labels import agent_labels
 from .names import container_name
@@ -103,15 +104,16 @@ class AgentRuntime:
             if opts.mount_docker_socket is not None
             else bool(pconf and pconf.workspace.mount_docker_socket)
         )
-        mounts = setup_mounts(
-            self.engine,
-            project,
-            opts.agent,
-            root,
-            mode=mode,
-            extra_mounts=(pconf.workspace.extra_mounts if pconf else None),
-            worktree_git_dir=opts.worktree_git_dir,
-        )
+        with phases.phase("workspace_mounts"):
+            mounts = setup_mounts(
+                self.engine,
+                project,
+                opts.agent,
+                root,
+                mode=mode,
+                extra_mounts=(pconf.workspace.extra_mounts if pconf else None),
+                worktree_git_dir=opts.worktree_git_dir,
+            )
 
         env = self._build_env(project, opts)
         harness = opts.harness or (pconf.build.harness if pconf else "")
@@ -148,16 +150,20 @@ class AgentRuntime:
             ),
         )
         try:
-            cid = self.engine.create_container(name, spec)
+            with phases.phase("engine_create"):
+                cid = self.engine.create_container(name, spec)
         except ConflictError:
             raise ConflictError(
                 f"agent {opts.agent!r} already exists for project {project!r} "
                 f"(container {name}); use --replace or `clawker start`"
             )
-        mounts.seed(self.engine, cid)
-        self._seed_harness_config(cid, harness, root)
+        with phases.phase("workspace_seed"):
+            mounts.seed(self.engine, cid)
+        with phases.phase("harness_seed"):
+            self._seed_harness_config(cid, harness, root)
         if self.bootstrap:
-            self.bootstrap(cid, project, opts.agent)
+            with phases.phase("identity_bootstrap"):
+                self.bootstrap(cid, project, opts.agent)
         return cid
 
     def _seed_harness_config(self, cid: str, harness: str, root: Path) -> None:
@@ -221,10 +227,13 @@ class AgentRuntime:
 
     def start(self, name_or_id: str) -> None:
         if self.pre_start:
-            self.pre_start(name_or_id)
-        self.engine.start_container(name_or_id)
+            with phases.phase("pre_start"):
+                self.pre_start(name_or_id)
+        with phases.phase("engine_start"):
+            self.engine.start_container(name_or_id)
         if self.post_start:
-            self.post_start(name_or_id)
+            with phases.phase("post_start"):
+                self.post_start(name_or_id)
 
     def attach_and_run(
         self,

@@ -12,6 +12,7 @@ Bridge (bridge.go:59).
 
 from __future__ import annotations
 
+import functools as _functools
 import os
 import socket
 import subprocess
@@ -31,22 +32,28 @@ CONTAINER_CMD = [
 ]
 
 
+@_functools.lru_cache(maxsize=1)
+def _gpgconf_extra_socket() -> str:
+    """One gpgconf subprocess per process: its answer depends only on
+    the gpg home, and the probe was a fixed per-create cost."""
+    try:
+        res = subprocess.run(
+            ["gpgconf", "--list-dirs", "agent-extra-socket"],
+            capture_output=True, text=True, timeout=5,
+        )
+        if res.returncode == 0:
+            return res.stdout.strip()
+    except OSError:
+        pass
+    return ""
+
+
 def default_host_sockets() -> dict[int, str]:
     out: dict[int, str] = {}
     ssh = os.environ.get("SSH_AUTH_SOCK", "")
     if ssh:
         out[W_SSH] = ssh
-    gpg = os.environ.get("GPG_AGENT_EXTRA_SOCK", "")
-    if not gpg:
-        try:
-            res = subprocess.run(
-                ["gpgconf", "--list-dirs", "agent-extra-socket"],
-                capture_output=True, text=True, timeout=5,
-            )
-            if res.returncode == 0:
-                gpg = res.stdout.strip()
-        except OSError:
-            pass
+    gpg = os.environ.get("GPG_AGENT_EXTRA_SOCK", "") or _gpgconf_extra_socket()
     if gpg and os.path.exists(gpg):
         out[W_GPG] = gpg
     return out

@@ -28,6 +28,20 @@ class DaemonError(ClawkerError):
     pass
 
 
+# positive health verdicts, keyed by health_url: the create hot path
+# probes the host proxy before every agent start, and a live daemon does
+# not need re-proving every few milliseconds.  Only positives are cached
+# -- a dead daemon must be re-probed so ensure_running can spawn it.
+_HEALTH_CACHE: dict[str, tuple[float, dict]] = {}
+
+
+def invalidate_health_cache(url: str | None = None) -> None:
+    if url is None:
+        _HEALTH_CACHE.clear()
+    else:
+        _HEALTH_CACHE.pop(url, None)
+
+
 class DaemonSpec:
     def __init__(self, *, name: str, module: str, pidfile: Path, logfile: Path,
                  health_url: str, start_deadline_s: float = 15.0):
@@ -40,23 +54,37 @@ class DaemonSpec:
 
     # ------------------------------------------------------------ probes
 
-    def health(self, timeout: float = 2.0) -> dict | None:
+    def health(self, timeout: float = 2.0, *,
+               cache_ttl_s: float = 0.0) -> dict | None:
         """The health body, or None when nothing answers.  A 503 is a
         live-but-degraded daemon: the body still comes back so callers
-        can see which subsystem is down, instead of kill/respawn loops."""
+        can see which subsystem is down, instead of kill/respawn loops.
+
+        ``cache_ttl_s`` > 0 reuses a recent POSITIVE verdict for this
+        url (hot create paths); negatives always re-probe."""
+        if cache_ttl_s > 0:
+            hit = _HEALTH_CACHE.get(self.health_url)
+            if hit is not None and time.monotonic() - hit[0] < cache_ttl_s:
+                return hit[1]
+        out: dict | None
         try:
             with urlrequest.urlopen(self.health_url, timeout=timeout) as r:
-                return json.loads(r.read() or b"{}")
+                out = json.loads(r.read() or b"{}")
         except urlerror.HTTPError as e:
             try:
-                return json.loads(e.read() or b"{}")
+                out = json.loads(e.read() or b"{}")
             except (OSError, json.JSONDecodeError):
-                return {"degraded": True}
+                out = {"degraded": True}
         except (urlerror.URLError, OSError, json.JSONDecodeError):
-            return None
+            out = None
+        if out is not None:
+            _HEALTH_CACHE[self.health_url] = (time.monotonic(), out)
+        else:
+            _HEALTH_CACHE.pop(self.health_url, None)
+        return out
 
-    def running(self) -> bool:
-        return self.health() is not None
+    def running(self, *, cache_ttl_s: float = 0.0) -> bool:
+        return self.health(cache_ttl_s=cache_ttl_s) is not None
 
     def _read_pid(self) -> int:
         try:
@@ -93,8 +121,9 @@ class DaemonSpec:
 
     # --------------------------------------------------------- lifecycle
 
-    def ensure_running(self, *, env: dict | None = None, log=None) -> None:
-        if self.running():
+    def ensure_running(self, *, env: dict | None = None, log=None,
+                       probe_ttl_s: float = 0.0) -> None:
+        if self.running(cache_ttl_s=probe_ttl_s):
             return
         pid = self._read_pid()
         if self._pid_alive(pid):
@@ -139,4 +168,7 @@ class DaemonSpec:
         if was:
             self._terminate(pid)
         self.pidfile.unlink(missing_ok=True)
+        # the daemon is gone: a cached positive verdict would make the
+        # next ensure_running(probe_ttl_s=...) skip the respawn
+        invalidate_health_cache(self.health_url)
         return was

@@ -1,0 +1,58 @@
+"""Process-local phase stopwatch: cold-start attribution for bench.py.
+
+Disabled by default and free when off (one truthiness check per phase).
+bench.py enables it around each measured `clawker run` and reads the
+per-stage totals, so BENCH_r{N}.json can say WHERE the milliseconds
+went (config load / mounts / engine create / harness seed / identity
+bootstrap / pre-start / engine start / post-start) instead of only the
+headline p50 -- the round-4 verdict's "creep with no owner" gap.
+
+Not a tracing system: for spans shipped to the collector use
+controlplane/otel.py.  This is a single-process accumulator with zero
+dependencies, safe to call from any layer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+_enabled = False
+_totals: dict[str, float] = {}
+_counts: dict[str, int] = {}
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+    _totals.clear()
+    _counts.clear()
+
+
+def disable() -> dict[str, float]:
+    """Stop recording; returns {phase: total_seconds}."""
+    global _enabled
+    _enabled = False
+    return dict(_totals)
+
+
+def totals() -> dict[str, float]:
+    return dict(_totals)
+
+
+def counts() -> dict[str, int]:
+    return dict(_counts)
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        _totals[name] = _totals.get(name, 0.0) + dt
+        _counts[name] = _counts.get(name, 0) + 1

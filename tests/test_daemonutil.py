@@ -1,0 +1,66 @@
+"""util/daemon + util/phases: health-probe caching and the
+cold-start phase stopwatch (bench attribution, VERDICT r4 task 7)."""
+
+
+def test_phases_stopwatch_accumulates_only_when_enabled():
+    from clawker_tpu.util import phases
+
+    with phases.phase("off"):
+        pass
+    assert "off" not in phases.totals()
+    phases.enable()
+    for _ in range(3):
+        with phases.phase("on"):
+            pass
+    out = phases.disable()
+    assert out["on"] >= 0 and phases.counts()["on"] == 3
+    with phases.phase("off2"):
+        pass
+    assert "off2" not in phases.totals()
+
+
+def test_health_cache_reuses_positive_and_reprobes_negative(tmp_path):
+    import json as _json
+
+    from clawker_tpu.util import daemon as dmod
+
+    calls = []
+
+    class Spec(dmod.DaemonSpec):
+        def __init__(self):
+            super().__init__(name="t", module="m", pidfile=tmp_path / "p",
+                             logfile=tmp_path / "l",
+                             health_url="http://127.0.0.1:1/healthz")
+
+    spec = Spec()
+    real_urlopen = dmod.urlrequest.urlopen
+
+    class FakeResp:
+        def __enter__(self): return self
+        def __exit__(self, *a): return False
+        def read(self): return _json.dumps({"ok": True}).encode()
+
+    def fake_urlopen(url, timeout=0):
+        calls.append(url)
+        return FakeResp()
+
+    dmod.invalidate_health_cache()
+    dmod.urlrequest.urlopen = fake_urlopen
+    try:
+        assert spec.health(cache_ttl_s=5.0) == {"ok": True}
+        assert spec.health(cache_ttl_s=5.0) == {"ok": True}
+        assert len(calls) == 1                   # positive verdict cached
+        assert spec.health() == {"ok": True}     # ttl 0: always probes
+        assert len(calls) == 2
+
+        def dead_urlopen(url, timeout=0):
+            calls.append(url)
+            raise OSError("refused")
+
+        dmod.urlrequest.urlopen = dead_urlopen
+        assert spec.health() is None             # negative evicts
+        assert spec.health(cache_ttl_s=5.0) is None   # and is NOT cached
+        assert len(calls) == 4
+    finally:
+        dmod.urlrequest.urlopen = real_urlopen
+        dmod.invalidate_health_cache()
