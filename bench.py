@@ -238,9 +238,13 @@ def previous_round_p50() -> float:
             continue
         try:
             doc = json.loads(p.read_text())
+            if doc.get("rc", 0) != 0:
+                continue  # a failed round never becomes the baseline
             # driver wrapper format: the bench line lives in "tail"
             if "value" not in doc and "tail" in doc:
                 doc = json.loads(doc["tail"])
+            if "regression" in doc:
+                continue  # nor does a round that tripped the gate
             val = float(doc.get("value", 0.0))
         except (OSError, ValueError):
             continue

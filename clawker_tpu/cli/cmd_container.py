@@ -5,6 +5,7 @@ logs / inspect, as a ``container`` group plus Docker-style top-level aliases
 
 from __future__ import annotations
 
+import io
 import json
 import re
 import sys
@@ -32,7 +33,12 @@ def _resolve_ref(f: Factory, name_or_agent: str) -> str:
 # ------------------------------------------------------------------- run
 
 
-@click.command("run")
+@click.command("run", context_settings={
+    # docker semantics: everything after the first CMD token belongs to
+    # the container command ("run ... sh -c 'exit 7'"), never to clawker
+    "ignore_unknown_options": True,
+    "allow_interspersed_args": False,
+})
 @click.option("--agent", "-a", default=None, help="Agent name (default: project config).")
 @click.option("--image", default="@", show_default=True, help="Image ('@' = project image).")
 @click.option("--env", "-e", multiple=True, help="KEY=VALUE (repeatable).")
@@ -45,10 +51,12 @@ def _resolve_ref(f: Factory, name_or_agent: str) -> str:
 @click.option("--detach", "-d", is_flag=True, help="Start without attaching.")
 @click.option("--no-tty", is_flag=True, help="Disable TTY allocation.")
 @click.option("--worktree", default="", help="Run in the named git worktree.")
-@click.argument("cmd", nargs=-1)
+@click.option("--workdir", default="",
+              help="Override the container working directory.")
+@click.argument("cmd", nargs=-1, type=click.UNPROCESSED)
 @pass_factory
 def run_cmd(f: Factory, agent, image, env, env_files, workspace, replace,
-            detach, no_tty, worktree, cmd):
+            detach, no_tty, worktree, workdir, cmd):
     """Create an agent container and attach to it (create+start+attach)."""
     cfg = f.config
     # TTL-gated bundle refresh before resolving images/harnesses
@@ -70,6 +78,7 @@ def run_cmd(f: Factory, agent, image, env, env_files, workspace, replace,
         tty=not no_tty,
         workspace_mode=workspace or "",
         replace=replace,
+        workdir=workdir,
     )
     if worktree:
         from ..project.manager import ProjectManager
@@ -110,22 +119,32 @@ def _assemble_env(env: tuple, env_files: tuple) -> dict[str, str]:
     return out
 
 
-@container_group.command("create")
+@container_group.command("create", context_settings={
+    "ignore_unknown_options": True,
+    "allow_interspersed_args": False,
+})
 @click.option("--agent", "-a", default=None)
 @click.option("--image", default="@")
 @click.option("--env", "-e", multiple=True)
 @click.option("--env-file", "env_files", multiple=True,
               type=click.Path(exists=True))
 @click.option("--replace", is_flag=True)
-@click.argument("cmd", nargs=-1)
+@click.option("--workspace", type=click.Choice(["bind", "snapshot"]),
+              default=None)
+@click.option("--workdir", default="",
+              help="Override the container working directory.")
+@click.argument("cmd", nargs=-1, type=click.UNPROCESSED)
 @pass_factory
-def create_cmd(f: Factory, agent, image, env, env_files, replace, cmd):
+def create_cmd(f: Factory, agent, image, env, env_files, replace, workspace,
+               workdir, cmd):
     """Create an agent container without starting it."""
     cfg = f.config
     agent = agent or (cfg.project.agent.default if cfg.project else "dev")
     envd = _assemble_env(env, env_files)
     f.runtime().create(
-        CreateOptions(agent=agent, image=image, cmd=list(cmd), env=envd, replace=replace)
+        CreateOptions(agent=agent, image=image, cmd=list(cmd), env=envd,
+                      replace=replace, workspace_mode=workspace or "",
+                      workdir=workdir)
     )
     click.echo(container_name(cfg.project_name(), agent))
 
@@ -258,6 +277,38 @@ def wait_cmd(f: Factory, name):
     click.echo(f.engine().wait_container(_resolve_ref(f, name)))
 
 
+@click.command("exec", context_settings={
+    "ignore_unknown_options": True,
+    "allow_interspersed_args": False,
+})
+@click.option("--tty", "-t", is_flag=True, help="Allocate a pseudo-TTY.")
+@click.option("--interactive", "-i", is_flag=True, help="Keep stdin open.")
+@click.option("--env", "-e", multiple=True, help="KEY=VALUE (repeatable).")
+@click.option("--user", "-u", default="", help="User inside the container.")
+@click.option("--workdir", default="", help="Working directory for the command.")
+@click.argument("name")
+@click.argument("cmd", nargs=-1, type=click.UNPROCESSED, required=True)
+@pass_factory
+def exec_cmd(f: Factory, tty, interactive, env, user, workdir, name, cmd):
+    """Run a command inside a running agent container.
+
+    Reference parity: clawker container exec / clawker exec
+    (docs/cli-reference/clawker_container_exec.md); exit code propagates.
+    """
+    ref = _resolve_ref(f, name)
+    engine = f.engine()
+    envd = dict(e.split("=", 1) if "=" in e else (e, "") for e in env)
+    eid, stream = engine.exec(ref, list(cmd), user=user, env=envd,
+                              tty=tty, stdin=interactive, workdir=workdir)
+    from ..runtime import attach as attach_mod
+
+    stdin: object = sys.stdin.buffer if interactive else io.BytesIO(b"")
+    attach_mod.pump_streams(stream, stdin, sys.stdout.buffer)
+    code = engine.exec_exit_code(eid)
+    if code != 0:
+        raise SystemExit(code)
+
+
 def register(root: click.Group) -> None:
     root.add_command(run_cmd)
     root.add_command(container_group)
@@ -269,3 +320,5 @@ def register(root: click.Group) -> None:
     root.add_command(attach_cmd, "attach")
     root.add_command(kill_cmd, "kill")
     root.add_command(logs_cmd, "logs")
+    root.add_command(exec_cmd, "exec")
+    container_group.add_command(exec_cmd)

@@ -264,6 +264,7 @@ class Engine:
         tty: bool = False,
         detach: bool = False,
         stdin: bool = False,
+        workdir: str = "",
     ):
         """Create+start an exec; returns (exec_id, stream-or-None)."""
         self._assert_managed_container(ref)
@@ -276,6 +277,8 @@ class Engine:
         }
         if user:
             cfg["User"] = user
+        if workdir:
+            cfg["WorkingDir"] = workdir
         if env:
             cfg["Env"] = [f"{k}={v}" for k, v in env.items()]
         eid = self.api.exec_create(ref, cfg)["Id"]
@@ -283,7 +286,20 @@ class Engine:
         return eid, stream
 
     def exec_exit_code(self, exec_id: str) -> int:
-        return int(self.api.exec_inspect(exec_id).get("ExitCode") or 0)
+        """Exit code once the exec has finished.  Stream EOF can precede
+        the daemon committing the code (docker CLI polls inspect for the
+        same reason), so poll briefly while Running/None."""
+        import time as _time
+
+        deadline = _time.monotonic() + 5.0
+        while True:
+            info = self.api.exec_inspect(exec_id)
+            code = info.get("ExitCode")
+            if code is not None and not info.get("Running"):
+                return int(code)
+            if _time.monotonic() >= deadline:
+                return int(code or 0)
+            _time.sleep(0.05)
 
     def run_exec(self, ref: str, cmd: list[str], *, user: str = "") -> tuple[int, bytes]:
         """Exec to completion, collecting output."""

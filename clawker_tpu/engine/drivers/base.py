@@ -101,4 +101,9 @@ def get_driver(settings: "Settings", *, override: str = "") -> RuntimeDriver:
         from .tpu_vm import TPUVMDriver
 
         return TPUVMDriver(settings.runtime.tpu)
-    raise ConfigError(f"unknown runtime driver {name!r} (expected local|tpu_vm|fake)")
+    if name == "nsd":
+        from .nsdriver import NsdDriver
+
+        return NsdDriver(docker_host=settings.runtime.docker_host)
+    raise ConfigError(
+        f"unknown runtime driver {name!r} (expected local|tpu_vm|nsd|fake)")

@@ -80,8 +80,15 @@ class HijackedStream:
 
     def read(self, n: int = 65536) -> bytes:
         try:
+            if self._resp.status == 101:
+                # http.client pins 1xx body length to 0, so resp.read()
+                # would return b"" forever; after a real daemon's 101
+                # the raw stream follows the headers on the response's
+                # buffered reader (which may already hold early bytes)
+                return self._resp.fp.read1(n) or b""
             return self._resp.read(n) or b""
-        except (http.client.IncompleteRead, ConnectionResetError):
+        except (http.client.IncompleteRead, ConnectionResetError,
+                ValueError, OSError):
             return b""
 
     def frames(self) -> Iterator[tuple[int, bytes]]:

@@ -113,9 +113,23 @@ class RulesStore:
         return list(out.values())
 
     def _save(self, rules: list[EgressRule]) -> None:
-        body = yaml.safe_dump(
-            {"rules": [to_dict(r) for r in rules]}, sort_keys=False
-        )
+        tree = {"rules": [to_dict(r) for r in rules]}
+        body = None
+        if self.path.exists():
+            # egress-rules.yaml is exactly the file users hand-comment:
+            # patch item-surgically (storage/yamledit) so an add/remove
+            # keeps every comment; fall back to the re-dump on anything
+            # not expressible
+            try:
+                original = self.path.read_text(encoding="utf-8")
+            except OSError:
+                original = ""
+            if original.strip():
+                from ..storage.yamledit import apply_edits
+
+                body = apply_edits(original, tree)
+        if body is None:
+            body = yaml.safe_dump(tree, sort_keys=False)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         atomic_write(self.path, body.encode())
 

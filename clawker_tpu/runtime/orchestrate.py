@@ -40,6 +40,7 @@ class CreateOptions:
     mount_docker_socket: bool | None = None
     worktree_git_dir: Path | None = None
     workspace_root: Path | None = None  # override project root (worktrees)
+    workdir: str = ""                   # override container working dir
 
 
 class AgentRuntime:
@@ -132,7 +133,7 @@ class AgentRuntime:
             labels=labels,
             tty=opts.tty,
             open_stdin=True,
-            working_dir=consts.WORKSPACE_DIR,
+            working_dir=opts.workdir or consts.WORKSPACE_DIR,
             hostname=f"{project}-{opts.agent}",
             binds=mounts.binds,
             memory=(pconf.agent.memory if pconf else ""),

@@ -12,7 +12,6 @@ Bridge (bridge.go:59).
 
 from __future__ import annotations
 
-import functools as _functools
 import os
 import socket
 import subprocess
@@ -32,18 +31,26 @@ CONTAINER_CMD = [
 ]
 
 
-@_functools.lru_cache(maxsize=1)
+_gpgconf_cache: str | None = None
+
+
 def _gpgconf_extra_socket() -> str:
-    """One gpgconf subprocess per process: its answer depends only on
-    the gpg home, and the probe was a fixed per-create cost."""
+    """One SUCCESSFUL gpgconf subprocess per process: the answer depends
+    only on the gpg home, and the probe was a fixed per-create cost.
+    Failures stay retryable -- a host that grows a gpg setup mid-process
+    must not be locked out of agent forwarding until restart."""
+    global _gpgconf_cache
+    if _gpgconf_cache is not None:
+        return _gpgconf_cache
     try:
         res = subprocess.run(
             ["gpgconf", "--list-dirs", "agent-extra-socket"],
             capture_output=True, text=True, timeout=5,
         )
         if res.returncode == 0:
-            return res.stdout.strip()
-    except OSError:
+            _gpgconf_cache = res.stdout.strip()
+            return _gpgconf_cache
+    except (OSError, subprocess.SubprocessError):
         pass
     return ""
 

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import io
+import os
 import tarfile
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -45,12 +46,26 @@ class SnapshotSeed:
 
 
 def _tar_tree(src: Path) -> bytes:
+    """Tar the project tree, never descending into .git, symlinked dirs
+    or foreign mounts.  A mount point inside the project (say a runtime's
+    overlay that mirrors the whole host) would otherwise turn the seed
+    walk into a filesystem-wide -- or cyclic -- traversal."""
     buf = io.BytesIO()
     with tarfile.open(fileobj=buf, mode="w") as tf:
-        for p in sorted(src.rglob("*")):
-            if ".git" in p.parts[len(src.parts):-1]:
-                continue
-            tf.add(p, arcname=str(p.relative_to(src)), recursive=False)
+        def walk(d: Path, rel: str) -> None:
+            for p in sorted(d.iterdir()):
+                arc = f"{rel}/{p.name}" if rel else p.name
+                if p.name == ".git" and p.is_dir():
+                    continue
+                if p.is_dir() and not p.is_symlink():
+                    if os.path.ismount(p):
+                        continue
+                    tf.add(p, arcname=arc, recursive=False)
+                    walk(p, arc)
+                else:
+                    tf.add(p, arcname=arc, recursive=False)
+
+        walk(src, "")
     return buf.getvalue()
 
 

@@ -29,9 +29,7 @@ E2E_ENV = "CLAWKER_TPU_E2E"
 BASE_IMAGE = os.environ.get("CLAWKER_TPU_E2E_IMAGE", "busybox:latest")
 
 
-def docker_available() -> bool:
-    if os.environ.get(E2E_ENV) != "1":
-        return False
+def _dockerd_available() -> bool:
     sock = Path(os.environ.get("DOCKER_HOST", "/var/run/docker.sock")
                 .removeprefix("unix://"))
     if not sock.exists():
@@ -41,6 +39,22 @@ def docker_available() -> bool:
 
         return LocalDriver().engine().ping()
     except Exception:  # noqa: BLE001 - any failure = not available
+        return False
+
+
+def docker_available() -> bool:
+    """A real daemon is reachable or can be provisioned: dockerd when the
+    host has one, else the first-party namespace daemon (nsd) when the
+    kernel allows.  Either way the suite drives a REAL daemon socket."""
+    if os.environ.get(E2E_ENV) != "1":
+        return False
+    if _dockerd_available():
+        return True
+    try:
+        from clawker_tpu.engine.drivers.nsdriver import nsd_capable
+
+        return nsd_capable()
+    except Exception:  # noqa: BLE001
         return False
 
 
@@ -73,6 +87,22 @@ class E2E:
         self.env["CLAWKER_TPU_DRIVER"] = "local"
         self.env["CLAWKER_TPU_NO_NOTICES"] = "1"
         self.env["PYTHONPATH"] = str(REPO)
+        self._nsd = None
+        if not _dockerd_available():
+            # no dockerd: provision a first-party nsd daemon inside this
+            # installation's sandbox; the CLI still rides driver=local
+            # against a real daemon socket
+            from clawker_tpu.engine.drivers.nsdriver import NsdDriver
+
+            sock = self.base / "nsd.sock"
+            os.environ[  # the driver reads env for state placement
+                "CLAWKER_TPU_NSD_STATE"] = str(self.base / "nsd-state")
+            self._nsd = NsdDriver(docker_host=f"unix://{sock}")
+            self._nsd.connect()
+            self.env["DOCKER_HOST"] = f"unix://{sock}"
+            self._docker_host = f"unix://{sock}"
+        else:
+            self._docker_host = os.environ.get("DOCKER_HOST", "")
 
     def run(self, *argv: str, timeout: float = 120.0,
             input_text: str = "") -> RunResult:
@@ -91,25 +121,32 @@ class E2E:
 
     # --------------------------------------------------------- leak guard
 
-    def managed_containers(self) -> list[dict]:
+    def _engine(self):
         from clawker_tpu.engine.drivers.local import LocalDriver
 
-        eng = LocalDriver().engine()
+        return LocalDriver(docker_host=self._docker_host).engine()
+
+    def managed_containers(self) -> list[dict]:
+        eng = self._engine()
         return [c for c in eng.list_containers(all=True)
                 if self.project in (c.get("Names") or [""])[0]]
 
     def cleanup(self) -> None:
         """Remove every container this installation created; assert the
         daemon is clean afterwards (reference cleanupTestEnvironment)."""
-        from clawker_tpu.engine.drivers.local import LocalDriver
-
-        eng = LocalDriver().engine()
+        eng = self._engine()
         for c in self.managed_containers():
             try:
                 eng.remove_container(c["Id"], force=True, volumes=True)
             except Exception:  # noqa: BLE001
                 pass
         leaked = self.managed_containers()
+        if self._nsd is not None and self._nsd._proc is not None:
+            self._nsd._proc.terminate()
+            try:
+                self._nsd._proc.wait(5)
+            except subprocess.TimeoutExpired:
+                self._nsd._proc.kill()
         shutil.rmtree(self.base, ignore_errors=True)
         assert not leaked, f"containers leaked: {leaked}"
 

@@ -1,0 +1,91 @@
+"""Worktree git-protection e2e against a real daemon.
+
+Parity reference: test/e2e/worktree_git_protection_test.go
+(TestWorktreeGitProtection_E2E).  This framework's contract diverges
+deliberately: the main repo's git dir is mounted READ-ONLY (the
+reference mounts RW and masks hooks/config) -- stronger containment
+with the same everyday outcome pinned here: worktree git ops work,
+host-code-execution vectors (hooks, config) cannot be planted.
+"""
+
+from __future__ import annotations
+
+import subprocess
+
+import pytest
+
+from .harness import BASE_IMAGE, E2E, docker_available
+
+pytestmark = pytest.mark.skipif(
+    not docker_available(),
+    reason="real-daemon e2e: set CLAWKER_TPU_E2E=1 (dockerd or nsd-capable)")
+
+
+def _git(cwd, *args):
+    res = subprocess.run(["git", *args], cwd=cwd, capture_output=True,
+                         text=True)
+    assert res.returncode == 0, f"git {args}: {res.stderr}"
+    return res.stdout
+
+
+@pytest.fixture()
+def h():
+    with E2E("wtproj") as harness:
+        _git(harness.proj_dir, "init", "-b", "main")
+        _git(harness.proj_dir, "config", "user.email", "e2e@clawker.test")
+        _git(harness.proj_dir, "config", "user.name", "clawker e2e")
+        (harness.proj_dir / "README.md").write_text("worktree e2e\n")
+        _git(harness.proj_dir, "add", "README.md")
+        _git(harness.proj_dir, "commit", "-m", "init")
+        harness.must("project", "register")
+        yield harness
+
+
+def test_worktree_container_protects_main_git(h):
+    h.must("worktree", "add", "e2e-probe")
+    h.must("run", "--agent", "wt1", "--image", BASE_IMAGE, "--detach",
+           "--worktree", "e2e-probe", "sh", "-c", "sleep 60")
+    git_dir = h.proj_dir / ".git"
+
+    # the worktree checkout is the container's workspace
+    res = h.must("exec", "wt1", "sh", "-c", "cat /workspace/README.md")
+    assert "worktree e2e" in res.stdout
+
+    # everyday worktree git ops work (the .git FILE resolves through the
+    # mounted main git dir)
+    res = h.must("exec", "wt1", "sh", "-c",
+                 "cd /workspace && git status --porcelain && git log "
+                 "--oneline | head -1")
+    assert "init" in res.stdout
+
+    # host-code-execution vectors are sealed: the main git dir mount is
+    # read-only, so hooks/config cannot be planted from the container
+    res = h.run("exec", "wt1", "sh", "-c",
+                f"echo evil > {git_dir}/hooks/post-checkout")
+    assert res.code != 0
+    assert not (git_dir / "hooks" / "post-checkout").exists()
+    res = h.run("exec", "wt1", "sh", "-c",
+                f"echo '[core]' >> {git_dir}/config")
+    assert res.code != 0
+    assert "hooksPath" not in (git_dir / "config").read_text()
+
+    # container-side commits in the worktree are blocked too (commits
+    # write to the main object store, which is the read-only mount) --
+    # the worktree is a review-before-merge surface on this framework
+    res = h.run("exec", "wt1", "sh", "-c",
+                "cd /workspace && echo x > f && git add f 2>&1; echo rc=$?")
+    assert "rc=0" not in res.stdout or "read-only" in res.stdout.lower()
+
+    h.must("stop", "wt1")
+    h.must("rm", "--force", "wt1")
+
+
+def test_worktree_requires_git_repo(h):
+    import shutil
+
+    shutil.rmtree(h.proj_dir / ".git")
+    res = h.run("run", "--agent", "wt2", "--image", BASE_IMAGE, "--detach",
+                "--worktree", "nope", "sh", "-c", "true")
+    assert res.code != 0
+    msg = (res.stderr + res.stdout).lower()
+    assert "worktree" in msg or "git" in msg or "registered" in msg

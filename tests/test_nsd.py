@@ -1,0 +1,180 @@
+"""nsd daemon tests: the Docker API surface over real namespaces.
+
+Skip-gated on nsd capability (root + unshare/nsenter); where it runs,
+every assertion is against real kernel behavior through the SAME client
+(engine/httpapi.HTTPDockerAPI) the local/tpu_vm drivers use -- so wire
+format, hijack framing and lifecycle semantics are pinned daemon-side.
+The CLI-level behavior rides on top in tests/e2e/.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+import threading
+import time
+
+import pytest
+
+from clawker_tpu.engine.drivers.nsdriver import nsd_capable
+
+pytestmark = pytest.mark.skipif(
+    not nsd_capable(), reason="nsd needs root + unshare/nsenter")
+
+
+@pytest.fixture(scope="module")
+def api(tmp_path_factory):
+    from clawker_tpu.engine.httpapi import HTTPDockerAPI, unix_socket_factory
+    from clawker_tpu.nsd.server import NsDaemon
+
+    td = tmp_path_factory.mktemp("nsd")
+    sock = td / "nsd.sock"
+    daemon = NsDaemon(td / "state", sock)
+    t = threading.Thread(target=daemon.serve, daemon=True)
+    t.start()
+    for _ in range(200):
+        if sock.exists():
+            break
+        time.sleep(0.01)
+    api = HTTPDockerAPI(unix_socket_factory(sock))
+    list(api.image_pull("busybox:latest"))
+    yield api
+    daemon.shutdown()
+
+
+def _create(api, name, cmd, **cfg):
+    base = {"Image": "busybox:latest", "Cmd": cmd, "Labels": {}}
+    base.update(cfg)
+    return api.container_create(name, base)["Id"]
+
+
+def test_ping_info_version(api):
+    assert api.ping()
+    assert api.info()["Name"] == "nsd"
+    assert api.version()["ApiVersion"] == "1.43"
+
+
+def test_lifecycle_exit_code_and_framed_logs(api):
+    cid = _create(api, "lc1", ["sh", "-c", "echo out-line; echo err-line >&2; exit 3"])
+    api.container_start(cid)
+    assert api.container_wait(cid)["StatusCode"] == 3
+    insp = api.container_inspect(cid)
+    assert insp["State"]["Status"] == "exited"
+    assert insp["State"]["ExitCode"] == 3
+    logs = b"".join(api.container_logs(cid))
+    # stdcopy framing: stream ids distinguish stdout/stderr
+    assert b"\x01\x00\x00\x00" in logs and b"out-line" in logs
+    assert b"\x02\x00\x00\x00" in logs and b"err-line" in logs
+    api.container_remove(cid, force=True)
+
+
+def test_pid_and_uts_isolation(api):
+    cid = _create(api, "iso1", ["sh", "-c", 'echo "pid=$$ host=$(hostname)"'],
+                  Hostname="isolated-ns")
+    api.container_start(cid)
+    api.container_wait(cid)
+    logs = b"".join(api.container_logs(cid))
+    assert b"pid=1 " in logs          # the command IS namespace init
+    assert b"host=isolated-ns" in logs
+    api.container_remove(cid, force=True)
+
+
+def test_overlay_writes_never_touch_host(api):
+    marker = f"/tmp/nsd-breakout-{os.getpid()}"
+    cid = _create(api, "ovl1", ["sh", "-c", f"echo gotcha > {marker}"])
+    api.container_start(cid)
+    api.container_wait(cid)
+    assert not os.path.exists(marker), "container write leaked to host"
+    api.container_remove(cid, force=True)
+
+
+def test_attach_stdin_and_archive_before_start(api):
+    cid = _create(api, "att1", ["sh", "-c",
+                                "read l; echo got:$l; cat /seeded/f.txt"],
+                  OpenStdin=True)
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        data = b"seeded-content\n"
+        ti = tarfile.TarInfo("f.txt")
+        ti.size = len(data)
+        tf.addfile(ti, io.BytesIO(data))
+    api.put_archive(cid, "/seeded", buf.getvalue())
+    stream = api.container_attach(cid, tty=False)
+    api.container_start(cid)
+    stream.write(b"over-stdin\n")
+    got = b"".join(p for _, p in stream.frames())
+    stream.close()
+    assert b"got:over-stdin" in got
+    assert b"seeded-content" in got
+    api.container_wait(cid)
+    api.container_remove(cid, force=True)
+
+
+def test_archive_maps_bind_shadowed_paths(api, tmp_path):
+    host_dir = tmp_path / "bound"
+    host_dir.mkdir()
+    cid = _create(api, "arc1", ["sh", "-c", "cat /work/in.txt > /work/out.txt"],
+                  HostConfig={"Binds": [f"{host_dir}:/work"]})
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        data = b"bind-routed\n"
+        ti = tarfile.TarInfo("in.txt")
+        ti.size = len(data)
+        tf.addfile(ti, io.BytesIO(data))
+    api.put_archive(cid, "/work", buf.getvalue())
+    assert (host_dir / "in.txt").read_bytes() == b"bind-routed\n"
+    api.container_start(cid)
+    api.container_wait(cid)
+    assert (host_dir / "out.txt").read_bytes() == b"bind-routed\n"
+    out = api.get_archive(cid, "/work/out.txt")
+    with tarfile.open(fileobj=io.BytesIO(out)) as tf:
+        assert tf.extractfile("out.txt").read() == b"bind-routed\n"
+    api.container_remove(cid, force=True)
+
+
+def test_exec_in_namespaces_with_exit_code(api):
+    cid = _create(api, "ex1", ["sh", "-c", "sleep 15"], Hostname="exhost")
+    api.container_start(cid)
+    time.sleep(0.3)
+    e = api.exec_create(cid, {"Cmd": ["sh", "-c", "hostname"]})
+    s = api.exec_start(e["Id"], tty=False)
+    out = b"".join(p for _, p in s.frames())
+    assert b"exhost" in out
+    e2 = api.exec_create(cid, {"Cmd": ["sh", "-c", "exit 9"]})
+    s2 = api.exec_start(e2["Id"], tty=False)
+    list(s2.frames())
+    assert api.exec_inspect(e2["Id"])["ExitCode"] == 9
+    api.container_stop(cid, timeout=1)
+    assert api.container_inspect(cid)["State"]["ExitCode"] == 137
+    api.container_remove(cid, force=True)
+
+
+def test_volumes_and_label_filters(api):
+    api.volume_create("nsdvol1", labels={"clawker.managed": "1"})
+    vols = api.volume_list(filters={"label": ["clawker.managed=1"]})
+    assert any(v["Name"] == "nsdvol1" for v in vols["Volumes"])
+    cid = _create(api, "vol1", ["sh", "-c", "echo kept > /data/keep.txt"],
+                  HostConfig={"Binds": ["nsdvol1:/data"]},
+                  Labels={"clawker.project": "nsdtest"})
+    api.container_start(cid)
+    api.container_wait(cid)
+    out = api.get_archive(cid, "/data/keep.txt")
+    assert b"kept" in out
+    rows = api.container_list(all=True,
+                              filters={"label": ["clawker.project=nsdtest"]})
+    assert any(r["Id"] == cid for r in rows)
+    api.container_remove(cid, force=True)
+    api.volume_remove("nsdvol1")
+
+
+def test_conflict_and_not_found_map_to_http_statuses(api):
+    from clawker_tpu.errors import NotFoundError
+
+    cid = _create(api, "dup1", ["true"])
+    with pytest.raises(Exception) as ei:
+        _create(api, "dup1", ["true"])
+    assert "already in use" in str(ei.value)
+    api.container_remove(cid, force=True)
+    with pytest.raises(NotFoundError):
+        api.container_inspect("definitely-missing")

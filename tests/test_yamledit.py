@@ -133,3 +133,211 @@ def test_store_fallback_still_correct(tmp_path):
     store.set("security.egress", [{"dst": "x.com", "proto": "https"}])
     assert store.get("security.egress")[0]["dst"] == "x.com"
     assert yaml.safe_load(p.read_text())["project"] == "demo"
+
+
+# --------------------------------------------------------- sequence items
+# Round-4 verdict weak #5: list interiors fell back to the re-dump; the
+# egress-rule lists are exactly the comment-bearing blocks users
+# hand-edit.
+
+RULES_DOC = """\
+# egress policy for the demo project
+security:
+  egress:
+    # core API access -- do not remove
+    - dst: api.anthropic.com
+      proto: https
+    # package mirror (review quarterly)
+    - dst: pypi.org
+      proto: https
+      port: 443
+    - dst: github.com   # git-over-ssh
+      proto: ssh
+      port: 22
+workspace:
+  mode: bind  # bind vs snapshot
+"""
+
+
+def test_seq_append_keeps_every_comment():
+    after = yaml.safe_load(RULES_DOC)
+    after["security"]["egress"].append({"dst": "crates.io", "proto": "https"})
+    out = apply_edits(RULES_DOC, after)
+    assert out is not None and yaml.safe_load(out) == after
+    for marker in ("# egress policy", "# core API access",
+                   "# package mirror", "# git-over-ssh", "# bind vs snapshot"):
+        assert marker in out, marker
+    assert "crates.io" in out
+
+
+def test_seq_replace_one_item_keeps_other_items_comments():
+    after = yaml.safe_load(RULES_DOC)
+    after["security"]["egress"][1] = {"dst": "mirror.example.com",
+                                      "proto": "https"}
+    out = apply_edits(RULES_DOC, after)
+    assert out is not None and yaml.safe_load(out) == after
+    # comments on the untouched items and key lines survive; the
+    # replaced item's own block is the only casualty
+    assert "# core API access" in out
+    assert "# git-over-ssh" in out
+    assert "# egress policy" in out
+    assert "pypi.org" not in out
+
+
+def test_seq_delete_middle_item():
+    after = yaml.safe_load(RULES_DOC)
+    del after["security"]["egress"][1]
+    out = apply_edits(RULES_DOC, after)
+    assert out is not None and yaml.safe_load(out) == after
+    assert "# core API access" in out
+    assert "# git-over-ssh" in out
+    assert "pypi.org" not in out
+
+
+def test_seq_insert_middle_item():
+    after = yaml.safe_load(RULES_DOC)
+    after["security"]["egress"].insert(
+        1, {"dst": "docs.example.com", "proto": "https"})
+    out = apply_edits(RULES_DOC, after)
+    assert out is not None and yaml.safe_load(out) == after
+    assert "# core API access" in out
+    assert "# package mirror" in out
+    assert "# git-over-ssh" in out
+    # inserted before the pypi item
+    assert out.index("docs.example.com") < out.index("pypi.org")
+
+
+def test_seq_multiple_deletes_and_inserts():
+    after = yaml.safe_load(RULES_DOC)
+    del after["security"]["egress"][2]
+    del after["security"]["egress"][0]
+    out = apply_edits(RULES_DOC, after)
+    assert out is not None and yaml.safe_load(out) == after
+    assert "# package mirror" in out
+
+    after2 = yaml.safe_load(RULES_DOC)
+    after2["security"]["egress"].insert(0, {"dst": "a.example", "proto": "https"})
+    after2["security"]["egress"].insert(2, {"dst": "b.example", "proto": "https"})
+    out2 = apply_edits(RULES_DOC, after2)
+    assert out2 is not None and yaml.safe_load(out2) == after2
+    assert "# git-over-ssh" in out2
+
+
+def test_seq_scalar_items():
+    doc = "packages:\n  # build deps\n  - curl\n  - git\n"
+    after = {"packages": ["curl", "git", "jq"]}
+    out = apply_edits(doc, after)
+    assert out is not None and yaml.safe_load(out) == after
+    assert "# build deps" in out
+
+
+def test_seq_reshuffle_falls_back_to_whole_set():
+    after = yaml.safe_load(RULES_DOC)
+    after["security"]["egress"].reverse()
+    out = apply_edits(RULES_DOC, after)
+    # whole-list replace (or fallback None) -- either way data wins
+    if out is not None:
+        assert yaml.safe_load(out) == after
+
+
+def test_seq_empty_result_renders_empty_list():
+    after = yaml.safe_load(RULES_DOC)
+    after["security"]["egress"] = []
+    out = apply_edits(RULES_DOC, after)
+    if out is not None:
+        assert yaml.safe_load(out) == after
+
+
+def test_store_rule_edit_preserves_comments(tmp_path):
+    """The product path: firewall add-rules over a hand-commented file
+    keeps every comment (VERDICT r4 task 8 'Done' bar)."""
+    p = tmp_path / "clawker.yaml"
+    p.write_text(RULES_DOC)
+    store = Store([Layer("project", p)])
+    rules = store.get("security.egress")
+    rules.append({"dst": "claude.ai", "proto": "https"})
+    store.set("security.egress", rules)
+    text = p.read_text()
+    for marker in ("# core API access", "# package mirror",
+                   "# git-over-ssh", "# bind vs snapshot"):
+        assert marker in text, marker
+    assert store.get("security.egress")[-1]["dst"] == "claude.ai"
+
+
+def test_oracle_sweep_list_edits():
+    """Randomized single-list mutations: every non-None result parses to
+    the target."""
+    rng = random.Random(11)
+    for _ in range(300):
+        n = rng.randint(1, 5)
+        items = [{"dst": f"h{i}.example", "port": 400 + i} for i in range(n)]
+        text = yaml.safe_dump({"top": {"rules": items}, "tail": 1},
+                              sort_keys=False)
+        text = "# hdr\n" + text.replace("rules:", "rules:  # inline", 1)
+        after = {"top": {"rules": [dict(x) for x in items]}, "tail": 1}
+        op = rng.choice(["set", "del", "ins", "app"])
+        rules = after["top"]["rules"]
+        if op == "set":
+            rules[rng.randrange(n)] = {"dst": "new.example"}
+        elif op == "del":
+            del rules[rng.randrange(n)]
+        elif op == "ins":
+            rules.insert(rng.randrange(n + 1), {"dst": "ins.example"})
+        else:
+            rules.append("plain-scalar")
+        out = apply_edits(text, after)
+        assert out is not None, f"{op} on {n} items should be expressible"
+        assert yaml.safe_load(out) == after, f"{op}: {text!r} -> {out!r}"
+        assert "# hdr" in out
+
+
+def test_rules_store_add_remove_keeps_hand_comments(tmp_path):
+    """firewall add-rules / remove over a hand-commented egress-rules.yaml
+    keeps every untouched comment (VERDICT r4 task 8 'Done' bar)."""
+    from clawker_tpu.config.schema import EgressRule
+    from clawker_tpu.firewall.rules import RulesStore
+
+    p = tmp_path / "egress-rules.yaml"
+    store = RulesStore(p)
+    store.add([EgressRule(dst="api.anthropic.com", proto="https"),
+               EgressRule(dst="pypi.org", proto="https")])
+    # a user hand-annotates the stored file
+    text = p.read_text()
+    text = "# managed by clawker; edited by hand\n" + text
+    text = text.replace("- dst: api.anthropic.com",
+                        "# the API lane -- keep first\n- dst: api.anthropic.com")
+    p.write_text(text)
+    loaded = store.load()
+    store.add([EgressRule(dst="github.com", proto="ssh", port=22)])
+    out = p.read_text()
+    assert "# managed by clawker; edited by hand" in out
+    assert "# the API lane -- keep first" in out
+    assert "github.com" in out
+    assert len(store.load()) == len(loaded) + 1
+    # removing a different rule keeps the annotations too
+    removed = store.remove(EgressRule(dst="pypi.org", proto="https").key())
+    assert removed
+    out = p.read_text()
+    assert "# the API lane -- keep first" in out
+    assert "pypi.org" not in out
+
+
+def test_trailing_comment_block_belongs_to_what_follows():
+    doc = (
+        "rules:\n"
+        "  - dst: a.example\n"
+        "  - dst: b.example\n"
+        "# ---- workspace section: tune carefully ----\n"
+        "workspace: bind\n"
+    )
+    # deleting the last item keeps the standalone trailer comment
+    after = {"rules": [{"dst": "a.example"}], "workspace": "bind"}
+    out = apply_edits(doc, after)
+    assert out is not None and yaml.safe_load(out) == after
+    assert "# ---- workspace section" in out
+    # appending lands BEFORE the trailer comment, not after it
+    after2 = {"rules": [{"dst": "a.example"}, {"dst": "b.example"},
+                        {"dst": "c.example"}], "workspace": "bind"}
+    out2 = apply_edits(doc, after2)
+    assert out2 is not None and yaml.safe_load(out2) == after2
+    assert out2.index("c.example") < out2.index("# ---- workspace section")
