@@ -45,7 +45,17 @@ class ProjectBuilder:
         self.ca_cert_pem = ca_cert_pem
         self.progress = progress or (lambda _line: None)
 
-    def build(self, *, harness_override: str = "", no_cache: bool = False) -> BuildResult:
+    def build(self, *, harness_override: str = "", no_cache: bool = False,
+              secrets: dict[str, bytes] | None = None,
+              ssh_auth_sock: str = "") -> BuildResult:
+        """secrets/ssh ride the BuildKit session lane (RUN --mount=type=
+        secret|ssh); see engine/bksession.py."""
+        self._secrets = secrets
+        self._ssh = ssh_auth_sock
+        return self._build_impl(harness_override=harness_override,
+                                no_cache=no_cache)
+
+    def _build_impl(self, *, harness_override: str = "", no_cache: bool = False) -> BuildResult:
         pconf = self.cfg.project
         if pconf is None:
             raise ClawkerError("no project config found -- run `clawker init` first")
@@ -132,7 +142,9 @@ class ProjectBuilder:
         self, ctx: bytes, *, tags: list[str], labels: dict, res: BuildResult, no_cache: bool = False
     ) -> None:
         stream: Iterator[dict] = self.engine.build_image(
-            ctx, tags=tags, labels=labels, no_cache=no_cache
+            ctx, tags=tags, labels=labels, no_cache=no_cache,
+            secrets=getattr(self, "_secrets", None),
+            ssh_auth_sock=getattr(self, "_ssh", ""),
         )
         err = ""
         for ev in stream:

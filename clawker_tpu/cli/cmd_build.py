@@ -17,8 +17,15 @@ pass_factory = click.make_pass_decorator(Factory)
 @click.option("--no-cache", is_flag=True, help="Build without layer cache.")
 @click.option("--quiet", "-q", is_flag=True, help="Only print the final image ref.")
 @click.option("--plain", is_flag=True, help="Raw build output (no progress tree).")
+@click.option("--secret", "secret_specs", multiple=True,
+              help="id=NAME,src=PATH secret for RUN --mount=type=secret "
+                   "(BuildKit session lane; repeatable).")
+@click.option("--ssh", "ssh_spec", default="",
+              help="Forward an ssh agent into the build: 'default' uses "
+                   "$SSH_AUTH_SOCK, or default=/path/to/sock.")
 @pass_factory
-def build_cmd(f: Factory, harness, no_cache, quiet, plain):
+def build_cmd(f: Factory, harness, no_cache, quiet, plain, secret_specs,
+              ssh_spec):
     """Build the project image (base stage + harness stage + :default tag)."""
     from ..ui.buildview import BuildProgressView
     from ..ui.progress import ProgressTree
@@ -46,18 +53,22 @@ def build_cmd(f: Factory, harness, no_cache, quiet, plain):
             else:
                 view.line(line)
 
+    secrets = _parse_secrets(secret_specs)
+    ssh_sock = _parse_ssh(ssh_spec)
     builder = ProjectBuilder(f.engine(), f.config, ca_cert_pem=ca_pem,
                              progress=progress)
+    kw = dict(harness_override=harness, no_cache=no_cache,
+              secrets=secrets, ssh_auth_sock=ssh_sock)
     if view is not None:
         with view.tree:
             try:
-                res = builder.build(harness_override=harness, no_cache=no_cache)
+                res = builder.build(**kw)
                 view.done()
             except Exception as e:
                 view.failed(str(e))
                 raise
     else:
-        res = builder.build(harness_override=harness, no_cache=no_cache)
+        res = builder.build(**kw)
     click.echo(res.default_ref)
     if not res.with_agentd and not quiet:
         click.echo(
@@ -69,3 +80,44 @@ def build_cmd(f: Factory, harness, no_cache, quiet, plain):
 
 def register(root: click.Group) -> None:
     root.add_command(build_cmd)
+
+
+def _parse_secrets(specs: tuple[str, ...]) -> dict[str, bytes] | None:
+    """docker-compatible: --secret id=NAME,src=PATH (also env=VAR)."""
+    import os
+
+    out: dict[str, bytes] = {}
+    for spec in specs:
+        kv = dict(part.split("=", 1) for part in spec.split(",") if "=" in part)
+        sid = kv.get("id", "")
+        if not sid:
+            raise click.BadParameter(f"--secret {spec!r}: id= required")
+        if "src" in kv or "source" in kv:
+            path = kv.get("src") or kv.get("source", "")
+            try:
+                out[sid] = open(path, "rb").read()
+            except OSError as e:
+                raise click.BadParameter(f"--secret {sid}: {e}") from None
+        elif "env" in kv:
+            val = os.environ.get(kv["env"])
+            if val is None:
+                raise click.BadParameter(
+                    f"--secret {sid}: env {kv['env']} not set")
+            out[sid] = val.encode()
+        else:
+            raise click.BadParameter(f"--secret {spec!r}: src= or env= required")
+    return out or None
+
+
+def _parse_ssh(spec: str) -> str:
+    import os
+
+    if not spec:
+        return ""
+    name, _, path = spec.partition("=")
+    if path:
+        return path
+    sock = os.environ.get("SSH_AUTH_SOCK", "")
+    if not sock:
+        raise click.BadParameter("--ssh default: SSH_AUTH_SOCK not set")
+    return sock

@@ -303,7 +303,13 @@ def exec_cmd(f: Factory, tty, interactive, env, user, workdir, name, cmd):
     from ..runtime import attach as attach_mod
 
     stdin: object = sys.stdin.buffer if interactive else io.BytesIO(b"")
-    attach_mod.pump_streams(stream, stdin, sys.stdout.buffer)
+    if tty and interactive and sys.stdin.isatty() and sys.stdout.isatty():
+        # same raw-mode discipline as the attach path: without it the
+        # local cooked terminal double-echoes and eats Ctrl-C
+        with attach_mod.raw_terminal(sys.stdin.fileno()):
+            attach_mod.pump_streams(stream, stdin, sys.stdout.buffer)
+    else:
+        attach_mod.pump_streams(stream, stdin, sys.stdout.buffer)
     code = engine.exec_exit_code(eid)
     if code != 0:
         raise SystemExit(code)

@@ -337,6 +337,8 @@ class Engine:
         target: str = "",
         pull: bool = False,
         no_cache: bool = False,
+        secrets: dict[str, bytes] | None = None,
+        ssh_auth_sock: str = "",
     ) -> Iterator[dict]:
         from .buildkit import Builder
 
@@ -351,6 +353,8 @@ class Engine:
             target=target,
             pull=pull,
             no_cache=no_cache,
+            secrets=secrets,
+            ssh_auth_sock=ssh_auth_sock,
         )
 
     def tag_image(self, ref: str, repo: str, tag: str) -> None:

@@ -124,16 +124,43 @@ class Builder:
             self._version = builder_version(self.api)
         return self._version
 
-    def build(self, context_tar: bytes, **kw) -> Iterator[dict]:
+    def build(self, context_tar: bytes, *,
+              secrets: dict[str, bytes] | None = None,
+              ssh_auth_sock: str = "", **kw) -> Iterator[dict]:
+        """Build, preferring the BuildKit lane.
+
+        ``secrets`` / ``ssh_auth_sock`` require the SESSION lane
+        (`RUN --mount=type=secret|ssh`): a client session is attached via
+        /session (engine/bksession) and the daemon dials back into it for
+        secret bytes and agent round-trips during the solve.  Without
+        them the plain version=2 lane is used; the legacy /build lane
+        remains the capability fallback either way (a build that NEEDS a
+        session fails loudly on daemons that cannot provide one).
+        """
+        wants_session = bool(secrets) or bool(ssh_auth_sock)
         if self.version() == "2" and hasattr(self.api, "image_build_buildkit"):
             import uuid
 
             self.last_buildid = uuid.uuid4().hex
+            session = None
+            extra: dict = {}
             try:
+                if wants_session and hasattr(self.api, "session_attach"):
+                    from .bksession import Session, SessionServices
+
+                    session = Session(SessionServices(
+                        secrets=secrets, ssh_auth_sock=ssh_auth_sock))
+                    session.attach(self.api.session_attach(
+                        session.headers(), session.method_headers()))
+                    extra["session"] = session.session_id
                 raw = self.api.image_build_buildkit(
-                    context_tar, buildid=self.last_buildid, **kw)
-                return decode_stream(raw)
+                    context_tar, buildid=self.last_buildid, **extra, **kw)
+                return self._stream_with_session(raw, session)
             except DriverError as e:
+                if session is not None:
+                    session.close()
+                if wants_session:
+                    raise  # secret/ssh builds must not silently downgrade
                 # daemon advertised BuildKit but refused the request
                 # (e.g. session required): fall back AND remember -- the
                 # context tar is uploaded eagerly, so retrying the doomed
@@ -141,7 +168,27 @@ class Builder:
                 log.warning("buildkit lane refused (%s); legacy fallback", e)
                 self._version = "1"
                 self.last_buildid = ""
+            except BaseException:
+                # any other failure (transient socket error, attach
+                # crash): the loopback gRPC server and pumps must not
+                # outlive the attempt
+                if session is not None:
+                    session.close()
+                raise
+        if wants_session:
+            raise DriverError(
+                "build needs secrets/ssh mounts, which require the BuildKit "
+                "session lane; this daemon only offers the legacy builder")
         return self.api.image_build(context_tar, **kw)
+
+    @staticmethod
+    def _stream_with_session(raw: Iterator[dict], session) -> Iterator[dict]:
+        """Decode the progress stream; the session lives until it ends."""
+        try:
+            yield from decode_stream(raw)
+        finally:
+            if session is not None:
+                session.close()
 
     def cancel(self) -> None:
         """Cancel the in-flight BuildKit solve (no-op on the legacy lane)."""

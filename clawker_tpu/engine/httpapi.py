@@ -255,6 +255,8 @@ class HTTPDockerAPI:
         query: dict[str, Any] | None = None,
         body: Any = None,
         tty: bool = False,
+        upgrade: str = "tcp",
+        extra_headers: list[tuple[str, str]] | None = None,
     ) -> HijackedStream:
         conn = _SockConnection(self._factory)
         data = json.dumps(body).encode() if body is not None else b""
@@ -264,7 +266,9 @@ class HTTPDockerAPI:
             conn.putheader("Content-Type", "application/json")
             conn.putheader("Content-Length", str(len(data)))
             conn.putheader("Connection", "Upgrade")
-            conn.putheader("Upgrade", "tcp")
+            conn.putheader("Upgrade", upgrade)
+            for k, v in extra_headers or []:
+                conn.putheader(k, v)
             conn.endheaders()
             if data:
                 conn.send(data)
@@ -430,6 +434,17 @@ class HTTPDockerAPI:
     def exec_inspect(self, exec_id: str) -> dict:
         return self._request("GET", f"/exec/{exec_id}/json")
 
+    # ------------------------------------------------------------- session
+
+    def session_attach(self, headers: dict[str, str],
+                       method_headers: list[tuple[str, str]]) -> HijackedStream:
+        """POST /session with the h2c upgrade: the returned duplex stream
+        carries the daemon's gRPC calls back into the client
+        (engine/bksession.Session.attach bridges it)."""
+        return self._hijack(
+            "/session", upgrade="h2c", tty=True,
+            extra_headers=[*headers.items(), *method_headers])
+
     # -------------------------------------------------------------- images
 
     def image_list(self, *, filters: dict | None = None) -> list[dict]:
@@ -463,6 +478,7 @@ class HTTPDockerAPI:
         no_cache: bool = False,
         version: str = "1",
         buildid: str = "",
+        session: str = "",
     ) -> Iterator[dict]:
         q: dict[str, Any] = {
             "dockerfile": dockerfile,
@@ -479,6 +495,8 @@ class HTTPDockerAPI:
             q["version"] = "2"
             if buildid:
                 q["buildid"] = buildid
+            if session:
+                q["session"] = session
         url = self._url("/build", q)
         # t= repeats per tag; urlencode can't repeat via dict, append manually
         for t in tags:

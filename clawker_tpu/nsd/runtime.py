@@ -423,7 +423,10 @@ class NsRuntime:
                 target = (dest / m.name).resolve()
                 if not _inside(target, base):
                     raise RuntimeError(f"archive member escapes rootfs: {m.name}")
-            tf.extractall(dest)  # noqa: S202 - members verified above
+            # filter="data" closes the tar-slip TOCTOU the pre-check
+            # cannot (symlink member + path THROUGH it resolves clean
+            # before extraction creates the link)
+            tf.extractall(dest, filter="data")
 
     def get_archive(self, c: NsContainer, path: str) -> bytes:
         _, src = self._resolve_in_rootfs(c, path)

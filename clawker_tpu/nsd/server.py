@@ -372,8 +372,12 @@ class NsDaemon:
     def h_kill(self, req: Request, ref: str) -> None:
         c = self._find(ref)
         sig = req.query.get("signal", "KILL")
-        num = getattr(signal, f"SIG{sig}", signal.SIGKILL) \
-            if not sig.isdigit() else int(sig)
+        if sig.isdigit():
+            num = int(sig)
+        else:
+            name = sig.upper()
+            name = name if name.startswith("SIG") else f"SIG{name}"
+            num = getattr(signal, name, signal.SIGKILL)
         self.runtime.kill(c, num)
         self._respond(req.sock, 204)
 
@@ -529,7 +533,7 @@ class NsDaemon:
         sock = req.upgrade()
         try:
             p = self.runtime.exec_spawn(c, cfg)
-        except RuntimeError:
+        except (RuntimeError, OSError):
             # hijacked already: record the failure so exec_inspect
             # reports it (126 = command cannot execute), then close
             e["exit"] = 126
@@ -547,7 +551,9 @@ class NsDaemon:
         else:
             fds = {p.stdout.fileno(): 1, p.stderr.fileno(): 2}
             stdin_fd = p.stdin.fileno()
-        sock.setblocking(False)
+        # the socket stays BLOCKING: select gates reads (no spurious
+        # blocking recv), and sendall on a non-blocking socket could
+        # raise mid-frame and corrupt the stdcopy stream
         sfd = sock.fileno()
         while fds:
             ready, _, _ = select.select(list(fds) + [sfd], [], [], 0.5)

@@ -1,0 +1,265 @@
+"""BuildKit client-session lane: daemon-simulator wire tests.
+
+No dockerd exists in this environment, so the daemon's side of the
+/session contract is simulated with a REAL gRPC client (grpcio) dialing
+through the same hijacked-duplex-socket bridge dockerd would use:
+socketpair end A is the "hijacked connection" handed to
+bksession.Session.attach; end B is pumped to a loopback listener a
+grpc channel connects to.  Every byte crosses the same path as in
+production -- h2c preface, HPACK, gRPC framing -- only the transport's
+far end is local.
+
+Reference parity: pkg/whail/buildkit/solve.go session-based solve
+(secrets provider, ssh-agent forwarding); VERDICT r4 task 4.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+import pytest
+
+from clawker_tpu.engine import bksession as B
+
+grpc = pytest.importorskip("grpc")
+
+IDENT = lambda x: x  # noqa: E731
+
+
+class FakeHijack:
+    """engine.httpapi.HijackedStream surface over a socketpair end."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+
+    def read(self, n: int = 65536) -> bytes:
+        try:
+            return self._sock.recv(n)
+        except OSError:
+            return b""
+
+    def write(self, data: bytes) -> None:
+        self._sock.sendall(data)
+
+    def close_write(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+@pytest.fixture()
+def wired():
+    """(channel, session, cleanup): a grpc channel whose bytes traverse
+    the hijack bridge into the session's server."""
+    created = []
+
+    def build(services: B.SessionServices):
+        a, b = socket.socketpair()
+        session = B.Session(services)
+        session.attach(FakeHijack(a))
+
+        # daemon simulator: loopback listener pumped to socketpair end B
+        lst = socket.socket()
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(1)
+        port = lst.getsockname()[1]
+
+        def bridge():
+            conn, _ = lst.accept()
+            def pump(src, dst, shut):
+                try:
+                    while True:
+                        d = src.recv(65536)
+                        if not d:
+                            break
+                        dst.sendall(d)
+                except OSError:
+                    pass
+                finally:
+                    try:
+                        dst.shutdown(shut)
+                    except OSError:
+                        pass
+            threading.Thread(target=pump, args=(conn, b, socket.SHUT_WR),
+                             daemon=True).start()
+            pump(b, conn, socket.SHUT_WR)
+
+        threading.Thread(target=bridge, daemon=True).start()
+        ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+        created.append((ch, session, lst))
+        return ch, session
+
+    yield build
+    for ch, session, lst in created:
+        ch.close()
+        session.close()
+        lst.close()
+
+
+def _unary(ch, method: str, payload: bytes, timeout: float = 5.0) -> bytes:
+    fn = ch.unary_unary(method, request_serializer=IDENT,
+                        response_deserializer=IDENT)
+    return fn(payload, timeout=timeout)
+
+
+def test_protobuf_helpers_roundtrip():
+    msg = B._field_bytes(1, b"token-id") + B._field_bytes(2, b"extra")
+    fields = B._parse_fields(msg)
+    assert fields[1] == [b"token-id"] and fields[2] == [b"extra"]
+    assert B._parse_fields(b"") == {}
+
+
+def test_exposed_methods_follow_configuration():
+    s = B.SessionServices()
+    assert B.SECRETS_GET not in s.exposed_methods()
+    s = B.SessionServices(secrets={"t": b"x"}, ssh_auth_sock="/tmp/a")
+    ms = s.exposed_methods()
+    assert B.SECRETS_GET in ms and B.SSH_FORWARD in ms
+
+
+def test_session_headers_carry_identity():
+    s = B.Session(B.SessionServices(secrets={"t": b"x"}))
+    try:
+        h = s.headers()
+        assert h["X-Docker-Expose-Session-Uuid"] == s.session_id
+        assert any(m == ("X-Docker-Expose-Session-Grpc-Method", B.SECRETS_GET)
+                   for m in s.method_headers())
+    finally:
+        s.close()
+
+
+def test_secret_round_trip_over_hijack_bridge(wired):
+    ch, _ = wired(B.SessionServices(secrets={"apitoken": b"s3cr3t-bytes"}))
+    resp = _unary(ch, B.SECRETS_GET, B._field_bytes(1, b"apitoken"))
+    assert B._parse_fields(resp)[1] == [b"s3cr3t-bytes"]
+
+
+def test_unknown_secret_is_not_found(wired):
+    ch, _ = wired(B.SessionServices(secrets={"known": b"x"}))
+    with pytest.raises(grpc.RpcError) as ei:
+        _unary(ch, B.SECRETS_GET, B._field_bytes(1, b"missing"))
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    assert "not found" in ei.value.details()
+
+
+def test_health_check_serves_varint_status(wired):
+    ch, _ = wired(B.SessionServices(secrets={"k": b"v"}))
+    # HealthCheckResponse.status=SERVING is field 1 WIRE TYPE 0 (varint):
+    # tag 0x08 value 0x01 -- a length-delimited encoding here makes a
+    # real daemon mark the session unhealthy and cancel the build
+    assert _unary(ch, B.HEALTH_CHECK, b"") == b"\x08\x01"
+
+
+def test_ssh_check_and_forward_agent(wired, tmp_path):
+    # a fake ssh-agent: unix socket answering each message with a marker
+    agent_path = tmp_path / "agent.sock"
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(str(agent_path))
+    srv.listen(1)
+
+    def agent():
+        conn, _ = srv.accept()
+        while True:
+            d = conn.recv(65536)
+            if not d:
+                break
+            conn.sendall(b"AGENT-REPLY:" + d)
+        conn.close()
+
+    threading.Thread(target=agent, daemon=True).start()
+    ch, _ = wired(B.SessionServices(ssh_auth_sock=str(agent_path)))
+
+    assert _unary(ch, B.SSH_CHECK, B._field_bytes(1, b"default")) == b""
+
+    fwd = ch.stream_stream(B.SSH_FORWARD, request_serializer=IDENT,
+                           response_deserializer=IDENT)
+    replies = fwd(iter([B._field_bytes(1, b"sign-request")]), timeout=5.0)
+    got = b"".join((B._parse_fields(r).get(1) or [b""])[0] for r in replies)
+    assert got == b"AGENT-REPLY:sign-request"
+    srv.close()
+
+
+def test_ssh_unavailable_without_agent(wired):
+    ch, _ = wired(B.SessionServices(secrets={"k": b"v"}))  # no ssh
+    with pytest.raises(grpc.RpcError) as ei:
+        _unary(ch, B.SSH_CHECK, B._field_bytes(1, b"default"))
+    assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+
+
+# --------------------------------------------------------------- builder
+
+
+class _SessionApi:
+    """Stub daemon api recording the session wiring the Builder does."""
+
+    def __init__(self):
+        self.attached = None
+        self.build_query = {}
+        a, b = socket.socketpair()
+        self._a, self._b = a, b
+
+    def info(self):
+        return {"BuilderVersion": "2"}
+
+    def session_attach(self, headers, method_headers):
+        self.attached = (headers, method_headers)
+        return FakeHijack(self._a)
+
+    def image_build_buildkit(self, tar, **kw):
+        self.build_query = kw
+        return iter([{"stream": "ok\n"}])
+
+
+def test_builder_threads_session_through_build():
+    from clawker_tpu.engine.buildkit import Builder
+
+    api = _SessionApi()
+    b = Builder(api)
+    out = list(b.build(b"tar", secrets={"tok": b"v"}, tags=["t:1"]))
+    assert {"stream": "ok\n"} in out
+    assert api.attached is not None
+    headers, methods = api.attached
+    assert api.build_query["session"] == headers["X-Docker-Expose-Session-Uuid"]
+    assert ("X-Docker-Expose-Session-Grpc-Method", B.SECRETS_GET) in methods
+    api._b.close()
+
+
+def test_builder_refuses_secret_build_without_session_lane():
+    from clawker_tpu.engine.buildkit import Builder
+    from clawker_tpu.errors import DriverError
+
+    class LegacyApi:
+        def info(self):
+            return {"BuilderVersion": "1"}
+
+        def image_build(self, tar, **kw):
+            raise AssertionError("must not reach the legacy lane")
+
+    with pytest.raises(DriverError, match="session"):
+        list(Builder(LegacyApi()).build(b"tar", secrets={"t": b"v"}))
+
+
+def test_cli_secret_parsing(tmp_path, monkeypatch):
+    import click
+
+    from clawker_tpu.cli.cmd_build import _parse_secrets, _parse_ssh
+
+    p = tmp_path / "tok"
+    p.write_bytes(b"file-secret")
+    monkeypatch.setenv("MY_TOKEN", "env-secret")
+    out = _parse_secrets((f"id=a,src={p}", "id=b,env=MY_TOKEN"))
+    assert out == {"a": b"file-secret", "b": b"env-secret"}
+    assert _parse_secrets(()) is None
+    with pytest.raises(click.BadParameter):
+        _parse_secrets(("src=/nope",))
+    with pytest.raises(click.BadParameter):
+        _parse_secrets(("id=x",))
+    monkeypatch.setenv("SSH_AUTH_SOCK", "/run/agent.sock")
+    assert _parse_ssh("default") == "/run/agent.sock"
+    assert _parse_ssh("default=/custom.sock") == "/custom.sock"
+    assert _parse_ssh("") == ""
