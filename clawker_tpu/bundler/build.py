@@ -50,12 +50,6 @@ class ProjectBuilder:
               ssh_auth_sock: str = "") -> BuildResult:
         """secrets/ssh ride the BuildKit session lane (RUN --mount=type=
         secret|ssh); see engine/bksession.py."""
-        self._secrets = secrets
-        self._ssh = ssh_auth_sock
-        return self._build_impl(harness_override=harness_override,
-                                no_cache=no_cache)
-
-    def _build_impl(self, *, harness_override: str = "", no_cache: bool = False) -> BuildResult:
         pconf = self.cfg.project
         if pconf is None:
             raise ClawkerError("no project config found -- run `clawker init` first")
@@ -75,6 +69,8 @@ class ProjectBuilder:
             labels={consts.LABEL_IMAGE_KIND: "base", consts.LABEL_PROJECT: project},
             res=res,
             no_cache=no_cache,
+            secrets=secrets,
+            ssh_auth_sock=ssh_auth_sock,
         )
         res.base_ref = base_ref
 
@@ -126,6 +122,8 @@ class ProjectBuilder:
             },
             res=res,
             no_cache=no_cache,
+            secrets=secrets,
+            ssh_auth_sock=ssh_auth_sock,
         )
         res.harness_ref = harness_ref
         res.with_agentd = agentd is not None
@@ -139,12 +137,13 @@ class ProjectBuilder:
         return res
 
     def _run_build(
-        self, ctx: bytes, *, tags: list[str], labels: dict, res: BuildResult, no_cache: bool = False
+        self, ctx: bytes, *, tags: list[str], labels: dict, res: BuildResult,
+        no_cache: bool = False, secrets: dict[str, bytes] | None = None,
+        ssh_auth_sock: str = "",
     ) -> None:
         stream: Iterator[dict] = self.engine.build_image(
             ctx, tags=tags, labels=labels, no_cache=no_cache,
-            secrets=getattr(self, "_secrets", None),
-            ssh_auth_sock=getattr(self, "_ssh", ""),
+            secrets=secrets, ssh_auth_sock=ssh_auth_sock,
         )
         err = ""
         for ev in stream:

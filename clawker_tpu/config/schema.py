@@ -367,9 +367,24 @@ class LoopSettings:
 
 @dataclass
 class RuntimeSettings:
-    driver: str = "local"           # local | tpu_vm | fake
+    driver: str = "local"           # local | tpu_vm | nsd | fake
     docker_host: str = ""           # override local daemon address
     tpu: TPUSettings = field(default_factory=TPUSettings)
+
+
+@dataclass
+class CredentialSettings:
+    """Host-credential staging policy (off by default).
+
+    The default contract: credentials are NEVER copied from the host;
+    you authenticate once inside the agent container and the token
+    family persists across recreates in the per-agent config volume
+    (proven by tests/e2e/test_e2e_credentials.py).  ``stage: true``
+    opts in to copying the harness manifest's declared credential
+    files (staging.credentials) at create time -- the reference's
+    keyring behavior -- so fleet fan-outs start pre-authenticated."""
+
+    stage: bool = False
 
 
 @dataclass
@@ -381,6 +396,7 @@ class Settings:
     control_plane: ControlPlaneSettings = field(default_factory=ControlPlaneSettings)
     runtime: RuntimeSettings = field(default_factory=RuntimeSettings)
     loop: LoopSettings = field(default_factory=LoopSettings)
+    credentials: CredentialSettings = field(default_factory=CredentialSettings)
 
     @staticmethod
     def merge_strategies() -> dict[str, str]:

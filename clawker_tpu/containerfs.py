@@ -70,24 +70,33 @@ class CopySpec:
 @dataclass
 class Staging:
     copy: list[CopySpec] = field(default_factory=list)
+    # declared credential material (keyring-backed files).  NEVER staged
+    # by default: only when settings ``credentials.stage`` opts in
+    # (reference internal/containerfs stages its keyring path
+    # unconditionally -- the opt-in is this framework's divergence;
+    # see README "Credential staging")
+    credentials: list[CopySpec] = field(default_factory=list)
 
     @classmethod
     def from_raw(cls, raw: dict | None) -> "Staging":
         out = cls()
-        for c in (raw or {}).get("copy") or []:
-            if not isinstance(c, dict):
-                raise StagingError(f"staging.copy entry must be a mapping: {c!r}")
-            out.copy.append(CopySpec(
-                src=str(c.get("src") or ""),
-                dest=str(c.get("dest") or ""),
-                json_keys=[str(k) for k in c.get("json_keys") or []],
-                skip=[str(s) for s in c.get("skip") or []],
-                json_rewrites=[JsonRewrite(
-                    file=str(r.get("file") or ""),
-                    key=str(r.get("key") or ""),
-                    rewrite=str(r.get("rewrite") or "prefix-swap"))
-                    for r in c.get("json_rewrites") or []],
-            ))
+        for section, target in (("copy", out.copy),
+                                ("credentials", out.credentials)):
+            for c in (raw or {}).get(section) or []:
+                if not isinstance(c, dict):
+                    raise StagingError(
+                        f"staging.{section} entry must be a mapping: {c!r}")
+                target.append(CopySpec(
+                    src=str(c.get("src") or ""),
+                    dest=str(c.get("dest") or ""),
+                    json_keys=[str(k) for k in c.get("json_keys") or []],
+                    skip=[str(s) for s in c.get("skip") or []],
+                    json_rewrites=[JsonRewrite(
+                        file=str(r.get("file") or ""),
+                        key=str(r.get("key") or ""),
+                        rewrite=str(r.get("rewrite") or "prefix-swap"))
+                        for r in c.get("json_rewrites") or []],
+                ))
         return out
 
 
@@ -121,17 +130,26 @@ def resolve_host_mount_source(src: str) -> tuple[str, bool]:
 # --------------------------------------------------------------- staging
 
 def prepare_config(staging: Staging, *, container_home: str,
-                   container_work: str, host_project_root: str) -> tuple[Path, "callable"]:
+                   container_work: str, host_project_root: str,
+                   include_credentials: bool = False) -> tuple[Path, "callable"]:
     """Run every copy directive into a temp staging mirror.  Returns
     (staging_dir, cleanup); the staged layout mirrors the container home:
-    each directive lands at ``<dir>/<dest>``."""
+    each directive lands at ``<dir>/<dest>``.
+
+    ``include_credentials`` additionally stages the manifest's declared
+    credential material -- the settings-gated opt-in (credentials.stage)
+    that makes ``loop --parallel N`` start N authenticated agents
+    without N manual logins."""
     tmp = Path(tempfile.mkdtemp(prefix="clawker-config-"))
 
     def cleanup() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
+    specs = list(staging.copy)
+    if include_credentials:
+        specs += staging.credentials
     try:
-        for c in staging.copy:
+        for c in specs:
             _stage_copy(c, tmp, container_home=container_home,
                         container_work=container_work,
                         host_project_root=host_project_root)

@@ -206,8 +206,27 @@ class Engine:
         self.api.container_unpause(ref)
 
     def remove_container(self, ref: str, *, force: bool = False, volumes: bool = False) -> None:
-        self._assert_managed_container(ref)
+        """volumes=True also removes the agent's NAMED volumes by label.
+        Docker's ?v=1 only removes anonymous volumes, and every agent
+        volume is named -- without the label-scoped sweep `rm --volumes`
+        would be a silent no-op for agent data on real daemons."""
+        info = self._assert_managed_container(ref)
+        labels = (info.get("Config") or {}).get("Labels") or {}
         self.api.container_remove(ref, force=force, volumes=volumes)
+        if not volumes:
+            return
+        project = labels.get(consts.LABEL_PROJECT, "")
+        agent = labels.get(consts.LABEL_AGENT, "")
+        if not project or not agent:
+            return
+        got = self.api.volume_list(filters={"label": [
+            f"{consts.LABEL_PROJECT}={project}",
+            f"{consts.LABEL_AGENT}={agent}"]})
+        for vol in (got or {}).get("Volumes") or []:
+            try:
+                self.api.volume_remove(vol["Name"], force=force)
+            except NotFoundError:
+                pass
 
     def rename_container(self, ref: str, new_name: str) -> None:
         self._assert_managed_container(ref)

@@ -138,6 +138,10 @@ class Builder:
         session fails loudly on daemons that cannot provide one).
         """
         wants_session = bool(secrets) or bool(ssh_auth_sock)
+        if wants_session and not hasattr(self.api, "session_attach"):
+            raise DriverError(
+                "build needs secrets/ssh mounts, but this daemon API has "
+                "no /session lane")
         if self.version() == "2" and hasattr(self.api, "image_build_buildkit"):
             import uuid
 
@@ -145,7 +149,7 @@ class Builder:
             session = None
             extra: dict = {}
             try:
-                if wants_session and hasattr(self.api, "session_attach"):
+                if wants_session:
                     from .bksession import Session, SessionServices
 
                     session = Session(SessionServices(

@@ -395,6 +395,9 @@ class NsDaemon:
         with self._lock:
             self.containers.pop(c.id, None)
         self.runtime.remove(c)
+        # ?v=1 is docker's ANONYMOUS-volume cleanup; nsd has none, so it
+        # is a no-op here.  Named agent volumes are removed by the engine
+        # layer's label-scoped sweep (engine/api.py remove_container).
         self._event("container", "destroy", c.id, {"name": c.name})
         self._respond(req.sock, 204)
 
@@ -736,12 +739,18 @@ class NsDaemon:
 
     def h_volume_remove(self, req: Request, name: str) -> None:
         with self._lock:
-            vol = self.volumes.pop(name, None)
-        if vol is None:
-            raise HttpError(404, f"no such volume: {name}")
+            vol = self.volumes.get(name)
+            if vol is None:
+                raise HttpError(404, f"no such volume: {name}")
+            mp = vol["Mountpoint"]
+            for c in self.containers.values():
+                if any(b.split(":")[0] == mp for b in c.binds()):
+                    raise HttpError(
+                        409, f"volume {name} is in use by {c.name}")
+            self.volumes.pop(name)
         import shutil
 
-        shutil.rmtree(vol["Mountpoint"], ignore_errors=True)
+        shutil.rmtree(mp, ignore_errors=True)
         self._respond(req.sock, 204)
 
     # networks (records only: nsd containers share the host network) ----

@@ -181,13 +181,15 @@ class AgentRuntime:
         except NotFoundError:
             return
         staging = containerfs.Staging.from_raw(h.staging)
-        if not staging.copy:
+        stage_creds = self.cfg.settings.credentials.stage
+        if not staging.copy and not (stage_creds and staging.credentials):
             return
         sdir, cleanup = containerfs.prepare_config(
             staging,
             container_home=consts.CONTAINER_HOME,
             container_work=consts.WORKSPACE_DIR,
             host_project_root=str(root),
+            include_credentials=stage_creds,
         )
         try:
             tar = containerfs.staging_tar(sdir)

@@ -264,3 +264,54 @@ def test_create_with_no_host_state_still_works(tmp_path, monkeypatch):
         rt = AgentRuntime(drv.engine(), cfg)
         cid = rt.create(CreateOptions(agent="dev", workspace_mode="snapshot"))
         assert drv.api.containers[cid].state == "created"
+
+
+def test_credentials_staged_only_on_opt_in(tmp_path):
+    """staging.credentials is parsed but NEVER staged unless the caller
+    opts in (settings credentials.stage; VERDICT r4 task 5)."""
+    from clawker_tpu.containerfs import Staging, prepare_config
+
+    host = tmp_path / "claude-home"
+    host.mkdir()
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (host / ".credentials.json").write_text('{"access":"tok"}')
+    (host / "CLAUDE.md").write_text("# memo")
+    staging = Staging.from_raw({
+        "copy": [{"src": str(host / "CLAUDE.md"), "dest": ".claude/CLAUDE.md"}],
+        "credentials": [{"src": str(host / ".credentials.json"),
+                         "dest": ".claude/.credentials.json"}],
+    })
+    assert len(staging.credentials) == 1
+
+    sdir, cleanup = prepare_config(
+        staging, container_home="/home/agent", container_work="/workspace",
+        host_project_root=str(proj))
+    try:
+        assert (sdir / ".claude/CLAUDE.md").exists()
+        assert not (sdir / ".claude/.credentials.json").exists()
+    finally:
+        cleanup()
+
+    sdir, cleanup = prepare_config(
+        staging, container_home="/home/agent", container_work="/workspace",
+        host_project_root=str(proj), include_credentials=True)
+    try:
+        assert (sdir / ".claude/.credentials.json").read_text() == '{"access":"tok"}'
+    finally:
+        cleanup()
+
+
+def test_claude_manifest_declares_credentials_as_opt_in():
+    """The floor harness declares the keyring path under credentials,
+    not copy -- a default build must never stage it."""
+    import yaml
+
+    from clawker_tpu.bundle.resolver import FLOOR_DIR
+    from clawker_tpu.containerfs import Staging
+
+    raw = yaml.safe_load(
+        (FLOOR_DIR / "harnesses/claude/harness.yaml").read_text())
+    st = Staging.from_raw(raw.get("staging"))
+    assert any(".credentials.json" in c.src for c in st.credentials)
+    assert not any(".credentials.json" in c.src for c in st.copy)
