@@ -45,16 +45,19 @@ def project_list(f: Factory, fmt):
 
 
 @project_group.command("edit")
+@click.option("--select", "select_mode", is_flag=True,
+              help="Numbered-select editor instead of the full browser.")
 @pass_factory
-def project_edit(f: Factory):
+def project_edit(f: Factory, select_mode):
     """Interactively browse + edit project config fields (reference
     internal/config/storeui/project)."""
-    from ..storeui import EditError, run_editor
+    from ..storeui import EditError
+    from ..ui.fieldbrowser import edit_store
 
     store = f.config.project_store_ref
     if store is None:
         raise EditError("no project config found (run `clawker init` first)")
-    n = run_editor(store, f.streams)
+    n = edit_store(store, f.streams, select_mode=select_mode)
     click.echo(f"{n} field(s) changed")
 
 

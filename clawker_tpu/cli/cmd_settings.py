@@ -71,13 +71,16 @@ def settings_get(f: Factory, path):
 
 
 @settings_group.command("edit")
+@click.option("--select", "select_mode", is_flag=True,
+              help="Numbered-select editor instead of the full browser.")
 @pass_factory
-def settings_edit(f: Factory):
+def settings_edit(f: Factory, select_mode):
     """Interactively browse + edit settings fields (reflection-driven,
-    reference internal/storeui)."""
-    from ..storeui import run_editor
+    reference internal/storeui + internal/tui field browser)."""
+    from ..ui.fieldbrowser import edit_store
 
-    n = run_editor(f.config.settings_store_ref, f.streams)
+    n = edit_store(f.config.settings_store_ref, f.streams,
+                   select_mode=select_mode)
     click.echo(f"{n} field(s) changed")
 
 

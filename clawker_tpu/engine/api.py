@@ -219,12 +219,14 @@ class Engine:
         agent = labels.get(consts.LABEL_AGENT, "")
         if not project or not agent:
             return
-        got = self.api.volume_list(filters={"label": [
-            f"{consts.LABEL_PROJECT}={project}",
-            f"{consts.LABEL_AGENT}={agent}"]})
-        for vol in (got or {}).get("Volumes") or []:
+        # jailed sweep: the managed filter scopes the listing, and
+        # remove_volume re-asserts ownership per volume -- `rm --volumes`
+        # must never touch a volume this framework does not own
+        for vol in self.list_volumes(filters={"label": [
+                f"{consts.LABEL_PROJECT}={project}",
+                f"{consts.LABEL_AGENT}={agent}"]}):
             try:
-                self.api.volume_remove(vol["Name"], force=force)
+                self.remove_volume(vol["Name"], force=force)
             except NotFoundError:
                 pass
 

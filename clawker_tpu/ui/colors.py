@@ -21,6 +21,7 @@ _CODES = {
     "magenta": "35",
     "cyan": "36",
     "gray": "90",
+    "invert": "7",
 }
 
 
@@ -56,6 +57,9 @@ class ColorScheme:
 
     def cyan(self, s: str) -> str:
         return self._wrap(_CODES["cyan"], s)
+
+    def invert(self, s: str) -> str:
+        return self._wrap(_CODES["invert"], s)
 
     def gray(self, s: str) -> str:
         return self._wrap(_CODES["gray"], s)

@@ -150,7 +150,28 @@ class LoopDashboard:
                     + cs.gray(f":{ev.get('dst_port', '?')}"
                               f" zone={ev.get('zone', ev.get('zone_hash', ''))}")
                 )
+        lines += ["", self._statusbar(status, egress, elapsed, width)]
         return lines
+
+    def _statusbar(self, status: list[dict], egress: list[dict],
+                   elapsed: float, width: int) -> str:
+        """One inverted summary line (reference internal/tui statusbar):
+        loop id, per-state agent counts, recent denies, hottest anomaly
+        z-score, elapsed, quit hint."""
+        cs = self.streams.colors()
+        by_state: dict[str, int] = {}
+        for s in status:
+            by_state[s["status"]] = by_state.get(s["status"], 0) + 1
+        states = " ".join(f"{k}:{v}" for k, v in sorted(by_state.items()))
+        denies = sum(1 for e in egress
+                     if str(e.get("verdict", e.get("action", ""))).upper()
+                     in ("1", "DENY"))
+        zs = [s["anomaly_z"] for s in status if s.get("anomaly_z") is not None]
+        anom = f"  anom-max:{max(zs):.1f}" if zs else ""
+        bar = (f" loop {self.scheduler.loop_id}  {states or 'no agents'}"
+               f"  denies:{denies}{anom}  {elapsed:.0f}s  ctrl-c stops ")
+        bar = bar[:max(10, width)]
+        return cs.invert(bar + " " * max(0, width - visible_len(bar)))
 
     def render_once(self) -> None:
         if not self.streams.is_stdout_tty():

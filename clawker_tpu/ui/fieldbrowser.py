@@ -1,0 +1,354 @@
+"""Full-screen field browser: navigate / filter / edit typed store fields.
+
+``clawker settings edit`` (and ``project edit``) without arguments opens
+this browser over storeui.field_specs: every leaf of the typed schema
+as a row with its current value and provenance layer, arrow/jk
+navigation, ``/`` type-to-filter, Enter editing inline on a prompt line,
+``L`` cycling the write layer, and live re-read after every write so
+provenance updates immediately.
+
+Key handling reads the byte stream (escape sequences decoded here), so
+tests drive it headlessly through IOStreams.test with injected key
+bytes; on a real TTY the caller wraps it in raw mode + the alternate
+screen.
+
+Parity reference: internal/tui componentry (BubbleTea field browser /
+statusbar, SURVEY.md 2.4) -- re-designed as an ANSI repaint loop over
+the same IOStreams seam the dashboard uses.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..storeui import EditError, FieldSpec, _fmt, _raw, coerce, field_specs
+from .colors import visible_len
+from .iostreams import IOStreams
+
+# decoded key tokens
+K_UP, K_DOWN, K_PGUP, K_PGDN, K_HOME, K_END = "up", "down", "pgup", "pgdn", "home", "end"
+K_ENTER, K_ESC, K_BACKSPACE = "enter", "esc", "backspace"
+K_NONE = "none"   # swallowed/unknown input: NOT end-of-input ('')
+
+
+class _FdStream:
+    """Unbuffered char reads straight off a file descriptor.
+
+    The interactive path must NOT read keys through sys.stdin's
+    TextIOWrapper: its userspace buffer can already hold the tail of an
+    escape sequence, making select() on the fd report 'nothing pending'
+    and a Delete key decode as a bare ESC (which quits the browser)."""
+
+    def __init__(self, fd: int):
+        self._fd = fd
+
+    def fileno(self) -> int:
+        return self._fd
+
+    def read(self, n: int = 1) -> str:
+        try:
+            return os.read(self._fd, n).decode(errors="replace")
+        except OSError:
+            return ""
+
+
+def _follow_up(stream) -> str:
+    """Next char IF one is immediately pending ('' otherwise): a bare
+    ESC press must decode as ESC without blocking on the next key.
+    Non-fileno streams (StringIO in tests) just read -- EOF is ''."""
+    fn = getattr(stream, "fileno", None)
+    if fn is not None:
+        try:
+            fd = fn()
+        except (OSError, ValueError, AttributeError):
+            fd = None
+        if fd is not None:
+            import select as _select
+
+            r, _, _ = _select.select([fd], [], [], 0.03)
+            if not r:
+                return ""
+    return stream.read(1)
+
+
+def read_key(stream) -> str:
+    """One decoded key token from a text stream ('' on EOF).
+
+    Printable characters come back as themselves; control/escape
+    sequences as the K_* tokens above.  Unrecognized CSI sequences are
+    consumed to their final byte and ignored (never mis-read as ESC:
+    that would quit the browser on a stray Delete key)."""
+    ch = stream.read(1)
+    if not ch:
+        return ""
+    if ch in ("\r", "\n"):
+        return K_ENTER
+    if ch in ("\x7f", "\x08"):
+        return K_BACKSPACE
+    if ch == "\x1b":
+        nxt = _follow_up(stream)
+        if nxt != "[":
+            return K_ESC
+        # CSI: params/intermediates until a final byte in @..~
+        seq = ""
+        while True:
+            c = _follow_up(stream)
+            if not c:
+                return ""
+            seq += c
+            if "@" <= c <= "~":
+                break
+        finals = {"A": K_UP, "B": K_DOWN, "H": K_HOME, "F": K_END}
+        if seq in finals:
+            return finals[seq]
+        if seq == "5~":
+            return K_PGUP
+        if seq == "6~":
+            return K_PGDN
+        return K_NONE  # unknown sequence: swallowed whole, not ESC/EOF
+    return ch if ch.isprintable() else K_NONE
+
+
+class FieldBrowser:
+    """State machine over the spec list; render() returns frame lines so
+    tests can assert on them without a terminal."""
+
+    def __init__(self, store, streams: IOStreams, *, layers: list[str] | None = None):
+        self.store = store
+        self.streams = streams
+        self.layers: list[str | None] = [None] + list(layers or [])
+        self.layer_idx = 0
+        self.cursor = 0
+        self.offset = 0
+        self.filter = ""
+        self.filtering = False
+        self.editing = False
+        self.edit_buf = ""
+        self.message = ""
+        self.changed = 0
+        self.specs: list[FieldSpec] = []
+        self.reload()
+
+    # ------------------------------------------------------------- model
+
+    def reload(self) -> None:
+        self.specs = field_specs(self.store)
+
+    def visible(self) -> list[FieldSpec]:
+        if not self.filter:
+            return self.specs
+        f = self.filter.lower()
+        return [s for s in self.specs if f in s.path.lower()]
+
+    def current(self) -> FieldSpec | None:
+        vis = self.visible()
+        if not vis:
+            return None
+        self.cursor = max(0, min(self.cursor, len(vis) - 1))
+        return vis[self.cursor]
+
+    @property
+    def write_layer(self) -> str | None:
+        return self.layers[self.layer_idx]
+
+    # ------------------------------------------------------------- input
+
+    def handle(self, key: str) -> bool:
+        """One key; returns False when the browser should close."""
+        if self.editing:
+            return self._handle_edit(key)
+        if self.filtering:
+            return self._handle_filter(key)
+        vis = self.visible()
+        if key == K_NONE:
+            return True
+        if key in ("q", K_ESC) or key == "":
+            return False
+        if key in (K_UP, "k"):
+            self.cursor = max(0, self.cursor - 1)
+        elif key in (K_DOWN, "j"):
+            self.cursor = min(max(0, len(vis) - 1), self.cursor + 1)
+        elif key == K_PGUP:
+            self.cursor = max(0, self.cursor - self._page())
+        elif key == K_PGDN:
+            self.cursor = min(max(0, len(vis) - 1), self.cursor + self._page())
+        elif key == K_HOME:
+            self.cursor = 0
+        elif key == K_END:
+            self.cursor = max(0, len(vis) - 1)
+        elif key == "/":
+            self.filtering = True
+            self.filter = ""
+            self.cursor = 0
+        elif key in ("L", "l"):
+            self.layer_idx = (self.layer_idx + 1) % len(self.layers)
+        elif key == K_ENTER:
+            spec = self.current()
+            if spec is not None:
+                self.editing = True
+                self.edit_buf = _raw(spec)
+                self.message = ""
+        return True
+
+    def _handle_filter(self, key: str) -> bool:
+        if key == K_NONE:
+            return True
+        if key in (K_ENTER, K_ESC):
+            self.filtering = False
+            if key == K_ESC:
+                self.filter = ""
+        elif key == K_BACKSPACE:
+            self.filter = self.filter[:-1]
+        elif key == "":
+            return False
+        elif len(key) == 1:
+            self.filter += key
+            self.cursor = 0
+        return True
+
+    def _handle_edit(self, key: str) -> bool:
+        if key == K_NONE:
+            return True
+        if key == K_ESC:
+            self.editing = False
+            self.message = "edit cancelled"
+            return True
+        if key == "":
+            return False
+        if key == K_ENTER:
+            spec = self.current()
+            self.editing = False
+            if spec is None:
+                return True
+            try:
+                value = coerce(spec, self.edit_buf)
+            except EditError as e:
+                self.message = str(e)
+                return True
+            if value != spec.value:
+                self.store.set(spec.path, value, layer=self.write_layer)
+                self.changed += 1
+                self.reload()
+                self.message = f"set {spec.path} = {_fmt(value)}"
+            return True
+        if key == K_BACKSPACE:
+            self.edit_buf = self.edit_buf[:-1]
+        elif len(key) == 1:
+            self.edit_buf += key
+        return True
+
+    # ------------------------------------------------------------ render
+
+    def _page(self) -> int:
+        return max(4, self._height() - 4)
+
+    def _height(self) -> int:
+        import shutil as _sh
+
+        try:
+            return _sh.get_terminal_size().lines
+        except OSError:
+            return 24
+
+    def render(self) -> list[str]:
+        cs = self.streams.colors()
+        width = self.streams.terminal_width()
+        page = self._page()
+        vis = self.visible()
+        self.cursor = max(0, min(self.cursor, max(0, len(vis) - 1)))
+        if self.cursor < self.offset:
+            self.offset = self.cursor
+        if self.cursor >= self.offset + page:
+            self.offset = self.cursor - page + 1
+        rows = vis[self.offset:self.offset + page]
+
+        head = cs.bold("settings browser") + cs.gray(
+            f"  {len(vis)}/{len(self.specs)} fields"
+            f"  write layer: {self.write_layer or 'auto'}")
+        lines = [head]
+        path_w = max([visible_len(s.path) for s in rows], default=20)
+        for i, s in enumerate(rows):
+            idx = self.offset + i
+            prov = f"  [{s.provenance}]" if s.provenance else "  [default]"
+            val = _fmt(s.value)
+            line = (f"{s.path:<{path_w}}  {val}"[:max(10, width - 12)]
+                    + cs.gray(prov))
+            if idx == self.cursor:
+                line = cs.invert(" " + line + " ") if hasattr(cs, "invert") \
+                    else cs.bold("> " + line)
+            else:
+                line = "  " + line
+            lines.append(line)
+        if not rows:
+            lines.append(cs.gray("  (no fields match the filter)"))
+
+        if self.editing:
+            spec = self.current()
+            name = spec.path if spec else "?"
+            lines.append(cs.bold(f"edit {name} > ") + self.edit_buf + "_")
+        elif self.filtering:
+            lines.append(cs.bold("filter > ") + self.filter + "_")
+        else:
+            hints = ("arrows/jk move  / filter  enter edit  "
+                     "L layer  q quit")
+            status = f" {hints}  {self.message}"
+            lines.append(cs.gray(status[:width]))
+        return lines
+
+
+def browse(store, streams: IOStreams, *, key_stream=None,
+           layers: list[str] | None = None) -> int:
+    """Run the browser; returns the number of fields changed.
+
+    ``key_stream`` defaults to the streams' stdin buffer; on a real TTY
+    the caller should hold raw mode for the duration (cmd_settings does)."""
+    browser = FieldBrowser(store, streams, layers=layers)
+    stream = key_stream if key_stream is not None else streams.stdin
+    out = streams.stdout
+    alt = streams.is_stdout_tty()
+    painted = 0
+    if alt:
+        out.write("\x1b[?1049h\x1b[H")
+    # the caller holds raw mode: OPOST is off, so \n does not imply \r --
+    # every line must carriage-return explicitly or frames stair-step
+    nl = "\r\n"
+    try:
+        while True:
+            lines = browser.render()
+            if alt:
+                out.write("\x1b[H")
+            elif painted:
+                out.write(f"\x1b[{painted}A")
+            for line in lines:
+                out.write("\x1b[2K" + line + nl)
+            for _ in range(max(0, painted - len(lines))):
+                out.write("\x1b[2K" + nl)
+            if painted > len(lines):
+                out.write(f"\x1b[{painted - len(lines)}A")
+            painted = len(lines)
+            out.flush()
+            if not browser.handle(read_key(stream)):
+                break
+    finally:
+        if alt:
+            out.write("\x1b[?1049l")
+            out.flush()
+    return browser.changed
+
+
+def edit_store(store, streams: IOStreams, *, select_mode: bool = False) -> int:
+    """Shared launch for ``settings edit`` / ``project edit``: the
+    full-screen browser on a real terminal (raw mode held here), the
+    numbered-select editor otherwise or with --select."""
+    if not select_mode and streams.is_stdin_tty() and streams.is_stdout_tty():
+        import sys
+
+        from ..runtime.attach import raw_terminal
+
+        writable = [l.name for l in store.layers if l.writable]
+        with raw_terminal(sys.stdin.fileno()):
+            return browse(store, streams, layers=writable,
+                          key_stream=_FdStream(sys.stdin.fileno()))
+    from ..storeui import run_editor
+
+    return run_editor(store, streams)
