@@ -398,7 +398,9 @@ class Engine:
         return self.api.volume_create(name, labels=self._managed_labels(labels))
 
     def list_volumes(self, *, filters: dict | None = None) -> list[dict]:
-        return self.api.volume_list(filters=self._managed_filter(filters))["Volumes"]
+        # dockerd marshals an empty result as {"Volumes": null}
+        got = self.api.volume_list(filters=self._managed_filter(filters))
+        return (got or {}).get("Volumes") or []
 
     def remove_volume(self, name: str, *, force: bool = False) -> None:
         try:

@@ -47,6 +47,10 @@ class CgroupResolver:
             f"{self.root}/docker/{cid}",                          # cgroupfs driver
             f"{self.root}/machine.slice/docker-{cid}.scope",
         ]
+        # the first-party nsd daemon reports its cgroup dir directly
+        nsd_dir = info.get("NsdCgroupDir")
+        if nsd_dir:
+            candidates.insert(0, nsd_dir)
         for path in candidates:
             if os.path.isdir(path):
                 # kernel cgroup id == the directory inode on cgroup2
@@ -91,6 +95,32 @@ class Attacher:
 
     def detach(self, cgroup_path: str) -> None:
         self._run("detach", "--cgroup", cgroup_path)
+
+
+class KernelAttacher(Attacher):
+    """In-process attach: the programs live in THIS process's verified
+    FwKernel (firewall/fwprogs) -- no fwctl binary, no pinned object.
+    The attacher owns the kernel handle; callers read/write policy
+    through its LiveMaps."""
+
+    def __init__(self, kern=None):
+        from .fwprogs import FwKernel, LiveMaps
+
+        self.kern = kern if kern is not None else FwKernel()
+        self.maps = LiveMaps(self.kern)
+
+    def attach(self, cgroup_path: str) -> None:
+        try:
+            self.kern.attach_cgroup(cgroup_path)
+        except (OSError, ClawkerError) as e:
+            raise EnrollError(f"attach {cgroup_path}: {e}") from None
+
+    def detach(self, cgroup_path: str) -> None:
+        self.kern.detach_cgroup(cgroup_path)
+
+    def close(self) -> None:
+        self.maps.close()
+        self.kern.close()
 
 
 class FakeAttacher(Attacher):

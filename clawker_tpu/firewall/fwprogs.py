@@ -658,6 +658,7 @@ class FwKernel:
         self.maps = create_maps()
         self.progs: dict[str, LoadedProg] = {}
         self._attached: list[tuple[int, int, int]] = []  # prog_fd, cg_fd, type
+        self._by_path: dict[str, int] = {}               # cgroup path -> cg_fd
         try:
             for name, ptype, atype, build in PROGRAM_SPECS:
                 asm = build(self.maps)
@@ -673,7 +674,12 @@ class FwKernel:
             raise
 
     def attach_cgroup(self, cgroup_path: str) -> int:
-        """Attach all nine programs to a cgroup-v2 dir; returns its id."""
+        """Attach all nine programs to a cgroup-v2 dir; returns its id.
+        Idempotent per path: a re-enable after container restart (same
+        path, fresh cgroup) replaces the old attachment instead of
+        leaking its fd and stranding its program set."""
+        if str(cgroup_path) in self._by_path:
+            self.detach_cgroup(cgroup_path)
         cg_fd = os.open(cgroup_path, os.O_RDONLY | os.O_DIRECTORY)
         done: list[tuple[int, int, int]] = []
         try:
@@ -691,9 +697,32 @@ class FwKernel:
             os.close(cg_fd)
             raise
         self._attached.extend(done)
+        self._by_path[str(cgroup_path)] = cg_fd
         return K.cgroup_id(cgroup_path)
 
+    def detach_cgroup(self, cgroup_path: str) -> bool:
+        """Detach the program set from one cgroup (drain/disable path)."""
+        cg_fd = self._by_path.pop(str(cgroup_path), None)
+        if cg_fd is None:
+            return False
+        remaining = []
+        for prog_fd, fd, atype in self._attached:
+            if fd != cg_fd:
+                remaining.append((prog_fd, fd, atype))
+                continue
+            try:
+                K.prog_detach(prog_fd, fd, atype)
+            except K.BpfError:
+                pass
+        self._attached = remaining
+        try:
+            os.close(cg_fd)
+        except OSError:
+            pass
+        return True
+
     def detach_all(self) -> None:
+        self._by_path.clear()
         seen_cg = set()
         for prog_fd, cg_fd, atype in self._attached:
             try:

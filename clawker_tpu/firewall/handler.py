@@ -457,7 +457,13 @@ class FirewallHandler:
     # --------------------------------------------------------------- drain
 
     def close(self) -> None:
-        """Drain ordering: queue first (no new mutations), then timers."""
+        """Drain ordering: queue first (no new mutations), then timers.
+
+        NOTE: an in-process KernelAttacher is deliberately NOT closed
+        here -- closing would detach the programs and drop enforcement,
+        and close() runs on crash-path drains too (fail-closed: pinned
+        OR in-process maps keep enforcing).  teardown() is the explicit
+        data-plane removal."""
         self.queue.close()
         for t in self._bypass_timers.values():
             t.cancel()

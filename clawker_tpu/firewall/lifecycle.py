@@ -43,6 +43,9 @@ def _local(cfg: Config, driver: RuntimeDriver):
             _local_handlers[key] = build_handler(
                 cfg, driver.engine(),
                 monitor_fallback=not cfg.settings.firewall.default_deny,
+                # fake-driver containers have no real cgroups to attach
+                # the in-process kernel programs to
+                inprocess_ok=getattr(driver, "name", "") != "fake",
             )
         return _local_handlers[key]
 

@@ -35,6 +35,16 @@ def kernel_available(pin_dir: str = consts.BPF_PIN_DIR) -> bool:
     return (Path(pin_dir) / "containers").exists()
 
 
+def inprocess_kernel_available() -> bool:
+    """bpf(2) PROG_LOAD + writable cgroup-v2 from this process."""
+    try:
+        from .bpfkern import kernel_available as probe
+
+        return probe()
+    except Exception:  # noqa: BLE001 - any failure means the lane is out
+        return False
+
+
 def build_handler(
     cfg: Config,
     engine: Engine,
@@ -43,9 +53,13 @@ def build_handler(
     resolver: CgroupResolver | None = None,
     attacher: Attacher | None = None,
     monitor_fallback: bool = False,
+    inprocess_ok: bool = True,
     dns_host: str = "",
     dns_port: int = consts.DNS_PORT,
 ) -> FirewallHandler:
+    """``inprocess_ok`` gates the in-process verifier-loaded lane: it
+    only makes sense when the engine runs REAL containers whose cgroups
+    exist on this host (callers with a fake driver pass False)."""
     if maps is None:
         if kernel_available():
             from .bpfsys import PinnedMaps
@@ -54,6 +68,19 @@ def build_handler(
             resolver = resolver or CgroupResolver()
             attacher = attacher or Attacher(pin_dir=consts.BPF_PIN_DIR)
             log.info("firewall: kernel enforcement (pinned maps)")
+        elif inprocess_ok and inprocess_kernel_available():
+            # no pinned object, but bpf(2) + cgroup-v2 work from this
+            # process: assemble + verifier-load the programs in-process
+            # (firewall/fwprogs) -- full kernel enforcement with zero
+            # native tooling, the lane nsd-backed hosts use
+            from .enroll import KernelAttacher
+
+            ka = KernelAttacher()
+            maps = ka.maps
+            resolver = resolver or CgroupResolver()
+            attacher = attacher or ka
+            log.info("firewall: kernel enforcement (in-process verifier-"
+                     "loaded programs)")
         elif monitor_fallback:
             maps = FakeMaps()
             resolver = resolver or FakeCgroupResolver()

@@ -164,6 +164,10 @@ class NsContainer:
         return {
             "Id": self.id,
             "Name": "/" + self.name,
+            # first-party extension: where this container's cgroup lives,
+            # so the firewall's CgroupResolver enrolls nsd containers
+            # without daemon-specific path guessing
+            "NsdCgroupDir": str(self.cgroup_dir) if self.cgroup_dir else "",
             "Created": self.created_at,
             "Config": json.loads(json.dumps(self.config)),
             "State": {
@@ -484,17 +488,29 @@ class NsRuntime:
             argv.append(f"{k}={v}")
         argv += list(cmd)
         tty = bool(config.get("Tty"))
+        cg = c.cgroup_dir
+
+        def pre_exec() -> None:
+            # execs belong to the CONTAINER's cgroup (docker semantics):
+            # the egress firewall keys enforcement on it
+            if cg is not None:
+                try:
+                    (cg / "cgroup.procs").write_text(str(os.getpid()))
+                except OSError:
+                    pass
+
         if tty:
             master, slave = pty.openpty()
             p = subprocess.Popen(argv, stdin=slave, stdout=slave,
                                  stderr=slave, start_new_session=True,
-                                 close_fds=True)
+                                 preexec_fn=pre_exec, close_fds=True)
             os.close(slave)
             p.nsd_io = (master, None, None)  # type: ignore[attr-defined]
         else:
             p = subprocess.Popen(argv, stdin=subprocess.PIPE,
                                  stdout=subprocess.PIPE,
-                                 stderr=subprocess.PIPE, close_fds=True)
+                                 stderr=subprocess.PIPE,
+                                 preexec_fn=pre_exec, close_fds=True)
             p.nsd_io = None  # type: ignore[attr-defined]
         return p
 

@@ -210,9 +210,15 @@ class NsDaemon:
 
     def shutdown(self) -> None:
         self._stop.set()
+        # full teardown: overlay mounts must not outlive the daemon (a
+        # leftover merged mount makes the state dir un-removable)
         for c in list(self.containers.values()):
-            if c.state == "running":
-                self.runtime.kill(c)
+            try:
+                self.runtime.remove(c)
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                if c.state == "running":
+                    self.runtime.kill(c)
+        self.containers.clear()
 
     # ----------------------------------------------------------- http i/o
 

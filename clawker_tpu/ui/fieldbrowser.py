@@ -29,6 +29,7 @@ from .iostreams import IOStreams
 K_UP, K_DOWN, K_PGUP, K_PGDN, K_HOME, K_END = "up", "down", "pgup", "pgdn", "home", "end"
 K_ENTER, K_ESC, K_BACKSPACE = "enter", "esc", "backspace"
 K_NONE = "none"   # swallowed/unknown input: NOT end-of-input ('')
+K_INT = "interrupt"  # Ctrl-C: raw mode disables ISIG, so decode it here
 
 
 class _FdStream:
@@ -81,6 +82,8 @@ def read_key(stream) -> str:
     ch = stream.read(1)
     if not ch:
         return ""
+    if ch == "\x03":
+        return K_INT
     if ch in ("\r", "\n"):
         return K_ENTER
     if ch in ("\x7f", "\x08"):
@@ -162,7 +165,7 @@ class FieldBrowser:
         vis = self.visible()
         if key == K_NONE:
             return True
-        if key in ("q", K_ESC) or key == "":
+        if key in ("q", K_ESC, K_INT) or key == "":
             return False
         if key in (K_UP, "k"):
             self.cursor = max(0, self.cursor - 1)
@@ -193,6 +196,8 @@ class FieldBrowser:
     def _handle_filter(self, key: str) -> bool:
         if key == K_NONE:
             return True
+        if key == K_INT:
+            return False
         if key in (K_ENTER, K_ESC):
             self.filtering = False
             if key == K_ESC:
@@ -209,6 +214,8 @@ class FieldBrowser:
     def _handle_edit(self, key: str) -> bool:
         if key == K_NONE:
             return True
+        if key == K_INT:
+            return False
         if key == K_ESC:
             self.editing = False
             self.message = "edit cancelled"
@@ -308,7 +315,8 @@ def browse(store, streams: IOStreams, *, key_stream=None,
     alt = streams.is_stdout_tty()
     painted = 0
     if alt:
-        out.write("\x1b[?1049h\x1b[H")
+        streams.start_alt_screen()
+        out.write("\x1b[H")
     # the caller holds raw mode: OPOST is off, so \n does not imply \r --
     # every line must carriage-return explicitly or frames stair-step
     nl = "\r\n"
@@ -331,8 +339,7 @@ def browse(store, streams: IOStreams, *, key_stream=None,
                 break
     finally:
         if alt:
-            out.write("\x1b[?1049l")
-            out.flush()
+            streams.stop_alt_screen()
     return browser.changed
 
 
