@@ -49,7 +49,7 @@ def main() -> int:
             firewall = build_handler(
                 cfg, driver.engine(),
                 monitor_fallback=not cfg.settings.firewall.default_deny,
-                inprocess_ok=getattr(driver, "name", "") != "fake",
+                inprocess_ok=getattr(driver, "real_cgroups", True),
             )
         except Exception as e:
             import logging

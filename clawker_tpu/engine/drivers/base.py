@@ -42,6 +42,9 @@ class RuntimeDriver:
     """
 
     name = "abstract"
+    # do this driver's containers have real cgroup dirs on THIS host?
+    # (gates kernel-enforcement lanes; the fake driver says no)
+    real_cgroups = True
 
     def connect(self) -> list[Worker]:
         raise NotImplementedError

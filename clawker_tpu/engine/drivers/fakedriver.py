@@ -9,6 +9,7 @@ from .base import RuntimeDriver, Worker
 
 class FakeDriver(RuntimeDriver):
     name = "fake"
+    real_cgroups = False
 
     def __init__(self, n_workers: int = 1):
         self.apis = [FakeDockerAPI() for _ in range(n_workers)]

@@ -675,11 +675,11 @@ class FwKernel:
 
     def attach_cgroup(self, cgroup_path: str) -> int:
         """Attach all nine programs to a cgroup-v2 dir; returns its id.
-        Idempotent per path: a re-enable after container restart (same
-        path, fresh cgroup) replaces the old attachment instead of
-        leaking its fd and stranding its program set."""
-        if str(cgroup_path) in self._by_path:
-            self.detach_cgroup(cgroup_path)
+        Idempotent per path: a re-enable (restart, or same live cgroup)
+        attaches the NEW set first -- BPF_F_ALLOW_MULTI allows the
+        overlap -- and only then detaches the old one, so there is no
+        unenforced window, no leaked fd, no stranded program set."""
+        prior = self._by_path.pop(str(cgroup_path), None)
         cg_fd = os.open(cgroup_path, os.O_RDONLY | os.O_DIRECTORY)
         done: list[tuple[int, int, int]] = []
         try:
@@ -698,13 +698,11 @@ class FwKernel:
             raise
         self._attached.extend(done)
         self._by_path[str(cgroup_path)] = cg_fd
+        if prior is not None:
+            self._detach_fd(prior)
         return K.cgroup_id(cgroup_path)
 
-    def detach_cgroup(self, cgroup_path: str) -> bool:
-        """Detach the program set from one cgroup (drain/disable path)."""
-        cg_fd = self._by_path.pop(str(cgroup_path), None)
-        if cg_fd is None:
-            return False
+    def _detach_fd(self, cg_fd: int) -> None:
         remaining = []
         for prog_fd, fd, atype in self._attached:
             if fd != cg_fd:
@@ -719,6 +717,13 @@ class FwKernel:
             os.close(cg_fd)
         except OSError:
             pass
+
+    def detach_cgroup(self, cgroup_path: str) -> bool:
+        """Detach the program set from one cgroup (drain/disable path)."""
+        cg_fd = self._by_path.pop(str(cgroup_path), None)
+        if cg_fd is None:
+            return False
+        self._detach_fd(cg_fd)
         return True
 
     def detach_all(self) -> None:
@@ -739,6 +744,37 @@ class FwKernel:
 
     def event_reader(self) -> K.RingBufReader:
         return K.RingBufReader(self.maps.events, RING_SZ)
+
+    def pin_all(self, pin_dir: str) -> None:
+        """Pin every map (by ABI name) and program (``prog_<name>``) into
+        a bpffs directory: other processes -- PinnedMaps consumers, the
+        raw-syscall fwctl -- then reach this kernel state by path."""
+        from pathlib import Path as _P
+
+        d = _P(pin_dir)
+        d.mkdir(parents=True, exist_ok=True)
+        from .maps import (
+            MAP_BYPASS, MAP_CONTAINERS, MAP_DNS_CACHE, MAP_EVENTS,
+            MAP_RATELIMIT, MAP_ROUTES, MAP_TCP_FLOWS, MAP_UDP_FLOWS,
+        )
+
+        by_name = {
+            MAP_CONTAINERS: self.maps.containers, MAP_BYPASS: self.maps.bypass,
+            MAP_DNS_CACHE: self.maps.dns_cache, MAP_ROUTES: self.maps.routes,
+            MAP_UDP_FLOWS: self.maps.udp_flows, MAP_TCP_FLOWS: self.maps.tcp_flows,
+            MAP_EVENTS: self.maps.events, MAP_RATELIMIT: self.maps.ratelimit,
+        }
+        # stale pins from a previous kernel would SHADOW this one: map
+        # writes would land in the dead kernel's maps while the live
+        # programs enforce from these -- always replace
+        for name, fd in by_name.items():
+            path = d / name
+            path.unlink(missing_ok=True)
+            K.obj_pin(fd, path)
+        for name, p in self.progs.items():
+            path = d / f"prog_{name}"
+            path.unlink(missing_ok=True)
+            K.obj_pin(p.fd, path)
 
     def close(self) -> None:
         self.detach_all()

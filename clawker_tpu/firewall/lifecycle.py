@@ -43,9 +43,9 @@ def _local(cfg: Config, driver: RuntimeDriver):
             _local_handlers[key] = build_handler(
                 cfg, driver.engine(),
                 monitor_fallback=not cfg.settings.firewall.default_deny,
-                # fake-driver containers have no real cgroups to attach
-                # the in-process kernel programs to
-                inprocess_ok=getattr(driver, "name", "") != "fake",
+                # drivers whose containers have no real cgroups on this
+                # host cannot take the in-process kernel lane
+                inprocess_ok=getattr(driver, "real_cgroups", True),
             )
         return _local_handlers[key]
 

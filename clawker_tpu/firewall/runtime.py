@@ -61,6 +61,21 @@ def build_handler(
     only makes sense when the engine runs REAL containers whose cgroups
     exist on this host (callers with a fake driver pass False)."""
     if maps is None:
+        ka = None
+        if not kernel_available() and inprocess_ok \
+                and inprocess_kernel_available():
+            # no pinned object, but bpf(2) + cgroup-v2 work: try to
+            # assemble + verifier-load the programs in-process.  A probe
+            # that passed does not guarantee the full set loads (older
+            # kernels, verifier limits), so a failure here degrades to
+            # the next lane instead of sinking every firewall verb.
+            from .enroll import KernelAttacher
+
+            try:
+                ka = KernelAttacher()
+            except Exception as e:  # noqa: BLE001 - lane probe
+                log.warning("firewall: in-process kernel lane failed "
+                            "(%s); falling back", e)
         if kernel_available():
             from .bpfsys import PinnedMaps
 
@@ -68,14 +83,7 @@ def build_handler(
             resolver = resolver or CgroupResolver()
             attacher = attacher or Attacher(pin_dir=consts.BPF_PIN_DIR)
             log.info("firewall: kernel enforcement (pinned maps)")
-        elif inprocess_ok and inprocess_kernel_available():
-            # no pinned object, but bpf(2) + cgroup-v2 work from this
-            # process: assemble + verifier-load the programs in-process
-            # (firewall/fwprogs) -- full kernel enforcement with zero
-            # native tooling, the lane nsd-backed hosts use
-            from .enroll import KernelAttacher
-
-            ka = KernelAttacher()
+        elif ka is not None:
             maps = ka.maps
             resolver = resolver or CgroupResolver()
             attacher = attacher or ka

@@ -42,6 +42,18 @@ def _now() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
 
+def _cgroup_preexec(cg: Path | None):
+    """Fork-time cgroup migration for container init AND execs.  Failing
+    to join is FATAL (the exec aborts): proceeding outside the cgroup
+    would silently escape the firewall's enforcement scope."""
+
+    def pre_exec() -> None:
+        if cg is not None:
+            (cg / "cgroup.procs").write_text(str(os.getpid()))
+
+    return pre_exec
+
+
 def frame(stream: int, payload: bytes) -> bytes:
     """Docker stdcopy framing: [stream, 0, 0, 0, len_be32, payload]."""
     return bytes([stream, 0, 0, 0]) + struct.pack(">I", len(payload)) + payload
@@ -259,17 +271,7 @@ class NsRuntime:
                 str(cfg_path)]
         spawn_env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin"),
                      "PYTHONPATH": REPO_ROOT}
-        cg = c.cgroup_dir
-
-        def pre_exec() -> None:
-            # host-ns pid is still correct here; all namespace children
-            # inherit the cgroup, which is where the egress firewall's
-            # programs attach
-            if cg is not None:
-                try:
-                    (cg / "cgroup.procs").write_text(str(os.getpid()))
-                except OSError:
-                    pass
+        pre_exec = _cgroup_preexec(c.cgroup_dir)
 
         if c.tty:
             master, slave = pty.openpty()
@@ -488,16 +490,9 @@ class NsRuntime:
             argv.append(f"{k}={v}")
         argv += list(cmd)
         tty = bool(config.get("Tty"))
-        cg = c.cgroup_dir
-
-        def pre_exec() -> None:
-            # execs belong to the CONTAINER's cgroup (docker semantics):
-            # the egress firewall keys enforcement on it
-            if cg is not None:
-                try:
-                    (cg / "cgroup.procs").write_text(str(os.getpid()))
-                except OSError:
-                    pass
+        # execs belong to the CONTAINER's cgroup (docker semantics):
+        # the egress firewall keys enforcement on it
+        pre_exec = _cgroup_preexec(c.cgroup_dir)
 
         if tty:
             master, slave = pty.openpty()

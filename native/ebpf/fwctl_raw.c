@@ -1,0 +1,301 @@
+/* fwctl_raw.c - raw-syscall firewall control: no libbpf, no ELF.
+ *
+ * The full fwctl (fwctl.c) needs libbpf for one thing only: loading the
+ * clang-built ELF object.  Every OTHER operation -- attaching pinned
+ * programs to cgroups, dumping maps, draining the events ringbuf -- is
+ * plain bpf(2) + mmap, so this tool compiles with nothing but a libc
+ * and works against ANY pinned program set: the clang/libbpf object on
+ * provisioned workers, or the in-process assembled programs the Python
+ * lane pins via FwKernel.pin_all().
+ *
+ * Commands (JSON on stdout, errors on stderr, exit != 0 on failure):
+ *   fwctl-raw attach  --cgroup PATH --pin-dir DIR
+ *   fwctl-raw detach  --cgroup PATH --pin-dir DIR
+ *   fwctl-raw events  [--max N] --pin-dir DIR
+ *   fwctl-raw status  --pin-dir DIR
+ *
+ * The events output is the exact JSON dialect
+ * clawker_tpu/firewall/bpfsys.PinnedMaps.drain_events parses, so this
+ * binary IS the product's native event drain.
+ *
+ * Parity reference: controlplane/firewall/ebpf/manager.go Attach/Events
+ * -- re-implemented at the syscall layer (tested against the real
+ * kernel by tests/test_fwctl_raw.py, which this build runs live).
+ */
+
+#define _GNU_SOURCE
+#include <errno.h>
+#include <fcntl.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include "fw_maps.h"
+
+/* ---- bpf(2) plumbing (uapi/linux/bpf.h subset) ---- */
+
+#define BPF_OBJ_GET 7
+#define BPF_PROG_ATTACH 8
+#define BPF_PROG_DETACH 9
+#define BPF_MAP_GET_NEXT_KEY 4
+#define BPF_MAP_LOOKUP_ELEM 1
+#define BPF_OBJ_GET_INFO_BY_FD 15
+
+#define BPF_F_ALLOW_MULTI 2
+
+struct obj_attr { uint64_t pathname; uint32_t bpf_fd; uint32_t file_flags; };
+struct attach_attr {
+	uint32_t target_fd, attach_bpf_fd, attach_type, attach_flags,
+		replace_bpf_fd;
+};
+struct elem_attr {
+	uint32_t map_fd, pad;
+	uint64_t key, value, flags;
+};
+struct info_attr { uint32_t bpf_fd, info_len; uint64_t info; };
+
+static long sys_bpf(int cmd, void *attr, unsigned int size)
+{
+	return syscall(__NR_bpf, cmd, attr, size);
+}
+
+static int obj_get(const char *dir, const char *name)
+{
+	char path[512];
+	struct obj_attr a = {0};
+	int fd;
+
+	snprintf(path, sizeof(path), "%s/%s", dir, name);
+	a.pathname = (uint64_t)(uintptr_t)path;
+	fd = (int)sys_bpf(BPF_OBJ_GET, &a, sizeof(a));
+	if (fd < 0)
+		fprintf(stderr, "fwctl-raw: obj_get %s: %s\n", path,
+			strerror(errno));
+	return fd;
+}
+
+/* ---- program set: name -> expected cgroup attach type ---- */
+
+static const struct { const char *name; uint32_t attach_type; } PROGS[] = {
+	{ "fw_connect4", 10 },     /* BPF_CGROUP_INET4_CONNECT */
+	{ "fw_sendmsg4", 14 },     /* BPF_CGROUP_UDP4_SENDMSG */
+	{ "fw_recvmsg4", 19 },     /* BPF_CGROUP_UDP4_RECVMSG */
+	{ "fw_getpeername4", 29 }, /* BPF_CGROUP_INET4_GETPEERNAME */
+	{ "fw_connect6", 11 },     /* BPF_CGROUP_INET6_CONNECT */
+	{ "fw_sendmsg6", 15 },     /* BPF_CGROUP_UDP6_SENDMSG */
+	{ "fw_recvmsg6", 20 },     /* BPF_CGROUP_UDP6_RECVMSG */
+	{ "fw_getpeername6", 30 }, /* BPF_CGROUP_INET6_GETPEERNAME */
+	{ "fw_sock_create", 2 },   /* BPF_CGROUP_INET_SOCK_CREATE */
+};
+#define NPROGS (sizeof(PROGS) / sizeof(PROGS[0]))
+
+static int cmd_attach(const char *cgroup, const char *pin_dir, int detach)
+{
+	int cg_fd = open(cgroup, O_RDONLY | O_DIRECTORY);
+	size_t i;
+	int rc = 0;
+
+	if (cg_fd < 0) {
+		fprintf(stderr, "fwctl-raw: open %s: %s\n", cgroup,
+			strerror(errno));
+		return 1;
+	}
+	for (i = 0; i < NPROGS; i++) {
+		char pin[300];
+		struct attach_attr a = {0};
+		int prog_fd;
+
+		snprintf(pin, sizeof(pin), "prog_%s", PROGS[i].name);
+		prog_fd = obj_get(pin_dir, pin);
+		if (prog_fd < 0) {
+			rc = 1;
+			continue;
+		}
+		a.target_fd = (uint32_t)cg_fd;
+		a.attach_bpf_fd = (uint32_t)prog_fd;
+		a.attach_type = PROGS[i].attach_type;
+		a.attach_flags = detach ? 0 : BPF_F_ALLOW_MULTI;
+		if (sys_bpf(detach ? BPF_PROG_DETACH : BPF_PROG_ATTACH, &a,
+			    sizeof(a)) < 0) {
+			/* detach of a never-attached prog is not an error */
+			if (!(detach && errno == ENOENT)) {
+				fprintf(stderr, "fwctl-raw: %s %s: %s\n",
+					detach ? "detach" : "attach",
+					PROGS[i].name, strerror(errno));
+				rc = 1;
+			}
+		}
+		close(prog_fd);
+	}
+	close(cg_fd);
+	if (!rc)
+		printf("{\"ok\": true, \"cgroup\": \"%s\", \"programs\": %zu}\n",
+		       cgroup, NPROGS);
+	return rc;
+}
+
+/* ---- events: mmap ringbuf drain (kernel/bpf/ringbuf.c layout) ---- */
+
+static int map_max_entries(int fd)
+{
+	/* struct bpf_map_info: type,id,key_size,value_size,max_entries,... */
+	uint32_t info[20] = {0};
+	struct info_attr a = {0};
+
+	a.bpf_fd = (uint32_t)fd;
+	a.info_len = sizeof(info);
+	a.info = (uint64_t)(uintptr_t)info;
+	if (sys_bpf(BPF_OBJ_GET_INFO_BY_FD, &a, sizeof(a)) < 0)
+		return -1;
+	return (int)info[4];
+}
+
+static int cmd_events(const char *pin_dir, int max_events)
+{
+	long page = sysconf(_SC_PAGESIZE);
+	int fd = obj_get(pin_dir, "events");
+	int size, n = 0;
+	unsigned char *cons, *data;
+	uint64_t cons_pos, prod_pos;
+
+	if (fd < 0)
+		return 1;
+	size = map_max_entries(fd);
+	if (size <= 0) {
+		fprintf(stderr, "fwctl-raw: events map info failed\n");
+		return 1;
+	}
+	cons = mmap(NULL, (size_t)page, PROT_READ | PROT_WRITE, MAP_SHARED,
+		    fd, 0);
+	data = mmap(NULL, (size_t)page + 2ul * (size_t)size, PROT_READ,
+		    MAP_SHARED, fd, page);
+	if (cons == MAP_FAILED || data == MAP_FAILED) {
+		fprintf(stderr, "fwctl-raw: ringbuf mmap: %s\n",
+			strerror(errno));
+		return 1;
+	}
+	cons_pos = *(volatile uint64_t *)cons;
+	while (n < max_events) {
+		uint32_t hdr, len;
+		const struct fw_event *ev;
+		size_t off;
+
+		prod_pos = *(volatile uint64_t *)data;
+		if (cons_pos >= prod_pos)
+			break;
+		off = (size_t)page + (cons_pos & ((uint64_t)size - 1));
+		hdr = *(volatile uint32_t *)(data + off);
+		if (hdr & (1u << 31))          /* BUSY: producer mid-write */
+			break;
+		len = hdr & ~((1u << 31) | (1u << 30));
+		if (!(hdr & (1u << 30)) && len >= sizeof(*ev)) {
+			ev = (const struct fw_event *)(data + off + 8);
+			printf("{\"ts_ns\": %llu, \"cgroup\": %llu, "
+			       "\"dst_ip\": \"%u.%u.%u.%u\", \"dst_port\": %u, "
+			       "\"zone\": %llu, \"verdict\": %u, "
+			       "\"proto\": %u, \"reason\": %u}\n",
+			       (unsigned long long)ev->ts_ns,
+			       (unsigned long long)ev->cgroup_id,
+			       ev->dst_ip & 0xff, (ev->dst_ip >> 8) & 0xff,
+			       (ev->dst_ip >> 16) & 0xff,
+			       (ev->dst_ip >> 24) & 0xff,
+			       /* __be16 -> host order */
+			       (unsigned)((ev->dst_port >> 8) |
+					  ((ev->dst_port & 0xff) << 8)),
+			       (unsigned long long)ev->zone_hash,
+			       ev->verdict, ev->proto, ev->reason);
+			n++;
+		}
+		cons_pos += (len + 8 + 7) & ~7u;
+		*(volatile uint64_t *)cons = cons_pos;
+	}
+	munmap(cons, (size_t)page);
+	munmap(data, (size_t)page + 2ul * (size_t)size);
+	close(fd);
+	return 0;
+}
+
+static int cmd_status(const char *pin_dir)
+{
+	int fd = obj_get(pin_dir, "containers");
+	uint64_t key = 0, next = 0;
+	struct fw_container val;
+	int have = 0, count = 0;
+
+	if (fd < 0)
+		return 1;
+	printf("{\"enrolled\": [");
+	for (;;) {
+		struct elem_attr a = {0};
+
+		a.map_fd = (uint32_t)fd;
+		a.key = have ? (uint64_t)(uintptr_t)&key : 0;
+		a.value = (uint64_t)(uintptr_t)&next;
+		if (sys_bpf(BPF_MAP_GET_NEXT_KEY, &a, sizeof(a)) < 0)
+			break;
+		key = next;
+		have = 1;
+		memset(&val, 0, sizeof(val));
+		a.map_fd = (uint32_t)fd;
+		a.key = (uint64_t)(uintptr_t)&key;
+		a.value = (uint64_t)(uintptr_t)&val;
+		if (sys_bpf(BPF_MAP_LOOKUP_ELEM, &a, sizeof(a)) == 0) {
+			printf("%s{\"cgroup\": %llu, \"flags\": %u}",
+			       count ? ", " : "",
+			       (unsigned long long)key, val.flags);
+			count++;
+		}
+	}
+	printf("], \"count\": %d}\n", count);
+	close(fd);
+	return 0;
+}
+
+static const char *flag_value(int argc, char **argv, const char *flag)
+{
+	int i;
+
+	for (i = 1; i < argc - 1; i++)
+		if (strcmp(argv[i], flag) == 0)
+			return argv[i + 1];
+	return NULL;
+}
+
+int main(int argc, char **argv)
+{
+	const char *pin_dir, *cgroup;
+
+	if (argc < 2) {
+		fprintf(stderr,
+			"usage: fwctl-raw attach|detach|events|status ...\n");
+		return 2;
+	}
+	pin_dir = flag_value(argc, argv, "--pin-dir");
+	if (!pin_dir) {
+		fprintf(stderr, "fwctl-raw: --pin-dir required\n");
+		return 2;
+	}
+	if (strcmp(argv[1], "attach") == 0 || strcmp(argv[1], "detach") == 0) {
+		cgroup = flag_value(argc, argv, "--cgroup");
+		if (!cgroup) {
+			fprintf(stderr, "fwctl-raw: --cgroup required\n");
+			return 2;
+		}
+		return cmd_attach(cgroup, pin_dir,
+				  strcmp(argv[1], "detach") == 0);
+	}
+	if (strcmp(argv[1], "events") == 0) {
+		const char *m = flag_value(argc, argv, "--max");
+
+		return cmd_events(pin_dir, m ? atoi(m) : 256);
+	}
+	if (strcmp(argv[1], "status") == 0)
+		return cmd_status(pin_dir);
+	fprintf(stderr, "fwctl-raw: unknown command %s\n", argv[1]);
+	return 2;
+}

@@ -36,6 +36,9 @@ except Exception:
     else
         make -C "$ebpf" check
     fi
+    # the raw-syscall native control tool builds everywhere and is
+    # exercised against this same kernel by tests/test_fwctl_raw.py
+    make -C "$ebpf" fwctl-raw
     echo "check_bpf: OK (verifier + live enforcement + C-twin gate)"
     exit 0
 fi

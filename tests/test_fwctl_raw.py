@@ -1,0 +1,150 @@
+"""fwctl-raw against the REAL kernel: pins, attach, enforce, drain.
+
+The raw-syscall native control tool (native/ebpf/fwctl_raw.c) is built
+with plain cc and driven against programs the in-process lane pinned
+into bpffs (FwKernel.pin_all): a cross-process, cross-language loop --
+Python assembles + verifier-loads + pins, the C binary attaches by pin
+path, a probe child observes kernel EPERM, and the C binary drains the
+ringbuf into the exact JSON dialect PinnedMaps.drain_events parses.
+
+Skip-gated on bpf(2) + bpffs + a compiler; where it runs, nothing is
+mocked (the fwctl mock suite remains the everywhere-tier).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu.firewall import bpfkern
+
+EBPF_DIR = Path(__file__).resolve().parent.parent / "native" / "ebpf"
+BPFFS = Path("/sys/fs/bpf")
+
+
+def _capable() -> bool:
+    return (bpfkern.kernel_available() and BPFFS.is_dir()
+            and os.access(BPFFS, os.W_OK))
+
+
+pytestmark = pytest.mark.skipif(
+    not _capable(), reason="needs bpf(2) + writable bpffs")
+
+
+@pytest.fixture(scope="module")
+def binary():
+    res = subprocess.run(["make", "-C", str(EBPF_DIR), "fwctl-raw"],
+                         capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr
+    return str(EBPF_DIR / "build" / "fwctl-raw")
+
+
+@pytest.fixture()
+def pinned():
+    """FwKernel pinned into a scratch bpffs dir (+cleanup)."""
+    from clawker_tpu.firewall.fwprogs import FwKernel
+
+    pin = BPFFS / f"clawker-test-{os.getpid()}"
+    kern = FwKernel()
+    kern.pin_all(str(pin))
+    yield kern, pin
+    for f in list(pin.iterdir()):
+        f.unlink()
+    pin.rmdir()
+    kern.close()
+
+
+def test_attach_enforce_events_via_native_tool(binary, pinned):
+    from clawker_tpu.firewall.bpflive import LiveSandbox, probe_tcp_connect
+    from clawker_tpu.firewall.fwprogs import LiveMaps
+    from clawker_tpu.firewall.model import ContainerPolicy, FLAG_ENFORCE
+
+    kern, pin = pinned
+    maps = LiveMaps(kern)
+    # scratch cgroup WITHOUT python-side attach: the C binary does it
+    sb = LiveSandbox.__new__(LiveSandbox)
+    root = bpfkern.cgroup2_root()
+    sb.cg_dir = root / f"fwctlraw-{os.getpid()}"
+    sb.cg_dir.mkdir(exist_ok=True)
+    sb.kern = None
+    sb.maps = None
+    try:
+        res = subprocess.run(
+            [binary, "attach", "--cgroup", str(sb.cg_dir),
+             "--pin-dir", str(pin)], capture_output=True, text=True)
+        assert res.returncode == 0, res.stderr
+        assert json.loads(res.stdout)["programs"] == 9
+
+        cg_id = os.stat(sb.cg_dir).st_ino
+        maps.enroll(cg_id, ContainerPolicy(
+            envoy_ip="127.0.0.1", dns_ip="127.0.0.1", flags=FLAG_ENFORCE))
+
+        out = sb.run_in_cgroup(probe_tcp_connect, "10.99.0.9", 443, 1.0)
+        assert out["result"] == "eperm", out
+
+        # native status sees the enrollment
+        res = subprocess.run([binary, "status", "--pin-dir", str(pin)],
+                             capture_output=True, text=True)
+        st = json.loads(res.stdout)
+        assert any(e["cgroup"] == cg_id for e in st["enrolled"]), st
+
+        # native events drain: the dialect PinnedMaps parses
+        res = subprocess.run([binary, "events", "--max", "64",
+                              "--pin-dir", str(pin)],
+                             capture_output=True, text=True)
+        assert res.returncode == 0, res.stderr
+        evs = [json.loads(l) for l in res.stdout.splitlines()]
+        deny = [e for e in evs if e["cgroup"] == cg_id]
+        assert deny and deny[0]["dst_ip"] == "10.99.0.9"
+        assert deny[0]["dst_port"] == 443 and deny[0]["verdict"] == 1
+
+        # native detach restores egress
+        res = subprocess.run(
+            [binary, "detach", "--cgroup", str(sb.cg_dir),
+             "--pin-dir", str(pin)], capture_output=True, text=True)
+        assert res.returncode == 0, res.stderr
+        out = sb.run_in_cgroup(probe_tcp_connect, "10.99.0.9", 443, 0.4)
+        assert out["result"] != "eperm", out
+    finally:
+        maps.close()
+        try:
+            sb.cg_dir.rmdir()
+        except OSError:
+            pass
+
+
+def test_pinnedmaps_drain_events_via_native_tool(binary, pinned):
+    """The PRODUCT event lane: PinnedMaps opens the pins and shells to
+    the native tool for the ringbuf drain -- fully real end to end."""
+    from clawker_tpu.firewall.bpflive import LiveSandbox, probe_raw_socket
+    from clawker_tpu.firewall.bpfsys import PinnedMaps
+    from clawker_tpu.firewall.model import ContainerPolicy, FLAG_ENFORCE, Reason
+
+    kern, pin = pinned
+    pm = PinnedMaps(pin, fwctl=binary)
+    sb = LiveSandbox.__new__(LiveSandbox)
+    root = bpfkern.cgroup2_root()
+    sb.cg_dir = root / f"fwctlraw-pm-{os.getpid()}"
+    sb.cg_dir.mkdir(exist_ok=True)
+    try:
+        cg_id = kern.attach_cgroup(str(sb.cg_dir))
+        # enrollment THROUGH the pins: both views are the same kernel maps
+        pm.enroll(cg_id, ContainerPolicy(
+            envoy_ip="127.0.0.1", dns_ip="127.0.0.1", flags=FLAG_ENFORCE))
+        assert sb.run_in_cgroup(probe_raw_socket)["result"] == "eperm"
+        time.sleep(0.1)
+        evs = pm.drain_events(128)
+        assert any(e.reason is Reason.RAW_SOCKET for e in evs), evs
+        pm.unenroll(cg_id)
+        kern.detach_cgroup(str(sb.cg_dir))
+    finally:
+        pm.close()
+        try:
+            sb.cg_dir.rmdir()
+        except OSError:
+            pass
