@@ -31,4 +31,4 @@ Layer map (mirrors reference SURVEY.md section 1, re-architected for Python/C++)
     socketbridge/   SSH/GPG agent forwarding mux (reference: internal/socketbridge)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
