@@ -1,0 +1,59 @@
+"""Loop fan-out e2e: parallel agent loops over REAL containers.
+
+BASELINE config 4's shape (`clawker loop --parallel N`) driven through
+the real CLI against the real daemon: N loops place, run their
+iteration budget as actual namespaced processes, exit codes land in the
+status JSON, and teardown leaves nothing behind.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from .harness import BASE_IMAGE, E2E, docker_available
+
+pytestmark = pytest.mark.skipif(
+    not docker_available(),
+    reason="real-daemon e2e: set CLAWKER_TPU_E2E=1 (dockerd or nsd-capable)")
+
+
+@pytest.fixture()
+def h():
+    with E2E("loopproj") as harness:
+        (harness.proj_dir / ".clawker.yaml").write_text(
+            "project: loopproj\n"
+            "agent:\n"
+            "  cmd: [sh, -c, echo loop-iteration-ran]\n")
+        yield harness
+
+
+def test_parallel_loops_run_real_containers(h):
+    res = h.must("loop", "--parallel", "2", "--iterations", "2",
+                 "--image", BASE_IMAGE, "--json", timeout=180.0)
+    doc = json.loads(res.stdout[res.stdout.index("{"):])
+    agents = doc["agents"]
+    assert len(agents) == 2
+    for a in agents:
+        assert a["status"] == "done", agents
+        assert a["iteration"] == 2
+        assert a["exit_codes"] == [0, 0]
+    # loop containers were cleaned up (no --keep)
+    assert h.managed_containers() == []
+
+
+def test_loop_failure_ceiling_fails_loudly(h):
+    (h.proj_dir / ".clawker.yaml").write_text(
+        "project: loopproj\n"
+        "agent:\n"
+        "  cmd: [sh, -c, exit 3]\n")
+    res = h.run("loop", "--parallel", "1", "--iterations", "0",
+                "--image", BASE_IMAGE, "--json", timeout=180.0)
+    assert res.code == 1
+    doc = json.loads(res.stdout[res.stdout.index("{"):])
+    a = doc["agents"][0]
+    assert a["status"] == "failed"
+    assert all(c == 3 for c in a["exit_codes"])
+    assert len(a["exit_codes"]) >= 3          # the failure ceiling
+    assert h.managed_containers() == []

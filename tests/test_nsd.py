@@ -178,3 +178,42 @@ def test_conflict_and_not_found_map_to_http_statuses(api):
     api.container_remove(cid, force=True)
     with pytest.raises(NotFoundError):
         api.container_inspect("definitely-missing")
+
+
+def test_concurrent_lifecycles_do_not_interfere(api):
+    """Daemon-level race stress: N containers created/started/waited/
+    removed from parallel threads; every exit code and log must be the
+    right container's (the reference's -race analog at the daemon
+    seam)."""
+    N = 6
+    errors: list[str] = []
+
+    def one(i: int) -> None:
+        try:
+            # DISTINCT exit code per container: shared codes would let a
+            # swapped wait result pass undetected
+            cid = _create(api, f"race{i}",
+                          ["sh", "-c", f"echo out-{i}; exit {10 + i}"])
+            api.container_start(cid)
+            code = api.container_wait(cid)["StatusCode"]
+            if code != 10 + i:
+                errors.append(f"race{i}: exit {code} != {10 + i}")
+            logs = b"".join(api.container_logs(cid))
+            if f"out-{i}".encode() not in logs:
+                errors.append(f"race{i}: logs missing own marker: {logs!r}")
+            for j in range(N):
+                if j != i and f"out-{j}".encode() in logs:
+                    errors.append(f"race{i}: got race{j}'s output: {logs!r}")
+            api.container_remove(cid, force=True)
+        except Exception as e:  # noqa: BLE001 - collect, don't die
+            errors.append(f"race{i}: {e.__class__.__name__}: {e}")
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not any(t.is_alive() for t in threads), "daemon deadlock under load"
+    assert not errors, errors
+    rows = api.container_list(all=True)
+    assert not any(r["Names"][0].startswith("/race") for r in rows)
