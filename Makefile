@@ -14,11 +14,13 @@ test:
 test-fast:
 	$(PY) -m pytest tests/ -q -x -m "not slow"
 
-# Real-daemon e2e (reference test/e2e): needs a running dockerd.
+# Real-daemon e2e (reference test/e2e): dockerd when present, else the
+# first-party nsd namespace daemon (root Linux).
 test-e2e:
 	CLAWKER_TPU_E2E=1 $(PY) -m pytest tests/e2e -q
 
-# The 22-scenario + 30-technique firewall parity scorecard.
+# The 22-scenario + 35-technique firewall parity scorecard (twin rows
+# re-graded on the real kernel where bpf(2) works).
 parity:
 	$(PY) -m clawker_tpu.parity
 
