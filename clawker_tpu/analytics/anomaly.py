@@ -89,7 +89,20 @@ def denoise_step(
     the denoising objective forces it to learn the fleet manifold
     instead, so off-manifold windows keep a high reconstruction error.
     Same jit/pjit shape as train_step (noise is elementwise, fused)."""
-    noisy = x + sigma * jax.random.normal(key, x.shape, x.dtype)
+    noise = jax.random.normal(key, x.shape, x.dtype)
+    return denoise_step_with_noise(params, x, noise, lr=lr, sigma=sigma)
+
+
+def denoise_step_with_noise(
+    params: AnomalyParams, x: jax.Array, noise: jax.Array,
+    lr: float = 1e-3, sigma: float = 0.25,
+) -> tuple[AnomalyParams, jax.Array]:
+    """Denoising step with CALLER-SUPPLIED unit noise.  The scoring
+    runtime precomputes the whole noise tensor host-side and scans over
+    it: in-program threefry made the fit's compile pathologically slow
+    on tunneled backends, and the objective does not care where the
+    gaussians came from."""
+    noisy = x + sigma * noise
 
     def loss_fn(p: AnomalyParams) -> jax.Array:
         return jnp.mean(jnp.square(_reconstruct(p, noisy) - x))

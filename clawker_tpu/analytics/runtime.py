@@ -83,12 +83,15 @@ def _jitted():
 
         from . import anomaly
 
-        def fit(params, x, noise_keys, lr):
-            def body(p, key):
-                p, loss = anomaly.denoise_step(p, x, key, lr=lr)
+        def fit(params, x, noises, lr):
+            # noises: [steps, n, feat], generated host-side -- keeps
+            # threefry out of the compiled program (see
+            # anomaly.denoise_step_with_noise)
+            def body(p, noise):
+                p, loss = anomaly.denoise_step_with_noise(p, x, noise, lr=lr)
                 return p, loss
 
-            return jax.lax.scan(body, params, noise_keys)
+            return jax.lax.scan(body, params, noises)
 
         _jit_cache["fit"] = jax.jit(fit)
         _jit_cache["score"] = jax.jit(anomaly.score)
@@ -113,10 +116,14 @@ def _fit_and_score(X: np.ndarray, *, train_steps: int, lr: float, seed: int):
     fit, score_fn = _jitted()
     params = anomaly_init(seed)
     x = jnp.asarray(Xn)
-    noise_keys = jax.random.split(jax.random.key(seed + 1), train_steps)
+    # the whole noise tensor as ONE un-jitted device op: threefry stays
+    # out of the compiled scan (pathological compile on tunneled
+    # backends) without shipping tens of MB host->device per fit
+    noises = jax.random.normal(jax.random.key(seed + 1),
+                               (train_steps,) + Xn.shape, jnp.float32)
 
     t0 = time.perf_counter()
-    params, losses = fit(params, x, noise_keys, lr)
+    params, losses = fit(params, x, noises, lr)
     jax.block_until_ready(losses)
     train_ms = (time.perf_counter() - t0) * 1000.0
 

@@ -1,0 +1,117 @@
+"""Randomized differential: Python policy oracle vs the REAL kernel.
+
+The policy oracle (firewall/policy.py) is the executable spec; the
+assembled programs (firewall/fwprogs.py) claim to implement it step for
+step.  This sweep generates random policies, DNS entries, routes and
+destinations, mirrors every table into BOTH the oracle's FakeMaps and
+the live kernel's maps, then compares the oracle's verdict against what
+a real connect()/socket() in an enrolled cgroup actually returns.
+
+This is the strongest possible answer to "the twin might not match the
+kernel": any decision-order or masking divergence between spec and
+bytecode shows up as a verdict mismatch on real syscalls.
+
+Skip-gated on bpf(2) + cgroup-v2 (tests/test_fw_kernel.py's host-gcc
+differential remains the everywhere-tier).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+import pytest
+
+from clawker_tpu.firewall import bpfkern
+
+pytestmark = pytest.mark.skipif(
+    not bpfkern.kernel_available(),
+    reason="bpf(2) PROG_LOAD or writable cgroup-v2 unavailable")
+
+CASES = 60
+
+
+def _random_world(rng: random.Random):
+    from clawker_tpu.firewall.hashes import zone_hash
+    from clawker_tpu.firewall.model import (
+        Action, ContainerPolicy, DnsEntry, FLAG_ENFORCE, FLAG_HOSTPROXY,
+        PROTO_TCP, RouteKey, RouteVal,
+    )
+
+    pol = ContainerPolicy(
+        envoy_ip=f"192.0.2.{rng.randint(1, 40)}",
+        dns_ip=f"192.0.2.{rng.randint(41, 80)}",
+        hostproxy_ip=f"192.0.2.{rng.randint(81, 120)}",
+        hostproxy_port=rng.choice([18374, 8080]),
+        flags=(FLAG_ENFORCE if rng.random() < 0.8 else 0)
+        | (FLAG_HOSTPROXY if rng.random() < 0.5 else 0),
+        net_ip=f"10.{rng.randint(0, 200)}.0.0",
+        net_prefix=rng.choice([0, 8, 16, 24, 31, 32]),
+    )
+    zones = {}
+    routes = {}
+    dns = {}
+    for _ in range(rng.randint(1, 4)):
+        apex = f"z{rng.randint(0, 999)}.example"
+        zh = zone_hash(apex)
+        ip = f"203.0.113.{rng.randint(1, 250)}"
+        dns[ip] = DnsEntry(zone_hash=zh, expires_unix=int(time.time()) + 600)
+        zones[apex] = (zh, ip)
+        if rng.random() < 0.8:
+            port = rng.choice([0, 443, 8443])
+            action = rng.choice([Action.ALLOW, Action.DENY, Action.REDIRECT])
+            routes[RouteKey(zh, port, PROTO_TCP)] = RouteVal(
+                action, redirect_ip="127.0.0.1",
+                redirect_port=rng.randint(20000, 40000))
+    return pol, dns, routes
+
+
+def _destinations(rng: random.Random, pol, dns) -> list[tuple[str, int]]:
+    out = [("127.0.0.1", 9999),                       # loopback
+           (pol.envoy_ip, rng.choice([443, 10000])),  # proxy itself
+           (pol.dns_ip, 53),                          # the gate
+           (pol.hostproxy_ip, pol.hostproxy_port),    # side channel
+           (pol.hostproxy_ip, pol.hostproxy_port + 1),
+           (f"10.{rng.randint(0, 200)}.{rng.randint(0, 3)}.9", 445),
+           ("198.18.0.1", 443)]                       # never resolved
+    for ip in dns:
+        out.append((ip, rng.choice([443, 8443, 2222])))
+    rng.shuffle(out)
+    return out[:6]
+
+
+def test_oracle_matches_real_kernel_over_random_worlds():
+    from clawker_tpu.firewall import policy
+    from clawker_tpu.firewall.bpflive import LiveSandbox, probe_tcp_connect
+    from clawker_tpu.firewall.maps import FakeMaps
+    from clawker_tpu.firewall.model import Action
+
+    rng = random.Random(0xC1A0)
+    mismatches = []
+    with LiveSandbox("bpfdiff") as sb:
+        checked = 0
+        while checked < CASES:
+            pol, dns, routes = _random_world(rng)
+            oracle = FakeMaps()
+            oracle.enroll(sb.cgroup_id, pol)
+            sb.maps.enroll(sb.cgroup_id, pol)
+            for ip, entry in dns.items():
+                oracle.cache_dns(ip, entry)
+                sb.maps.cache_dns(ip, entry)
+            oracle.sync_routes(routes)
+            sb.maps.sync_routes(routes)
+
+            for ip, port in _destinations(rng, pol, dns):
+                want = policy.connect4(oracle, sb.cgroup_id, ip, port)
+                got = sb.run_in_cgroup(probe_tcp_connect, ip, port, 0.25)
+                denied = got["result"] == "eperm"
+                if denied != (want.action is Action.DENY):
+                    mismatches.append(
+                        f"{ip}:{port} oracle={want.action.name}/"
+                        f"{want.reason.name} kernel={got['result']} "
+                        f"(pol={pol})")
+                checked += 1
+            sb.maps.flush_all()
+            sb.maps.drain_events(4096)
+    assert not mismatches, "\n".join(mismatches[:10])
+    assert checked >= CASES
