@@ -64,7 +64,14 @@ class LiveSandbox:
         result.  The child joins BEFORE any socket op so every syscall is
         under enforcement."""
         r, w = os.pipe()
-        pid = os.fork()
+        import warnings
+
+        with warnings.catch_warnings():
+            # the multi-threaded-fork DeprecationWarning doesn't apply:
+            # the child only does sockets + os.write + _exit, never
+            # touches locks, and execs nothing
+            warnings.simplefilter("ignore", DeprecationWarning)
+            pid = os.fork()
         if pid == 0:
             code = 0
             try:

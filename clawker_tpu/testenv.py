@@ -15,6 +15,7 @@ from . import consts
 
 
 class TestEnv(contextlib.AbstractContextManager):
+    __test__ = False  # pytest: helper, not a test class
     """Creates throwaway XDG dirs and points CLAWKER_TPU_*_DIR at them."""
 
     def __init__(self, base: Path | None = None):
