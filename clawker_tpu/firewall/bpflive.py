@@ -28,7 +28,16 @@ import os
 import socket
 import threading
 import time
+import warnings
 from pathlib import Path
+
+# the multi-threaded-fork DeprecationWarning doesn't apply to the probe
+# children (sockets + os.write + _exit only, no locks, no exec); filter
+# ONCE at import rather than mutating global filter state per fork while
+# service-double threads are live
+warnings.filterwarnings(
+    "ignore", message=r".*use of fork\(\) may lead to deadlocks.*",
+    category=DeprecationWarning)
 
 from . import bpfkern as K
 from .fwprogs import FwKernel, LiveMaps
@@ -64,14 +73,7 @@ class LiveSandbox:
         result.  The child joins BEFORE any socket op so every syscall is
         under enforcement."""
         r, w = os.pipe()
-        import warnings
-
-        with warnings.catch_warnings():
-            # the multi-threaded-fork DeprecationWarning doesn't apply:
-            # the child only does sockets + os.write + _exit, never
-            # touches locks, and execs nothing
-            warnings.simplefilter("ignore", DeprecationWarning)
-            pid = os.fork()
+        pid = os.fork()  # fork warning filtered at module import
         if pid == 0:
             code = 0
             try:

@@ -15,8 +15,9 @@ from . import consts
 
 
 class TestEnv(contextlib.AbstractContextManager):
-    __test__ = False  # pytest: helper, not a test class
     """Creates throwaway XDG dirs and points CLAWKER_TPU_*_DIR at them."""
+
+    __test__ = False  # pytest: helper, not a test class
 
     def __init__(self, base: Path | None = None):
         self._tmp = None

@@ -1,0 +1,163 @@
+"""Full data-plane loop on the REAL kernel: DNS -> cache -> route -> enforce.
+
+The product's DNS gate (firewall/dnsgate) runs against LIVE kernel maps
+while the verifier-loaded programs enforce a probe cgroup:
+
+  1. the probe's hardcoded-resolver query (8.8.8.8:53) is REDIRECTED by
+     fw_sendmsg4 to the gate, whose reply reverse-NATs back as 8.8.8.8;
+  2. the gate resolves the allowed zone (stub upstream), writes the
+     dns_cache entry into the KERNEL map, and answers the A record;
+  3. the probe's connect() to the resolved IP rides dns_cache + routes
+     in-kernel and lands on the route's redirect target;
+  4. a denied zone gets NXDOMAIN and its IP stays unreachable (EPERM).
+
+That is the reference's CoreDNS -> dns_cache -> clawker.c pipeline
+(dnsbpf + firewall_test.go dnsRedirection) with every hop real except
+the upstream resolver.  Skip-gated on bpf(2) + the :53 bind.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+
+import pytest
+
+from clawker_tpu.firewall import bpfkern
+
+pytestmark = pytest.mark.skipif(
+    not bpfkern.kernel_available(),
+    reason="bpf(2) PROG_LOAD or writable cgroup-v2 unavailable")
+
+ALLOWED_IP = "198.51.100.44"
+
+
+def _upstream_stub(data: bytes, resolvers, *, tcp: bool):
+    """Answer any *.allowed.example A query with ALLOWED_IP."""
+    from clawker_tpu.firewall.dnsgate import parse_query
+
+    q = parse_query(data)
+    if not q.qname.endswith("allowed.example"):
+        flags = 0x8180 | 3
+        return struct.pack(">HHHHHH", q.qid, flags, 1, 0, 0, 0) + q.raw_question
+    hdr = struct.pack(">HHHHHH", q.qid, 0x8180, 1, 1, 0, 0)
+    answer = (struct.pack(">HHHIH", 0xC00C, 1, 1, 120, 4)
+              + socket.inet_aton(ALLOWED_IP))
+    return hdr + q.raw_question + answer
+
+
+def _probe_resolve_then_connect(expect_ip: str):
+    """Runs INSIDE the enforced cgroup: resolve via a hardcoded public
+    resolver (the kernel must gate it), then connect to the answer."""
+    from clawker_tpu.firewall.dnsgate import _encode_name, parse_a_records
+
+    out = {}
+    q = (struct.pack(">HHHHHH", 0x7777, 0x0100, 1, 0, 0, 0)
+         + _encode_name("api.allowed.example") + struct.pack(">HH", 1, 1))
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.settimeout(2.0)
+    s.sendto(q, ("8.8.8.8", 53))      # hardcoded resolver: gate MUST catch
+    try:
+        reply, src = s.recvfrom(4096)
+        out["reply_src"] = list(src)
+        out["ips"] = [ip for ip, _ in parse_a_records(reply)]
+    except OSError as e:
+        out["resolve_err"] = str(e)
+        s.close()
+        return out
+    s.close()
+
+    t = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    t.settimeout(2.0)
+    try:
+        t.connect((expect_ip, 443))
+        peer = t.getpeername()
+        out["connect"] = "connected"
+        out["peer"] = [peer[0], peer[1]]
+    except OSError as e:
+        out["connect"] = f"errno-{e.errno}"
+    finally:
+        t.close()
+
+    # the denied zone: NXDOMAIN, and its address stays sealed
+    q2 = (struct.pack(">HHHHHH", 0x7778, 0x0100, 1, 0, 0, 0)
+          + _encode_name("c2.evil.example") + struct.pack(">HH", 1, 1))
+    s2 = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s2.settimeout(2.0)
+    s2.sendto(q2, ("8.8.8.8", 53))
+    try:
+        reply, _ = s2.recvfrom(4096)
+        out["denied_rcode"] = struct.unpack(">H", reply[2:4])[0] & 0xF
+    except OSError:
+        out["denied_rcode"] = -1
+    s2.close()
+    b = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    b.settimeout(1.0)
+    try:
+        b.connect(("203.0.113.66", 443))
+        out["denied_connect"] = "connected"
+    except OSError as e:
+        out["denied_connect"] = f"errno-{e.errno}"
+    finally:
+        b.close()
+    return out
+
+
+def test_dns_cache_route_enforce_loop_on_real_kernel():
+    from clawker_tpu.config.schema import EgressRule
+    from clawker_tpu.firewall.bpflive import LiveSandbox, TcpEcho
+    from clawker_tpu.firewall.dnsgate import DnsGate, ZonePolicy
+    from clawker_tpu.firewall.hashes import zone_hash
+    from clawker_tpu.firewall.model import (
+        Action, ContainerPolicy, FLAG_ENFORCE, PROTO_TCP, RouteKey, RouteVal,
+    )
+
+    with LiveSandbox("dnsloop") as sb:
+        gate = DnsGate(
+            ZonePolicy.from_rules([EgressRule(dst="*.allowed.example",
+                                              proto="https")]),
+            sb.maps, host="127.0.0.1", port=53)
+        gate._forward = _upstream_stub
+        try:
+            gate.start()
+        except OSError:
+            pytest.skip("port 53 unavailable")
+        envoy = TcpEcho()
+        envoy.start()
+        try:
+            sb.enroll(ContainerPolicy(envoy_ip="127.0.0.1",
+                                      dns_ip="127.0.0.1",
+                                      flags=FLAG_ENFORCE))
+            sb.maps.sync_routes({
+                RouteKey(zone_hash("allowed.example"), 443, PROTO_TCP):
+                    RouteVal(Action.REDIRECT, "127.0.0.1", envoy.port)})
+
+            out = sb.run_in_cgroup(_probe_resolve_then_connect, ALLOWED_IP)
+
+            # 1. the hardcoded-resolver query was gated + reverse-NATted
+            assert out.get("reply_src") == ["8.8.8.8", 53], out
+            assert out.get("ips") == [ALLOWED_IP], out
+            # 2+3. the resolved IP connects THROUGH the kernel route
+            assert out.get("connect") == "connected", out
+            assert out.get("peer") == [ALLOWED_IP, 443], out
+            # 4. denied zone: NXDOMAIN + sealed egress
+            assert out.get("denied_rcode") == 3, out
+            assert out.get("denied_connect") == "errno-1", out
+
+            # the gate's cache write landed in the KERNEL map
+            entry = sb.maps.lookup_dns(ALLOWED_IP)
+            assert entry is not None
+            assert entry.zone_hash == zone_hash("allowed.example")
+            # and the kernel logged the redirect + the deny
+            time.sleep(0.1)
+            evs = sb.maps.drain_events(512)
+            kinds = {(e.verdict, e.reason) for e in evs}
+            from clawker_tpu.firewall.model import Reason
+
+            assert (Action.REDIRECT_DNS, Reason.DNS) in kinds
+            assert (Action.REDIRECT, Reason.ROUTE) in kinds
+            assert (Action.DENY, Reason.NO_DNS_ENTRY) in kinds
+        finally:
+            envoy.stop()
+            gate.stop()
