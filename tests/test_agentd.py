@@ -221,4 +221,10 @@ class TestSession:
 class TestReadyFile:
     def test_ready_written_on_listen(self, agent_env):
         d, base = agent_env
-        assert (base / "ready").read_text() == "ok\n"
+        # the fixture waits for bound_port; the ready-file write can land
+        # a beat later under load -- wait for the FILE, then assert
+        ready = base / "ready"
+        deadline = time.time() + 5
+        while not ready.exists() and time.time() < deadline:
+            time.sleep(0.01)
+        assert ready.read_text() == "ok\n"
