@@ -266,9 +266,14 @@ class NsRuntime:
         cfg_path = c.dir / "shim.json"
         cfg_path.write_text(json.dumps(shim_cfg))
 
+        # --cgroup: the namespace captures at unshare time, AFTER the
+        # preexec joined the container cgroup -- so the container's
+        # cgroup view is rooted at its OWN cgroup and even a fresh
+        # cgroup2 mount inside cannot reach (or move processes to) any
+        # ancestor, sealing the move-yourself-out firewall escape
         argv = ["unshare", "--fork", "--pid", "--mount", "--uts", "--ipc",
-                "--kill-child", sys.executable, "-m", "clawker_tpu.nsd.shim",
-                str(cfg_path)]
+                "--cgroup", "--kill-child",
+                sys.executable, "-m", "clawker_tpu.nsd.shim", str(cfg_path)]
         spawn_env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin"),
                      "PYTHONPATH": REPO_ROOT}
         pre_exec = _cgroup_preexec(c.cgroup_dir)

@@ -65,7 +65,13 @@ def main(argv: list[str]) -> int:
     _mount("proc", os.path.join(merged, "proc"), "proc", 0)
     _mount("/dev", os.path.join(merged, "dev"), "", MS_BIND | MS_REC)
     try:
-        _mount("/sys", os.path.join(merged, "sys"), "", MS_BIND | MS_REC)
+        # /sys NON-recursively (host cgroupfs and friends stay OUT of
+        # the container) and read-only: a root process writing host
+        # cgroup.procs through a recursive RW bind could move itself
+        # out of its enforcement cgroup (docker mounts sysfs ro too)
+        sys_dst = os.path.join(merged, "sys")
+        _mount("/sys", sys_dst, "", MS_BIND)
+        _mount("none", sys_dst, "", MS_BIND | MS_REMOUNT | MS_RDONLY)
     except OSError:
         pass  # sysfs is a nicety, not a requirement
 

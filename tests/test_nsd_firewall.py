@@ -185,3 +185,47 @@ def test_inprocess_lane_selected_by_runtime_factory():
     from clawker_tpu.firewall.runtime import inprocess_kernel_available
 
     assert inprocess_kernel_available()
+
+_ESCAPE_PROBE = (
+    # every known move-yourself-out-of-the-cgroup lane, from root
+    # inside the container
+    "w1=sealed; w2=sealed; w3=sealed\n"
+    "echo $$ > /sys/fs/cgroup/unified/cgroup.procs 2>/dev/null && w1=ESCAPED\n"
+    "echo $$ > /sys/fs/cgroup/cgroup.procs 2>/dev/null && w2=ESCAPED\n"
+    "mkdir -p /tmp/cgm && mount -t cgroup2 none /tmp/cgm 2>/dev/null && "
+    "echo $$ > /tmp/cgm/cgroup.procs 2>/dev/null && w3=mounted-and-moved\n"
+    "echo sysfs:$w1 hostpath:$w2 mount:$w3\n"
+    "cat /proc/self/cgroup | tail -1\n"
+)
+
+
+def test_container_cannot_escape_its_enforcement_cgroup(rig):
+    """A root process inside the container must not be able to move
+    itself out of the cgroup the firewall keys on: /sys is non-recursive
+    + read-only, and the cgroup NAMESPACE roots any fresh cgroup2 mount
+    at the container's own cgroup -- 'escaping' to its root is a no-op
+    for enforcement."""
+    from clawker_tpu.firewall.model import ContainerPolicy, FLAG_ENFORCE
+
+    api, resolver, attacher = rig
+    cid = api.container_create("cgesc", {"Image": "busybox:latest",
+                                         "Cmd": ["sh", "-c", "sleep 60"],
+                                         "Labels": {}})["Id"]
+    api.container_start(cid)
+    time.sleep(0.3)
+    cg_id, cg_path = resolver.resolve(_EngineShim(api), cid)
+    attacher.attach(cg_path)
+    attacher.maps.enroll(cg_id, ContainerPolicy(
+        envoy_ip="127.0.0.1", dns_ip="127.0.0.1", flags=FLAG_ENFORCE))
+    try:
+        out = _exec(api, cid, _ESCAPE_PROBE)
+        assert "sysfs:sealed" in out, out
+        assert "hostpath:sealed" in out, out
+        assert "ESCAPED" not in out, out
+        # whatever the mount lane did, enforcement must still hold:
+        out = _exec(api, cid, _CONNECT_PROBE)
+        assert "errno 1" in out, out
+    finally:
+        attacher.maps.unenroll(cg_id)
+        attacher.detach(cg_path)
+        api.container_remove(cid, force=True)
