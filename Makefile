@@ -3,8 +3,8 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-e2e parity bench native ebpf-check \
-        docs docs-check adversarial graft clean
+.PHONY: all test test-fast test-e2e parity bench bench-smoke native \
+        ebpf-check docs docs-check adversarial graft clean
 
 all: native test
 
@@ -26,6 +26,12 @@ parity:
 
 bench:
 	$(PY) bench.py
+
+# Scheduler/provisioning perf gates (fan-out latency, poll cost,
+# provision wall vs serial) under a hard timeout -- regressions in the
+# concurrent control plane fail in-repo, not in the next bench round.
+bench-smoke:
+	timeout -k 10 300 $(PY) scripts/bench_smoke.py
 
 native:
 	$(MAKE) -C native

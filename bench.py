@@ -20,6 +20,15 @@ Added (round-4 verdict task #4), in ``extra``:
   until all 8 loops are created+started across an 8-worker fake pod
   (BASELINE config #4; budget 10 s).
 
+Added (parallel control plane PR):
+- loop_poll_cost_n8 -- control-plane round-trips per agent iteration
+  while a fanned-out loop runs (batched list + wait threads vs the old
+  one-inspect-per-agent-per-tick; budget 12 calls/iteration).
+- fleet_provision_wall_n8 -- wall seconds to provision an 8-worker pod
+  over FakeRunner transports with an injected per-call delay standing
+  in for SSH RTT; vs_baseline is the speedup over the serial,
+  tar-per-worker path (bar: >= 2x).
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "extra": [...]}.  vs_baseline > 1 (or == 1.0 for pass rates) means
 within budget; bigger is better.
@@ -157,8 +166,13 @@ def bench_dnsgate_qps(budget_s: float = 1.0) -> float:
 
 
 def bench_loop_fanout(n: int = 8, iters: int = 3) -> float:
-    """p50 seconds from scheduler.start() to all N loops running across
-    an N-worker fake pod."""
+    """p50 seconds from scheduler.start() until all N loop containers are
+    created across an N-worker fake pod.  start() only SUBMITS the
+    fan-out (creates ride per-worker lanes), so the sample spans
+    submit -> the Nth ``created`` event -- the same create-all span the
+    serial scheduler's start() used to cover inline."""
+    import threading
+
     from clawker_tpu import consts
     from clawker_tpu.config import load_config
     from clawker_tpu.engine.drivers import FakeDriver
@@ -172,19 +186,125 @@ def bench_loop_fanout(n: int = 8, iters: int = 3) -> float:
         proj.mkdir()
         (proj / consts.PROJECT_FLAT_FORM).write_text("project: benchloop\n")
         cfg = load_config(proj)
-        for _ in range(iters):
+        # one warmup run eats lazy-import costs (bootstrap, channels,
+        # workspace) so the samples measure scheduling, not importing
+        for trial in range(iters + 1):
             drv = FakeDriver(n_workers=n)
             for api in drv.apis:
                 api.add_image("clawker-benchloop:default")
                 api.set_behavior("clawker-benchloop:default",
                                  exit_behavior(b"done\n", 0))
-            sched = LoopScheduler(cfg, drv, LoopSpec(parallel=n, iterations=1))
+            all_started = threading.Event()
+            t_started = [0.0]
+            remaining = [n]
+
+            def on_event(agent, event, detail=""):
+                if event == "created":
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        t_started[0] = time.perf_counter()
+                        all_started.set()
+
+            sched = LoopScheduler(cfg, drv, LoopSpec(parallel=n, iterations=1),
+                                  on_event=on_event)
             t0 = time.perf_counter()
             sched.start()
-            samples.append(time.perf_counter() - t0)
+            all_started.wait(30.0)
+            if trial > 0:
+                samples.append((t_started[0] or time.perf_counter()) - t0)
             sched.run(poll_s=0.02)
             sched.cleanup(remove_containers=True)
     return statistics.median(samples)
+
+
+def bench_loop_poll_cost(n: int = 8, iterations: int = 2) -> dict:
+    """Control-plane round-trips per agent iteration while a fanned-out
+    loop runs.  The serial scheduler paid one inspect per agent per
+    tick; the batched one pays one list per worker per tick, one
+    blocking wait per running iteration, and one inspect per finished
+    iteration.  Counts list + inspect + wait calls, measured over N
+    agents on 2 fake workers with a 0.1s iteration body."""
+    from clawker_tpu import consts
+    from clawker_tpu.config import load_config
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.engine.fake import exit_behavior
+    from clawker_tpu.loop import LoopScheduler, LoopSpec
+    from clawker_tpu.testenv import TestEnv
+
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: benchloop\n")
+        cfg = load_config(proj)
+        drv = FakeDriver(n_workers=2)
+        for api in drv.apis:
+            api.add_image("clawker-benchloop:default")
+            api.set_behavior("clawker-benchloop:default",
+                             exit_behavior(b"", 0, delay=0.1))
+        sched = LoopScheduler(cfg, drv,
+                              LoopSpec(parallel=n, iterations=iterations))
+        sched.start()
+        sched.run(poll_s=0.05)
+        lists = sum(len(api.calls_named("container_list")) for api in drv.apis)
+        inspects = sum(len(api.calls_named("container_inspect"))
+                       for api in drv.apis)
+        waits = sum(len(api.calls_named("container_wait")) for api in drv.apis)
+        total_iters = sum(l.iteration for l in sched.loops) or 1
+        sched.cleanup(remove_containers=True)
+    return {
+        "list_calls": lists,
+        "inspect_calls": inspects,
+        "wait_calls": waits,
+        "iterations": total_iters,
+        "calls_per_iteration": round(
+            (lists + inspects + waits) / total_iters, 2),
+    }
+
+
+def bench_fleet_provision(n: int = 8, per_call_delay: float = 0.02) -> dict:
+    """Wall seconds to provision an N-worker pod over FakeRunner
+    transports with an injected per-call delay (standing in for SSH
+    RTT), vs the same plan run serially with a per-worker tar build --
+    the pre-tentpole behavior.  The repo payload is a tiny synthetic
+    tree so the delay (not tar IO) dominates both sides equally."""
+    from clawker_tpu.config.schema import TPUSettings
+    from clawker_tpu.fleet.provision import provision_fleet, provision_worker
+    from clawker_tpu.fleet.transport import FakeRunner, SSHTransport
+
+    class SlowRunner(FakeRunner):
+        def run(self, argv, *, input_bytes=None, timeout=60.0):
+            time.sleep(per_call_delay)
+            return super().run(argv, input_bytes=input_bytes, timeout=timeout)
+
+    tpu = TPUSettings(ssh_user="bench")
+    with tempfile.TemporaryDirectory(prefix="clawker-bench-fleet-") as td:
+        root = Path(td) / "repo"
+        (root / "clawker_tpu").mkdir(parents=True)
+        (root / "clawker_tpu" / "__init__.py").write_text("x = 1\n")
+        (root / "native").mkdir()
+        (root / "native" / "Makefile").write_text("all:\n")
+
+        def transports():
+            return [SSHTransport(tpu, f"10.0.0.{i}", i,
+                                 mux_dir=Path(td) / "mux", runner=SlowRunner())
+                    for i in range(n)]
+
+        t0 = time.perf_counter()
+        for t in transports():   # serial baseline: per-worker plan AND tar
+            provision_worker(t, root)
+        serial = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        reports = provision_fleet(transports(), root)
+        wall = time.perf_counter() - t0
+    ok = all(r.ok for r in reports)
+    return {
+        "wall_s": round(wall, 3),
+        "serial_wall_s": round(serial, 3),
+        "speedup": round(serial / wall, 2) if wall > 0 else 0.0,
+        "workers": n,
+        "ok": ok,
+    }
 
 
 def synth_egress_records(agents: int = 8, windows: int = 64,
@@ -298,12 +418,17 @@ def previous_round_p50() -> float:
     return best[1]
 
 
+POLL_COST_BUDGET = 12.0       # control-plane calls per agent iteration
+
+
 def main() -> None:
     p50_s, stages = bench_cold_start()
     parity_wall, parity_passed, parity_total = bench_parity()
     decisions = bench_policy_oracle()
     qps = bench_dnsgate_qps()
     fanout_s = bench_loop_fanout()
+    poll_cost = bench_loop_poll_cost()
+    provision = bench_fleet_provision()
     anom = bench_anomaly()
 
     budget_s = 10.0
@@ -320,6 +445,17 @@ def main() -> None:
          "vs_baseline": round(qps / 1_000, 1)},
         {"metric": "loop_fanout_p50_n8", "value": round(fanout_s * 1000, 1),
          "unit": "ms", "vs_baseline": round(10.0 / max(fanout_s, 1e-9), 1)},
+        {"metric": "loop_poll_cost_n8",
+         "value": poll_cost["calls_per_iteration"], "unit": "calls/iter",
+         "vs_baseline": round(
+             POLL_COST_BUDGET / max(poll_cost["calls_per_iteration"], 1e-9), 1),
+         "detail": poll_cost},
+        {"metric": "fleet_provision_wall_n8", "value": provision["wall_s"],
+         "unit": "s",
+         # vs_baseline IS the speedup over serial provisioning: >= 2
+         # means the concurrency pass holds its acceptance bar
+         "vs_baseline": provision["speedup"] if provision["ok"] else 0.0,
+         "detail": provision},
         {"metric": "anomaly_score_step", "value": anom["score_step_us"],
          "unit": "us",
          # a dead lane (score_step 0 / device unavailable) must read as

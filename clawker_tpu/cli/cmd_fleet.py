@@ -9,6 +9,7 @@ run before anything touches a worker.
 from __future__ import annotations
 
 import json
+import threading
 from pathlib import Path
 
 import click
@@ -58,10 +59,16 @@ def fleet_workers(f: Factory):
 @click.option("--no-cp", is_flag=True, help="Skip the per-worker control plane.")
 @click.option("--worker", "only", type=int, default=-1,
               help="Provision a single worker index.")
+@click.option("--jobs", "-j", type=int, default=8,
+              help="Concurrent worker provisions (bounded pool).")
 @pass_factory
-def fleet_provision(f: Factory, dry_run, no_firewall, no_cp, only):
-    """Install the worker stack (native bits, eBPF, control plane)."""
-    from ..fleet.provision import build_plan, provision_worker
+def fleet_provision(f: Factory, dry_run, no_firewall, no_cp, only, jobs):
+    """Install the worker stack (native bits, eBPF, control plane).
+
+    Workers provision concurrently (one payload tar shared by all);
+    step results stream as they land, prefixed with the worker index.
+    """
+    from ..fleet.provision import build_plan, provision_fleet
 
     plan = build_plan(with_firewall=not no_firewall, with_cp=not no_cp)
     if dry_run:
@@ -70,20 +77,41 @@ def fleet_provision(f: Factory, dry_run, no_firewall, no_cp, only):
             click.echo(f"{step.name}{opt}\n    {step.cmd}")
         return
     repo_root = Path(__file__).resolve().parents[2]
+    transports = _transports(f)
+    if only >= 0:
+        chosen = [t for t in transports if t.index == only]
+        if not chosen:
+            valid = ", ".join(str(t.index) for t in transports)
+            raise click.ClickException(
+                f"--worker {only}: no such worker index (valid: {valid})")
+        transports = chosen
+
+    echo_lock = threading.Lock()   # step lines land from worker threads
+
+    def on_step(index, r):
+        mark = "+" if r.ok else "!"
+        with echo_lock:
+            click.echo(f"worker {index}: {mark} {r.name}"
+                       + (f": {r.detail}" if r.detail else ""))
+
+    reports = provision_fleet(
+        transports, repo_root,
+        with_firewall=not no_firewall, with_cp=not no_cp,
+        monitor=f.config.settings.monitoring.enable,
+        max_workers=max(1, jobs), on_step=on_step)
     failed = 0
-    for t in _transports(f):
-        if only >= 0 and t.index != only:
+    for report in reports:
+        if report.ok:
+            click.echo(f"worker {report.index} ({report.host}): ok")
             continue
-        report = provision_worker(t, repo_root,
-                                  with_firewall=not no_firewall,
-                                  with_cp=not no_cp,
-                                  monitor=f.config.settings.monitoring.enable)
-        status = "ok" if report.ok else "FAILED"
-        click.echo(f"worker {t.index} ({t.host}): {status}")
-        for r in report.results:
-            mark = "+" if r.ok else "!"
-            click.echo(f"  {mark} {r.name}" + (f": {r.detail}" if r.detail else ""))
-        failed += 0 if report.ok else 1
+        # the streamed '!' line may be interleaved far above: the final
+        # summary must carry the failure on its own
+        bad = next((r for r in report.results if not r.ok), None)
+        why = ""
+        if bad is not None:
+            why = f" at {bad.name}" + (f": {bad.detail}" if bad.detail else "")
+        click.echo(f"worker {report.index} ({report.host}): FAILED{why}")
+        failed += 1
     if failed:
         raise SystemExit(1)
 

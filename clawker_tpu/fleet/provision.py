@@ -195,27 +195,49 @@ def provision_worker(
     with_firewall: bool = True,
     with_cp: bool = True,
     monitor: bool = False,
+    payload: bytes | None = None,
+    on_step=None,
 ) -> ProvisionReport:
+    """Run the plan against one worker.
+
+    ``payload``: pre-built :func:`payload_tar` bytes -- fleet callers
+    build the tar ONCE and share it across every worker
+    (:func:`provision_fleet`); a standalone call may omit it and pay the
+    build here.  ``on_step(worker_index, StepResult)`` streams each step
+    result the moment it lands (CLI progress while other workers are
+    still mid-plan).
+    """
     report = ProvisionReport(transport.host, transport.index)
     plan = build_plan(with_firewall=with_firewall, with_cp=with_cp)
+
+    def record(res: StepResult) -> None:
+        report.results.append(res)
+        if on_step is not None:
+            try:
+                on_step(transport.index, res)
+            except Exception:
+                # a broken progress consumer must not abort provisioning
+                log.exception("on_step callback failed (worker %d)",
+                              transport.index)
 
     pushed = False
     for step in plan:
         # the payload rides in right before the first build step
         if step.name == "build-native" and not pushed:
             try:
-                transport.push_tar(payload_tar(repo_root, monitor=monitor),
-                                   REMOTE_ROOT, sudo=True)
-                report.results.append(StepResult("push-payload", True))
+                blob = (payload if payload is not None
+                        else payload_tar(repo_root, monitor=monitor))
+                transport.push_tar(blob, REMOTE_ROOT, sudo=True)
+                record(StepResult("push-payload", True))
             except TransportError as e:
-                report.results.append(StepResult("push-payload", False, str(e)))
+                record(StepResult("push-payload", False, str(e)))
                 return report
             pushed = True
         res = transport.run(step.cmd, timeout=step.timeout)
         ok = res.rc == 0
         detail = (res.err or res.out).strip()[:500]
-        report.results.append(StepResult(step.name, ok or step.optional,
-                                         "" if ok else detail))
+        record(StepResult(step.name, ok or step.optional,
+                          "" if ok else detail))
         log.info("worker %d %s: %s", transport.index, step.name,
                  "ok" if ok else f"FAILED ({detail[:120]})" if not step.optional
                  else f"skipped ({detail[:120]})")
@@ -226,3 +248,58 @@ def provision_worker(
         if not ok and not step.optional:
             return report
     return report
+
+
+def provision_fleet(
+    transports: list[SSHTransport],
+    repo_root: Path,
+    *,
+    with_firewall: bool = True,
+    with_cp: bool = True,
+    monitor: bool = False,
+    max_workers: int = 8,
+    on_step=None,
+    on_report=None,
+) -> list[ProvisionReport]:
+    """Provision every worker concurrently, one-pass.
+
+    The payload is tarred ONCE and shared (provisioning K workers used
+    to tar the repo K times), and the per-worker plans run over a
+    bounded thread pool -- the same idiom as the tpu_vm driver's
+    parallel dial (engine/drivers/tpu_vm.py), so wall time no longer
+    stacks O(K * RTT) with pod size.  ``on_report(report)`` fires the
+    moment each worker finishes (streaming CLI output); the returned
+    list is in transport order regardless of completion order.  One
+    worker's transport blowing up becomes a failed report for that
+    worker, never an abort of the rest (per-worker isolation).
+    """
+    from concurrent.futures import ThreadPoolExecutor, as_completed
+
+    if not transports:
+        return []
+    payload = payload_tar(repo_root, monitor=monitor)
+
+    def one(t: SSHTransport) -> ProvisionReport:
+        try:
+            return provision_worker(
+                t, repo_root, with_firewall=with_firewall, with_cp=with_cp,
+                monitor=monitor, payload=payload, on_step=on_step)
+        except Exception as e:    # transport layer raised past the plan
+            rep = ProvisionReport(t.host, t.index)
+            rep.results.append(StepResult("transport", False, str(e)))
+            return rep
+
+    by_index: dict[int, ProvisionReport] = {}
+    with ThreadPoolExecutor(
+            max_workers=min(max_workers, len(transports))) as pool:
+        futs = [pool.submit(one, t) for t in transports]
+        for fut in as_completed(futs):
+            rep = fut.result()
+            by_index[rep.index] = rep
+            if on_report is not None:
+                try:
+                    on_report(rep)
+                except Exception:
+                    log.exception("on_report callback failed (worker %d)",
+                                  rep.index)
+    return [by_index[t.index] for t in transports]

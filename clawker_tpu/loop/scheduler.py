@@ -14,6 +14,27 @@ across the runtime driver's workers:
 Placement is the ONLY thing pod topology feeds (SURVEY.md 2.13: ICI
 carries no control traffic); everything else is per-worker local.
 
+Concurrency model (the fan-out used to be strictly serial, O(N * RTT)
+on SSH-backed engines):
+
+- **Per-worker lanes.**  Every worker gets one serial lane thread; all
+  engine mutations for that worker (create, start, stop, remove, the
+  batched poll) run on its lane.  Two agents on one worker can never
+  race that worker's engine, while distinct workers proceed fully in
+  parallel -- and a hung worker engine wedges only its own lane.
+- **Batched polling.**  Instead of one ``inspect_container`` round-trip
+  per agent per tick, each tick issues ONE ``list_containers`` filtered
+  by the loop-run label per engine, then inspects only containers that
+  actually stopped (to fetch their exit code).
+- **Event-driven restarts.**  Each running iteration gets a blocking
+  ``wait_container`` thread that wakes the run loop the moment the
+  container exits, so the next iteration starts immediately instead of
+  waiting out the poll interval; ``poll_s`` only bounds the fallback
+  re-check cadence and stop() latency.
+- **Ordered events.**  ``on_event`` callbacks now fire from lane,
+  waiter, and anomaly-watch threads; a :class:`monitor.events.EventBus`
+  serializes them so per-agent ordering still holds.
+
 Per-iteration context rides a small state file written into the
 container between restarts (env is immutable after create), so the
 harness can see iteration number + loop id.  Consecutive-failure
@@ -23,9 +44,11 @@ ceiling stops a crash-looping agent from burning a worker forever.
 from __future__ import annotations
 
 import io
+import queue
 import tarfile
 import threading
-import time
+from concurrent.futures import Future
+from concurrent.futures import wait as futures_wait
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -33,6 +56,7 @@ from .. import consts, logsetup
 from ..config import Config
 from ..engine.drivers import RuntimeDriver, Worker
 from ..errors import ClawkerError
+from ..monitor.events import EventBus
 from ..runtime.orchestrate import AgentRuntime, CreateOptions
 from ..util import ids
 
@@ -40,6 +64,11 @@ log = logsetup.get("loop.scheduler")
 
 FAILURE_CEILING = 3          # consecutive nonzero exits -> loop failed
 LOOP_STATE_DIR = "/run/clawker"
+HALT_DEADLINE_S = 10.0       # bounded halt/cleanup: a hung worker's lane
+#                              must never wedge CLI shutdown
+
+# container-list summary states meaning "iteration still in flight"
+_ACTIVE_STATES = {"created", "running", "restarting", "paused"}
 
 
 @dataclass
@@ -85,6 +114,46 @@ def place(workers: list[Worker], n: int, policy: str) -> list[Worker]:
     raise ClawkerError(f"loop: unknown placement {policy!r} (spread|pack)")
 
 
+class _WorkerLane:
+    """Serial executor for ONE worker's engine calls.
+
+    Two agents placed on the same worker must never race that worker's
+    engine, so each worker gets exactly one lane thread; distinct
+    workers proceed in parallel.  A ``ThreadPoolExecutor(max_workers=1)``
+    would do, except its threads are joined at interpreter exit -- one
+    hung SSH engine would wedge the whole CLI shutdown.  A daemon thread
+    plus explicit futures keeps a hung worker's damage confined to that
+    worker.
+    """
+
+    def __init__(self, name: str):
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._thread = threading.Thread(target=self._drain, daemon=True,
+                                        name=f"loop-lane-{name}")
+        self._thread.start()
+
+    def submit(self, fn, *args) -> Future:
+        fut: Future = Future()
+        self._q.put((fut, fn, args))
+        return fut
+
+    def close(self) -> None:
+        self._q.put(None)
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, fn, args = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as e:   # the lane must survive any task
+                fut.set_exception(e)
+
+
 class LoopScheduler:
     def __init__(self, cfg: Config, driver: RuntimeDriver, spec: LoopSpec,
                  *, on_event=None):
@@ -93,9 +162,17 @@ class LoopScheduler:
         self.spec = spec
         self.loop_id = ids.short_id()
         self.loops: list[AgentLoop] = []
-        self.on_event = on_event or (lambda agent, event, detail="": None)
+        # every event (lane threads, waiter threads, anomaly watch) rides
+        # the bus so consumers see per-agent order despite the fan-out
+        self.events = EventBus(on_event)
+        self.on_event = self.events.emit
         self.anomaly_watch = None
         self._stop = threading.Event()
+        self._wake = threading.Event()        # set by waiters on any exit
+        self._git_lock = threading.Lock()     # worktree setup shares one repo
+        self._lanes: dict[str, _WorkerLane] = {}
+        self._inflight: dict[str, Future] = {}   # agent -> create/start task
+        self._waited: set[tuple[str, int]] = set()
 
     def attach_anomaly_watch(self, watch) -> None:
         """Surface fleet anomaly scores (analytics.runtime.AnomalyWatch)
@@ -118,6 +195,13 @@ class LoopScheduler:
             "scheduler", "anomaly_watch_error", msg)
 
     # -------------------------------------------------------------- set up
+
+    def _lane(self, worker: Worker) -> _WorkerLane:
+        lane = self._lanes.get(worker.id)
+        if lane is None:
+            lane = _WorkerLane(worker.id)
+            self._lanes[worker.id] = lane
+        return lane
 
     def _runtime(self, worker: Worker) -> AgentRuntime:
         from ..controlplane.bootstrap import post_start_services, pre_start_services
@@ -154,6 +238,13 @@ class LoopScheduler:
         return info.path, gm.git_dir()
 
     def start(self) -> None:
+        """Place loops and fan create+first-start across worker lanes.
+
+        Returns once every launch is SUBMITTED: the old serial create
+        loop stacked O(N * RTT) on SSH engines, and one wedged worker
+        blocked the whole pod's fan-out.  run() drives the launches to
+        completion (and accounts their failures).
+        """
         workers = self.driver.workers()
         slots = place(workers, self.spec.parallel, self.spec.placement)
         for i, worker in enumerate(slots):
@@ -163,15 +254,41 @@ class LoopScheduler:
             loop = AgentLoop(agent=agent, worker=worker)
             self.loops.append(loop)
         for loop in self.loops:
-            try:
-                self._create(loop)
-            except ClawkerError as e:
-                loop.status = "failed"
-                self.on_event(loop.agent, "create_failed", str(e))
-                log.error("loop %s: create failed: %s", loop.agent, e)
+            self._inflight[loop.agent] = self._lane(loop.worker).submit(
+                self._launch, loop)
+
+    def wait_launched(self, timeout: float | None = None) -> bool:
+        """Block until every submitted launch (create + first start) has
+        completed; True when all landed within ``timeout``.  For callers
+        that need the old synchronous start() semantics -- run() does NOT
+        need this (it harvests launches as they finish), so a hung worker
+        only stalls callers that explicitly opt into waiting."""
+        done, not_done = futures_wait(list(self._inflight.values()),
+                                      timeout=timeout)
+        return not not_done
+
+    def _launch(self, loop: AgentLoop) -> None:
+        """Create + first iteration start, on the owning worker's lane."""
+        if self._stop.is_set():
+            # a launch still queued behind a wedged lane when the user
+            # stopped the run must not create an orphan container (or
+            # worktree) once the engine recovers
+            return
+        try:
+            self._create(loop)
+        except ClawkerError as e:
+            loop.status = "failed"
+            self.on_event(loop.agent, "create_failed", str(e))
+            log.error("loop %s: create failed: %s", loop.agent, e)
+            return
+        self._guarded_start(loop)
 
     def _create(self, loop: AgentLoop) -> None:
-        workspace_root, git_dir = self._maybe_worktree(loop.agent)
+        # worktree setup mutates ONE shared git repo (refs, worktree
+        # metadata): serialize it across lanes or concurrent loops race
+        # git's own lock files
+        with self._git_lock:
+            workspace_root, git_dir = self._maybe_worktree(loop.agent)
         loop.worktree = workspace_root
         env = {
             "CLAWKER_LOOP_ID": self.loop_id,
@@ -234,6 +351,8 @@ class LoopScheduler:
     def _guarded_start(self, loop: AgentLoop) -> None:
         """One worker's transient failure must never abort the other
         loops (per-worker isolation) or skip the CLI's cleanup."""
+        if self._stop.is_set():
+            return
         try:
             self._start_iteration(loop)
         except ClawkerError as e:
@@ -257,49 +376,210 @@ class LoopScheduler:
             loop.status = "done"
             self.on_event(loop.agent, "done", f"{loop.iteration} iterations")
 
+    # ------------------------------------------------------------- polling
+
+    def _read_exit(self, loop: AgentLoop) -> tuple[int | None, str]:
+        """(exit_code, failure_detail) for a stopped container.
+
+        A ``None`` code with a detail means the iteration cannot be
+        accounted: the container vanished, or it stopped with no
+        ExitCode in its state -- a daemon that lost the exit status must
+        read as a FAILED iteration, never as success (the old
+        ``int(state.get("ExitCode") or 0)`` mapped exactly that to 0).
+        """
+        engine = loop.worker.require_engine()
+        try:
+            info = engine.inspect_container(loop.container_id)
+        except ClawkerError:
+            return None, "container vanished"
+        state = info.get("State") or {}
+        if state.get("Running"):
+            return None, ""        # raced a restart: not finished after all
+        code = state.get("ExitCode")
+        if code is None:
+            return None, "stopped without exit code"
+        try:
+            return int(code), ""
+        except (TypeError, ValueError):
+            return None, f"unreadable exit code {code!r}"
+
+    def _poll_lane(self, engine, loops: list[AgentLoop]
+                   ) -> list[tuple[AgentLoop, int | None, str]]:
+        """ONE ``list_containers`` round-trip for every loop agent this
+        worker hosts (the serial loop paid one inspect per agent per
+        tick), then one inspect per *stopped* container for its exit
+        code.  Runs on the worker's lane, so a hung engine blocks only
+        its own worker's poll."""
+        try:
+            rows = engine.list_containers(all=True, filters={
+                "label": [f"{consts.LABEL_LOOP}={self.loop_id}"]})
+        except ClawkerError:
+            rows = None
+        out: list[tuple[AgentLoop, int | None, str]] = []
+        if rows is None:
+            # engine unreachable: fall back to per-container inspect so a
+            # dead daemon still fails its loops instead of spinning forever
+            for l in loops:
+                code, detail = self._read_exit(l)
+                if code is not None or detail:
+                    out.append((l, code, detail))
+            return out
+        state_by_id = {r.get("Id", ""): str(r.get("State") or "").lower()
+                       for r in rows}
+        for l in loops:
+            st = state_by_id.get(l.container_id)
+            if st is None:
+                out.append((l, None, "container vanished"))
+            elif st not in _ACTIVE_STATES:
+                code, detail = self._read_exit(l)
+                if code is not None or detail:
+                    out.append((l, code, detail))
+        return out
+
+    def _spawn_waiter(self, loop: AgentLoop) -> None:
+        """Blocking ``wait_container`` on a side thread: a finished
+        iteration wakes run() immediately instead of waiting out the
+        poll interval.  Purely a wake-up -- the batched poll stays the
+        source of truth for exit accounting."""
+        key = (loop.agent, loop.iteration)
+        if key in self._waited:
+            return
+        self._waited.add(key)
+        engine = loop.worker.require_engine()
+        cid = loop.container_id
+
+        def wait() -> None:
+            try:
+                engine.wait_container(cid)
+            except Exception:
+                pass
+            self._wake.set()
+
+        threading.Thread(target=wait, daemon=True,
+                         name=f"loop-wait-{loop.agent}-{loop.iteration}").start()
+
     # ----------------------------------------------------------------- run
 
     def run(self, *, poll_s: float = 0.5) -> list[AgentLoop]:
-        """Drive every loop to completion (or stop()); returns final states."""
+        """Drive every loop to completion (or stop()); returns final states.
+
+        Event-driven: waiter threads wake the loop the moment an
+        iteration exits, so ``poll_s`` only bounds the fallback re-check
+        cadence (and stop() latency) -- it can stay coarse without
+        slowing restarts down.
+        """
         for loop in self.loops:
-            if loop.status == "pending":
-                self._guarded_start(loop)
+            # compat: loops registered without start() still launch here
+            if loop.agent not in self._inflight:
+                if loop.status == "pending":
+                    self._inflight[loop.agent] = self._lane(loop.worker).submit(
+                        self._launch, loop)
+                else:
+                    done: Future = Future()
+                    done.set_result(None)
+                    self._inflight[loop.agent] = done
+        polls: dict[str, Future] = {}
+        poll_errs: dict[str, int] = {}
         while not self._stop.is_set():
-            active = [l for l in self.loops if l.status == "running"]
-            if not active:
+            self._harvest_inflight()
+            # a loop is busy while running, or while its create/start/
+            # restart is still queued on a (possibly wedged) worker lane
+            busy = [l for l in self.loops
+                    if l.status == "running"
+                    or not self._inflight[l.agent].done()]
+            if not busy:
                 break
-            for loop in active:
-                engine = loop.worker.require_engine()
+            pollable = [l for l in self.loops
+                        if l.status == "running"
+                        and self._inflight[l.agent].done()]
+            by_worker: dict[str, list[AgentLoop]] = {}
+            for l in pollable:
+                self._spawn_waiter(l)
+                by_worker.setdefault(l.worker.id, []).append(l)
+            for wid, group in by_worker.items():
+                if wid not in polls:    # previous poll still pending: skip
+                    engine = group[0].worker.require_engine()
+                    polls[wid] = self._lane(group[0].worker).submit(
+                        self._poll_lane, engine, list(group))
+            if polls:
+                futures_wait(list(polls.values()), timeout=poll_s)
+            finished: list[tuple[AgentLoop, int | None, str]] = []
+            for wid in list(polls):
+                fut = polls[wid]
+                if not fut.done():
+                    continue             # slow worker: re-harvest next tick
+                del polls[wid]
                 try:
-                    info = engine.inspect_container(loop.container_id)
-                except ClawkerError:
+                    finished.extend(fut.result())
+                    poll_errs.pop(wid, None)
+                except Exception as e:
+                    # a DETERMINISTIC poll crash (engine bug, malformed
+                    # state) would otherwise retry at poll_s cadence
+                    # forever with the loops stuck "running"
+                    log.error("loop poll on %s failed: %r", wid, e)
+                    poll_errs[wid] = poll_errs.get(wid, 0) + 1
+                    if poll_errs[wid] >= FAILURE_CEILING:
+                        finished.extend(
+                            (l, None, f"poll crashed: {e!r}")
+                            for l in by_worker.get(wid, ()))
+            progressed = False
+            for loop, code, detail in finished:
+                if loop.status != "running":
+                    continue
+                progressed = True
+                self._waited.discard((loop.agent, loop.iteration))
+                if code is None:
                     loop.status = "failed"
-                    self.on_event(loop.agent, "failed", "container vanished")
+                    self.on_event(loop.agent, "failed", detail)
                     continue
-                state = info.get("State") or {}
-                if state.get("Running"):
-                    continue
-                self._finish_iteration(loop, int(state.get("ExitCode") or 0))
+                self._finish_iteration(loop, code)
                 if loop.status == "running":     # budget left: next iteration
-                    self._guarded_start(loop)
-            self._stop.wait(poll_s)
+                    self._inflight[loop.agent] = self._lane(loop.worker).submit(
+                        self._guarded_start, loop)
+            if not progressed:
+                self._wake.wait(poll_s)
+                self._wake.clear()
         if self._stop.is_set():
             self._halt_running()
+        # callers read final states + their own on_event capture right
+        # after run(); make sure every stamped event reached the sink
+        self.events.flush()
         return self.loops
+
+    def _harvest_inflight(self) -> None:
+        """Unexpected (non-ClawkerError) lane crashes must surface as a
+        failed loop, not evaporate inside a future nobody reads."""
+        for loop in self.loops:
+            fut = self._inflight.get(loop.agent)
+            if fut is None or not fut.done():
+                continue
+            exc = fut.exception()
+            if exc is not None and loop.status in ("pending", "running"):
+                loop.status = "failed"
+                self.on_event(loop.agent, "failed", f"internal: {exc!r}")
+                log.error("loop %s: lane task crashed: %r", loop.agent, exc)
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()
 
     def _halt_running(self) -> None:
+        futs = []
         for loop in self.loops:
             if loop.status != "running":
                 continue
-            try:
-                loop.worker.require_engine().stop_container(loop.container_id, timeout=5)
-            except ClawkerError:
-                pass
+            futs.append(self._lane(loop.worker).submit(self._halt_one, loop))
             loop.status = "stopped"
             self.on_event(loop.agent, "stopped")
+        if futs:
+            futures_wait(futs, timeout=HALT_DEADLINE_S)
+
+    def _halt_one(self, loop: AgentLoop) -> None:
+        try:
+            loop.worker.require_engine().stop_container(loop.container_id,
+                                                        timeout=5)
+        except ClawkerError:
+            pass
 
     def status(self) -> list[dict]:
         out = []
@@ -313,10 +593,26 @@ class LoopScheduler:
         return out
 
     def cleanup(self, *, remove_containers: bool = False) -> None:
-        for loop in self.loops:
-            if remove_containers and loop.container_id:
-                try:
-                    loop.worker.require_engine().remove_container(
-                        loop.container_id, force=True, volumes=True)
-                except ClawkerError:
-                    pass
+        if remove_containers:
+            # submit a removal for EVERY loop: it rides the same lane as
+            # the loop's launch, so by the time it runs the launch has
+            # drained and container_id is authoritative (checking it here
+            # on the main thread could snapshot '' mid-create and leak)
+            futs = [self._lane(loop.worker).submit(self._remove_one, loop)
+                    for loop in self.loops]
+            if futs:
+                futures_wait(futs, timeout=HALT_DEADLINE_S)
+        for lane in self._lanes.values():
+            lane.close()
+        self._lanes.clear()
+        self.events.flush()
+        self.events.close()
+
+    def _remove_one(self, loop: AgentLoop) -> None:
+        if not loop.container_id:
+            return      # create never ran (failed, or aborted by stop())
+        try:
+            loop.worker.require_engine().remove_container(
+                loop.container_id, force=True, volumes=True)
+        except ClawkerError:
+            pass

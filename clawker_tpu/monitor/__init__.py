@@ -5,3 +5,7 @@ Parity reference: internal/monitor (compose stack templates, monitoring
 units, ledger -- SURVEY.md 2.11) and controlplane/firewall/ebpf/netlogger
 (events ringbuf -> log records).
 """
+
+from .events import EventBus, EventRecord
+
+__all__ = ["EventBus", "EventRecord"]

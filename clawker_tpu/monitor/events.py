@@ -1,0 +1,117 @@
+"""Ordered event fan-in for the concurrent fleet control plane.
+
+With the loop scheduler fanned out across per-worker lanes, per-agent
+``wait_container`` threads, and the anomaly watch's scoring thread,
+``on_event`` callbacks fire from many threads at once.  Every consumer
+(CLI stderr lines, the loop dashboard, the final status JSON) assumes
+per-agent event order -- ``iteration_start 1`` must never be delivered
+before ``iteration_done 0``.  :class:`EventBus` restores that guarantee:
+emits are stamped with a global and a per-agent sequence number under
+one lock, and a single drainer thread delivers them to the sink in
+stamp order.
+
+Delivery rides its own thread on purpose: holding the stamp lock across
+the sink call would couple every lane, waiter, and the run loop to sink
+latency -- one consumer blocked on a wedged stderr (terminal flow
+control, a stalled pipe reader) would halt the whole pod's control
+plane, exactly the coupling the per-worker lanes exist to prevent.  The
+cost is that delivery is asynchronous: callers that need "everything
+emitted so far has reached the sink" (the scheduler before returning
+final states, tests) call :meth:`flush`.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable
+
+from .. import logsetup
+
+log = logsetup.get("monitor.events")
+
+HISTORY_LIMIT = 4096    # long unbounded loops must not grow without bound
+
+
+@dataclass(frozen=True)
+class EventRecord:
+    seq: int            # position in the global event stream
+    agent_seq: int      # position within this agent's event stream
+    agent: str
+    event: str
+    detail: str = ""
+
+
+class EventBus:
+    """Thread-safe, order-preserving emitter over an ``on_event`` sink."""
+
+    def __init__(self, sink: Callable[..., None] | None = None,
+                 *, history: int = HISTORY_LIMIT):
+        self._sink = sink
+        self._lock = threading.Lock()
+        self._delivered_cond = threading.Condition(self._lock)
+        self._seq = 0
+        self._delivered = 0
+        self._agent_seq: dict[str, int] = {}
+        self._closed = False
+        self.history: deque[EventRecord] = deque(maxlen=history)
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        if sink is not None:
+            threading.Thread(target=self._drain, daemon=True,
+                             name="event-bus").start()
+
+    def emit(self, agent: str, event: str, detail: str = "") -> EventRecord:
+        with self._lock:
+            self._seq += 1
+            aseq = self._agent_seq.get(agent, 0) + 1
+            self._agent_seq[agent] = aseq
+            rec = EventRecord(self._seq, aseq, agent, event, detail)
+            self.history.append(rec)
+            if self._sink is not None and not self._closed:
+                # stamped and enqueued under the same lock: queue order
+                # is stamp order, and the single drainer preserves it
+                self._q.put(rec)
+            else:
+                self._delivered = max(self._delivered, self._seq)
+        return rec
+
+    def close(self) -> None:
+        """Retire the drainer thread once everything queued so far has
+        been delivered.  Later emits still stamp + record history; they
+        just no longer reach the sink.  Without this, every scheduler
+        would leak one blocked drainer (plus its sink closure) for the
+        life of the process."""
+        with self._lock:
+            if self._sink is None or self._closed:
+                return
+            self._closed = True
+            self._q.put(None)
+
+    def _drain(self) -> None:
+        while True:
+            rec = self._q.get()
+            if rec is None:
+                return
+            try:
+                self._sink(rec.agent, rec.event, rec.detail)
+            except Exception:
+                # a broken consumer must never stall the event stream
+                log.exception("event sink failed for %s/%s",
+                              rec.agent, rec.event)
+            with self._delivered_cond:
+                self._delivered = max(self._delivered, rec.seq)
+                self._delivered_cond.notify_all()
+
+    def flush(self, timeout: float | None = 5.0) -> bool:
+        """Block until every event stamped so far has been handed to the
+        sink; False if the sink could not keep up within ``timeout``."""
+        with self._delivered_cond:
+            target = self._seq
+            return self._delivered_cond.wait_for(
+                lambda: self._delivered >= target, timeout)
+
+    def for_agent(self, agent: str) -> list[EventRecord]:
+        with self._lock:
+            return [r for r in self.history if r.agent == agent]

@@ -10,6 +10,7 @@ callables so this module stays below the CP layer in the import DAG.
 from __future__ import annotations
 
 import sys
+import threading
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import BinaryIO, Callable
@@ -69,20 +70,27 @@ class AgentRuntime:
         # addresses; local: host-gateway) -- fleet/channels.SideChannels,
         # or a zero-arg callable resolved lazily on the create path only
         self.channels = channels
+        # lazy resolution replaces self.channels in place; guard it so a
+        # runtime handed to threaded callers (the Factory exposes one to
+        # arbitrary commands) resolves exactly once.  The loop scheduler
+        # builds per-worker runtimes with channels already resolved, but
+        # the contract must not depend on that.
+        self._channels_lock = threading.Lock()
 
     def _resolve_channels(self):
-        if callable(self.channels):
-            try:
-                self.channels = self.channels()
-            except Exception as e:
-                # best-effort: a failed tunnel degrades the agent (no
-                # browser-open/OAuth/telemetry), never blocks the create
-                import logging
+        with self._channels_lock:
+            if callable(self.channels):
+                try:
+                    self.channels = self.channels()
+                except Exception as e:
+                    # best-effort: a failed tunnel degrades the agent (no
+                    # browser-open/OAuth/telemetry), never blocks the create
+                    import logging
 
-                logging.getLogger("runtime").warning(
-                    "event=side_channels_unavailable error=%s", e)
-                self.channels = None
-        return self.channels
+                    logging.getLogger("runtime").warning(
+                        "event=side_channels_unavailable error=%s", e)
+                    self.channels = None
+            return self.channels
 
     # -------------------------------------------------------------- create
 

@@ -9,39 +9,47 @@ headline p50 -- the round-4 verdict's "creep with no owner" gap.
 
 Not a tracing system: for spans shipped to the collector use
 controlplane/otel.py.  This is a single-process accumulator with zero
-dependencies, safe to call from any layer.
+dependencies, safe to call from any layer -- including concurrently:
+the loop scheduler drives orchestrator create/start on per-worker
+threads, so the accumulation (a read-modify-write) rides a lock.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 _enabled = False
 _totals: dict[str, float] = {}
 _counts: dict[str, int] = {}
+_mutex = threading.Lock()
 
 
 def enable() -> None:
     global _enabled
-    _enabled = True
-    _totals.clear()
-    _counts.clear()
+    with _mutex:
+        _enabled = True
+        _totals.clear()
+        _counts.clear()
 
 
 def disable() -> dict[str, float]:
     """Stop recording; returns {phase: total_seconds}."""
     global _enabled
-    _enabled = False
-    return dict(_totals)
+    with _mutex:
+        _enabled = False
+        return dict(_totals)
 
 
 def totals() -> dict[str, float]:
-    return dict(_totals)
+    with _mutex:
+        return dict(_totals)
 
 
 def counts() -> dict[str, int]:
-    return dict(_counts)
+    with _mutex:
+        return dict(_counts)
 
 
 @contextlib.contextmanager
@@ -54,5 +62,6 @@ def phase(name: str):
         yield
     finally:
         dt = time.perf_counter() - t0
-        _totals[name] = _totals.get(name, 0.0) + dt
-        _counts[name] = _counts.get(name, 0) + 1
+        with _mutex:
+            _totals[name] = _totals.get(name, 0.0) + dt
+            _counts[name] = _counts.get(name, 0) + 1

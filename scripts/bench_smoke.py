@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""Bench smoke: the concurrent control plane's perf gates, in seconds.
+
+Runs the scheduler + provisioning metrics from bench.py (fan-out
+latency, poll cost per iteration, fleet provision wall vs serial) --
+everything FakeDriver/FakeRunner-backed, no SSH, no TPU, no daemon --
+and fails loudly when a gate regresses.  Wired as ``make bench-smoke``
+(under a hard timeout) so perf regressions in the scheduler show up
+in-repo instead of only in the next full bench round.
+
+Gates:
+- loop_fanout_p50_n8   <= 10 s     (BASELINE config 4 cold-start budget)
+- loop_poll_cost_n8    <= budget   (bench.POLL_COST_BUDGET calls/iter)
+- fleet_provision_wall >= 2x faster than serial (ISSUE 1 acceptance bar)
+
+Prints one JSON line; exit 1 on any gate failure.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+FANOUT_BUDGET_S = 10.0
+PROVISION_MIN_SPEEDUP = 2.0
+
+
+def main() -> int:
+    from bench import (
+        POLL_COST_BUDGET,
+        bench_fleet_provision,
+        bench_loop_fanout,
+        bench_loop_poll_cost,
+    )
+
+    fanout_s = bench_loop_fanout(iters=1)
+    poll = bench_loop_poll_cost()
+    provision = bench_fleet_provision()
+
+    failures: list[str] = []
+    if fanout_s > FANOUT_BUDGET_S:
+        failures.append(
+            f"loop_fanout_p50_n8 {fanout_s:.2f}s > {FANOUT_BUDGET_S}s budget")
+    if poll["calls_per_iteration"] > POLL_COST_BUDGET:
+        failures.append(
+            f"loop_poll_cost_n8 {poll['calls_per_iteration']} calls/iter "
+            f"> {POLL_COST_BUDGET} budget")
+    if not provision["ok"]:
+        failures.append("fleet_provision_wall_n8: a worker report failed")
+    if provision["speedup"] < PROVISION_MIN_SPEEDUP:
+        failures.append(
+            f"fleet_provision_wall_n8 speedup {provision['speedup']}x "
+            f"< {PROVISION_MIN_SPEEDUP}x over serial")
+
+    print(json.dumps({
+        "loop_fanout_p50_n8_ms": round(fanout_s * 1000, 1),
+        "loop_poll_cost_n8": poll,
+        "fleet_provision_wall_n8": provision,
+        "ok": not failures,
+        "failures": failures,
+    }))
+    if failures:
+        print("BENCH-SMOKE FAILED: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

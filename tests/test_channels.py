@@ -207,6 +207,9 @@ def test_loop_agent_on_remote_worker_resolves_git_cred_via_laptop_proxy(
     )
     try:
         sched.start()
+        # start() fans creates across worker lanes asynchronously; wait
+        # for the launches before inspecting what they created
+        assert sched.wait_launched(timeout=30.0)
         assert [l.status for l in sched.loops] != ["failed", "failed"]
         for loop in sched.loops:
             eng = loop.worker.require_engine()

@@ -21,6 +21,7 @@ from clawker_tpu.fleet.provision import (
     REMOTE_ROOT,
     build_plan,
     payload_tar,
+    provision_fleet,
     provision_worker,
 )
 from clawker_tpu.fleet.transport import FakeRunner, SSHTransport, TransportError
@@ -152,6 +153,72 @@ def test_provision_worker_optional_failure_continues(transport):
     assert byname["toolchain-bpf"].ok and byname["toolchain-bpf"].detail
 
 
+def _fleet_transports(tmp_path, n=4, runner_factory=FakeRunner):
+    tpu = TPUSettings(pod="v5e-test", ssh_user="ops", ssh_key="/keys/id")
+    return [SSHTransport(tpu, f"10.0.0.{i}", i, mux_dir=tmp_path / "mux",
+                         runner=runner_factory()) for i in range(n)]
+
+
+def test_provision_worker_streams_step_results(transport):
+    t, runner = transport
+    seen = []
+    report = provision_worker(t, REPO,
+                              on_step=lambda i, r: seen.append((i, r.name)))
+    # every recorded result streamed, in order, tagged with the worker
+    assert [n for _, n in seen] == [r.name for r in report.results]
+    assert all(i == 2 for i, _ in seen)
+
+
+def test_provision_fleet_tars_the_payload_once(monkeypatch, tmp_path):
+    """One-pass provisioning: K workers share ONE payload tar build."""
+    from clawker_tpu.fleet import provision as prov_mod
+
+    builds = []
+    real = prov_mod.payload_tar
+
+    def spy(repo_root, *, monitor=False):
+        builds.append(repo_root)
+        return real(repo_root, monitor=monitor)
+
+    monkeypatch.setattr(prov_mod, "payload_tar", spy)
+    ts = _fleet_transports(tmp_path)
+    reports = prov_mod.provision_fleet(ts, REPO)
+    assert all(r.ok for r in reports)
+    assert len(builds) == 1
+    # and every worker still received the payload push
+    for t in ts:
+        assert t.runner.pushed
+
+
+def test_provision_fleet_streams_reports_and_isolates_failure(tmp_path):
+    ts = _fleet_transports(tmp_path)
+    # worker 2's daemon is down: its plan aborts at the first step
+    ts[2].runner.script["docker info"] = (1, "Cannot connect")
+    streamed = []
+    reports = provision_fleet(ts, REPO,
+                              on_report=lambda r: streamed.append(r.index))
+    # return order is transport order regardless of completion order
+    assert [r.index for r in reports] == [0, 1, 2, 3]
+    assert sorted(streamed) == [0, 1, 2, 3]  # every report streamed
+    assert not reports[2].ok
+    assert [r.name for r in reports[2].results] == ["preflight-docker"]
+    assert all(reports[i].ok for i in (0, 1, 3))
+
+
+def test_provision_fleet_transport_blowup_is_one_failed_report(tmp_path):
+    class ExplodingRunner(FakeRunner):
+        def run(self, argv, *, input_bytes=None, timeout=60.0):
+            raise TransportError("ssh melted")
+
+    ts = _fleet_transports(tmp_path, n=3)
+    boom = ExplodingRunner()
+    ts[1] = SSHTransport(TPUSettings(pod="v5e-test", ssh_user="ops"),
+                         "10.0.0.1", 1, mux_dir=tmp_path / "mux", runner=boom)
+    reports = provision_fleet(ts, REPO)
+    assert [r.ok for r in reports] == [True, False, True]
+    assert "ssh melted" in reports[1].results[-1].detail
+
+
 # ------------------------------------------------------------------ driver
 
 def test_tpu_vm_driver_hosts_and_order():
@@ -196,3 +263,27 @@ def test_fleet_cli_dry_run_and_workers(tmp_path):
                             catch_exceptions=False)
         assert res.exit_code == 0
         assert "preflight-docker" in res.stdout and "kernel-load" in res.stdout
+
+
+def test_fleet_cli_provision_bad_worker_index_errors():
+    """`fleet provision --worker N` with no such index must error and
+    name the valid indices (it used to print nothing and exit 0)."""
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.testenv import TestEnv
+
+    with TestEnv() as tenv:
+        tenv.write_settings(
+            "runtime:\n  tpu:\n    workers: [w0.example, w1.example]\n")
+        proj = tenv.base / "p"
+        proj.mkdir()
+        (proj / ".clawker.yaml").write_text("project: fleetproj\n")
+        res = CliRunner().invoke(
+            cli, ["fleet", "provision", "--worker", "7"],
+            obj=Factory(cwd=proj, driver=FakeDriver()))
+        assert res.exit_code != 0
+        assert "no such worker index" in res.output
+        assert "0, 1" in res.output

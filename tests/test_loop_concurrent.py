@@ -1,0 +1,417 @@
+"""Concurrent scheduler suite: per-worker fan-out isolation, ordered
+events under concurrency, batched polling, and exit-code accounting.
+
+The tentpole scenario (ISSUE 1 / BASELINE config 4): N agents spread
+over pod workers must fan out in parallel -- one slow or hung worker
+engine wedges only its own worker's loops, never the pod -- while
+``on_event`` consumers still see a coherent per-agent event stream.
+All of it runs over the in-process fake daemons; slowness and hangs are
+injected at the fake-API seam.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.config import load_config
+from clawker_tpu.engine.api import Engine
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import FakeDockerAPI, exit_behavior
+from clawker_tpu.errors import ClawkerError
+from clawker_tpu.loop import LoopScheduler, LoopSpec
+from clawker_tpu.monitor.events import EventBus
+from clawker_tpu.testenv import TestEnv
+
+IMAGE = "clawker-loopproj:default"
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: loopproj\n")
+        cfg = load_config(proj)
+        yield tenv, proj, cfg
+
+
+class SlowCreateAPI(FakeDockerAPI):
+    """Fake daemon with an injected per-create delay (a slow worker)."""
+
+    def __init__(self, create_delay: float):
+        super().__init__()
+        self.create_delay = create_delay
+
+    def container_create(self, name, config):
+        time.sleep(self.create_delay)
+        return super().container_create(name, config)
+
+
+class HungCreateAPI(FakeDockerAPI):
+    """Fake daemon whose create blocks until released (a hung engine)."""
+
+    def __init__(self):
+        super().__init__()
+        self.release = threading.Event()
+
+    def container_create(self, name, config):
+        self.release.wait(30.0)
+        return super().container_create(name, config)
+
+
+class NoExitCodeAPI(FakeDockerAPI):
+    """Fake daemon that loses the exit status of stopped containers."""
+
+    def container_inspect(self, cid):
+        info = super().container_inspect(cid)
+        if not info["State"]["Running"]:
+            info["State"].pop("ExitCode", None)
+        return info
+
+
+def swap_api(drv: FakeDriver, i: int, api: FakeDockerAPI) -> None:
+    drv.apis[i] = api
+    drv._workers[i].engine = Engine(api)
+
+
+def seed(drv: FakeDriver, behavior=None) -> None:
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, behavior or exit_behavior(b"iter done\n", 0))
+
+
+# ------------------------------------------------------------- fan-out
+
+
+def test_slow_worker_and_failed_create_do_not_block_peers(env):
+    """N=8 on 2 workers: worker 1's engine is slow per create, and one
+    of worker 0's creates fails.  Worker 0's surviving agents must all
+    finish before the slow worker's FIRST agent does, and the failed
+    create must stay an isolated single-agent failure."""
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    swap_api(drv, 1, SlowCreateAPI(create_delay=0.5))
+    seed(drv)
+    drv.apis[0].fail_next["container_create"] = ClawkerError(
+        "injected create failure")
+
+    events: list[tuple[str, str, str]] = []
+    done_at: dict[str, float] = {}
+
+    def on_event(agent, event, detail=""):
+        events.append((agent, event, detail))
+        if event == "done":
+            done_at[agent] = time.monotonic()
+
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=8, iterations=1),
+                          on_event=on_event)
+    sched.start()
+    loops = sched.run(poll_s=0.05)
+    sched.cleanup(remove_containers=True)
+
+    w0 = [l for l in loops if l.worker.id == "fake-0"]
+    w1 = [l for l in loops if l.worker.id == "fake-1"]
+    assert len(w0) == 4 and len(w1) == 4  # spread placement
+
+    failed = [l for l in w0 if l.status == "failed"]
+    assert len(failed) == 1               # exactly the injected failure
+    assert all(l.status == "done" for l in w0 if l not in failed)
+    assert all(l.status == "done" for l in w1)
+
+    # isolation: every healthy worker-0 agent finished before the slow
+    # worker's first agent could even have been created (0.5s/create)
+    w0_done = max(done_at[l.agent] for l in w0 if l not in failed)
+    w1_done = min(done_at[l.agent] for l in w1)
+    assert w0_done < w1_done
+
+    # per-agent event streams stay ordered despite the concurrent emit
+    for l in loops:
+        seq = [e for a, e, d in events if a == l.agent]
+        if l in failed:
+            assert seq == ["create_failed"]
+            continue
+        assert seq == ["created", "iteration_start", "iteration_done", "done"]
+    # and the bus recorded the same per-agent order with contiguous seqs
+    for l in loops:
+        recs = sched.events.for_agent(l.agent)
+        assert [r.agent_seq for r in recs] == list(range(1, len(recs) + 1))
+
+
+def test_hung_worker_engine_does_not_block_other_workers(env):
+    """Acceptance scenario: one worker's engine hangs (fake engine
+    sleeping in create); the remaining workers' loops still start and
+    complete their full iteration budget."""
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    hung = HungCreateAPI()
+    swap_api(drv, 1, hung)
+    seed(drv)
+
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=4, iterations=2))
+    sched.start()
+    t = threading.Thread(target=sched.run, kwargs={"poll_s": 0.05})
+    t.start()
+    try:
+        w0 = [l for l in sched.loops if l.worker.id == "fake-0"]
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if w0 and all(l.status == "done" for l in w0):
+                break
+            time.sleep(0.05)
+        assert all(l.status == "done" for l in w0)
+        assert all(l.iteration == 2 for l in w0)
+        # the hung worker's agents never started an iteration
+        assert all(l.status == "pending"
+                   for l in sched.loops if l.worker.id == "fake-1")
+    finally:
+        sched.stop()
+        hung.release.set()
+        t.join(10.0)
+    assert not t.is_alive()
+    sched.cleanup()
+
+
+def test_stopped_scheduler_never_creates_late_orphans(env):
+    """A launch still queued behind a wedged lane when the user stops
+    the run must NOT create a container once the engine recovers --
+    cleanup already ran and could never remove it."""
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    hung = HungCreateAPI()
+    swap_api(drv, 1, hung)
+    seed(drv)
+
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=1))
+    sched.start()
+    t = threading.Thread(target=sched.run, kwargs={"poll_s": 0.05})
+    t.start()
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        w0 = [l for l in sched.loops if l.worker.id == "fake-0"]
+        if w0 and all(l.status == "done" for l in w0):
+            break
+        time.sleep(0.05)
+    sched.stop()
+    t.join(10.0)
+    sched.cleanup(remove_containers=True)
+    hung.release.set()          # engine "recovers" after cleanup
+    time.sleep(0.5)             # let the wedged lane drain its queue
+    assert hung.containers == {}    # no orphan was created
+    assert drv.apis[0].containers == {}  # and worker 0 was cleaned up
+
+
+def test_same_worker_agents_are_serialized_distinct_workers_overlap(env):
+    """Per-worker serialization: two agents packed on one worker never
+    overlap their creates on that engine, while the same load spread
+    over two workers does overlap."""
+    tenv, proj, cfg = env
+
+    class TracingAPI(FakeDockerAPI):
+        def __init__(self, trace):
+            super().__init__()
+            self.trace = trace
+
+        def container_create(self, name, config):
+            self.trace.append(("enter", time.monotonic()))
+            time.sleep(0.1)
+            try:
+                return super().container_create(name, config)
+            finally:
+                self.trace.append(("exit", time.monotonic()))
+
+    def overlap(trace) -> bool:
+        depth = 0
+        for kind, _ in sorted(trace, key=lambda r: r[1]):
+            depth += 1 if kind == "enter" else -1
+            if depth > 1:
+                return True
+        return False
+
+    # pack: both agents on worker 0 -> serialized
+    drv = FakeDriver(n_workers=1)
+    pack_trace: list = []
+    swap_api(drv, 0, TracingAPI(pack_trace))
+    seed(drv)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=1,
+                                             placement="pack"))
+    sched.start()
+    sched.run(poll_s=0.05)
+    sched.cleanup(remove_containers=True)
+    assert not overlap(pack_trace)
+
+    # spread: one agent per worker -> creates overlap in time
+    drv = FakeDriver(n_workers=2)
+    spread_trace: list = []
+    swap_api(drv, 0, TracingAPI(spread_trace))
+    swap_api(drv, 1, TracingAPI(spread_trace))
+    seed(drv)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=1))
+    sched.start()
+    sched.run(poll_s=0.05)
+    sched.cleanup(remove_containers=True)
+    assert overlap(spread_trace)
+
+
+# ----------------------------------------------------- exit accounting
+
+
+def test_missing_exit_code_on_stopped_container_is_failure(env):
+    """A stopped container whose state carries no ExitCode must read as
+    a FAILED iteration (the old ``int(state.get("ExitCode") or 0)``
+    silently mapped it to success)."""
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=1)
+    swap_api(drv, 0, NoExitCodeAPI())
+    seed(drv)
+
+    events = []
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=3),
+                          on_event=lambda a, e, d="": events.append((a, e, d)))
+    sched.start()
+    loops = sched.run(poll_s=0.05)
+    sched.cleanup(remove_containers=True)
+    assert loops[0].status == "failed"
+    assert loops[0].exit_codes == []      # never accounted as a success
+    assert any(e == "failed" and "exit code" in d for _, e, d in events)
+
+
+def test_unreadable_exit_code_is_failure(env):
+    """A daemon reporting a non-numeric ExitCode must fail the loop, not
+    crash the poll (which would retry forever with the loop 'running')."""
+    tenv, proj, cfg = env
+
+    class BadExitCodeAPI(FakeDockerAPI):
+        def container_inspect(self, cid):
+            info = super().container_inspect(cid)
+            if not info["State"]["Running"]:
+                info["State"]["ExitCode"] = "flaked"
+            return info
+
+    drv = FakeDriver(n_workers=1)
+    swap_api(drv, 0, BadExitCodeAPI())
+    seed(drv)
+    events = []
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=2),
+                          on_event=lambda a, e, d="": events.append((e, d)))
+    sched.start()
+    loops = sched.run(poll_s=0.05)
+    sched.cleanup(remove_containers=True)
+    assert loops[0].status == "failed"
+    assert any(e == "failed" and "unreadable exit code" in d
+               for e, d in events)
+
+
+def test_persistent_poll_crash_fails_loops_instead_of_spinning(env):
+    """A deterministic non-ClawkerError from the poll (engine bug) must
+    eventually fail the affected loops so run() terminates."""
+    tenv, proj, cfg = env
+
+    class CrashingListAPI(FakeDockerAPI):
+        def container_list(self, *, all=False, filters=None):
+            raise RuntimeError("malformed daemon state")
+
+    drv = FakeDriver(n_workers=1)
+    swap_api(drv, 0, CrashingListAPI())
+    seed(drv)
+    events = []
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=1),
+                          on_event=lambda a, e, d="": events.append((e, d)))
+    sched.start()
+    loops = sched.run(poll_s=0.02)     # must return, not spin forever
+    sched.cleanup()
+    assert loops[0].status == "failed"
+    assert any(e == "failed" and "poll crashed" in d for e, d in events)
+
+
+def test_batched_poll_uses_one_list_per_worker_per_tick(env):
+    """Polling cost: a tick lists each engine once (label-filtered)
+    instead of inspecting every agent -- inspects only accompany actual
+    iteration finishes, not steady-state running agents."""
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    seed(drv, behavior=exit_behavior(b"", 0, delay=0.3))
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=8, iterations=1))
+    sched.start()
+    sched.run(poll_s=0.05)
+    sched.cleanup(remove_containers=True)
+    assert all(l.status == "done" for l in sched.loops)
+    for api in drv.apis:
+        lists = api.calls_named("container_list")
+        assert lists, "batched poll never ran"
+        # every poll list is scoped to THIS loop run's label
+        for _, kw in lists:
+            labels = (kw.get("filters") or {}).get("label", [])
+            assert f"{consts.LABEL_LOOP}={sched.loop_id}" in labels
+        # the serial scheduler issued >= agents-per-worker inspects per
+        # tick; batched polling must stay well under that (4 agents x
+        # ~6 ticks of 0.3s/0.05s would be ~24 poll inspects alone)
+        polls = len(lists)
+        assert polls < 24
+
+
+# ------------------------------------------------------------ event bus
+
+
+def test_event_bus_orders_concurrent_emitters():
+    bus = EventBus()
+    n_threads, per_thread = 8, 50
+
+    def spam(i):
+        for k in range(per_thread):
+            bus.emit(f"agent-{i}", "tick", str(k))
+
+    threads = [threading.Thread(target=spam, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    recs = list(bus.history)
+    assert len(recs) == n_threads * per_thread
+    # global seq is gapless and strictly increasing in delivery order
+    assert [r.seq for r in recs] == list(range(1, len(recs) + 1))
+    # per-agent streams are contiguous and in emit order
+    for i in range(n_threads):
+        mine = bus.for_agent(f"agent-{i}")
+        assert [r.agent_seq for r in mine] == list(range(1, per_thread + 1))
+        assert [r.detail for r in mine] == [str(k) for k in range(per_thread)]
+
+
+def test_event_bus_sink_failure_is_contained():
+    boom = {"count": 0}
+
+    def sink(agent, event, detail):
+        boom["count"] += 1
+        raise RuntimeError("consumer crashed")
+
+    bus = EventBus(sink)
+    bus.emit("a", "x")
+    bus.emit("a", "y")        # keeps emitting despite the sink raising
+    assert bus.flush(timeout=5.0)
+    assert boom["count"] == 2
+    assert [r.event for r in bus.for_agent("a")] == ["x", "y"]
+
+
+def test_event_bus_blocked_sink_does_not_block_emitters():
+    """Delivery is decoupled from emit: a sink wedged on a slow consumer
+    must not stall the threads driving the control plane."""
+    release = threading.Event()
+    seen = []
+
+    def sink(agent, event, detail):
+        release.wait(10.0)
+        seen.append(event)
+
+    bus = EventBus(sink)
+    t0 = time.monotonic()
+    for k in range(20):
+        bus.emit("a", f"e{k}")
+    assert time.monotonic() - t0 < 1.0    # emits returned immediately
+    assert not bus.flush(timeout=0.2)     # sink really is stuck
+    release.set()
+    assert bus.flush(timeout=5.0)
+    assert seen == [f"e{k}" for k in range(20)]   # order preserved
