@@ -3,7 +3,8 @@
 Parity reference: pkg/whail (label-jailed engine over the moby SDK,
 pkg/whail/engine.go:32) + internal/docker middleware.  This build collapses
 the SDK dependency: ``HTTPDockerAPI`` speaks the Docker Engine HTTP API
-directly (unix socket, TCP, or an SSH-forwarded socket on a TPU-VM worker),
+directly (unix socket, TCP, or an SSH-forwarded socket on a TPU-VM worker)
+over a keep-alive ``ConnectionPool`` (docs/engine-connection-pool.md),
 and ``Engine`` enforces the managed-label jail above it.  ``FakeDockerAPI``
 is the in-process test seam (reference: pkg/whail/whailtest FakeAPIClient).
 
@@ -14,7 +15,9 @@ calls go through pkg/whail").
 
 from .api import Engine
 from .httpapi import HTTPDockerAPI
+from .pool import ConnectionPool
 from .fake import FakeDockerAPI, FakeContainer
 from .errors_map import APIError
 
-__all__ = ["Engine", "HTTPDockerAPI", "FakeDockerAPI", "FakeContainer", "APIError"]
+__all__ = ["Engine", "HTTPDockerAPI", "ConnectionPool", "FakeDockerAPI",
+           "FakeContainer", "APIError"]

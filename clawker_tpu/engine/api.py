@@ -454,3 +454,18 @@ class Engine:
 
     def info(self) -> dict:
         return self.api.info()
+
+    # ----------------------------------------------------------- lifecycle
+
+    def pool_stats(self) -> dict:
+        """Connection-pool telemetry from the underlying client (empty for
+        clients without a pool)."""
+        stats = getattr(self.api, "pool_stats", None)
+        return stats() if stats is not None else {}
+
+    def close(self) -> None:
+        """Drain-on-shutdown: tear down event streams and the client's
+        idle keep-alive connections.  Safe to call more than once."""
+        closer = getattr(self.api, "close", None)
+        if closer is not None:
+            closer()

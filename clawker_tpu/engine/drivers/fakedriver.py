@@ -33,3 +33,8 @@ class FakeDriver(RuntimeDriver):
     def api(self) -> FakeDockerAPI:
         """Default worker's fake API (single-worker tests)."""
         return self.apis[0]
+
+    def close(self) -> None:
+        for w in self._workers:
+            if w.engine is not None:
+                w.engine.close()

@@ -46,3 +46,11 @@ class LocalDriver(RuntimeDriver):
         if self._workers is None:
             return self.connect()
         return self._workers
+
+    def close(self) -> None:
+        """Drain each worker engine's keep-alive pool; a later workers()
+        call reconnects from scratch."""
+        for w in self._workers or []:
+            if w.engine is not None:
+                w.engine.close()
+        self._workers = None

@@ -61,6 +61,10 @@ class TPUVMDriver(RuntimeDriver):
 
     def close(self) -> None:
         for w in self._workers or []:
+            if w.engine is not None:
+                # drain pooled keep-alive sockets while the forward is
+                # still up, then tear down the ssh -N forward itself
+                w.engine.close()
             transport = getattr(w.engine, "transport", None)
             if transport is not None:
                 transport.close()

@@ -729,6 +729,15 @@ class FakeDockerAPI:
             for q in self._event_subs:
                 q.put(None)
 
+    def pool_stats(self) -> dict:
+        """Surface parity with HTTPDockerAPI: no sockets, all zeros."""
+        return {"dials": 0, "reuses": 0, "stale_retries": 0, "idle": 0}
+
+    def close(self) -> None:
+        """Surface parity with HTTPDockerAPI.close (drain-on-shutdown)."""
+        self._record("close")
+        self.close_events()
+
 
 def _match_filters(labels: dict[str, str], name: str, filters: dict | None) -> bool:
     if not filters:

@@ -9,6 +9,15 @@ socket, TCP, or an SSH-forwarded unix socket living on a TPU-VM worker
 Implements the subset of API v1.43 this framework uses.  All methods return
 parsed JSON trees (daemon-shaped); the typed/jailed layer lives above in
 ``api.Engine``.
+
+Unary calls ride a keep-alive connection pool (pool.ConnectionPool):
+checkout an idle persistent connection, send, check it back in on clean
+completion.  A request that fails on a *reused* connection (the daemon
+reaped the idle socket: BrokenPipeError / ECONNRESET / BadStatusLine) is
+retried exactly once on a fresh dial; a first-dial failure raises
+``DriverError`` immediately.  Streams, ``/events`` and hijacked
+attach/exec connections use dedicated sockets that are never pooled.
+See docs/engine-connection-pool.md.
 """
 
 from __future__ import annotations
@@ -18,21 +27,29 @@ import io
 import json
 import socket
 import struct
+import threading
 import urllib.parse
 from pathlib import Path
 from typing import Any, Callable, Iterator
 
-from ..errors import DriverError
+from ..errors import ClawkerError, DriverError
 from .errors_map import raise_for
+from .pool import ConnectionPool, _SockConnection  # noqa: F401 (re-export)
 
 API_PREFIX = "/v1.43"
+
+# Unary calls against a hung daemon must fail, not block a scheduler
+# lane forever; streams/hijacks clear this (pool.dedicated -> unbounded).
+DEFAULT_UNARY_TIMEOUT_S = 30.0
 
 SocketFactory = Callable[[], socket.socket]
 
 
-def unix_socket_factory(path: str | Path) -> SocketFactory:
+def unix_socket_factory(path: str | Path, *,
+                        timeout: float | None = DEFAULT_UNARY_TIMEOUT_S) -> SocketFactory:
     def connect() -> socket.socket:
         s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(timeout)
         s.connect(str(path))
         return s
 
@@ -44,17 +61,6 @@ def tcp_socket_factory(host: str, port: int) -> SocketFactory:
         return socket.create_connection((host, port), timeout=30)
 
     return connect
-
-
-class _SockConnection(http.client.HTTPConnection):
-    """HTTPConnection over an arbitrary pre-dialed socket."""
-
-    def __init__(self, factory: SocketFactory):
-        super().__init__("localhost")
-        self._factory = factory
-
-    def connect(self) -> None:  # type: ignore[override]
-        self.sock = self._factory()
 
 
 class HijackedStream:
@@ -128,15 +134,25 @@ class HijackedStream:
 class HTTPDockerAPI:
     """The concrete daemon client.  One instance per daemon endpoint."""
 
-    def __init__(self, factory: SocketFactory, *, api_prefix: str = API_PREFIX):
+    def __init__(self, factory: SocketFactory, *, api_prefix: str = API_PREFIX,
+                 pool_max_idle: int | None = None,
+                 pool_idle_ttl: float | None = None):
         self._factory = factory
         self._prefix = api_prefix
+        pool_kw: dict[str, Any] = {}
+        if pool_max_idle is not None:
+            pool_kw["max_idle"] = pool_max_idle
+        if pool_idle_ttl is not None:
+            pool_kw["idle_ttl"] = pool_idle_ttl
+        self._pool = ConnectionPool(factory, **pool_kw)
         self._event_conns: set = set()  # live /events connections (close_events)
+        self._event_lock = threading.Lock()
 
     # ------------------------------------------------------------ plumbing
 
-    def _url(self, path: str, query: dict[str, Any] | None = None) -> str:
-        url = self._prefix + path
+    def _url(self, path: str, query: dict[str, Any] | None = None, *,
+             versioned: bool = True) -> str:
+        url = (self._prefix if versioned else "") + path
         if query:
             q = {}
             for k, v in query.items():
@@ -160,9 +176,20 @@ class HTTPDockerAPI:
         body: Any = None,
         raw_body: bytes | None = None,
         headers: dict[str, str] | None = None,
+        versioned: bool = True,
+        dedicated: bool = False,
     ) -> Any:
-        conn = _SockConnection(self._factory)
-        hdrs = {"Host": "docker"}
+        """Unary call over a pooled keep-alive connection.
+
+        ``dedicated=True`` dials a never-pooled, read-unbounded socket for
+        unary ops whose response legitimately takes arbitrarily long
+        (wait / stop / restart); everything else checks a connection out
+        of the pool and returns it on clean completion.  A failure on a
+        REUSED connection -- the daemon reaped the idle socket between
+        requests -- is retried exactly once on a fresh dial; first-dial
+        failures raise ``DriverError`` unchanged.
+        """
+        hdrs = {"Host": "docker", "Connection": "keep-alive"}
         data: bytes | None = None
         if raw_body is not None:
             data = raw_body
@@ -172,14 +199,48 @@ class HTTPDockerAPI:
             hdrs["Content-Type"] = "application/json"
         if headers:
             hdrs.update(headers)
-        try:
-            conn.request(method, self._url(path, query), body=data, headers=hdrs)
-            resp = conn.getresponse()
-            payload = resp.read()
-        except (OSError, http.client.HTTPException) as e:
-            raise DriverError(f"daemon unreachable ({method} {path}): {e}") from e
-        finally:
+        url = self._url(path, query, versioned=versioned)
+        conn: _SockConnection | None = None
+        reused = False
+        retried = False
+        while True:
+            try:
+                if dedicated:
+                    conn, reused = self._pool.dedicated(), False
+                elif retried:
+                    conn, reused = self._pool.fresh(), False
+                else:
+                    conn, reused = self._pool.checkout()
+                conn.request(method, url, body=data, headers=hdrs)
+                resp = conn.getresponse()
+            except (OSError, http.client.HTTPException) as e:
+                if conn is not None:
+                    conn.close()
+                if reused and not retried and not isinstance(e, TimeoutError):
+                    # the daemon reaped the idle socket under us
+                    # (BrokenPipe / ECONNRESET / BadStatusLine): one
+                    # retry on a guaranteed-fresh dial.  A TimeoutError
+                    # is excluded: that is a SLOW daemon still executing
+                    # the request, and re-sending would run it twice.
+                    self._pool.note_stale_retry()
+                    retried = True
+                    continue
+                raise DriverError(f"daemon unreachable ({method} {path}): {e}") from e
+            try:
+                payload = resp.read()
+            except (OSError, http.client.HTTPException) as e:
+                # a status line arrived, so the daemon definitely executed
+                # the request: NEVER retry here -- re-sending a delivered
+                # non-idempotent request (create/kill/...) would run it
+                # twice.  Stale-socket reaping manifests before the status
+                # line, which the block above already handles.
+                conn.close()
+                raise DriverError(f"daemon unreachable ({method} {path}): {e}") from e
+            break
+        if dedicated or resp.will_close:
             conn.close()
+        else:
+            self._pool.checkin(conn)
         self._check(resp.status, payload, path)
         if not payload:
             return None
@@ -187,6 +248,44 @@ class HTTPDockerAPI:
         if ct.startswith("application/json"):
             return json.loads(payload)
         return payload
+
+    def _open_stream(
+        self,
+        method: str,
+        url: str,
+        *,
+        body: Any = None,
+        headers: dict[str, str] | None = None,
+        label: str = "",
+        check_path: str = "",
+    ) -> tuple[_SockConnection, http.client.HTTPResponse]:
+        """Dial a dedicated (never-pooled, read-unbounded) connection and
+        send one request on it, mapping dial/send failures to DriverError
+        and HTTP errors through _check.  Shared by streams/logs/build."""
+        conn: _SockConnection | None = None
+        try:
+            conn = self._pool.dedicated()
+            conn.request(method, url, body=body, headers=headers or {})
+            resp = conn.getresponse()
+        except (OSError, http.client.HTTPException) as e:
+            if conn is not None:
+                conn.close()
+            raise DriverError(f"daemon unreachable ({label}): {e}") from e
+        if resp.status >= 400:
+            payload = resp.read()
+            conn.close()
+            self._check(resp.status, payload, check_path)
+        return conn, resp
+
+    def pool_stats(self) -> dict:
+        """Connection-pool telemetry: dials / reuses / stale_retries / idle."""
+        return self._pool.stats()
+
+    def close(self) -> None:
+        """Drain-on-shutdown: tear down event streams and idle pooled
+        connections.  In-flight checkouts finish and are then dropped."""
+        self.close_events()
+        self._pool.close()
 
     def _stream(
         self,
@@ -199,8 +298,11 @@ class HTTPDockerAPI:
         headers: dict[str, str] | None = None,
         track_events: bool = False,
     ) -> Iterator[dict]:
-        """Request returning a stream of JSON objects (build/pull/events)."""
-        conn = _SockConnection(self._factory)
+        """Request returning a stream of JSON objects (build/pull/events).
+
+        Rides a dedicated, never-pooled connection with no read timeout:
+        ``/events`` legitimately sits silent for hours.
+        """
         hdrs = {"Host": "docker"}
         data: Any = None
         if raw_body is not None:
@@ -211,18 +313,12 @@ class HTTPDockerAPI:
             hdrs["Content-Type"] = "application/json"
         if headers:
             hdrs.update(headers)
-        try:
-            conn.request(method, self._url(path, query), body=data, headers=hdrs)
-            resp = conn.getresponse()
-        except (OSError, http.client.HTTPException) as e:
-            conn.close()
-            raise DriverError(f"daemon unreachable ({method} {path}): {e}") from e
-        if resp.status >= 400:
-            payload = resp.read()
-            conn.close()
-            self._check(resp.status, payload, path)
+        conn, resp = self._open_stream(
+            method, self._url(path, query), body=data, headers=hdrs,
+            label=f"{method} {path}", check_path=path)
         if track_events:
-            self._event_conns.add(conn)
+            with self._event_lock:
+                self._event_conns.add(conn)
 
         def gen() -> Iterator[dict]:
             buf = b""
@@ -243,7 +339,8 @@ class HTTPDockerAPI:
                 if buf.strip():
                     yield json.loads(buf)
             finally:
-                self._event_conns.discard(conn)
+                with self._event_lock:
+                    self._event_conns.discard(conn)
                 conn.close()
 
         return gen()
@@ -258,9 +355,10 @@ class HTTPDockerAPI:
         upgrade: str = "tcp",
         extra_headers: list[tuple[str, str]] | None = None,
     ) -> HijackedStream:
-        conn = _SockConnection(self._factory)
         data = json.dumps(body).encode() if body is not None else b""
+        conn: _SockConnection | None = None
         try:
+            conn = self._pool.dedicated()
             conn.putrequest("POST", self._url(path, query), skip_host=True)
             conn.putheader("Host", "docker")
             conn.putheader("Content-Type", "application/json")
@@ -274,7 +372,8 @@ class HTTPDockerAPI:
                 conn.send(data)
             resp = conn.getresponse()
         except (OSError, http.client.HTTPException) as e:
-            conn.close()
+            if conn is not None:
+                conn.close()
             raise DriverError(f"daemon unreachable (hijack {path}): {e}") from e
         if resp.status not in (101, 200):
             payload = resp.read()
@@ -299,16 +398,11 @@ class HTTPDockerAPI:
     # -------------------------------------------------------------- system
 
     def ping(self) -> bool:
-        conn = _SockConnection(self._factory)
         try:
-            conn.request("GET", "/_ping", headers={"Host": "docker"})
-            resp = conn.getresponse()
-            resp.read()
-            return resp.status == 200
-        except (OSError, http.client.HTTPException):
+            self._request("GET", "/_ping", versioned=False)
+            return True
+        except ClawkerError:  # unreachable (DriverError) or non-200 status
             return False
-        finally:
-            conn.close()
 
     def info(self) -> dict:
         return self._request("GET", "/info")
@@ -325,13 +419,17 @@ class HTTPDockerAPI:
         self._request("POST", f"/containers/{cid}/start")
 
     def container_stop(self, cid: str, timeout: int = 10) -> None:
-        self._request("POST", f"/containers/{cid}/stop", query={"t": timeout})
+        # dedicated: the daemon answers only after up to `t` seconds of
+        # graceful shutdown -- must not trip the pooled read timeout
+        self._request("POST", f"/containers/{cid}/stop", query={"t": timeout},
+                      dedicated=True)
 
     def container_kill(self, cid: str, signal: str = "KILL") -> None:
         self._request("POST", f"/containers/{cid}/kill", query={"signal": signal})
 
     def container_restart(self, cid: str, timeout: int = 10) -> None:
-        self._request("POST", f"/containers/{cid}/restart", query={"t": timeout})
+        self._request("POST", f"/containers/{cid}/restart", query={"t": timeout},
+                      dedicated=True)
 
     def container_pause(self, cid: str) -> None:
         self._request("POST", f"/containers/{cid}/pause")
@@ -340,7 +438,10 @@ class HTTPDockerAPI:
         self._request("POST", f"/containers/{cid}/unpause")
 
     def container_remove(self, cid: str, *, force: bool = False, volumes: bool = False) -> None:
-        self._request("DELETE", f"/containers/{cid}", query={"force": force, "v": volumes})
+        # dedicated: removing a container with large volumes can
+        # legitimately outlast the pooled unary read timeout
+        self._request("DELETE", f"/containers/{cid}",
+                      query={"force": force, "v": volumes}, dedicated=True)
 
     def container_rename(self, cid: str, new_name: str) -> None:
         self._request("POST", f"/containers/{cid}/rename", query={"name": new_name})
@@ -354,8 +455,12 @@ class HTTPDockerAPI:
         )
 
     def container_wait(self, cid: str, condition: str = "not-running") -> dict:
+        # dedicated: blocks until the container exits (the scheduler's
+        # waker threads park here for whole iterations) -- never pooled,
+        # never read-bounded
         return self._request(
-            "POST", f"/containers/{cid}/wait", query={"condition": condition}
+            "POST", f"/containers/{cid}/wait", query={"condition": condition},
+            dedicated=True,
         )
 
     def container_resize(self, cid: str, height: int, width: int) -> None:
@@ -381,18 +486,11 @@ class HTTPDockerAPI:
     def container_logs(
         self, cid: str, *, follow: bool = False, tail: str = "all"
     ) -> Iterator[bytes]:
-        conn = _SockConnection(self._factory)
         q = {"stdout": True, "stderr": True, "follow": follow, "tail": tail}
-        try:
-            conn.request("GET", self._url(f"/containers/{cid}/logs", q), headers={"Host": "docker"})
-            resp = conn.getresponse()
-        except (OSError, http.client.HTTPException) as e:
-            conn.close()
-            raise DriverError(f"daemon unreachable (logs): {e}") from e
-        if resp.status >= 400:
-            payload = resp.read()
-            conn.close()
-            self._check(resp.status, payload, f"/containers/{cid}/logs")
+        conn, resp = self._open_stream(
+            "GET", self._url(f"/containers/{cid}/logs", q),
+            headers={"Host": "docker"}, label="logs",
+            check_path=f"/containers/{cid}/logs")
 
         def gen() -> Iterator[bytes]:
             try:
@@ -407,11 +505,15 @@ class HTTPDockerAPI:
         return gen()
 
     def put_archive(self, cid: str, path: str, tar_bytes: bytes) -> None:
+        # dedicated: the daemon extracts the whole tar before replying --
+        # a large snapshot-workspace seed can outlast the pooled unary
+        # read timeout on a perfectly healthy daemon
         self._request(
             "PUT",
             f"/containers/{cid}/archive",
             query={"path": path},
             raw_body=tar_bytes,
+            dedicated=True,
         )
 
     def get_archive(self, cid: str, path: str) -> bytes:
@@ -461,8 +563,11 @@ class HTTPDockerAPI:
         )
 
     def image_remove(self, ref: str, *, force: bool = False) -> None:
+        # dedicated: deleting a multi-GB image's layers can outlast the
+        # pooled unary read timeout
         self._request(
-            "DELETE", f"/images/{urllib.parse.quote(ref, safe='')}", query={"force": force}
+            "DELETE", f"/images/{urllib.parse.quote(ref, safe='')}",
+            query={"force": force}, dedicated=True,
         )
 
     def image_build(
@@ -501,22 +606,10 @@ class HTTPDockerAPI:
         # t= repeats per tag; urlencode can't repeat via dict, append manually
         for t in tags:
             url += "&t=" + urllib.parse.quote(t, safe="")
-        conn = _SockConnection(self._factory)
-        try:
-            conn.request(
-                "POST",
-                url,
-                body=context_tar,
-                headers={"Host": "docker", "Content-Type": "application/x-tar"},
-            )
-            resp = conn.getresponse()
-        except (OSError, http.client.HTTPException) as e:
-            conn.close()
-            raise DriverError(f"daemon unreachable (build): {e}") from e
-        if resp.status >= 400:
-            payload = resp.read()
-            conn.close()
-            self._check(resp.status, payload, "/build")
+        conn, resp = self._open_stream(
+            "POST", url, body=context_tar,
+            headers={"Host": "docker", "Content-Type": "application/x-tar"},
+            label="build", check_path="/build")
 
         def gen() -> Iterator[dict]:
             buf = b""
@@ -544,7 +637,7 @@ class HTTPDockerAPI:
 
     def build_cancel(self, buildid: str) -> None:
         """Cancel an in-flight BuildKit build by its buildid."""
-        self._request("POST", self._url("/build/cancel", {"id": buildid}))
+        self._request("POST", "/build/cancel", query={"id": buildid})
 
     def image_pull(self, ref: str) -> Iterator[dict]:
         if ":" in ref.rsplit("/", 1)[-1]:
@@ -569,7 +662,9 @@ class HTTPDockerAPI:
         return self._request("GET", f"/volumes/{name}")
 
     def volume_remove(self, name: str, *, force: bool = False) -> None:
-        self._request("DELETE", f"/volumes/{name}", query={"force": force})
+        # dedicated: same slow-deletion story as container/image remove
+        self._request("DELETE", f"/volumes/{name}", query={"force": force},
+                      dedicated=True)
 
     # ------------------------------------------------------------ networks
 
@@ -606,8 +701,12 @@ class HTTPDockerAPI:
 
     def close_events(self) -> None:
         """Tear down live event streams so blocked readers unblock
-        (the Feeder's stop path; the fake exposes the same hook)."""
-        for conn in list(self._event_conns):
+        (the Feeder's stop path; the fake exposes the same hook).
+        Snapshot under the lock: stream generators concurrently discard
+        from the set as they wind down."""
+        with self._event_lock:
+            conns = list(self._event_conns)
+        for conn in conns:
             try:
                 conn.close()
             except Exception:

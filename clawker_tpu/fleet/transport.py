@@ -151,10 +151,8 @@ class SSHTransport:
         self._forwards: list[subprocess.Popen] = []
         self._rev_tags: set[str] = set()
         self._lock = threading.Lock()
-
-    # ------------------------------------------------------------ command
-
-    def ssh_base(self) -> list[str]:
+        # once, not per ssh invocation: every command used to re-mkdir the
+        # mux dir and rebuild the same argv
         self.mux_dir.mkdir(parents=True, exist_ok=True)
         base = [
             "ssh",
@@ -168,7 +166,12 @@ class SSHTransport:
         if self.tpu.ssh_key:
             base += ["-i", self.tpu.ssh_key]
         user = self.tpu.ssh_user or consts.TPU_SSH_USER_DEFAULT
-        return base + [f"{user}@{self.host}"]
+        self._ssh_base = base + [f"{user}@{self.host}"]
+
+    # ------------------------------------------------------------ command
+
+    def ssh_base(self) -> list[str]:
+        return list(self._ssh_base)
 
     def run(self, remote_cmd: str, *, input_bytes: bytes | None = None,
             timeout: float = 120.0) -> RunResult:
@@ -344,6 +347,7 @@ def connect_worker_engine(tpu: TPUSettings, host: str, index: int,
 
     mux = mux_dir if mux_dir is not None else state_dir() / consts.TPU_SSH_MUX_DIR
     transport = SSHTransport(tpu, host, index, mux_dir=mux, runner=runner)
+    engine = None
     try:
         local_sock = transport.forward_unix("/var/run/docker.sock")
         engine = Engine(HTTPDockerAPI(unix_socket_factory(local_sock)))
@@ -352,6 +356,8 @@ def connect_worker_engine(tpu: TPUSettings, host: str, index: int,
                 f"worker {index} ({host}): forwarded docker daemon not answering"
             )
     except Exception:
+        if engine is not None:
+            engine.close()  # drain any keep-alive socket on the forward
         transport.close()  # never orphan the ssh -N forward process
         raise
     engine.transport = transport  # keep the mux alive with the engine

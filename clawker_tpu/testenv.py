@@ -8,7 +8,10 @@ from __future__ import annotations
 
 import contextlib
 import os
+import socket
 import tempfile
+import threading
+import time
 from pathlib import Path
 
 from . import consts
@@ -77,3 +80,154 @@ class TestEnv(contextlib.AbstractContextManager):
             if local is not None:
                 (root / ".clawker.local.yaml").write_text(local)
         return main
+
+
+class StubDockerDaemon:
+    """Minimal keep-alive HTTP daemon over a unix socket (test/bench
+    support for the engine client's connection pool).
+
+    Answers EVERY request with one canned JSON body, so
+    ``HTTPDockerAPI`` exercises real sockets, wire framing and
+    keep-alive reuse without a real daemon behind them.  Counters:
+    ``connections`` (accepts) and ``requests`` (responses served).
+
+    ``max_requests_per_conn > 0`` closes the socket after N responses
+    WITHOUT advertising ``Connection: close`` -- models a daemon reaping
+    an idle keep-alive socket, which drives the client's
+    retry-once-on-stale path.
+
+    ``truncate_after > 0`` serves that many full responses per
+    connection, then answers with a status line + headers advertising
+    the full body but sends only half of it before closing -- models a
+    daemon dying mid-response AFTER executing the request (the case the
+    client must never retry).
+
+    ``delay_after > 0`` serves that many prompt responses per
+    connection, then sleeps ``response_delay_s`` before answering --
+    models a healthy-but-slow daemon (a client read timeout here must
+    NOT trigger a re-send).
+    """
+
+    __test__ = False  # pytest: helper, not a test class
+
+    def __init__(self, sock_path: str | Path, *, body: bytes | None = None,
+                 max_requests_per_conn: int = 0, truncate_after: int = 0,
+                 delay_after: int = 0, response_delay_s: float = 0.0):
+        self.sock_path = Path(sock_path)
+        self.body = (body if body is not None
+                     else b'{"Id": "stub", "StatusCode": 0, "Warnings": []}')
+        self.max_requests_per_conn = max_requests_per_conn
+        self.truncate_after = truncate_after
+        self.delay_after = delay_after
+        self.response_delay_s = response_delay_s
+        self.connections = 0
+        self.requests = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._srv: socket.socket | None = None
+        self._conns: set[socket.socket] = set()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "StubDockerDaemon":
+        self.sock_path.parent.mkdir(parents=True, exist_ok=True)
+        if self.sock_path.exists():
+            self.sock_path.unlink()
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(str(self.sock_path))
+        srv.listen(64)
+        srv.settimeout(0.2)
+        self._srv = srv
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(2.0)
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            with self._lock:
+                self.connections += 1
+                self._conns.add(conn)
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        served = 0
+        buf = b""
+        try:
+            while not self._stop.is_set():
+                while b"\r\n\r\n" not in buf:
+                    try:
+                        chunk = conn.recv(65536)
+                    except OSError:
+                        return
+                    if not chunk:
+                        return
+                    buf += chunk
+                head, _, buf = buf.partition(b"\r\n\r\n")
+                length = 0
+                for line in head.split(b"\r\n")[1:]:
+                    k, _, v = line.partition(b":")
+                    if k.strip().lower() == b"content-length":
+                        length = int(v.strip() or b"0")
+                while len(buf) < length:
+                    try:
+                        chunk = conn.recv(65536)
+                    except OSError:
+                        return
+                    if not chunk:
+                        return
+                    buf += chunk
+                buf = buf[length:]
+                # counted on receipt, before the response goes out: a
+                # client that has READ response N must find requests >= N
+                with self._lock:
+                    self.requests += 1
+                if self.delay_after and served >= self.delay_after:
+                    time.sleep(self.response_delay_s)
+                payload = self.body
+                truncate = bool(self.truncate_after
+                                and served >= self.truncate_after)
+                if truncate:
+                    payload = self.body[: len(self.body) // 2]
+                try:
+                    conn.sendall(
+                        b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Type: application/json\r\n"
+                        b"Content-Length: " + str(len(self.body)).encode()
+                        + b"\r\n\r\n" + payload)
+                except OSError:
+                    return
+                served += 1
+                if truncate:
+                    return
+                if self.max_requests_per_conn and served >= self.max_requests_per_conn:
+                    return
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass

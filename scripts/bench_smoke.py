@@ -12,6 +12,8 @@ Gates:
 - loop_fanout_p50_n8   <= 10 s     (BASELINE config 4 cold-start budget)
 - loop_poll_cost_n8    <= budget   (bench.POLL_COST_BUDGET calls/iter)
 - fleet_provision_wall >= 2x faster than serial (ISSUE 1 acceptance bar)
+- engine_dials_per_run >= 2x fewer dials than dial-per-request
+                                   (ISSUE 2 acceptance bar)
 
 Prints one JSON line; exit 1 on any gate failure.
 """
@@ -26,11 +28,13 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 FANOUT_BUDGET_S = 10.0
 PROVISION_MIN_SPEEDUP = 2.0
+DIALS_MIN_REDUCTION = 2.0
 
 
 def main() -> int:
     from bench import (
         POLL_COST_BUDGET,
+        bench_engine_dials,
         bench_fleet_provision,
         bench_loop_fanout,
         bench_loop_poll_cost,
@@ -39,6 +43,7 @@ def main() -> int:
     fanout_s = bench_loop_fanout(iters=1)
     poll = bench_loop_poll_cost()
     provision = bench_fleet_provision()
+    dials = bench_engine_dials()
 
     failures: list[str] = []
     if fanout_s > FANOUT_BUDGET_S:
@@ -54,11 +59,20 @@ def main() -> int:
         failures.append(
             f"fleet_provision_wall_n8 speedup {provision['speedup']}x "
             f"< {PROVISION_MIN_SPEEDUP}x over serial")
+    if dials["stale_retries"]:
+        failures.append(
+            f"engine_dials_per_run: {dials['stale_retries']} stale retries "
+            "against a healthy stub daemon")
+    if dials["dial_reduction"] < DIALS_MIN_REDUCTION:
+        failures.append(
+            f"engine_dials_per_run reduction {dials['dial_reduction']}x "
+            f"< {DIALS_MIN_REDUCTION}x over dial-per-request")
 
     print(json.dumps({
         "loop_fanout_p50_n8_ms": round(fanout_s * 1000, 1),
         "loop_poll_cost_n8": poll,
         "fleet_provision_wall_n8": provision,
+        "engine_dials_per_run": dials,
         "ok": not failures,
         "failures": failures,
     }))

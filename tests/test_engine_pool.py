@@ -1,0 +1,295 @@
+"""Engine HTTP client connection pool: keep-alive reuse over real sockets.
+
+Every test runs HTTPDockerAPI against the in-process StubDockerDaemon
+(clawker_tpu.testenv) -- a real unix socket speaking real HTTP/1.1 with
+keep-alive -- so checkout/checkin, stale-socket retry, TTL reaping and
+drain semantics are pinned at the wire, not against mocks.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from clawker_tpu.engine.httpapi import HTTPDockerAPI, unix_socket_factory
+from clawker_tpu.errors import DriverError
+from clawker_tpu.testenv import StubDockerDaemon
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    d = StubDockerDaemon(tmp_path / "stub.sock").start()
+    yield d
+    d.stop()
+
+
+def counting_factory(sock_path):
+    """(factory, dial-counter) -- counts factory invocations, i.e. dials."""
+    base = unix_socket_factory(sock_path)
+    dials = [0]
+
+    def factory():
+        dials[0] += 1
+        return base()
+
+    return factory, dials
+
+
+# ------------------------------------------------------------------ reuse
+
+
+def test_sequential_unary_calls_reuse_one_connection(daemon):
+    api = HTTPDockerAPI(unix_socket_factory(daemon.sock_path))
+    for _ in range(6):
+        api.info()
+    stats = api.pool_stats()
+    assert stats["dials"] == 1
+    assert stats["reuses"] == 5
+    assert daemon.connections == 1
+    assert daemon.requests == 6
+    api.close()
+
+
+def test_keep_alive_header_sent_and_pool_disabled_dials_per_request(daemon):
+    api = HTTPDockerAPI(unix_socket_factory(daemon.sock_path), pool_max_idle=0)
+    for _ in range(4):
+        api.info()
+    stats = api.pool_stats()
+    assert stats["dials"] == 4          # the pre-pool behavior, explicitly
+    assert stats["reuses"] == 0
+    assert daemon.connections == 4
+    api.close()
+
+
+def test_concurrent_checkout_from_scheduler_like_threads(daemon):
+    """8 lanes hammering one endpoint: every call succeeds, concurrent
+    checkouts never share a socket, and dials stay bounded by the lane
+    count (the pool's whole point under PR-1 parallelism)."""
+    api = HTTPDockerAPI(unix_socket_factory(daemon.sock_path))
+    calls_per_thread, n_threads = 10, 8
+    errors: list[Exception] = []
+
+    def lane():
+        try:
+            for _ in range(calls_per_thread):
+                api.container_inspect("c1")
+        except Exception as e:  # noqa: BLE001 - collected for the assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=lane) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+    assert not errors
+    stats = api.pool_stats()
+    total = calls_per_thread * n_threads
+    assert stats["dials"] + stats["reuses"] == total
+    assert stats["dials"] <= n_threads  # never more sockets than lanes
+    assert daemon.requests == total
+    api.close()
+
+
+# ------------------------------------------------------------ stale retry
+
+
+def test_request_on_reaped_idle_socket_retried_once_and_succeeds(tmp_path):
+    """The daemon closes keep-alive sockets after every response (without
+    advertising Connection: close): each call after the first picks up a
+    dead pooled socket, retries exactly once on a fresh dial, succeeds."""
+    daemon = StubDockerDaemon(tmp_path / "stub.sock",
+                              max_requests_per_conn=1).start()
+    try:
+        api = HTTPDockerAPI(unix_socket_factory(daemon.sock_path))
+        for _ in range(3):
+            assert api.info() is not None
+        stats = api.pool_stats()
+        assert stats["stale_retries"] == 2   # calls 2 and 3
+        assert stats["dials"] == 3           # one fresh dial per retry
+        api.close()
+    finally:
+        daemon.stop()
+
+
+def test_first_dial_failure_raises_driver_error_without_retry(tmp_path):
+    factory, dials = counting_factory(tmp_path / "nothing-listens-here.sock")
+    api = HTTPDockerAPI(factory)
+    with pytest.raises(DriverError, match=r"daemon unreachable \(GET /info\)"):
+        api.info()
+    assert dials[0] == 1  # no retry on a first-dial failure
+    assert api.pool_stats()["stale_retries"] == 0
+
+
+def test_failure_after_response_started_is_never_retried(tmp_path):
+    """A status line proves the daemon executed the request; dying
+    mid-body on a reused connection must raise, not re-send a delivered
+    non-idempotent request."""
+    daemon = StubDockerDaemon(tmp_path / "stub.sock", truncate_after=1).start()
+    try:
+        api = HTTPDockerAPI(unix_socket_factory(daemon.sock_path))
+        api.info()                  # full response; conn pooled
+        with pytest.raises(DriverError, match="daemon unreachable"):
+            api.container_kill("c1")   # reused conn dies mid-body
+        stats = api.pool_stats()
+        assert stats["stale_retries"] == 0
+        assert stats["dials"] == 1
+        assert daemon.requests == 2    # the kill was sent exactly once
+        api.close()
+    finally:
+        daemon.stop()
+
+
+def test_slow_daemon_timeout_on_reused_conn_is_never_retried(tmp_path):
+    """A read timeout is a SLOW daemon still executing the request, not a
+    reaped socket: re-sending would run the request twice."""
+    daemon = StubDockerDaemon(tmp_path / "stub.sock",
+                              delay_after=1, response_delay_s=1.0).start()
+    try:
+        api = HTTPDockerAPI(unix_socket_factory(daemon.sock_path, timeout=0.2))
+        api.info()                  # prompt response; conn pooled
+        with pytest.raises(DriverError, match="daemon unreachable"):
+            api.container_kill("c1")   # reused conn, daemon slow
+        stats = api.pool_stats()
+        assert stats["stale_retries"] == 0
+        assert stats["dials"] == 1
+        assert daemon.requests == 2    # the kill was sent exactly once
+        api.close()
+    finally:
+        daemon.stop()
+
+
+def test_stale_retry_whose_fresh_dial_fails_raises_driver_error(tmp_path):
+    daemon = StubDockerDaemon(tmp_path / "stub.sock").start()
+    factory, dials = counting_factory(daemon.sock_path)
+    api = HTTPDockerAPI(factory)
+    api.info()                      # one pooled connection now idle
+    daemon.stop()                   # socket gone AND no daemon to redial
+    with pytest.raises(DriverError, match="daemon unreachable"):
+        api.info()
+    stats = api.pool_stats()
+    assert stats["stale_retries"] == 1
+    assert dials[0] == 2            # original + exactly one fresh attempt
+
+
+# -------------------------------------------------- dedicated connections
+
+
+def test_streams_and_hijacks_never_enter_the_pool(daemon):
+    api = HTTPDockerAPI(unix_socket_factory(daemon.sock_path))
+    api.info()                                    # one pooled conn
+    assert api.pool_stats()["idle"] == 1
+
+    list(api.container_logs("c1"))                # stream: dedicated
+    stream = api.container_attach("c1", tty=True)  # hijack: dedicated
+    stream.close()
+    list(api.events())                            # /events: dedicated
+
+    stats = api.pool_stats()
+    assert stats["idle"] == 1                     # none of them was pooled
+    assert stats["dials"] == 4
+    assert stats["reuses"] == 0
+    api.close()
+
+
+def test_blocking_unary_ops_use_dedicated_unpooled_sockets(daemon):
+    """wait/stop/restart park on the daemon for arbitrarily long -- they
+    must not consume pool slots nor inherit the unary read timeout."""
+    api = HTTPDockerAPI(unix_socket_factory(daemon.sock_path))
+    api.container_wait("c1")
+    api.container_stop("c1")
+    assert api.pool_stats()["idle"] == 0
+    assert api.pool_stats()["dials"] == 2
+    api.info()
+    assert api.pool_stats()["idle"] == 1
+    api.close()
+
+
+def test_stream_socket_has_no_read_timeout(daemon):
+    """unix_socket_factory bounds unary reads (hung-daemon protection);
+    dedicated stream sockets must clear that back to unbounded."""
+    api = HTTPDockerAPI(unix_socket_factory(daemon.sock_path))
+    conn = api._pool.dedicated()
+    assert conn.sock.gettimeout() is None
+    conn.close()
+    conn2, _ = api._pool.checkout()
+    conn2.connect()
+    assert conn2.sock.gettimeout() is not None
+    conn2.close()
+    api.close()
+
+
+# --------------------------------------------------------- ttl and drain
+
+
+def test_idle_connections_past_ttl_are_reaped(daemon):
+    api = HTTPDockerAPI(unix_socket_factory(daemon.sock_path),
+                        pool_idle_ttl=0.05)
+    api.info()
+    time.sleep(0.12)
+    api.info()                       # idle socket aged out -> fresh dial
+    stats = api.pool_stats()
+    assert stats["dials"] == 2
+    assert stats["reuses"] == 0
+    api.close()
+
+
+def test_close_drains_idle_connections(daemon):
+    api = HTTPDockerAPI(unix_socket_factory(daemon.sock_path))
+    api.info()
+    assert api.pool_stats()["idle"] == 1
+    api.close()
+    assert api.pool_stats()["idle"] == 0
+    # a drained client still answers (fresh dial), but never re-pools
+    api.info()
+    assert api.pool_stats()["idle"] == 0
+
+
+def test_engine_close_and_pool_stats_pass_through(daemon):
+    from clawker_tpu.engine.api import Engine
+
+    eng = Engine(HTTPDockerAPI(unix_socket_factory(daemon.sock_path)))
+    assert eng.ping()
+    assert eng.pool_stats()["dials"] == 1
+    eng.close()
+    assert eng.pool_stats()["idle"] == 0
+
+
+def test_fake_api_matches_close_surface():
+    from clawker_tpu.engine.api import Engine
+    from clawker_tpu.engine.fake import FakeDockerAPI
+
+    eng = Engine(FakeDockerAPI())
+    assert eng.pool_stats() == {"dials": 0, "reuses": 0,
+                                "stale_retries": 0, "idle": 0}
+    eng.close()  # must not raise
+    assert eng.api.calls_named("close")
+
+
+def test_fake_driver_close_closes_engines():
+    from clawker_tpu.engine.drivers import FakeDriver
+
+    drv = FakeDriver(n_workers=2)
+    drv.close()
+    for api in drv.apis:
+        assert api.calls_named("close")
+
+
+# ------------------------------------------------------------- telemetry
+
+
+def test_dials_ride_the_phases_stopwatch(daemon):
+    from clawker_tpu.util import phases
+
+    api = HTTPDockerAPI(unix_socket_factory(daemon.sock_path))
+    phases.enable()
+    try:
+        for _ in range(3):
+            api.info()
+        counts = phases.counts()
+    finally:
+        totals = phases.disable()
+    assert totals.get("engine.dial", 0) > 0
+    assert counts.get("engine.dial") == 1  # one dial, two reuses
+    api.close()
