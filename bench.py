@@ -36,6 +36,13 @@ Added (connection-pool PR):
   mux); vs_baseline is the dial reduction over the dial-per-request
   client (bar: >= 2x).
 
+Added (health & failover PR):
+- failover_detect_to_restart_s -- kill one fake worker mid-loop under
+  `--failover migrate`; wall seconds from the death to the first
+  migrated agent's next iteration start, with every loop still
+  reaching its budget (bar: 5 s -- recovery must undercut the 10 s
+  cold-start budget or failover is pointless).
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "extra": [...]}.  vs_baseline > 1 (or == 1.0 for pass rates) means
 within budget; bigger is better.
@@ -252,7 +259,11 @@ def bench_loop_poll_cost(n: int = 8, iterations: int = 2) -> dict:
                               LoopSpec(parallel=n, iterations=iterations))
         sched.start()
         sched.run(poll_s=0.05)
-        lists = sum(len(api.calls_named("container_list")) for api in drv.apis)
+        # health probes also list (all=False); the poll cost is the
+        # scheduler's all=True batched lists
+        lists = sum(1 for api in drv.apis
+                    for _, kw in api.calls_named("container_list")
+                    if kw.get("all"))
         inspects = sum(len(api.calls_named("container_inspect"))
                        for api in drv.apis)
         waits = sum(len(api.calls_named("container_wait")) for api in drv.apis)
@@ -311,6 +322,89 @@ def bench_fleet_provision(n: int = 8, per_call_delay: float = 0.02) -> dict:
         "speedup": round(serial / wall, 2) if wall > 0 else 0.0,
         "workers": n,
         "ok": ok,
+    }
+
+
+def bench_failover(n_loops: int = 8, n_workers: int = 4,
+                   iterations: int = 4) -> dict:
+    """failover_detect_to_restart_s: kill one fake worker mid-loop under
+    ``--failover migrate`` and measure death -> the first migrated
+    agent's next iteration START (detection + breaker trip + orphan +
+    re-place + create + bootstrap on the new worker).  Budget: the
+    worker-death recovery must stay well under the 10 s cold-start
+    budget -- a dead worker costing more than a cold start would make
+    failover pointless.
+    """
+    import threading
+
+    from clawker_tpu import consts
+    from clawker_tpu.config import load_config
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.engine.fake import exit_behavior
+    from clawker_tpu.health import BreakerConfig, HealthConfig
+    from clawker_tpu.loop import LoopScheduler, LoopSpec
+    from clawker_tpu.testenv import TestEnv
+
+    victim = 1
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: benchloop\n")
+        cfg = load_config(proj)
+        drv = FakeDriver(n_workers=n_workers)
+        for api in drv.apis:
+            api.add_image("clawker-benchloop:default")
+            api.set_behavior("clawker-benchloop:default",
+                             exit_behavior(b"", 0, delay=0.1))
+        migrated: set = set()
+        restart_evt = threading.Event()
+        t_restart = [0.0]
+
+        def on_event(agent, event, detail=""):
+            if event == "migrated":
+                migrated.add(agent)
+            elif (event == "iteration_start" and agent in migrated
+                  and not restart_evt.is_set()):
+                t_restart[0] = time.perf_counter()
+                restart_evt.set()
+
+        sched = LoopScheduler(
+            cfg, drv,
+            LoopSpec(parallel=n_loops, iterations=iterations,
+                     failover="migrate"),
+            on_event=on_event,
+            health_config=HealthConfig(
+                probe_interval_s=0.05, probe_deadline_s=0.5,
+                breaker=BreakerConfig(failure_threshold=3,
+                                      backoff_base_s=0.05,
+                                      backoff_max_s=0.2)))
+        sched.start()
+        runner = threading.Thread(target=sched.run,
+                                  kwargs={"poll_s": 0.05}, daemon=True)
+        runner.start()
+        deadline = time.monotonic() + 20.0
+        vid = drv.workers()[victim].id
+        while time.monotonic() < deadline:     # victim must be mid-loop
+            if any(l.status == "running" and l.worker.id == vid
+                   for l in sched.loops):
+                break
+            time.sleep(0.01)
+        t_kill = time.perf_counter()
+        drv.inject_fault(victim, "refuse")
+        restart_evt.wait(20.0)
+        runner.join(30.0)
+        all_done = bool(sched.loops) and all(
+            l.status == "done" and l.iteration == iterations
+            for l in sched.loops)
+        migrations = sum(l.migrations for l in sched.loops)
+        sched.cleanup(remove_containers=True)
+    detect = (t_restart[0] - t_kill) if restart_evt.is_set() else -1.0
+    return {
+        "detect_to_restart_s": round(detect, 3),
+        "all_loops_done": all_done,
+        "migrations": migrations,
+        "loops": n_loops,
+        "workers": n_workers,
     }
 
 
@@ -507,6 +601,7 @@ def previous_round_p50() -> float:
 
 
 POLL_COST_BUDGET = 12.0       # control-plane calls per agent iteration
+FAILOVER_BUDGET_S = 5.0       # worker death -> first migrated iteration
 
 
 def main() -> None:
@@ -517,6 +612,7 @@ def main() -> None:
     fanout_s = bench_loop_fanout()
     poll_cost = bench_loop_poll_cost()
     provision = bench_fleet_provision()
+    failover = bench_failover()
     dials = bench_engine_dials()
     anom = bench_anomaly()
 
@@ -545,6 +641,15 @@ def main() -> None:
          # means the concurrency pass holds its acceptance bar
          "vs_baseline": provision["speedup"] if provision["ok"] else 0.0,
          "detail": provision},
+        {"metric": "failover_detect_to_restart_s",
+         "value": failover["detect_to_restart_s"], "unit": "s",
+         # a failed scenario (no migration, loops short of budget, or a
+         # negative detect) must read as FAILED, never as within budget
+         "vs_baseline": (round(
+             FAILOVER_BUDGET_S / max(failover["detect_to_restart_s"], 1e-9), 1)
+             if failover["all_loops_done"]
+             and failover["detect_to_restart_s"] > 0 else 0.0),
+         "detail": failover},
         {"metric": "engine_dials_per_run", "value": dials["dials_pooled"],
          "unit": "dials",
          # vs_baseline IS the dial reduction over the dial-per-request

@@ -94,25 +94,101 @@ def fleet_provision(f: Factory, dry_run, no_firewall, no_cp, only, jobs):
             click.echo(f"worker {index}: {mark} {r.name}"
                        + (f": {r.detail}" if r.detail else ""))
 
+    def on_report(report):
+        """Per-worker summary the moment THAT worker finishes -- slow
+        workers must not gate the fast workers' verdicts (the streaming
+        behavior docs/loop-parallel.md promises)."""
+        if report.ok:
+            line = f"worker {report.index} ({report.host}): ok"
+        else:
+            # the streamed '!' line may be interleaved far above: the
+            # summary must carry the failure on its own
+            bad = next((r for r in report.results if not r.ok), None)
+            why = ""
+            if bad is not None:
+                why = f" at {bad.name}" + (f": {bad.detail}" if bad.detail else "")
+            line = f"worker {report.index} ({report.host}): FAILED{why}"
+        with echo_lock:
+            click.echo(line)
+
     reports = provision_fleet(
         transports, repo_root,
         with_firewall=not no_firewall, with_cp=not no_cp,
         monitor=f.config.settings.monitoring.enable,
-        max_workers=max(1, jobs), on_step=on_step)
-    failed = 0
-    for report in reports:
-        if report.ok:
-            click.echo(f"worker {report.index} ({report.host}): ok")
-            continue
-        # the streamed '!' line may be interleaved far above: the final
-        # summary must carry the failure on its own
-        bad = next((r for r in report.results if not r.ok), None)
-        why = ""
-        if bad is not None:
-            why = f" at {bad.name}" + (f": {bad.detail}" if bad.detail else "")
-        click.echo(f"worker {report.index} ({report.host}): FAILED{why}")
-        failed += 1
-    if failed:
+        max_workers=max(1, jobs), on_step=on_step, on_report=on_report)
+    if any(not r.ok for r in reports):
+        raise SystemExit(1)
+
+
+_HEALTH_COLUMNS = ("WORKER", "STATE", "P50MS", "P95MS", "PROBES", "FAILS",
+                   "ORPHANED", "MIG-OUT", "MIG-IN", "LAST-ERROR")
+
+
+def _health_rows(stats: list[dict]) -> list[str]:
+    lines = ["\t".join(_HEALTH_COLUMNS)]
+    for s in stats:
+        lines.append("\t".join(str(x) for x in (
+            s["worker"], s["state"], s["probe_p50_ms"], s["probe_p95_ms"],
+            s["probes"], s["probe_failures"], s["orphaned"],
+            s["migrations_out"], s["migrations_in"],
+            (s["last_error"] or "-")[:60])))
+    return lines
+
+
+@fleet_group.command("health")
+@click.option("--probes", type=int, default=3,
+              help="Probe rounds before the one-shot verdict.")
+@click.option("--watch", is_flag=True,
+              help="Keep probing and re-print the table every interval.")
+@click.option("--interval", type=float, default=2.0,
+              help="Probe/refresh interval seconds (with --watch).")
+@click.option("--format", "fmt", type=click.Choice(["table", "json"]),
+              default="table")
+@pass_factory
+def fleet_health(f: Factory, probes, watch, interval, fmt):
+    """Per-worker breaker state, probe latency, and failover counters.
+
+    Probes every worker of the active runtime driver through the same
+    probe hook and circuit breakers `clawker loop --failover` uses
+    (docs/fleet-health.md).  One-shot by default: exits non-zero when
+    any worker's breaker is not closed.
+    """
+    import json as _json
+    import time as _time
+
+    from ..health import BreakerConfig, HealthConfig, HealthMonitor
+
+    # one-shot: the breaker must be able to open within the rounds the
+    # user asked for, or `--probes 1` would report a dead fleet healthy
+    threshold = (BreakerConfig.failure_threshold if watch
+                 else max(1, min(BreakerConfig.failure_threshold, probes)))
+    cfg = HealthConfig(probe_interval_s=max(0.1, interval),
+                       probe_deadline_s=max(1.0, min(interval, 5.0)),
+                       breaker=BreakerConfig(failure_threshold=threshold,
+                                             backoff_base_s=max(0.5, interval)))
+    mon = HealthMonitor(f.driver, config=cfg)
+
+    def emit() -> list[dict]:
+        stats = mon.stats()
+        if fmt == "json":
+            click.echo(_json.dumps(stats, indent=2))
+        else:
+            for line in _health_rows(stats):
+                click.echo(line)
+        return stats
+
+    if watch:
+        try:
+            while True:
+                mon.probe_all()
+                emit()
+                _time.sleep(max(0.1, interval))
+        except KeyboardInterrupt:
+            return
+    for _ in range(max(1, probes)):
+        mon.probe_all()
+    stats = emit()
+    if any(s["state"] != "closed" for s in stats):
         raise SystemExit(1)
 
 

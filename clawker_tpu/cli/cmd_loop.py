@@ -33,11 +33,21 @@ pass_factory = click.make_pass_decorator(Factory)
 @click.option("--worktrees/--no-worktrees", default=False,
               help="One git worktree per agent loop.")
 @click.option("--env", "env_kv", multiple=True, help="KEY=VAL extra agent env.")
+@click.option("--failover", type=click.Choice(["migrate", "wait", "fail"]),
+              default=None,
+              help="When a worker's health breaker opens: migrate its loops "
+                   "to the healthiest worker (default), wait for recovery, "
+                   "or fail them.")
+@click.option("--orphan-grace", type=float, default=None,
+              help="Seconds an orphaned loop may wait for a healthy "
+                   "placement before failing (default 600, 0 = fail "
+                   "immediately; bounds a run against a fleet that "
+                   "never recovers).")
 @click.option("--json", "as_json", is_flag=True, help="Final status as JSON.")
 @click.option("--keep", is_flag=True, help="Keep containers after the run.")
 @pass_factory
 def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
-             worktrees, env_kv, as_json, keep):
+             worktrees, env_kv, failover, orphan_grace, as_json, keep):
     """Fan autonomous agent loops across the runtime's workers."""
     env = {}
     for kv in env_kv:
@@ -54,6 +64,8 @@ def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
         prompt=prompt,
         worktrees=worktrees,
         env=env,
+        failover=failover or defaults.failover,
+        orphan_grace_s=orphan_grace,
     )
 
     live = f.streams.is_stdout_tty() and not as_json
@@ -100,7 +112,8 @@ def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
     signal.signal(signal.SIGTERM, lambda *_: sched.stop())
     click.echo(
         f"loop {sched.loop_id}: {spec.parallel} agent(s), "
-        f"{spec.iterations or 'unbounded'} iteration(s), {spec.placement} placement",
+        f"{spec.iterations or 'unbounded'} iteration(s), {spec.placement} "
+        f"placement, {spec.failover} failover",
         err=True,
     )
     sched.start()
@@ -125,7 +138,9 @@ def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
             codes = ",".join(map(str, l.exit_codes)) or "-"
             click.echo(f"{l.agent}\t{l.worker.id}\t{l.status}\t"
                        f"iters={l.iteration}\texits={codes}")
-    if any(l.status == "failed" for l in loops):
+    # orphaned loops never completed their budget (worker died, no
+    # failover outcome before stop): that is not a success either
+    if any(l.status in ("failed", "orphaned") for l in loops):
         raise SystemExit(1)
 
 

@@ -363,6 +363,7 @@ class LoopSettings:
     max_iterations: int = 0         # 0 = unbounded
     idle_exit_s: float = 300.0
     placement: str = "spread"       # spread | pack
+    failover: str = "migrate"       # migrate | wait | fail (worker death)
 
 
 @dataclass

@@ -30,7 +30,9 @@ class Worker:
 
     def require_engine(self) -> Engine:
         if self.engine is None:
-            raise DriverError(f"worker {self.id}: engine not connected")
+            why = self.meta.get("dial_error", "")
+            raise DriverError(f"worker {self.id}: engine not connected"
+                              + (f" ({why})" if why else ""))
         return self.engine
 
 
@@ -61,6 +63,27 @@ class RuntimeDriver:
     def engine(self) -> Engine:
         """Engine of the default worker (single-daemon callers)."""
         return self.default_worker().require_engine()
+
+    def probe(self, worker: Worker) -> None:
+        """One lightweight health round-trip against the worker's engine;
+        raises on any failure.  ``ping`` proves the daemon answers at
+        all, the label-jailed ``list_containers`` proves it can serve a
+        real (filtered) query -- the pair is what the scheduler's control
+        plane actually depends on.  Deadline enforcement is the caller's
+        job: health.monitor runs probes under a hard per-attempt deadline
+        so a wedged engine call reads as a failure, not a stall.
+        """
+        engine = worker.require_engine()
+        if not engine.ping():
+            raise DriverError(f"worker {worker.id}: engine ping failed")
+        engine.list_containers(all=False)
+
+    def diagnose(self, worker: Worker) -> str:
+        """Best-effort one-liner on WHY a probe is failing, consulted by
+        the health monitor when a probe overruns its deadline (the probe
+        itself never got to say).  Must be cheap and bounded; empty
+        string = nothing to add."""
+        return ""
 
     def close(self) -> None:
         pass

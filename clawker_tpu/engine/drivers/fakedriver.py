@@ -1,10 +1,91 @@
-"""Fake driver: N in-process fake daemons (test seam for multi-worker paths)."""
+"""Fake driver: N in-process fake daemons (test seam for multi-worker paths).
+
+Per-worker fault injection rides a :class:`_FaultGate` between each
+worker's ``Engine`` and its ``FakeDockerAPI``: tests (and the failover
+bench) kill, wedge, or flap one worker's daemon without touching the
+fake's semantic state, then revive it -- the seam the health subsystem's
+probes, breakers, and the scheduler's migration path are tested through.
+"""
 
 from __future__ import annotations
 
+import threading
+
+from ...errors import DriverError
 from ..api import Engine
 from ..fake import FakeDockerAPI
 from .base import RuntimeDriver, Worker
+
+# a wedged call must eventually die even if the test forgets to revive
+# the worker (daemon threads would otherwise pile up across a session)
+WEDGE_ABANDON_S = 60.0
+
+FAULT_KINDS = ("refuse", "wedge", "flap")
+
+
+class _FaultGate:
+    """Injectable fault seam in front of one worker's FakeDockerAPI.
+
+    - ``refuse``: every call raises DriverError immediately (dial
+      refusal: daemon process gone, socket forward torn down).
+    - ``wedge``: every call blocks until the fault clears (hung daemon:
+      probes hit their deadline, lanes wedge).
+    - ``flap``: every other call refuses (a worker bouncing between
+      alive and dead -- the breaker must quarantine it, not bounce
+      loops on and off it).
+
+    Lifecycle/telemetry passthroughs (``close``/``close_events``/
+    ``pool_stats``) are never gated: draining a dead worker's engine on
+    shutdown must not raise.
+    """
+
+    _UNGATED = {"close", "close_events", "pool_stats"}
+
+    def __init__(self, inner: FakeDockerAPI):
+        self.inner = inner
+        self._mode: str | None = None
+        self._cleared = threading.Event()
+        self._cleared.set()
+        self._lock = threading.Lock()
+        self._calls = 0
+
+    def set_fault(self, mode: str | None) -> None:
+        if mode is not None and mode not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {mode!r} "
+                             f"(expected {'|'.join(FAULT_KINDS)})")
+        with self._lock:
+            # mode and event flip together: publishing 'wedge' before
+            # clearing the event would let a concurrent call slip
+            # through the wedge ungated
+            self._mode = mode
+            if mode == "wedge":
+                self._cleared.clear()
+            else:
+                self._cleared.set()
+
+    def _gate(self) -> None:
+        with self._lock:
+            mode = self._mode
+            self._calls += 1
+            n = self._calls
+        if mode == "refuse":
+            raise DriverError("injected fault: connection refused")
+        if mode == "wedge":
+            if not self._cleared.wait(WEDGE_ABANDON_S):
+                raise DriverError("injected fault: wedged (never revived)")
+        if mode == "flap" and n % 2:
+            raise DriverError("injected fault: flapping connection refused")
+
+    def __getattr__(self, name: str):
+        attr = getattr(self.inner, name)
+        if not callable(attr) or name in self._UNGATED:
+            return attr
+
+        def call(*args, **kwargs):
+            self._gate()
+            return attr(*args, **kwargs)
+
+        return call
 
 
 class FakeDriver(RuntimeDriver):
@@ -13,14 +94,15 @@ class FakeDriver(RuntimeDriver):
 
     def __init__(self, n_workers: int = 1):
         self.apis = [FakeDockerAPI() for _ in range(n_workers)]
+        self.gates = [_FaultGate(api) for api in self.apis]
         self._workers = [
             Worker(
                 id=f"fake-{i}",
                 index=i,
                 hostname=f"fake-worker-{i}",
-                engine=Engine(api),
+                engine=Engine(gate),
             )
-            for i, api in enumerate(self.apis)
+            for i, gate in enumerate(self.gates)
         ]
 
     def connect(self) -> list[Worker]:
@@ -33,6 +115,14 @@ class FakeDriver(RuntimeDriver):
     def api(self) -> FakeDockerAPI:
         """Default worker's fake API (single-worker tests)."""
         return self.apis[0]
+
+    def inject_fault(self, index: int, kind: str = "refuse") -> None:
+        """Kill/wedge/flap worker ``index``'s daemon (see _FaultGate)."""
+        self.gates[index].set_fault(kind)
+
+    def clear_fault(self, index: int) -> None:
+        """Revive worker ``index`` (blocked 'wedge' calls proceed)."""
+        self.gates[index].set_fault(None)
 
     def close(self) -> None:
         for w in self._workers:

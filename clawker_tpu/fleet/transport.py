@@ -178,6 +178,20 @@ class SSHTransport:
         return self.runner.run(self.ssh_base() + [remote_cmd],
                                input_bytes=input_bytes, timeout=timeout)
 
+    def probe(self, *, timeout: float = 5.0) -> float:
+        """One control-channel round trip (``true`` over the mux);
+        returns latency in seconds, raises TransportError on failure.
+        The fleet health prober's SSH-level signal: distinguishes a dead
+        forwarded daemon (engine probe fails, this succeeds) from a dead
+        worker VM (both fail)."""
+        t0 = time.monotonic()
+        res = self.run("true", timeout=timeout)
+        if res.rc != 0:
+            raise TransportError(
+                f"worker {self.index} ({self.host}): ssh probe rc={res.rc}: "
+                f"{res.err.strip() or res.out.strip()}")
+        return time.monotonic() - t0
+
     def check(self, remote_cmd: str, *, timeout: float = 120.0) -> str:
         res = self.run(remote_cmd, timeout=timeout)
         if res.rc != 0:

@@ -39,6 +39,26 @@ Per-iteration context rides a small state file written into the
 container between restarts (env is immutable after create), so the
 harness can see iteration number + loop id.  Consecutive-failure
 ceiling stops a crash-looping agent from burning a worker forever.
+
+Failover (the health subsystem, ``--failover``): a
+:class:`~clawker_tpu.health.HealthMonitor` probes every pod worker while
+run() drives the loops; when a worker's circuit breaker opens (K probe
+failures, an unreachable poll, or a wedged lane), the loops placed
+there are marked ``orphaned``, their containers best-effort halted on a
+side thread (stop rides a dedicated never-pooled socket -- the pool of
+a dead worker is exactly what not to wait on), and the policy decides
+what happens next:
+
+- ``migrate`` (default): re-place each orphan onto the least-loaded
+  worker whose breaker is CLOSED (half-open workers are mid-trial and
+  never receive migrations), preserving iteration count and the
+  consecutive-failure ceiling across the move.
+- ``wait``: orphans stay put until their worker's breaker closes again,
+  then resume on it.
+- ``fail``: orphans fail immediately (crash-only accounting).
+
+A recovered worker (open -> half-open -> closed) rejoins the placement
+set automatically.
 """
 
 from __future__ import annotations
@@ -47,6 +67,7 @@ import io
 import queue
 import tarfile
 import threading
+import time
 from concurrent.futures import Future
 from concurrent.futures import wait as futures_wait
 from dataclasses import dataclass, field
@@ -55,7 +76,8 @@ from pathlib import Path
 from .. import consts, logsetup
 from ..config import Config
 from ..engine.drivers import RuntimeDriver, Worker
-from ..errors import ClawkerError
+from ..errors import ClawkerError, DriverError, NotFoundError
+from ..health import BREAKER_CLOSED, BREAKER_OPEN, HealthConfig, HealthMonitor
 from ..monitor.events import EventBus
 from ..runtime.orchestrate import AgentRuntime, CreateOptions
 from ..util import ids
@@ -66,9 +88,34 @@ FAILURE_CEILING = 3          # consecutive nonzero exits -> loop failed
 LOOP_STATE_DIR = "/run/clawker"
 HALT_DEADLINE_S = 10.0       # bounded halt/cleanup: a hung worker's lane
 #                              must never wedge CLI shutdown
+FAILOVER_POLICIES = ("migrate", "wait", "fail")
+LANE_WEDGE_FLOOR_S = 2.0     # a poll future EXECUTING past max(4*poll_s,
+#                              this) trips the worker's breaker
+LAUNCH_WEDGE_S = 300.0       # a create/start/restart task EXECUTING this
+#                              long trips the breaker too: catches a lane
+#                              wedged inside a dedicated read-unbounded
+#                              engine call (put_archive, start) on a
+#                              daemon that still answers probes.  Must
+#                              stay generous -- a first create legitimately
+#                              includes an image pull.
+ORPHAN_GRACE_S = 600.0       # an orphan with no placement for this long
+#                              fails: total fleet death must terminate a
+#                              non-interactive run, not hang it forever
+STRAND_CEILING = 8           # consecutive stranded create/starts (across
+#                              re-placements) before the loop fails: a
+#                              DETERMINISTIC daemon 5xx (bad image cmd,
+#                              disk full) must not churn strand->rescue->
+#                              re-place forever -- probes keep succeeding
+#                              so the breaker never opens for it
 
 # container-list summary states meaning "iteration still in flight"
 _ACTIVE_STATES = {"created", "running", "restarting", "paused"}
+
+
+class _EngineUnreachable(ClawkerError):
+    """A lane poll could not reach the worker's daemon at all.  Routed to
+    the health breaker instead of failing loops: whether the loops die,
+    wait, or migrate is the failover policy's call, not the poll's."""
 
 
 @dataclass
@@ -82,6 +129,11 @@ class LoopSpec:
     workspace_mode: str = ""         # default: snapshot (isolation per loop)
     agent_prefix: str = "loop"
     env: dict[str, str] = field(default_factory=dict)
+    failover: str = "migrate"        # migrate | wait | fail
+    orphan_grace_s: float | None = None    # None = ORPHAN_GRACE_S; bounds
+    #                                  how long an orphan may sit with no
+    #                                  healthy placement before failing
+    #                                  (0 = fail at the first rescue tick)
 
 
 @dataclass
@@ -92,14 +144,23 @@ class AgentLoop:
     iteration: int = 0
     consecutive_failures: int = 0
     exit_codes: list[int] = field(default_factory=list)
-    status: str = "pending"          # pending|running|done|failed|stopped
+    status: str = "pending"          # pending|running|orphaned|done|failed|stopped
     worktree: Path | None = None
+    fresh_container: bool = True     # next start needs the full bootstrap
+    migrations: int = 0
+    strands: int = 0                 # consecutive stranded create/starts
+    #                                  (reset once an iteration starts)
+    epoch: int = 0                   # bumped at orphan time: stale lane
+    #                                  tasks for an earlier placement no-op
+    abandoned: list[tuple[Worker, str]] = field(default_factory=list)
+    #                                  containers left on dead workers
 
     def summary(self) -> dict:
         return {
             "agent": self.agent, "worker": self.worker.id,
             "status": self.status, "iteration": self.iteration,
             "exit_codes": list(self.exit_codes),
+            "migrations": self.migrations,
         }
 
 
@@ -156,7 +217,11 @@ class _WorkerLane:
 
 class LoopScheduler:
     def __init__(self, cfg: Config, driver: RuntimeDriver, spec: LoopSpec,
-                 *, on_event=None):
+                 *, on_event=None, health_config: HealthConfig | None = None):
+        if spec.failover not in FAILOVER_POLICIES:
+            raise ClawkerError(
+                f"loop: unknown failover policy {spec.failover!r} "
+                f"({'|'.join(FAILOVER_POLICIES)})")
         self.cfg = cfg
         self.driver = driver
         self.spec = spec
@@ -167,12 +232,33 @@ class LoopScheduler:
         self.events = EventBus(on_event)
         self.on_event = self.events.emit
         self.anomaly_watch = None
+        self.health: HealthMonitor | None = None   # live while run() runs
+        self._health_config = health_config
         self._stop = threading.Event()
         self._wake = threading.Event()        # set by waiters on any exit
         self._git_lock = threading.Lock()     # worktree setup shares one repo
+        # placement state (epoch / container_id / status transitions) is
+        # mutated by lane threads (_create tail, _strand) AND the run
+        # thread (_orphan_worker, _rescue_orphans): every check-then-act
+        # on it rides this lock, or an orphan landing mid-create could
+        # leak a container into neither container_id nor abandoned
+        self._placement_lock = threading.Lock()
         self._lanes: dict[str, _WorkerLane] = {}
         self._inflight: dict[str, Future] = {}   # agent -> create/start task
         self._waited: set[tuple[str, int]] = set()
+        self._exit_hints: set[str] = set()    # workers with a fresh exit
+        self._verdicts: queue.SimpleQueue = queue.SimpleQueue()
+        self.launch_wedge_s = LAUNCH_WEDGE_S  # tests tighten these
+        self.orphan_grace_s = (ORPHAN_GRACE_S if spec.orphan_grace_s is None
+                               else spec.orphan_grace_s)
+        self._orphan_since: dict[str, float] = {}   # agent -> first unplaceable
+        self._halted: set[tuple[str, str]] = set()  # (wid, cid) stops that
+        #                                             landed: recovery re-halts
+        #                                             must not repeat them
+        self._unreach: dict[str, int] = {}    # consecutive unreachable polls;
+        #                                       reset on success, orphan, and
+        #                                       recovery (a stale count must
+        #                                       not condemn a healed worker)
 
     def attach_anomaly_watch(self, watch) -> None:
         """Surface fleet anomaly scores (analytics.runtime.AnomalyWatch)
@@ -202,6 +288,16 @@ class LoopScheduler:
             lane = _WorkerLane(worker.id)
             self._lanes[worker.id] = lane
         return lane
+
+    def _submit_inflight(self, loop: AgentLoop, worker: Worker,
+                         fn, *args) -> None:
+        """Submit a create/start task as the loop's inflight work.  Its
+        completion wakes the run loop (the tick after a launch/restart
+        spawns the iteration's waiter and poll): without the wake, a
+        coarse ``poll_s`` would gate every post-launch step."""
+        fut = self._lane(worker).submit(fn, *args)
+        fut.add_done_callback(lambda _f: self._wake.set())
+        self._inflight[loop.agent] = fut
 
     def _runtime(self, worker: Worker) -> AgentRuntime:
         from ..controlplane.bootstrap import post_start_services, pre_start_services
@@ -254,8 +350,8 @@ class LoopScheduler:
             loop = AgentLoop(agent=agent, worker=worker)
             self.loops.append(loop)
         for loop in self.loops:
-            self._inflight[loop.agent] = self._lane(loop.worker).submit(
-                self._launch, loop)
+            self._submit_inflight(loop, loop.worker,
+                                  self._launch, loop, loop.epoch)
 
     def wait_launched(self, timeout: float | None = None) -> bool:
         """Block until every submitted launch (create + first start) has
@@ -267,57 +363,86 @@ class LoopScheduler:
                                       timeout=timeout)
         return not not_done
 
-    def _launch(self, loop: AgentLoop) -> None:
-        """Create + first iteration start, on the owning worker's lane."""
-        if self._stop.is_set():
-            # a launch still queued behind a wedged lane when the user
-            # stopped the run must not create an orphan container (or
-            # worktree) once the engine recovers
+    def _launch(self, loop: AgentLoop, epoch: int,
+                worker: Worker | None = None) -> None:
+        """Create + first iteration start, on the owning worker's lane.
+
+        ``epoch`` pins the task to the placement it was submitted for: a
+        launch still queued behind a wedged lane when the loop was
+        orphaned (and possibly migrated) must no-op once that lane
+        drains, exactly like one queued behind a user stop().  ``worker``
+        is captured at submit time for the same reason -- the task must
+        act on ITS placement's worker even if the loop has since moved.
+        """
+        worker = worker or loop.worker
+        if self._stop.is_set() or loop.epoch != epoch:
             return
         try:
-            self._create(loop)
+            self._create(loop, epoch, worker)
+        except DriverError as e:
+            # the worker's daemon is unreachable: that is a HEALTH
+            # verdict, not this loop's failure -- strand the loop and
+            # let the failover policy place it
+            self._strand(loop, epoch, f"create: {e}")
+            return
         except ClawkerError as e:
+            if loop.epoch != epoch:
+                return      # raced an orphan mid-create; rescue owns it
             loop.status = "failed"
             self.on_event(loop.agent, "create_failed", str(e))
             log.error("loop %s: create failed: %s", loop.agent, e)
             return
-        self._guarded_start(loop)
+        self._guarded_start(loop, epoch, worker)
 
-    def _create(self, loop: AgentLoop) -> None:
+    def _create(self, loop: AgentLoop, epoch: int, worker: Worker) -> None:
         # worktree setup mutates ONE shared git repo (refs, worktree
         # metadata): serialize it across lanes or concurrent loops race
-        # git's own lock files
-        with self._git_lock:
-            workspace_root, git_dir = self._maybe_worktree(loop.agent)
-        loop.worktree = workspace_root
+        # git's own lock files.  A migrated loop keeps its worktree.
+        if loop.worktree is None:
+            with self._git_lock:
+                workspace_root, git_dir = self._maybe_worktree(loop.agent)
+            loop.worktree = workspace_root
+        else:
+            workspace_root, git_dir = loop.worktree, None
+            if self.spec.worktrees:
+                from ..gitx.git import GitManager
+                git_dir = GitManager(self.cfg.project_root or Path.cwd()).git_dir()
         env = {
             "CLAWKER_LOOP_ID": self.loop_id,
             "CLAWKER_LOOP_AGENT": loop.agent,
             **({"CLAWKER_LOOP_PROMPT": self.spec.prompt} if self.spec.prompt else {}),
             **self.spec.env,
         }
-        rt = self._runtime(loop.worker)
+        rt = self._runtime(worker)
         # isolation default: snapshot copies; a worktree IS the isolation
         # (and the linked .git file only resolves under a live bind)
         mode = self.spec.workspace_mode or ("bind" if self.spec.worktrees
                                             else "snapshot")
-        loop.container_id = rt.create(CreateOptions(
+        cid = rt.create(CreateOptions(
             agent=loop.agent,
             image=self.spec.image,
             env=env,
             tty=False,
             workspace_mode=mode,
-            worker=loop.worker.id,
+            worker=worker.id,
             loop_id=self.loop_id,
             replace=True,
             workspace_root=workspace_root,
             worktree_git_dir=git_dir,
         ))
-        self.on_event(loop.agent, "created", loop.worker.id)
+        with self._placement_lock:
+            if loop.epoch != epoch:
+                # orphaned mid-create: the new placement owns the loop
+                # now; this container is a leftover to clean up
+                loop.abandoned.append((worker, cid))
+                return
+            loop.container_id = cid
+            loop.fresh_container = True
+        self.on_event(loop.agent, "created", worker.id)
 
     # ----------------------------------------------------------- iteration
 
-    def _write_iteration(self, loop: AgentLoop) -> None:
+    def _write_iteration(self, loop: AgentLoop, engine, cid: str) -> None:
         """Per-iteration context file (env can't change after create)."""
         body = (f"loop_id={self.loop_id}\nagent={loop.agent}\n"
                 f"iteration={loop.iteration}\n").encode()
@@ -326,39 +451,93 @@ class LoopScheduler:
             ti = tarfile.TarInfo("loop-state")
             ti.size = len(body)
             tf.addfile(ti, io.BytesIO(body))
-        engine = loop.worker.require_engine()
-        engine.put_archive(loop.container_id, LOOP_STATE_DIR, buf.getvalue())
+        engine.put_archive(cid, LOOP_STATE_DIR, buf.getvalue())
 
-    def _start_iteration(self, loop: AgentLoop) -> None:
-        engine = loop.worker.require_engine()
-        rt = self._runtime(loop.worker)
+    def _start_iteration(self, loop: AgentLoop, worker: Worker,
+                         epoch: int) -> None:
+        engine = worker.require_engine()
+        rt = self._runtime(worker)
+        # snapshot the placement under the lock: a stale task unblocking
+        # after a migration must act on ITS container, never read (or
+        # write) the new placement's container_id / fresh_container
+        with self._placement_lock:
+            if loop.epoch != epoch:
+                return
+            cid = loop.container_id
+            fresh = loop.fresh_container
         try:
-            self._write_iteration(loop)
+            self._write_iteration(loop, engine, cid)
         except ClawkerError:
             pass  # state file is advisory; the loop itself is not
-        if loop.iteration == 0:
-            rt.start(loop.container_id)          # full pre/post bootstrap
+        if fresh:
+            # first start of THIS container (iteration 0, or the first
+            # iteration after a migration re-created it elsewhere): the
+            # full pre/post bootstrap must run
+            rt.start(cid)
         else:
-            engine.start_container(loop.container_id)
+            engine.start_container(cid)
             # a restarted container gets a fresh cgroup: enforcement must
             # re-enroll every iteration (the handler's drift guard keys
             # on exactly this)
             if rt.post_start:
-                rt.post_start(loop.container_id)
-        loop.status = "running"
+                rt.post_start(cid)
+        with self._placement_lock:
+            if loop.epoch != epoch:
+                # orphaned mid-start: the orphan already moved this
+                # container to the abandoned list -- committing
+                # "running" would silently un-orphan a loop the rescue
+                # pass owns
+                return
+            loop.fresh_container = False
+            loop.status = "running"
+            loop.strands = 0        # the placement genuinely works
         self.on_event(loop.agent, "iteration_start", str(loop.iteration))
 
-    def _guarded_start(self, loop: AgentLoop) -> None:
+    def _guarded_start(self, loop: AgentLoop, epoch: int,
+                       worker: Worker | None = None) -> None:
         """One worker's transient failure must never abort the other
         loops (per-worker isolation) or skip the CLI's cleanup."""
-        if self._stop.is_set():
+        worker = worker or loop.worker
+        if self._stop.is_set() or loop.epoch != epoch:
             return
         try:
-            self._start_iteration(loop)
+            self._start_iteration(loop, worker, epoch)
+        except DriverError as e:
+            # daemon unreachable mid-run: strand, don't fail -- the
+            # failover policy owns the outcome (the container, if any,
+            # is abandoned and re-created at the next placement)
+            self._strand(loop, epoch, f"start: {e}")
         except ClawkerError as e:
+            if loop.epoch != epoch:
+                return      # raced an orphan mid-start; rescue owns it
             loop.status = "failed"
             self.on_event(loop.agent, "failed", f"start: {e}")
             log.error("loop %s: start failed: %s", loop.agent, e)
+
+    def _strand(self, loop: AgentLoop, epoch: int, reason: str) -> None:
+        """Mark a loop orphaned after its worker's engine refused a
+        create/start.  Runs on a lane thread; the run loop's rescue pass
+        (_rescue_orphans) re-places it under the failover policy."""
+        with self._placement_lock:
+            if loop.epoch != epoch or self._stop.is_set():
+                return
+            loop.epoch += 1
+            # captured under the lock: the rescue pass may reassign
+            # loop.worker the moment status flips to orphaned, and the
+            # accounting below must hit the worker that FAILED, not the
+            # healthy migration target
+            wid = loop.worker.id
+            if loop.container_id:
+                loop.abandoned.append((loop.worker, loop.container_id))
+                loop.container_id = ""
+            loop.status = "orphaned"
+            loop.strands += 1
+        if self.health is not None:
+            self.health.report_failure(wid, reason)
+            self.health.note_orphaned(wid)
+        self.on_event(loop.agent, "orphaned", f"{wid}: {reason}")
+        log.info("loop %s stranded on %s: %s", loop.agent, wid, reason)
+        self._wake.set()
 
     def _finish_iteration(self, loop: AgentLoop, code: int) -> None:
         loop.exit_codes.append(code)
@@ -386,12 +565,17 @@ class LoopScheduler:
         ExitCode in its state -- a daemon that lost the exit status must
         read as a FAILED iteration, never as success (the old
         ``int(state.get("ExitCode") or 0)`` mapped exactly that to 0).
+        A daemon that cannot be REACHED is neither: that raises
+        ``_EngineUnreachable`` for the health breaker to judge.
         """
         engine = loop.worker.require_engine()
         try:
             info = engine.inspect_container(loop.container_id)
-        except ClawkerError:
+        except NotFoundError:
             return None, "container vanished"
+        except ClawkerError as e:
+            raise _EngineUnreachable(
+                f"{loop.worker.id}: inspect failed: {e}") from e
         state = info.get("State") or {}
         if state.get("Running"):
             return None, ""        # raced a restart: not finished after all
@@ -409,16 +593,28 @@ class LoopScheduler:
         worker hosts (the serial loop paid one inspect per agent per
         tick), then one inspect per *stopped* container for its exit
         code.  Runs on the worker's lane, so a hung engine blocks only
-        its own worker's poll."""
+        its own worker's poll.  Raises ``_EngineUnreachable`` when the
+        daemon itself is gone: run() routes that to the health breaker
+        (the failover policy decides the loops' fate), instead of the
+        old behavior of failing every loop on the first dead poll."""
         try:
             rows = engine.list_containers(all=True, filters={
                 "label": [f"{consts.LABEL_LOOP}={self.loop_id}"]})
-        except ClawkerError:
+        except ClawkerError as e:
+            # transient list hiccup vs daemon-down: one cheap ping
+            # decides (real engines return False rather than raising)
+            try:
+                alive = engine.ping()
+            except Exception as pe:     # noqa: BLE001
+                raise _EngineUnreachable(
+                    f"list+ping failed: {pe}") from e
+            if not alive:
+                raise _EngineUnreachable(f"list+ping failed: {e}") from e
             rows = None
         out: list[tuple[AgentLoop, int | None, str]] = []
         if rows is None:
-            # engine unreachable: fall back to per-container inspect so a
-            # dead daemon still fails its loops instead of spinning forever
+            # daemon answers pings but the list failed: fall back to
+            # per-container inspects this tick
             for l in loops:
                 code, detail = self._read_exit(l)
                 if code is not None or detail:
@@ -447,12 +643,16 @@ class LoopScheduler:
         self._waited.add(key)
         engine = loop.worker.require_engine()
         cid = loop.container_id
+        wid = loop.worker.id
 
         def wait() -> None:
             try:
                 engine.wait_container(cid)
             except Exception:
                 pass
+            # the hint makes the NEXT tick submit this worker's poll
+            # immediately instead of waiting out the fallback cadence
+            self._exit_hints.add(wid)
             self._wake.set()
 
         threading.Thread(target=wait, daemon=True,
@@ -464,87 +664,395 @@ class LoopScheduler:
         """Drive every loop to completion (or stop()); returns final states.
 
         Event-driven: waiter threads wake the loop the moment an
-        iteration exits, so ``poll_s`` only bounds the fallback re-check
-        cadence (and stop() latency) -- it can stay coarse without
-        slowing restarts down.
+        iteration exits, and poll futures wake it the moment they
+        complete (done-callbacks on the waker event), so ``poll_s`` only
+        bounds the fallback re-check cadence (and stop() latency) -- it
+        can stay coarse without slowing restarts down, and one wedged
+        worker's never-completing poll future no longer degrades healthy
+        workers' restarts to poll-interval latency.
+
+        The fleet :class:`HealthMonitor` runs for the duration: breaker
+        verdicts (from probes, unreachable polls, and wedged lanes) are
+        drained each tick on THIS thread, so orphaning and migration
+        never race the accounting.
         """
         for loop in self.loops:
             # compat: loops registered without start() still launch here
             if loop.agent not in self._inflight:
                 if loop.status == "pending":
-                    self._inflight[loop.agent] = self._lane(loop.worker).submit(
-                        self._launch, loop)
+                    self._submit_inflight(loop, loop.worker,
+                                          self._launch, loop, loop.epoch)
                 else:
                     done: Future = Future()
                     done.set_result(None)
                     self._inflight[loop.agent] = done
+        self.health = HealthMonitor(
+            self.driver, self.driver.workers(),
+            config=self._health_config, events=self.events,
+            on_verdict=lambda wid, old, new, reason: (
+                self._verdicts.put((wid, old, new, reason)),
+                self._wake.set()))
+        self.health.start()
+        wedge_after = max(4.0 * poll_s, LANE_WEDGE_FLOOR_S)
         polls: dict[str, Future] = {}
+        poll_running_since: dict[str, float] = {}    # first tick seen EXECUTING
+        launch_running_since: dict[str, float] = {}  # agent -> ditto, inflight
+        poll_epochs: dict[str, dict[str, int]] = {}  # wid -> agent epochs
+        #                                              at poll submit
+        next_poll_at: dict[str, float] = {}   # backoff after unreachable
         poll_errs: dict[str, int] = {}
-        while not self._stop.is_set():
-            self._harvest_inflight()
-            # a loop is busy while running, or while its create/start/
-            # restart is still queued on a (possibly wedged) worker lane
-            busy = [l for l in self.loops
-                    if l.status == "running"
-                    or not self._inflight[l.agent].done()]
-            if not busy:
-                break
-            pollable = [l for l in self.loops
-                        if l.status == "running"
-                        and self._inflight[l.agent].done()]
-            by_worker: dict[str, list[AgentLoop]] = {}
-            for l in pollable:
-                self._spawn_waiter(l)
-                by_worker.setdefault(l.worker.id, []).append(l)
-            for wid, group in by_worker.items():
-                if wid not in polls:    # previous poll still pending: skip
-                    engine = group[0].worker.require_engine()
-                    polls[wid] = self._lane(group[0].worker).submit(
-                        self._poll_lane, engine, list(group))
-            if polls:
-                futures_wait(list(polls.values()), timeout=poll_s)
-            finished: list[tuple[AgentLoop, int | None, str]] = []
-            for wid in list(polls):
-                fut = polls[wid]
-                if not fut.done():
-                    continue             # slow worker: re-harvest next tick
-                del polls[wid]
-                try:
-                    finished.extend(fut.result())
-                    poll_errs.pop(wid, None)
-                except Exception as e:
-                    # a DETERMINISTIC poll crash (engine bug, malformed
-                    # state) would otherwise retry at poll_s cadence
-                    # forever with the loops stuck "running"
-                    log.error("loop poll on %s failed: %r", wid, e)
-                    poll_errs[wid] = poll_errs.get(wid, 0) + 1
-                    if poll_errs[wid] >= FAILURE_CEILING:
-                        finished.extend(
-                            (l, None, f"poll crashed: {e!r}")
-                            for l in by_worker.get(wid, ()))
-            progressed = False
-            for loop, code, detail in finished:
-                if loop.status != "running":
-                    continue
-                progressed = True
-                self._waited.discard((loop.agent, loop.iteration))
-                if code is None:
-                    loop.status = "failed"
-                    self.on_event(loop.agent, "failed", detail)
-                    continue
-                self._finish_iteration(loop, code)
-                if loop.status == "running":     # budget left: next iteration
-                    self._inflight[loop.agent] = self._lane(loop.worker).submit(
-                        self._guarded_start, loop)
-            if not progressed:
-                self._wake.wait(poll_s)
+        unreach = self._unreach
+        wedged: set[str] = set()
+        try:
+            while not self._stop.is_set():
                 self._wake.clear()
+                self._harvest_inflight()
+                self._drain_verdicts()
+                self._rescue_orphans()
+                # a loop is busy while running or orphaned (awaiting
+                # failover), or while its create/start/restart is still
+                # queued on a (possibly wedged) worker lane
+                busy = [l for l in self.loops
+                        if l.status in ("running", "orphaned")
+                        or not self._inflight[l.agent].done()]
+                if not busy:
+                    break
+                pollable = [l for l in self.loops
+                            if l.status == "running"
+                            and self._inflight[l.agent].done()]
+                by_worker: dict[str, list[AgentLoop]] = {}
+                for l in pollable:
+                    self._spawn_waiter(l)
+                    by_worker.setdefault(l.worker.id, []).append(l)
+                now = time.monotonic()
+                # a launch/restart EXECUTING far past any legitimate
+                # duration means the lane is wedged inside a dedicated
+                # read-unbounded engine call on a daemon that may still
+                # answer probes -- without this, such a loop would hang
+                # forever with no poll ever submitted for it
+                for l in self.loops:
+                    fut = self._inflight.get(l.agent)
+                    if (l.status not in ("pending", "running")
+                            or fut is None or fut.done()
+                            or not fut.running()):
+                        # an orphaned loop's stale future may stay
+                        # running forever on the retired lane: reporting
+                        # it again would re-trip every half-open trial
+                        # and pin the worker open past recovery
+                        launch_running_since.pop(l.agent, None)
+                        continue
+                    started = launch_running_since.setdefault(l.agent, now)
+                    if now - started >= self.launch_wedge_s:
+                        self.health.report_wedge(
+                            l.worker.id, f"launch/restart executing "
+                                         f"{now - started:.1f}s")
+                for wid, group in by_worker.items():
+                    pending = polls.get(wid)
+                    if pending is not None:
+                        if self._poll_is_stale(poll_epochs.get(wid, {})):
+                            # every loop this poll was submitted for has
+                            # moved on (orphaned, then resumed/migrated):
+                            # abandon the stale future so a recovered
+                            # worker's polls aren't blocked behind it
+                            # forever (its results are unusable anyway)
+                            polls.pop(wid, None)
+                            poll_epochs.pop(wid, None)
+                            poll_running_since.pop(wid, None)
+                            wedged.discard(wid)
+                        else:
+                            # wedge detection clocks time EXECUTING on
+                            # the lane -- a poll merely queued behind a
+                            # slow-but-healthy create/bootstrap must not
+                            # trip the breaker
+                            if pending.running():
+                                started = poll_running_since.setdefault(
+                                    wid, now)
+                                if (now - started >= wedge_after
+                                        and wid not in wedged):
+                                    wedged.add(wid)
+                                    self.health.report_wedge(
+                                        wid, f"poll executing "
+                                             f"{now - started:.1f}s")
+                            continue
+                    # polls are demand-driven: an exit hint (waiter fired
+                    # since the last poll) submits one immediately, else
+                    # the fallback cadence applies -- submitting on every
+                    # tick would spin, since each completion wakes a tick
+                    if (wid not in self._exit_hints
+                            and now < next_poll_at.get(wid, 0.0)):
+                        continue
+                    self._exit_hints.discard(wid)
+                    engine = group[0].worker.require_engine()
+                    fut = self._lane(group[0].worker).submit(
+                        self._poll_lane, engine, list(group))
+                    # completion wakes the tick immediately: no healthy
+                    # worker ever waits out another worker's poll
+                    fut.add_done_callback(lambda _f: self._wake.set())
+                    polls[wid] = fut
+                    poll_epochs[wid] = {l.agent: l.epoch for l in group}
+                    next_poll_at[wid] = now + poll_s
+                finished: list[tuple[AgentLoop, int | None, str]] = []
+                for wid in list(polls):
+                    fut = polls[wid]
+                    if not fut.done():
+                        continue         # slow worker: re-harvest next tick
+                    del polls[wid]
+                    poll_running_since.pop(wid, None)
+                    epochs = poll_epochs.pop(wid, {})
+                    wedged.discard(wid)
+                    try:
+                        # a result only counts for loops still at the
+                        # placement the poll was submitted for: a wedged
+                        # poll completing AFTER its loops were orphaned
+                        # and migrated must not fail the healthy
+                        # re-placements ("container vanished" on the old
+                        # worker is about the old placement, not them)
+                        finished.extend(
+                            (l, c, d) for l, c, d in fut.result()
+                            if l.epoch == epochs.get(l.agent, l.epoch))
+                        poll_errs.pop(wid, None)
+                        unreach.pop(wid, None)
+                        self.health.report_success(wid)
+                    except _EngineUnreachable as e:
+                        unreach[wid] = unreach.get(wid, 0) + 1
+                        # a fresh successful probe is direct evidence the
+                        # daemon is alive (unlike breaker state, it can't
+                        # be perturbed by our own failure reports): a
+                        # deterministic inspect/list fault, not death --
+                        # feeding the breaker would quarantine a healthy
+                        # worker, and never escalating would spin run()
+                        # forever behind a breaker that never opens
+                        alive = self.health.probe_says_alive(wid)
+                        if alive and unreach[wid] >= FAILURE_CEILING:
+                            # the freshness window can straddle the
+                            # moment of death: confirm with a probe NOW
+                            # before condemning the loops
+                            group = by_worker.get(wid) or ()
+                            confirm = (self.health.probe_worker(
+                                group[0].worker) if group else None)
+                            if confirm is not None and confirm.ok:
+                                unreach[wid] = 0
+                                finished.extend(
+                                    (l, None, f"poll unreachable: {e}")
+                                    for l in group)
+                                continue
+                            alive = False   # confirmation failed: dying
+                        if not alive:
+                            # the worker may be dying -- health's call,
+                            # not the poll's: the breaker opens after K
+                            # of these (or the probes get there first)
+                            # and the failover policy takes over
+                            self.health.report_failure(wid, str(e))
+                    except Exception as e:
+                        # a DETERMINISTIC poll crash (engine bug,
+                        # malformed state) would otherwise retry at
+                        # poll_s cadence forever with the loops stuck
+                        # "running"
+                        log.error("loop poll on %s failed: %r", wid, e)
+                        poll_errs[wid] = poll_errs.get(wid, 0) + 1
+                        if poll_errs[wid] >= FAILURE_CEILING:
+                            finished.extend(
+                                (l, None, f"poll crashed: {e!r}")
+                                for l in by_worker.get(wid, ()))
+                progressed = False
+                for loop, code, detail in finished:
+                    if loop.status != "running":
+                        continue
+                    progressed = True
+                    self._waited.discard((loop.agent, loop.iteration))
+                    if code is None:
+                        loop.status = "failed"
+                        self.on_event(loop.agent, "failed", detail)
+                        continue
+                    self._finish_iteration(loop, code)
+                    if loop.status == "running":  # budget left: next iteration
+                        self._submit_inflight(
+                            loop, loop.worker,
+                            self._guarded_start, loop, loop.epoch, loop.worker)
+                if not progressed:
+                    self._wake.wait(poll_s)
+        finally:
+            self.health.stop()
         if self._stop.is_set():
             self._halt_running()
         # callers read final states + their own on_event capture right
         # after run(); make sure every stamped event reached the sink
         self.events.flush()
         return self.loops
+
+    # ----------------------------------------------------------- failover
+
+    def _poll_is_stale(self, snap: dict[str, int]) -> bool:
+        """True when EVERY loop a pending poll was submitted for has
+        moved on (epoch bumped by orphan/strand, or gone entirely) --
+        the future's results are unusable and keeping it would block a
+        recovered worker's fresh polls forever.  Checked against ALL
+        loops, not the worker's current group: a loop that migrated AWAY
+        is exactly the 'moved on' case."""
+        if not snap:
+            return False
+        live = {l.agent: l.epoch for l in self.loops}
+        return all(live.get(agent, epoch + 1) != epoch
+                   for agent, epoch in snap.items())
+
+    def _drain_verdicts(self) -> None:
+        """Apply queued breaker transitions on the run thread.  Only the
+        OPEN edge needs action (orphan the worker's loops); recovery is
+        picked up by the per-tick rescue pass, which sees the closed
+        breaker directly."""
+        while True:
+            try:
+                wid, old, new, reason = self._verdicts.get_nowait()
+            except queue.Empty:
+                return
+            if new == BREAKER_OPEN:
+                self._orphan_worker(wid, reason)
+            elif new == BREAKER_CLOSED:
+                self._unreach.pop(wid, None)   # a fresh episode starts clean
+                # the halt attempted at orphan time ran against a dead
+                # daemon and likely failed: a recovered worker may still
+                # be running the abandoned copy of a migrated agent --
+                # re-halt now that the daemon answers
+                for loop in self.loops:
+                    for worker, cid in list(loop.abandoned):
+                        if worker.id == wid:
+                            self._halt_abandoned(worker, cid)
+
+    def _orphan_worker(self, wid: str, reason: str) -> None:
+        # retire the worker's lane: its single thread may be parked
+        # inside the very call that got the worker quarantined (a
+        # dedicated read-unbounded engine op never errors out), and
+        # abandoning futures does not free the thread -- work submitted
+        # after recovery must get a FRESH lane thread, not queue behind
+        # the wedged one.  Tasks already queued on the old lane are
+        # epoch-guarded, so they no-op when (if) the thread unblocks.
+        stale_lane = self._lanes.pop(wid, None)
+        if stale_lane is not None:
+            stale_lane.close()
+        self._unreach.pop(wid, None)   # the episode ends with the orphaning
+        for loop in self.loops:
+            halt_cid = ""
+            with self._placement_lock:
+                if loop.worker.id != wid:
+                    continue
+                if loop.status not in ("pending", "running"):
+                    continue
+                loop.epoch += 1        # stale lane tasks for this placement die
+                loop.status = "orphaned"
+                self._waited.discard((loop.agent, loop.iteration))
+                if loop.container_id:
+                    loop.abandoned.append((loop.worker, loop.container_id))
+                    halt_cid = loop.container_id
+                    loop.container_id = ""
+            if halt_cid:
+                # best-effort halt OFF the wedged lane: stop rides a
+                # dedicated never-pooled socket (engine/httpapi), so a
+                # dead worker's pool is never part of the attempt
+                self._halt_abandoned(loop.worker, halt_cid)
+            if self.health is not None:
+                self.health.note_orphaned(wid)
+            self.on_event(loop.agent, "orphaned", f"{wid}: {reason}")
+
+    def _rescue_orphans(self) -> None:
+        """Re-place orphaned loops under the failover policy.  Runs every
+        tick: orphans that found no healthy target (or whose worker has
+        not recovered yet, under ``wait``) are retried at tick cadence.
+        """
+        orphans = [l for l in self.loops if l.status == "orphaned"]
+        if not orphans or self.health is None:
+            return
+        policy = self.spec.failover
+        now = time.monotonic()
+        for loop in orphans:
+            # a bounded wait for a placement: when the whole fleet is
+            # dead (or the waited-for worker never recovers), the run
+            # must eventually fail and return rather than hang a
+            # non-interactive invocation forever
+            since = self._orphan_since.setdefault(loop.agent, now)
+            if now - since >= self.orphan_grace_s:
+                self._fail_orphan(loop, f"no healthy placement for "
+                                        f"{now - since:.0f}s "
+                                        f"(failover={policy})")
+                continue
+            # a loop that keeps stranding across placements while the
+            # breakers read healthy is hitting a DETERMINISTIC daemon
+            # failure (bad image, disk full): stop churning, fail it --
+            # re-placements reset the grace timer, so only this ceiling
+            # bounds that cycle
+            if loop.strands >= STRAND_CEILING:
+                self._fail_orphan(loop, f"{loop.strands} consecutive "
+                                        "stranded create/starts")
+                continue
+            if policy == "fail":
+                self._fail_orphan(loop, f"worker {loop.worker.id} "
+                                        "unhealthy (failover=fail)")
+                continue
+            if policy == "wait":
+                # resume on the SAME worker once its breaker closes
+                if self.health.state(loop.worker.id) != BREAKER_CLOSED:
+                    continue
+                target = loop.worker
+            else:                       # migrate
+                # prefer a DIFFERENT worker: the orphan's own worker may
+                # still read closed (one stranded create is below the
+                # breaker threshold) yet just refused a create -- but
+                # fall back to it rather than strand the only worker of
+                # a one-worker fleet behind a transient blip
+                load = self._load_by_worker()
+                target = (self.health.pick_target(
+                    load, exclude={loop.worker.id})
+                    or self.health.pick_target(load))
+                if target is None:
+                    continue            # no healthy worker right now
+            with self._placement_lock:
+                if loop.status != "orphaned":
+                    continue            # raced a concurrent transition
+                old = loop.worker
+                loop.worker = target
+                loop.status = "pending"
+                loop.fresh_container = True
+            self._orphan_since.pop(loop.agent, None)
+            if target.id != old.id:
+                loop.migrations += 1
+                self.health.note_migration(old.id, target.id)
+                self.on_event(loop.agent, "migrated",
+                              f"{old.id}->{target.id}")
+            else:
+                self.on_event(loop.agent, "resumed", target.id)
+            self._submit_inflight(loop, target,
+                                  self._launch, loop, loop.epoch, target)
+
+    def _fail_orphan(self, loop: AgentLoop, detail: str) -> None:
+        loop.status = "failed"
+        # the loop may still be "inflight" behind a wedged lane task
+        # that will never complete: replace the future or busy stays
+        # truthy and run() never returns
+        done: Future = Future()
+        done.set_result(None)
+        self._inflight[loop.agent] = done
+        self._orphan_since.pop(loop.agent, None)
+        self.on_event(loop.agent, "failed", detail)
+
+    def _load_by_worker(self) -> dict[str, int]:
+        load: dict[str, int] = {}
+        for l in self.loops:
+            if l.status in ("pending", "running"):
+                load[l.worker.id] = load.get(l.worker.id, 0) + 1
+        return load
+
+    def _halt_abandoned(self, worker: Worker, cid: str) -> None:
+        if (worker.id, cid) in self._halted:
+            return      # a previous halt landed; don't re-stop per recovery
+
+        def halt() -> None:
+            try:
+                worker.require_engine().stop_container(cid, timeout=2)
+                self._halted.add((worker.id, cid))
+            except Exception:           # noqa: BLE001 -- best effort by design
+                pass
+
+        threading.Thread(target=halt, daemon=True,
+                         name=f"loop-halt-{cid[:12]}").start()
 
     def _harvest_inflight(self) -> None:
         """Unexpected (non-ClawkerError) lane crashes must surface as a
@@ -600,6 +1108,23 @@ class LoopScheduler:
             # on the main thread could snapshot '' mid-create and leak)
             futs = [self._lane(loop.worker).submit(self._remove_one, loop)
                     for loop in self.loops]
+            # containers abandoned on dead/recovered workers by failover
+            # ride THEIR worker's lane (a dead worker's removal fails
+            # fast or eats the bounded wait, never the healthy lanes')
+            sweep_workers: dict[str, Worker] = {}
+            for loop in self.loops:
+                sweep_workers.setdefault(loop.worker.id, loop.worker)
+                for worker, cid in loop.abandoned:
+                    sweep_workers.setdefault(worker.id, worker)
+                    futs.append(self._lane(worker).submit(
+                        self._remove_cid, worker, cid))
+            # label-scoped sweep: a create whose response was lost AFTER
+            # the daemon executed it (the case the engine client must
+            # not blindly re-send) leaves a container in neither
+            # container_id nor abandoned -- only listing by this run's
+            # loop label catches such ghosts
+            futs.extend(self._lane(w).submit(self._sweep_worker, w)
+                        for w in sweep_workers.values())
             if futs:
                 futures_wait(futs, timeout=HALT_DEADLINE_S)
         for lane in self._lanes.values():
@@ -611,8 +1136,23 @@ class LoopScheduler:
     def _remove_one(self, loop: AgentLoop) -> None:
         if not loop.container_id:
             return      # create never ran (failed, or aborted by stop())
+        self._remove_cid(loop.worker, loop.container_id)
+
+    def _remove_cid(self, worker: Worker, cid: str) -> None:
         try:
-            loop.worker.require_engine().remove_container(
-                loop.container_id, force=True, volumes=True)
+            worker.require_engine().remove_container(
+                cid, force=True, volumes=True)
         except ClawkerError:
             pass
+
+    def _sweep_worker(self, worker: Worker) -> None:
+        """Remove every container carrying THIS run's loop label on the
+        worker -- the backstop for ghosts no bookkeeping tracked."""
+        engine = worker.require_engine()
+        try:
+            rows = engine.list_containers(all=True, filters={
+                "label": [f"{consts.LABEL_LOOP}={self.loop_id}"]})
+        except ClawkerError:
+            return
+        for row in rows:
+            self._remove_cid(worker, row.get("Id", ""))

@@ -34,6 +34,37 @@ log = logsetup.get("monitor.events")
 
 HISTORY_LIMIT = 4096    # long unbounded loops must not grow without bound
 
+# Event name the health subsystem publishes breaker transitions under.
+# The record's ``agent`` field carries the WORKER id (workers are the
+# subjects of fleet health, agents of everything else on the bus).
+WORKER_HEALTH = "worker.health"
+
+
+@dataclass(frozen=True)
+class WorkerHealthEvent:
+    """Typed payload of a ``worker.health`` event.
+
+    Rides the bus as the record's detail string so every existing sink
+    (CLI stderr lines, the loop dashboard, status JSON) renders it with
+    zero changes; structured consumers (``clawker fleet health``, tests)
+    round-trip it with :meth:`parse`.
+    """
+
+    worker: str
+    old_state: str
+    new_state: str
+    reason: str = ""
+
+    def detail(self) -> str:
+        base = f"{self.old_state}->{self.new_state}"
+        return f"{base}: {self.reason}" if self.reason else base
+
+    @classmethod
+    def parse(cls, worker: str, detail: str) -> "WorkerHealthEvent":
+        states, _, reason = detail.partition(": ")
+        old, _, new = states.partition("->")
+        return cls(worker, old, new, reason)
+
 
 @dataclass(frozen=True)
 class EventRecord:

@@ -52,6 +52,16 @@ def counts() -> dict[str, int]:
         return dict(_counts)
 
 
+def incr(name: str, n: int = 1) -> None:
+    """Count-only marker for discrete occurrences (breaker transitions,
+    dial retries): shows up in :func:`counts` with no duration half.
+    Same contract as :func:`phase` -- free when recording is off."""
+    if not _enabled:
+        return
+    with _mutex:
+        _counts[name] = _counts.get(name, 0) + n
+
+
 @contextlib.contextmanager
 def phase(name: str):
     if not _enabled:

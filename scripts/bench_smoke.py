@@ -14,6 +14,8 @@ Gates:
 - fleet_provision_wall >= 2x faster than serial (ISSUE 1 acceptance bar)
 - engine_dials_per_run >= 2x fewer dials than dial-per-request
                                    (ISSUE 2 acceptance bar)
+- failover_detect_to_restart_s <= bench.FAILOVER_BUDGET_S with every
+  loop reaching its budget  (ISSUE 3 acceptance bar)
 
 Prints one JSON line; exit 1 on any gate failure.
 """
@@ -33,8 +35,10 @@ DIALS_MIN_REDUCTION = 2.0
 
 def main() -> int:
     from bench import (
+        FAILOVER_BUDGET_S,
         POLL_COST_BUDGET,
         bench_engine_dials,
+        bench_failover,
         bench_fleet_provision,
         bench_loop_fanout,
         bench_loop_poll_cost,
@@ -43,6 +47,7 @@ def main() -> int:
     fanout_s = bench_loop_fanout(iters=1)
     poll = bench_loop_poll_cost()
     provision = bench_fleet_provision()
+    failover = bench_failover()
     dials = bench_engine_dials()
 
     failures: list[str] = []
@@ -59,6 +64,14 @@ def main() -> int:
         failures.append(
             f"fleet_provision_wall_n8 speedup {provision['speedup']}x "
             f"< {PROVISION_MIN_SPEEDUP}x over serial")
+    if not failover["all_loops_done"]:
+        failures.append(
+            "failover_detect_to_restart_s: loops missed their iteration "
+            "budget after the injected worker death")
+    elif not 0 < failover["detect_to_restart_s"] <= FAILOVER_BUDGET_S:
+        failures.append(
+            f"failover_detect_to_restart_s {failover['detect_to_restart_s']}s"
+            f" outside (0, {FAILOVER_BUDGET_S}]s budget")
     if dials["stale_retries"]:
         failures.append(
             f"engine_dials_per_run: {dials['stale_retries']} stale retries "
@@ -72,6 +85,7 @@ def main() -> int:
         "loop_fanout_p50_n8_ms": round(fanout_s * 1000, 1),
         "loop_poll_cost_n8": poll,
         "fleet_provision_wall_n8": provision,
+        "failover_detect_to_restart_s": failover,
         "engine_dials_per_run": dials,
         "ok": not failures,
         "failures": failures,

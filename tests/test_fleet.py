@@ -205,6 +205,40 @@ def test_provision_fleet_streams_reports_and_isolates_failure(tmp_path):
     assert all(reports[i].ok for i in (0, 1, 3))
 
 
+def test_cli_provision_streams_per_worker_summaries(tmp_path, monkeypatch):
+    """ROADMAP open item (ISSUE 3 satellite): `fleet provision` must pass
+    on_report through so each worker's summary prints the moment THAT
+    worker finishes (docs/loop-parallel.md promises streaming), not
+    after the whole fleet -- and a failed worker still exits non-zero."""
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli import cmd_fleet
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+    from clawker_tpu.fleet import provision as prov_mod
+
+    ts = _fleet_transports(tmp_path, n=3)
+    ts[1].runner.script["docker info"] = (1, "Cannot connect")
+    monkeypatch.setattr(cmd_fleet, "_transports", lambda f: ts)
+    seen_kwargs = {}
+    real = prov_mod.provision_fleet
+
+    def spy(transports, repo_root, **kw):
+        seen_kwargs.update(kw)
+        return real(transports, repo_root, **kw)
+
+    monkeypatch.setattr(prov_mod, "provision_fleet", spy)
+    res = CliRunner().invoke(cli, ["fleet", "provision"], obj=Factory(),
+                             catch_exceptions=False)
+    assert res.exit_code == 1                    # worker 1 failed
+    # the summaries were streamed through on_report (not printed after
+    # the returned list), one per worker
+    assert callable(seen_kwargs.get("on_report"))
+    assert "worker 0 (10.0.0.0): ok" in res.output
+    assert "worker 2 (10.0.0.2): ok" in res.output
+    assert "worker 1 (10.0.0.1): FAILED at preflight-docker" in res.output
+
+
 def test_provision_fleet_transport_blowup_is_one_failed_report(tmp_path):
     class ExplodingRunner(FakeRunner):
         def run(self, argv, *, input_bytes=None, timeout=60.0):

@@ -1,0 +1,1061 @@
+"""Health & failover suite: breaker transitions, probe verdicts under
+injected per-worker faults, orphan re-placement across the fake pod,
+and the `fleet health` CLI.
+
+The tentpole scenario (ISSUE 3 acceptance): 8 loops across 4 fake
+workers, one worker killed mid-run under ``--failover migrate`` -- every
+loop still reaches its iteration budget, the dead worker's breaker
+walks open -> half_open -> closed after revival, and half-open workers
+never receive migrations.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.config import load_config
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.errors import DriverError
+from clawker_tpu.health import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    BreakerConfig,
+    CircuitBreaker,
+    HealthConfig,
+    HealthMonitor,
+)
+from clawker_tpu.loop import LoopScheduler, LoopSpec
+from clawker_tpu.monitor.events import WORKER_HEALTH, WorkerHealthEvent
+from clawker_tpu.testenv import TestEnv
+
+IMAGE = "clawker-loopproj:default"
+
+# fast knobs: probes every 30ms, 2 failures open, ~50ms backoff
+FAST_HEALTH = HealthConfig(
+    probe_interval_s=0.03, probe_deadline_s=0.4,
+    breaker=BreakerConfig(failure_threshold=2, backoff_base_s=0.05,
+                          backoff_max_s=0.2, backoff_jitter=0.0,
+                          half_open_successes=2))
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: loopproj\n")
+        cfg = load_config(proj)
+        yield tenv, proj, cfg
+
+
+def seed(drv: FakeDriver, behavior=None) -> None:
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, behavior or exit_behavior(b"iter done\n", 0))
+
+
+def wait_for(pred, timeout=10.0, interval=0.01) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# -------------------------------------------------------------- breaker
+
+
+def test_breaker_opens_after_threshold_and_backs_off():
+    clock = [100.0]
+    transitions = []
+    br = CircuitBreaker(
+        "w0",
+        BreakerConfig(failure_threshold=3, backoff_base_s=1.0,
+                      backoff_max_s=8.0, backoff_jitter=0.0),
+        on_transition=lambda n, o, new, r: transitions.append((o, new)),
+        clock=lambda: clock[0])
+    assert br.state == BREAKER_CLOSED
+    br.record_failure("a")
+    br.record_failure("b")
+    assert br.state == BREAKER_CLOSED          # under threshold
+    br.record_failure("c")
+    assert br.state == BREAKER_OPEN
+    assert transitions == [(BREAKER_CLOSED, BREAKER_OPEN)]
+    # quarantined: no probes inside the backoff window
+    assert not br.probe_due()
+    clock[0] += 1.0
+    assert br.probe_due()                      # backoff expired -> trial
+    assert br.state == BREAKER_HALF_OPEN
+    # a failed trial re-opens with a DOUBLED backoff
+    br.record_failure("still dead")
+    assert br.state == BREAKER_OPEN
+    clock[0] += 1.0
+    assert not br.probe_due()                  # 2s now, only 1s elapsed
+    clock[0] += 1.0
+    assert br.probe_due()
+    br.record_success()
+    assert br.state == BREAKER_HALF_OPEN       # one trial is not enough
+    br.record_success()
+    assert br.state == BREAKER_CLOSED
+    assert transitions[-1] == (BREAKER_HALF_OPEN, BREAKER_CLOSED)
+    # a full recovery resets the backoff exponent
+    br.record_failure("x")
+    br.record_failure("y")
+    br.record_failure("z")
+    clock[0] += 1.0
+    assert br.probe_due()
+
+
+def test_breaker_trip_is_immediate_and_success_while_open_is_stale():
+    br = CircuitBreaker("w0", BreakerConfig(backoff_base_s=60.0))
+    br.trip("lane wedged")
+    assert br.state == BREAKER_OPEN
+    br.record_success()                        # stale pre-trip signal
+    assert br.state == BREAKER_OPEN
+
+
+def test_breaker_jitter_stays_within_fraction():
+    class FixedRng:
+        def __init__(self, v):
+            self.v = v
+
+        def random(self):
+            return self.v
+
+    for rng_v, expect in ((0.0, 0.8), (1.0, 1.2), (0.5, 1.0)):
+        clock = [0.0]
+        br = CircuitBreaker(
+            "w", BreakerConfig(failure_threshold=1, backoff_base_s=1.0,
+                               backoff_jitter=0.2),
+            clock=lambda: clock[0], rng=FixedRng(rng_v))
+        br.record_failure()
+        assert br.snapshot()["retry_in_s"] == pytest.approx(expect, abs=1e-6)
+
+
+# -------------------------------------------------------------- monitor
+
+
+def test_probe_failures_open_breaker_and_revival_closes_it():
+    drv = FakeDriver(n_workers=2)
+    mon = HealthMonitor(drv, config=FAST_HEALTH)
+    for _ in range(2):
+        mon.probe_all()
+    assert mon.healthy_ids() == ["fake-0", "fake-1"]
+    stats = {s["worker"]: s for s in mon.stats()}
+    assert stats["fake-0"]["probes"] == 2
+    assert stats["fake-0"]["probe_p50_ms"] >= 0
+
+    drv.inject_fault(1, "refuse")
+    mon.start()
+    try:
+        assert wait_for(lambda: mon.state("fake-1") == BREAKER_OPEN)
+        assert mon.state("fake-0") == BREAKER_CLOSED   # isolation
+        drv.clear_fault(1)
+        assert wait_for(lambda: mon.state("fake-1") == BREAKER_CLOSED)
+    finally:
+        mon.stop()
+    # the typed worker.health transitions rode the bus in order
+    seq = [WorkerHealthEvent.parse(r.agent, r.detail)
+           for r in mon.events.for_agent("fake-1")
+           if r.event == WORKER_HEALTH]
+    states = [(e.old_state, e.new_state) for e in seq]
+    assert (BREAKER_CLOSED, BREAKER_OPEN) in states
+    i = states.index((BREAKER_CLOSED, BREAKER_OPEN))
+    assert states[i:][-2:] == [(BREAKER_OPEN, BREAKER_HALF_OPEN),
+                               (BREAKER_HALF_OPEN, BREAKER_CLOSED)]
+
+
+def test_wedged_probe_hits_deadline_and_opens():
+    drv = FakeDriver(n_workers=1)
+    drv.inject_fault(0, "wedge")
+    mon = HealthMonitor(drv, config=FAST_HEALTH)
+    try:
+        res = mon.probe_worker(drv.workers()[0])
+        assert not res.ok and "deadline" in res.error
+        mon.probe_all()
+        assert mon.state("fake-0") == BREAKER_OPEN
+    finally:
+        drv.clear_fault(0)
+
+
+def test_flapping_worker_stays_quarantined_until_stable():
+    """A worker alternating ok/refused must open and STAY open across
+    half-open trials (each trial probe hits a failing call), closing
+    only once the flap clears."""
+    drv = FakeDriver(n_workers=1)
+    drv.inject_fault(0, "flap")
+    mon = HealthMonitor(drv, config=FAST_HEALTH)
+    mon.start()
+    try:
+        assert wait_for(lambda: mon.state("fake-0") == BREAKER_OPEN)
+        time.sleep(0.3)            # several backoff windows: trials flap
+        assert mon.state("fake-0") != BREAKER_CLOSED
+        drv.clear_fault(0)
+        assert wait_for(lambda: mon.state("fake-0") == BREAKER_CLOSED)
+    finally:
+        mon.stop()
+
+
+def test_pick_target_least_loaded_closed_only():
+    drv = FakeDriver(n_workers=3)
+    mon = HealthMonitor(drv, config=FAST_HEALTH)
+    load = {"fake-0": 3, "fake-1": 1, "fake-2": 2}
+    assert mon.pick_target(load).id == "fake-1"
+    # open workers never receive placements
+    mon.breakers["fake-1"].trip("dead")
+    assert mon.pick_target(load).id == "fake-2"
+    # half-open workers are mid-trial: no migrations onto them either
+    mon.breakers["fake-2"].trip("dead")
+    assert wait_for(mon.breakers["fake-2"].probe_due)   # backoff -> half_open
+    assert mon.breakers["fake-2"].state == BREAKER_HALF_OPEN
+    assert mon.pick_target(load).id == "fake-0"
+    mon.breakers["fake-0"].trip("dead")
+    assert mon.pick_target(load) is None
+
+
+def test_scheduler_signals_accelerate_breaker():
+    drv = FakeDriver(n_workers=1)
+    mon = HealthMonitor(drv, config=FAST_HEALTH)
+    mon.report_failure("fake-0", "poll: unreachable")
+    mon.report_failure("fake-0", "poll: unreachable")
+    assert mon.state("fake-0") == BREAKER_OPEN
+    mon2 = HealthMonitor(drv, config=FAST_HEALTH)
+    mon2.report_wedge("fake-0", "poll pending 4.2s")
+    assert mon2.state("fake-0") == BREAKER_OPEN
+    assert mon2.breakers["fake-0"].last_error == "poll pending 4.2s"
+
+
+def test_driver_probe_hook_pings_and_lists():
+    drv = FakeDriver(n_workers=1)
+    drv.probe(drv.workers()[0])
+    names = [n for n, _, _ in drv.api.calls]
+    assert names == ["ping", "container_list"]
+    drv.inject_fault(0, "refuse")
+    with pytest.raises(DriverError):
+        drv.probe(drv.workers()[0])
+
+
+# ------------------------------------------------------------- failover
+
+
+def run_scheduler(cfg, drv, spec, on_event=None, poll_s=0.02,
+                  health_config=FAST_HEALTH):
+    sched = LoopScheduler(cfg, drv, spec, on_event=on_event,
+                          health_config=health_config)
+    sched.start()
+    t = threading.Thread(target=sched.run, kwargs={"poll_s": poll_s},
+                         daemon=True)
+    t.start()
+    return sched, t
+
+
+def health_states(sched, wid):
+    return [tuple(WorkerHealthEvent.parse(r.agent, r.detail).__dict__[k]
+                  for k in ("old_state", "new_state"))
+            for r in sched.events.for_agent(wid)
+            if r.event == WORKER_HEALTH]
+
+
+def test_failover_migrate_acceptance(env):
+    """ISSUE 3 acceptance: 8 loops / 4 workers, one killed mid-run under
+    migrate -- every loop reaches its budget, iteration counts survive
+    the move, and the revived worker's breaker walks
+    open -> half_open -> closed."""
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=4)
+    seed(drv, behavior=exit_behavior(b"", 0, delay=0.08))
+    events = []
+    sched, t = run_scheduler(
+        cfg, drv, LoopSpec(parallel=8, iterations=6, failover="migrate"),
+        on_event=lambda a, e, d="": events.append((a, e, d)))
+    try:
+        victims = [l for l in sched.loops if l.worker.id == "fake-1"]
+        assert len(victims) == 2
+        # kill mid-run: every victim must already be iterating
+        assert wait_for(lambda: all(l.iteration >= 1 for l in victims))
+        pre_iters = {l.agent: l.iteration for l in victims}
+        drv.inject_fault(1, "refuse")
+        assert wait_for(lambda: all(l.worker.id != "fake-1"
+                                    for l in victims))
+        drv.clear_fault(1)          # revive while the run is still going
+        t.join(30.0)
+        assert not t.is_alive()
+    finally:
+        sched.stop()
+        drv.clear_fault(1)
+        t.join(10.0)
+    # the run may finish before the revived worker's trial probes land;
+    # the monitor's breakers stay live, so drive the remaining probes
+    # synchronously -- same verdict path, deterministic timing
+    w1 = drv.workers()[1]
+    for _ in range(100):
+        if (BREAKER_HALF_OPEN, BREAKER_CLOSED) in health_states(sched, "fake-1"):
+            break
+        sched.health.probe_worker(w1)
+        time.sleep(0.01)
+    assert all(l.status == "done" and l.iteration == 6 for l in sched.loops)
+    # iteration budget preserved across the move: every migrated loop
+    # accounted exactly its budget, never re-ran from zero
+    for l in victims:
+        assert l.migrations >= 1
+        assert len(l.exit_codes) == 6
+        assert l.iteration >= pre_iters[l.agent]
+    migrated_events = [a for a, e, d in events if e == "migrated"]
+    assert set(migrated_events) == {l.agent for l in victims}
+    orphan_events = [a for a, e, d in events if e == "orphaned"]
+    assert {l.agent for l in victims} <= set(orphan_events)
+    # the dead worker's breaker recovered: open -> half_open -> closed
+    states = health_states(sched, "fake-1")
+    assert (BREAKER_OPEN, BREAKER_HALF_OPEN) in states
+    assert (BREAKER_HALF_OPEN, BREAKER_CLOSED) in states
+    sched.cleanup(remove_containers=True)
+    for api in drv.apis:        # no leaked loop containers anywhere
+        assert not [c for c in api.container_list(all=True)
+                    if "loop" in c["Names"][0]]
+
+
+def test_failover_wait_resumes_on_recovered_worker(env):
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    seed(drv, behavior=exit_behavior(b"", 0, delay=0.05))
+    sched, t = run_scheduler(
+        cfg, drv, LoopSpec(parallel=2, iterations=4, failover="wait"))
+    try:
+        victim = next(l for l in sched.loops if l.worker.id == "fake-1")
+        assert wait_for(lambda: victim.iteration >= 1)
+        drv.inject_fault(1, "refuse")
+        assert wait_for(lambda: victim.status == "orphaned")
+        # wait policy: no migration even though fake-0 is healthy
+        time.sleep(0.3)
+        assert victim.status == "orphaned"
+        assert victim.worker.id == "fake-1"
+        drv.clear_fault(1)
+        t.join(30.0)
+        assert not t.is_alive()
+    finally:
+        sched.stop()
+        drv.clear_fault(1)
+        t.join(10.0)
+    assert victim.status == "done" and victim.iteration == 4
+    assert victim.migrations == 0 and victim.worker.id == "fake-1"
+    sched.cleanup(remove_containers=True)
+
+
+def test_failover_fail_fails_fast_and_spares_peers(env):
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    seed(drv, behavior=exit_behavior(b"", 0, delay=0.05))
+    events = []
+    sched, t = run_scheduler(
+        cfg, drv, LoopSpec(parallel=2, iterations=4, failover="fail"),
+        on_event=lambda a, e, d="": events.append((a, e, d)))
+    try:
+        victim = next(l for l in sched.loops if l.worker.id == "fake-1")
+        assert wait_for(lambda: victim.iteration >= 1)
+        drv.inject_fault(1, "refuse")
+        t.join(30.0)
+        assert not t.is_alive()
+    finally:
+        sched.stop()
+        drv.clear_fault(1)
+        t.join(10.0)
+    assert victim.status == "failed"
+    assert any(e == "failed" and "failover=fail" in d
+               for a, e, d in events if a == victim.agent)
+    peer = next(l for l in sched.loops if l is not victim)
+    assert peer.status == "done" and peer.iteration == 4
+    sched.cleanup(remove_containers=True)
+
+
+def test_failover_preserves_consecutive_failure_ceiling(env):
+    """The ceiling counts across a migration: failures on the dead
+    worker plus failures at the new placement trip it together."""
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    # slow iterations: the fault injected at the first accounting must
+    # land before the next 0.2s iteration can finish
+    seed(drv, behavior=exit_behavior(b"boom\n", 2, delay=0.2))
+    killed = threading.Event()
+
+    def on_event(agent, event, detail=""):
+        # kill the victim's worker the moment its FIRST failed iteration
+        # is accounted (sink thread: safe to inject from here)
+        if event == "iteration_done" and agent.endswith("-1") and not killed.is_set():
+            killed.set()
+            drv.inject_fault(1, "refuse")
+
+    sched, t = run_scheduler(
+        cfg, drv, LoopSpec(parallel=2, iterations=10, failover="migrate"),
+        on_event=on_event)
+    try:
+        victim = next(l for l in sched.loops if l.worker.id == "fake-1")
+        t.join(30.0)
+        assert not t.is_alive()
+    finally:
+        sched.stop()
+        drv.clear_fault(1)
+        t.join(10.0)
+    # FAILURE_CEILING=3 consecutive failures total -- not 3 more after
+    # the move (a reset ceiling would account 4+ exits)
+    assert victim.status == "failed"
+    assert victim.exit_codes == [2, 2, 2]
+    assert victim.migrations >= 1
+    sched.cleanup(remove_containers=True)
+
+
+def test_no_migration_while_target_half_open(env):
+    """Orphans stay orphaned while the only candidate worker is mid-trial
+    (half-open): placement resumes only when a breaker actually closes."""
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    seed(drv, behavior=exit_behavior(b"", 0, delay=0.05))
+    # half_open_successes is unreachably high: any opened breaker walks
+    # to half-open after its tiny backoff and then STAYS half-open --
+    # a deterministic mid-trial worker, no timing windows
+    sticky = HealthConfig(
+        probe_interval_s=0.02, probe_deadline_s=0.4,
+        breaker=BreakerConfig(failure_threshold=2, backoff_base_s=0.02,
+                              backoff_max_s=0.05, backoff_jitter=0.0,
+                              half_open_successes=10_000))
+    sched, t = run_scheduler(
+        cfg, drv, LoopSpec(parallel=2, iterations=3, failover="migrate"),
+        health_config=sticky)
+    try:
+        assert wait_for(lambda: all(l.iteration >= 1 for l in sched.loops))
+        mover = next(l for l in sched.loops if l.worker.id == "fake-0")
+        # kill worker 0 and revive it immediately: its breaker opens,
+        # then sits half-open forever (trials succeed but never suffice)
+        drv.inject_fault(0, "refuse")
+        br0 = sched.health.breakers["fake-0"]
+        assert wait_for(lambda: br0.state == BREAKER_OPEN)
+        drv.clear_fault(0)
+        assert wait_for(lambda: br0.state == BREAKER_HALF_OPEN)
+        # its loop migrated AWAY to the closed worker, never back
+        assert wait_for(lambda: mover.worker.id == "fake-1"
+                        or mover.status == "done")
+        # now kill worker 1: its orphans have nowhere to go -- fake-0 is
+        # mid-trial and must not receive them
+        drv.inject_fault(1, "refuse")
+        assert wait_for(lambda: all(
+            l.status == "orphaned" for l in sched.loops
+            if l.status not in ("done", "failed")) or
+            all(l.status in ("done", "failed") for l in sched.loops),
+            timeout=5.0)
+        time.sleep(0.3)             # plenty of rescue ticks
+        for l in sched.loops:
+            if l.status == "orphaned":
+                assert l.worker.id == "fake-1"      # never placed on fake-0
+        assert br0.state == BREAKER_HALF_OPEN
+    finally:
+        sched.stop()
+        drv.clear_fault(0)
+        drv.clear_fault(1)
+        t.join(10.0)
+        assert not t.is_alive()
+    sched.cleanup(remove_containers=True)
+
+
+def test_stale_poll_after_migration_does_not_corrupt_accounting(env):
+    """A poll wedged on the dead worker completes AFTER its loops were
+    migrated: its stale view (old container ids, or 'vanished') must be
+    discarded, never fail the healthy re-placements or double-account an
+    iteration -- poll results are epoch-tagged at submit."""
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    seed(drv, behavior=exit_behavior(b"", 0, delay=0.05))
+    sched, t = run_scheduler(
+        cfg, drv, LoopSpec(parallel=2, iterations=6, failover="migrate"))
+    try:
+        victim = next(l for l in sched.loops if l.worker.id == "fake-1")
+        assert wait_for(lambda: victim.iteration >= 1)
+        drv.inject_fault(1, "wedge")        # polls + probes hang mid-call
+        assert wait_for(lambda: victim.worker.id == "fake-0")
+        # revive: the wedged lane drains and the stale poll completes
+        # while the migrated loop is mid-run on the new worker
+        drv.clear_fault(1)
+        t.join(30.0)
+        assert not t.is_alive()
+    finally:
+        sched.stop()
+        drv.clear_fault(1)
+        t.join(10.0)
+    assert victim.status == "done"
+    assert victim.iteration == 6
+    assert victim.exit_codes == [0] * 6     # no double-accounting
+    sched.cleanup(remove_containers=True)
+
+
+def test_persistent_inspect_failure_fails_loops_despite_healthy_probes(env):
+    """Daemon serves ping + list (probes all green) but inspect raises a
+    non-NotFound error deterministically: the breaker never opens, so
+    run() must escalate after the unreachable-poll ceiling and fail the
+    loops instead of spinning forever."""
+    from clawker_tpu.engine.api import Engine
+    from clawker_tpu.engine.fake import FakeDockerAPI
+    from clawker_tpu.errors import ClawkerError
+
+    class BrokenInspectAPI(FakeDockerAPI):
+        def container_inspect(self, cid):
+            info = super().container_inspect(cid)
+            # only the exit-reading inspects break; create-time inspects
+            # (state "created"/"running") stay healthy
+            if info["State"]["Status"] == "exited":
+                raise ClawkerError("daemon 500: corrupted state")
+            return info
+
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=1)
+    api = BrokenInspectAPI()
+    drv.apis[0] = api
+    drv._workers[0].engine = Engine(api)
+    seed(drv, behavior=exit_behavior(b"", 0, delay=0.03))
+    events = []
+    sched, t = run_scheduler(
+        cfg, drv, LoopSpec(parallel=1, iterations=2),
+        on_event=lambda a, e, d="": events.append((e, d)))
+    t.join(30.0)
+    try:
+        assert not t.is_alive()     # run() terminated, no livelock
+    finally:
+        sched.stop()
+        t.join(10.0)
+    assert sched.loops[0].status == "failed"
+    assert any(e == "failed" and "poll unreachable" in d for e, d in events)
+    sched.cleanup()
+
+
+def test_cli_fleet_health_single_probe_still_flags_dead_fleet(env):
+    """--probes 1: the one-shot breaker threshold clamps to the probe
+    count, so one failed round is already a non-closed verdict -- a dead
+    fleet must never exit 0 just because K rounds weren't requested."""
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    drv.inject_fault(0, "refuse")
+    drv.inject_fault(1, "refuse")
+    res = CliRunner().invoke(
+        cli, ["fleet", "health", "--probes", "1"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 1
+    assert "closed" not in res.output.replace("STATE", "")
+
+
+def test_poll_is_stale_predicate(env):
+    """A pending poll is stale only when EVERY loop it was submitted for
+    has moved on -- including loops that migrated AWAY from the worker
+    (absent from its current group), the case a group-scoped check would
+    miss."""
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    seed(drv)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=1),
+                          health_config=FAST_HEALTH)
+    sched.start()
+    sched.run(poll_s=0.05)
+    a0, a1 = sched.loops
+    assert not sched._poll_is_stale({})                      # no snapshot
+    assert not sched._poll_is_stale({a0.agent: a0.epoch})    # still current
+    assert sched._poll_is_stale({a0.agent: a0.epoch - 1})    # moved on
+    # mixed: one loop moved, one still at its polled epoch -> NOT stale
+    assert not sched._poll_is_stale({a0.agent: a0.epoch - 1,
+                                     a1.agent: a1.epoch})
+    # agents unknown to the scheduler (defensive) read as moved on
+    assert sched._poll_is_stale({"ghost": 0})
+    sched.cleanup(remove_containers=True)
+
+
+def test_launch_wedged_in_unbounded_call_still_fails_over(env):
+    """A lane wedged inside a dedicated read-unbounded engine call
+    (start hangs) on a daemon that still answers probes: the breaker
+    never opens via probes or polls (none run -- the loop's inflight
+    never completes), so the launch-wedge deadline must trip it and
+    migrate the loop."""
+    from clawker_tpu.engine.api import Engine
+    from clawker_tpu.engine.fake import FakeDockerAPI
+
+    class HungStartAPI(FakeDockerAPI):
+        def __init__(self):
+            super().__init__()
+            self.release = threading.Event()
+
+        def container_start(self, cid):
+            self.release.wait(30.0)
+            return super().container_start(cid)
+
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    hung = HungStartAPI()
+    drv.apis[1] = hung
+    drv._workers[1].engine = Engine(hung)
+    seed(drv, behavior=exit_behavior(b"", 0, delay=0.03))
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=2,
+                                             failover="migrate"),
+                          health_config=FAST_HEALTH)
+    sched.launch_wedge_s = 0.3
+    sched.start()
+    t = threading.Thread(target=sched.run, kwargs={"poll_s": 0.05},
+                         daemon=True)
+    t.start()
+    try:
+        victim = next(l for l in sched.loops if l.worker.id == "fake-1")
+        assert wait_for(lambda: victim.worker.id == "fake-0", timeout=15.0)
+        t.join(20.0)
+        assert not t.is_alive()
+    finally:
+        sched.stop()
+        hung.release.set()
+        t.join(10.0)
+    assert victim.status == "done" and victim.iteration == 2
+    assert victim.migrations >= 1
+    states = health_states(sched, "fake-1")
+    assert (BREAKER_CLOSED, BREAKER_OPEN) in states
+    sched.cleanup(remove_containers=True)
+
+
+def test_failover_fail_terminates_despite_wedged_inflight(env):
+    """failover=fail with the orphaning cause being a WEDGED launch: the
+    failed loop's never-completing inflight future must not keep run()
+    busy forever -- the fail path replaces it."""
+    from clawker_tpu.engine.api import Engine
+    from clawker_tpu.engine.fake import FakeDockerAPI
+
+    class HungStartAPI(FakeDockerAPI):
+        def __init__(self):
+            super().__init__()
+            self.release = threading.Event()
+
+        def container_start(self, cid):
+            self.release.wait(30.0)
+            return super().container_start(cid)
+
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    hung = HungStartAPI()
+    drv.apis[1] = hung
+    drv._workers[1].engine = Engine(hung)
+    seed(drv, behavior=exit_behavior(b"", 0, delay=0.03))
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=2,
+                                             failover="fail"),
+                          health_config=FAST_HEALTH)
+    sched.launch_wedge_s = 0.3
+    sched.start()
+    t = threading.Thread(target=sched.run, kwargs={"poll_s": 0.05},
+                         daemon=True)
+    t.start()
+    t.join(15.0)
+    try:
+        assert not t.is_alive()         # run() terminated
+    finally:
+        sched.stop()
+        hung.release.set()
+        t.join(10.0)
+    victim = next(l for l in sched.loops if l.worker.id == "fake-1")
+    peer = next(l for l in sched.loops if l is not victim)
+    assert victim.status == "failed"
+    assert peer.status == "done" and peer.iteration == 2
+    sched.cleanup(remove_containers=True)
+
+
+def test_cli_loop_orphaned_is_nonzero_exit(env):
+    """Interrupting a run whose loops are stranded 'orphaned' (worker
+    dead, failover=wait) must exit non-zero -- abandoned work is not a
+    success."""
+    import os
+    import signal as _signal
+
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    seed(drv, behavior=exit_behavior(b"", 0, delay=0.1))
+
+    def sabotage():
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if any(c.state == "running"
+                   for c in drv.apis[1].containers.values()):
+                break
+            time.sleep(0.01)
+        drv.inject_fault(1, "refuse")
+        # the CLI runs the DEFAULT health config (1s probes, threshold
+        # 3): give the breaker time to open and orphan the victim
+        time.sleep(6.0)
+        os.kill(os.getpid(), _signal.SIGINT)   # the user gives up
+
+    t = threading.Thread(target=sabotage, daemon=True)
+    t.start()
+    res = CliRunner().invoke(
+        cli, ["loop", "--parallel", "2", "--iterations", "50",
+              "--failover", "wait", "--json"],
+        obj=Factory(cwd=proj, driver=drv))
+    t.join(5.0)
+    drv.clear_fault(1)
+    assert res.exit_code == 1
+    import json as _json
+
+    statuses = {a["agent"]: a["status"]
+                for a in _json.loads(res.stdout)["agents"]}
+    assert "orphaned" in statuses.values(), statuses
+
+
+def test_orphan_grace_fails_run_when_whole_fleet_dead(env):
+    """Total fleet death under the default migrate policy must terminate
+    the run (orphans fail after orphan_grace_s), not hang a
+    non-interactive invocation forever."""
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=1)
+    seed(drv, behavior=exit_behavior(b"", 0, delay=0.05))
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=10,
+                                             failover="migrate"),
+                          health_config=FAST_HEALTH)
+    sched.orphan_grace_s = 0.4
+    sched.start()
+    t = threading.Thread(target=sched.run, kwargs={"poll_s": 0.05},
+                         daemon=True)
+    t.start()
+    try:
+        assert wait_for(lambda: sched.loops[0].iteration >= 1)
+        drv.inject_fault(0, "refuse")       # the only worker dies for good
+        t.join(15.0)
+        assert not t.is_alive()             # run() terminated
+    finally:
+        sched.stop()
+        drv.clear_fault(0)
+        t.join(10.0)
+    assert sched.loops[0].status == "failed"
+    recs = sched.events.for_agent(sched.loops[0].agent)
+    assert any(r.event == "failed" and "no healthy placement" in r.detail
+               for r in recs)
+    sched.cleanup()
+
+
+def test_failover_wait_recovers_after_launch_wedge(env):
+    """wait policy through a WEDGED start: the stale inflight future
+    stays running forever, but it must not keep re-tripping the breaker
+    -- once the daemon's probes stay green the worker closes, the orphan
+    resumes on a fresh lane, and the loop finishes."""
+    from clawker_tpu.engine.api import Engine
+    from clawker_tpu.engine.fake import FakeDockerAPI
+
+    class HungStartAPI(FakeDockerAPI):
+        def __init__(self):
+            super().__init__()
+            self.release = threading.Event()
+
+        def container_start(self, cid):
+            if not self.release.is_set():
+                self.release.wait(30.0)
+            return super().container_start(cid)
+
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    hung = HungStartAPI()
+    drv.apis[1] = hung
+    drv._workers[1].engine = Engine(hung)
+    seed(drv, behavior=exit_behavior(b"", 0, delay=0.03))
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=2,
+                                             failover="wait"),
+                          health_config=FAST_HEALTH)
+    sched.launch_wedge_s = 0.3
+    sched.start()
+    t = threading.Thread(target=sched.run, kwargs={"poll_s": 0.05},
+                         daemon=True)
+    t.start()
+    try:
+        victim = next(l for l in sched.loops if l.worker.id == "fake-1")
+        assert wait_for(lambda: victim.status == "orphaned", timeout=15.0)
+        hung.release.set()          # daemon unwedges; probes were green
+        t.join(20.0)
+        assert not t.is_alive()
+    finally:
+        sched.stop()
+        hung.release.set()
+        t.join(10.0)
+    assert victim.status == "done" and victim.iteration == 2
+    assert victim.worker.id == "fake-1" and victim.migrations == 0
+    sched.cleanup(remove_containers=True)
+
+
+def test_deterministic_start_5xx_fails_after_strand_ceiling(env):
+    """A daemon that EXECUTES requests but 5xxes every start (bad image
+    cmd, disk full) maps to DriverError, so the loop strands -- but the
+    breaker never opens (probes succeed), so rescue must stop churning
+    strand->re-place after the strand ceiling and fail the loop."""
+    from clawker_tpu.engine.api import Engine
+    from clawker_tpu.engine.fake import FakeDockerAPI
+
+    class Start500API(FakeDockerAPI):
+        def container_start(self, cid):
+            raise DriverError("500: OCI runtime create failed (injected)")
+
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    for i in range(2):
+        api = Start500API()
+        drv.apis[i] = api
+        drv._workers[i].engine = Engine(api)
+    seed(drv)
+    events = []
+    sched, t = run_scheduler(
+        cfg, drv, LoopSpec(parallel=2, iterations=3, failover="migrate"),
+        on_event=lambda a, e, d="": events.append((e, d)))
+    t.join(30.0)
+    try:
+        assert not t.is_alive()         # bounded: no infinite churn
+    finally:
+        sched.stop()
+        t.join(10.0)
+    assert all(l.status == "failed" for l in sched.loops)
+    assert any(e == "failed" and "stranded create/starts" in d
+               for e, d in events)
+    sched.cleanup(remove_containers=True)
+
+
+def test_ssh_transport_probe_latency_and_failure(tmp_path):
+    from clawker_tpu.config.schema import TPUSettings
+    from clawker_tpu.fleet.transport import FakeRunner, SSHTransport, TransportError
+
+    tpu = TPUSettings(ssh_user="ops")
+    t = SSHTransport(tpu, "10.0.0.1", 0, mux_dir=tmp_path / "mux",
+                     runner=FakeRunner())
+    assert t.probe() >= 0.0
+    assert any("true" in c for c in t.runner.calls[-1])
+    down = SSHTransport(tpu, "10.0.0.2", 1, mux_dir=tmp_path / "mux",
+                        runner=FakeRunner({"true": (255, "broken pipe")}))
+    with pytest.raises(TransportError):
+        down.probe()
+
+
+def test_tpu_vm_connect_tolerates_partial_dial_failure(monkeypatch):
+    """One worker refusing to dial must NOT kill connect(): it joins the
+    fleet engine-less (probe fails -> breaker opens -> failover routes
+    around it).  Only a totally undialable pod raises."""
+    from clawker_tpu.config.schema import TPUSettings
+    from clawker_tpu.engine.drivers.tpu_vm import TPUVMDriver
+    from clawker_tpu.fleet import transport as fleet_transport
+    from clawker_tpu.fleet.transport import TransportError
+
+    class FakeEngine:
+        def ping(self):
+            return True
+
+        def list_containers(self, **kw):
+            return []
+
+        def close(self):
+            pass
+
+    def fake_connect(tpu, host, index, *, runner=None):
+        if host == "h1":
+            raise TransportError("worker 1 (h1): forward did not come up")
+        return FakeEngine()
+
+    monkeypatch.setattr(fleet_transport, "connect_worker_engine",
+                        fake_connect)
+    drv = TPUVMDriver(TPUSettings(workers=["h0", "h1", "h2"]))
+    workers = drv.connect()
+    assert [w.id for w in workers] == ["tpu-0", "tpu-1", "tpu-2"]
+    assert workers[0].engine is not None and workers[2].engine is not None
+    assert workers[1].engine is None
+    assert "forward did not come up" in workers[1].meta["dial_error"]
+    # the engine-less worker's breaker is pre-opened at monitor init:
+    # placement routes around it from tick one, no K-probe warmup
+    mon = HealthMonitor(drv, config=FAST_HEALTH)
+    assert mon.state("tpu-1") == BREAKER_OPEN
+    assert "forward did not come up" in mon.breakers["tpu-1"].last_error
+    res = mon.probe_all()
+    assert res["tpu-0"].ok and res["tpu-2"].ok
+    assert not res["tpu-1"].ok
+
+    # a pod with NO dialable worker still raises loudly
+    monkeypatch.setattr(
+        fleet_transport, "connect_worker_engine",
+        lambda *a, **k: (_ for _ in ()).throw(TransportError("all dead")))
+    with pytest.raises(DriverError, match="no worker could be dialed"):
+        TPUVMDriver(TPUSettings(workers=["h0", "h1"])).connect()
+
+
+def test_unreach_counter_resets_on_orphan_and_recovery(env):
+    """The per-worker unreachable-poll count from a finished death
+    episode must not carry over: one post-recovery blip would otherwise
+    instantly condemn the worker's loops."""
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=1)
+    seed(drv)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=1),
+                          health_config=FAST_HEALTH)
+    sched.health = HealthMonitor(drv, config=FAST_HEALTH)
+    sched._unreach["fake-0"] = 3
+    sched._orphan_worker("fake-0", "test episode over")
+    assert "fake-0" not in sched._unreach
+    sched._unreach["fake-0"] = 3
+    sched._verdicts.put(("fake-0", BREAKER_HALF_OPEN, BREAKER_CLOSED, "ok"))
+    sched._drain_verdicts()
+    assert "fake-0" not in sched._unreach
+
+
+def test_deadline_probe_gets_ssh_diagnosis(env):
+    """A probe that overruns its deadline never reached the tpu_vm ssh
+    follow-up: the monitor's separate diagnose hook must still say
+    whether the HOST is alive (restart dockerd vs recreate the VM)."""
+    tenv, proj, cfg = env
+
+    class WedgedEngineDriver(FakeDriver):
+        def probe(self, worker):
+            time.sleep(10.0)        # engine call never returns in time
+
+        def diagnose(self, worker):
+            return "host ssh alive (7ms rtt); daemon hung?"
+
+    drv = WedgedEngineDriver(n_workers=1)
+    mon = HealthMonitor(drv, config=FAST_HEALTH)
+    res = mon.probe_worker(drv.workers()[0])
+    assert not res.ok
+    assert "deadline" in res.error and "host ssh alive" in res.error
+
+
+def test_tpu_vm_diagnose_reports_host_liveness():
+    from clawker_tpu.config.schema import TPUSettings
+    from clawker_tpu.engine.drivers.base import Worker
+    from clawker_tpu.engine.drivers.tpu_vm import TPUVMDriver
+    from clawker_tpu.fleet.transport import TransportError
+
+    class Eng:
+        pass
+
+    class FakeTransport:
+        def __init__(self, alive):
+            self.alive = alive
+
+        def probe(self, *, timeout=5.0):
+            if not self.alive:
+                raise TransportError("ssh dead")
+            return 0.007
+
+    drv = TPUVMDriver(TPUSettings(workers=["h0"]))
+    eng = Eng()
+    eng.transport = FakeTransport(alive=True)
+    w = Worker(id="tpu-0", engine=eng)
+    assert "host ssh alive" in drv.diagnose(w)
+    eng.transport = FakeTransport(alive=False)
+    assert drv.diagnose(w) == "host unreachable over ssh"
+    assert drv.diagnose(Worker(id="tpu-1", engine=None)) == ""
+
+
+def test_tpu_vm_probe_distinguishes_daemon_vs_host_death():
+    from clawker_tpu.config.schema import TPUSettings
+    from clawker_tpu.engine.drivers.base import Worker
+    from clawker_tpu.engine.drivers.tpu_vm import TPUVMDriver
+    from clawker_tpu.fleet.transport import TransportError
+
+    class DeadEngine:
+        def ping(self):
+            raise DriverError("socket gone")
+
+        def require(self):
+            return self
+
+    class FakeTransport:
+        def __init__(self, alive):
+            self.alive = alive
+
+        def probe(self, *, timeout=5.0):
+            if not self.alive:
+                raise TransportError("ssh dead")
+            return 0.01
+
+    drv = TPUVMDriver(TPUSettings(workers=["h0"]))
+    eng = DeadEngine()
+    eng.transport = FakeTransport(alive=True)
+    w = Worker(id="tpu-0", engine=eng)
+    with pytest.raises(DriverError, match="daemon unreachable but host"):
+        drv.probe(w)
+    eng.transport = FakeTransport(alive=False)
+    with pytest.raises(DriverError, match="host unreachable"):
+        drv.probe(w)
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def test_cli_fleet_health_table_and_exit_codes(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    res = CliRunner().invoke(
+        cli, ["fleet", "health", "--probes", "2"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert "WORKER" in res.output
+    assert "fake-0\tclosed" in res.output and "fake-1\tclosed" in res.output
+
+    drv.inject_fault(1, "refuse")
+    res = CliRunner().invoke(
+        cli, ["fleet", "health", "--probes", "3", "--format", "json"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 1
+    import json as _json
+
+    rows = {r["worker"]: r for r in _json.loads(res.output)}
+    assert rows["fake-1"]["state"] == "open"
+    assert "refused" in rows["fake-1"]["last_error"]
+    assert rows["fake-0"]["state"] == "closed"
+
+
+def test_cli_loop_failover_flag(env):
+    import json as _json
+
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    seed(drv)
+    res = CliRunner().invoke(
+        cli, ["loop", "--parallel", "2", "--iterations", "1",
+              "--failover", "wait", "--json"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    out = _json.loads(res.stdout)
+    assert all(a["status"] == "done" for a in out["agents"])
+    assert "wait failover" in res.stderr
+
+
+# --------------------------------------------------------------- phases
+
+
+def test_phases_incr_counts_without_duration():
+    from clawker_tpu.util import phases
+
+    phases.enable()
+    try:
+        phases.incr("health.open")
+        phases.incr("health.open")
+        phases.incr("health.closed")
+        assert phases.counts()["health.open"] == 2
+        assert phases.counts()["health.closed"] == 1
+        assert "health.open" not in phases.totals()
+    finally:
+        phases.disable()
+    phases.incr("health.open")      # disabled: free no-op
+    assert phases.counts()["health.open"] == 2

@@ -74,8 +74,13 @@ class NoExitCodeAPI(FakeDockerAPI):
 
 
 def swap_api(drv: FakeDriver, i: int, api: FakeDockerAPI) -> None:
+    from clawker_tpu.engine.drivers.fakedriver import _FaultGate
+
+    # rebuild the fault gate too: inject_fault(i) must act on the LIVE
+    # api, not the orphaned gate wrapping the discarded one
     drv.apis[i] = api
-    drv._workers[i].engine = Engine(api)
+    drv.gates[i] = _FaultGate(api)
+    drv._workers[i].engine = Engine(drv.gates[i])
 
 
 def seed(drv: FakeDriver, behavior=None) -> None:
@@ -340,7 +345,10 @@ def test_batched_poll_uses_one_list_per_worker_per_tick(env):
     sched.cleanup(remove_containers=True)
     assert all(l.status == "done" for l in sched.loops)
     for api in drv.apis:
-        lists = api.calls_named("container_list")
+        # health probes also list (all=False, no loop label); the poll
+        # cost accounting covers the scheduler's all=True batched lists
+        lists = [(a, kw) for a, kw in api.calls_named("container_list")
+                 if kw.get("all")]
         assert lists, "batched poll never ran"
         # every poll list is scoped to THIS loop run's label
         for _, kw in lists:
@@ -351,6 +359,68 @@ def test_batched_poll_uses_one_list_per_worker_per_tick(env):
         # ~6 ticks of 0.3s/0.05s would be ~24 poll inspects alone)
         polls = len(lists)
         assert polls < 24
+
+
+def test_wedged_poll_does_not_degrade_healthy_restart_latency(env):
+    """ROADMAP open item (ISSUE 3 satellite): one worker's
+    never-completing poll future used to make every tick sleep the full
+    ``poll_s`` (``futures_wait(polls, timeout=poll_s)`` waits for ALL),
+    degrading healthy workers' event-driven restarts to poll-interval
+    latency.  With done-callbacks on the poll futures waking the run
+    loop, the healthy worker's 3 iterations must finish in well under
+    ONE poll interval."""
+    tenv, proj, cfg = env
+
+    class HungLoopListAPI(FakeDockerAPI):
+        """Blocks only the scheduler's loop-label poll lists; probe
+        lists (no loop label) pass, so the breaker stays closed and the
+        wedge is purely the poll future's."""
+
+        def __init__(self):
+            super().__init__()
+            self.release = threading.Event()
+
+        def container_list(self, *, all=False, filters=None):
+            labels = (filters or {}).get("label", [])
+            if any(l.startswith(consts.LABEL_LOOP) for l in labels):
+                self.release.wait(30.0)
+            return super().container_list(all=all, filters=filters)
+
+    drv = FakeDriver(n_workers=2)
+    hung = HungLoopListAPI()
+    swap_api(drv, 1, hung)
+    seed(drv)
+
+    poll_s = 2.0
+    done_at: dict[str, float] = {}
+
+    def on_event(agent, event, detail=""):
+        if event == "done":
+            done_at[agent] = time.monotonic()
+
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=3),
+                          on_event=on_event)
+    t0 = time.monotonic()
+    sched.start()
+    t = threading.Thread(target=sched.run, kwargs={"poll_s": poll_s},
+                         daemon=True)
+    t.start()
+    try:
+        healthy = next(l for l in sched.loops if l.worker.id == "fake-0")
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and healthy.status != "done":
+            time.sleep(0.01)
+        assert healthy.status == "done" and healthy.iteration == 3
+        sched.events.flush()
+        # 3 iterations completed in under ONE poll interval: no tick in
+        # the healthy restart path waited out the wedged worker's poll
+        assert done_at[healthy.agent] - t0 < poll_s
+    finally:
+        sched.stop()
+        hung.release.set()
+        t.join(10.0)
+    assert not t.is_alive()
+    sched.cleanup()
 
 
 # ------------------------------------------------------------ event bus
