@@ -120,15 +120,19 @@ def fleet_provision(f: Factory, dry_run, no_firewall, no_cp, only, jobs):
         raise SystemExit(1)
 
 
-_HEALTH_COLUMNS = ("WORKER", "STATE", "P50MS", "P95MS", "PROBES", "FAILS",
-                   "ORPHANED", "MIG-OUT", "MIG-IN", "LAST-ERROR")
+_HEALTH_COLUMNS = ("WORKER", "STATE", "BRK", "P50MS", "P95MS", "PROBES",
+                   "FAILS", "ORPHANED", "MIG-OUT", "MIG-IN", "LAST-ERROR")
 
 
 def _health_rows(stats: list[dict]) -> list[str]:
+    # BRK is the registry's health_breaker_state gauge (0=closed
+    # 1=half_open 2=open) -- the same value a Prometheus scrape of
+    # `clawker loop --metrics-port` serves (docs/telemetry.md)
     lines = ["\t".join(_HEALTH_COLUMNS)]
     for s in stats:
         lines.append("\t".join(str(x) for x in (
-            s["worker"], s["state"], s["probe_p50_ms"], s["probe_p95_ms"],
+            s["worker"], s["state"], s["breaker_state_gauge"],
+            s["probe_p50_ms"], s["probe_p95_ms"],
             s["probes"], s["probe_failures"], s["orphaned"],
             s["migrations_out"], s["migrations_in"],
             (s["last_error"] or "-")[:60])))

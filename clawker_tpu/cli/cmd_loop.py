@@ -4,12 +4,18 @@ Net-new verb (no reference analogue -- SURVEY.md header); BASELINE.json
 benchmark configs 3-4: a single firewalled loop on one TPU-VM, and
 ``--parallel 8`` fanning one loop per v5e-8 worker with aggregated
 status output.
+
+``loop`` is a group whose bare invocation runs the loops (the original
+verb shape, so ``clawker loop -p 8`` keeps working); ``loop trace``
+reconstructs a finished run's iteration span trees from its flight
+recorder (docs/telemetry.md).
 """
 
 from __future__ import annotations
 
 import json
 import signal
+from pathlib import Path
 
 import click
 
@@ -19,7 +25,7 @@ from .factory import Factory
 pass_factory = click.make_pass_decorator(Factory)
 
 
-@click.command("loop")
+@click.group("loop", invoke_without_command=True)
 @click.option("--parallel", "-p", type=int, default=0,
               help="Number of agent loops (default: settings loop.parallel).")
 @click.option("--iterations", "-n", type=int, default=-1,
@@ -43,12 +49,29 @@ pass_factory = click.make_pass_decorator(Factory)
                    "placement before failing (default 600, 0 = fail "
                    "immediately; bounds a run against a fleet that "
                    "never recovers).")
+@click.option("--metrics-port", type=int, default=None,
+              help="Serve Prometheus metrics on 127.0.0.1:<port>/metrics "
+                   "for the run (default: settings telemetry.metrics_port; "
+                   "0 = off).")
 @click.option("--json", "as_json", is_flag=True, help="Final status as JSON.")
 @click.option("--keep", is_flag=True, help="Keep containers after the run.")
 @pass_factory
-def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
-             worktrees, env_kv, failover, orphan_grace, as_json, keep):
+@click.pass_context
+def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
+               placement, image, prompt, worktrees, env_kv, failover,
+               orphan_grace, metrics_port, as_json, keep):
     """Fan autonomous agent loops across the runtime's workers."""
+    if ctx.invoked_subcommand is not None:
+        return
+    _run_loops(f, parallel, iterations, placement, image, prompt, worktrees,
+               env_kv, failover, orphan_grace, metrics_port, as_json, keep)
+
+
+def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
+               worktrees, env_kv, failover, orphan_grace, metrics_port,
+               as_json, keep):
+    from .. import telemetry
+
     env = {}
     for kv in env_kv:
         if "=" not in kv:
@@ -56,6 +79,7 @@ def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
         k, _, v = kv.partition("=")
         env[k] = v
     defaults = f.config.settings.loop
+    tele = f.config.settings.telemetry
     spec = LoopSpec(
         parallel=parallel or defaults.parallel,
         iterations=iterations if iterations >= 0 else defaults.max_iterations,
@@ -66,12 +90,17 @@ def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
         env=env,
         failover=failover or defaults.failover,
         orphan_grace_s=orphan_grace,
+        telemetry=tele.flight_recorder,
     )
 
     live = f.streams.is_stdout_tty() and not as_json
     dashboard = None
 
     def on_event(agent, event, detail=""):
+        if event == "trace.span":
+            return      # spans go to the flight recorder; the stderr
+            #             lines / dashboard ticker stay the lifecycle
+            #             stream
         if dashboard is not None:
             dashboard.record_event(agent, event, detail)
             return
@@ -81,6 +110,17 @@ def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
     sched = LoopScheduler(f.config, f.driver, spec, on_event=on_event)
     feed = None
     watch = None
+    metrics_server = None
+    shipper = None
+    port = metrics_port if metrics_port is not None else tele.metrics_port
+    if port:
+        metrics_server = telemetry.MetricsServer(port).start()
+        click.echo(f"metrics: http://127.0.0.1:{metrics_server.port}/metrics",
+                   err=True)
+    if tele.otlp:
+        lane = telemetry.telemetry_lane(f.config)
+        if lane is not None:
+            shipper = telemetry.MetricsOtlpShipper(lane).start()
     # fleet anomaly scoring rides along whenever the accelerator runtime
     # is importable: scores land in the dashboard's ANOM-Z column, the
     # status JSON, and as scheduler events past the threshold
@@ -128,6 +168,10 @@ def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
             feed.stop()
         if watch is not None:
             watch.stop()
+        if shipper is not None:
+            shipper.stop()
+        if metrics_server is not None:
+            metrics_server.stop()
     if not keep:
         sched.cleanup(remove_containers=True)
     if as_json:
@@ -138,11 +182,126 @@ def loop_cmd(f: Factory, parallel, iterations, placement, image, prompt,
             codes = ",".join(map(str, l.exit_codes)) or "-"
             click.echo(f"{l.agent}\t{l.worker.id}\t{l.status}\t"
                        f"iters={l.iteration}\texits={codes}")
+        if sched.flight is not None:
+            click.echo(f"trace: clawker loop trace {sched.loop_id}", err=True)
     # orphaned loops never completed their budget (worker died, no
     # failover outcome before stop): that is not a success either
     if any(l.status in ("failed", "orphaned") for l in loops):
         raise SystemExit(1)
 
 
+# ------------------------------------------------------------------- trace
+
+
+def _resolve_flight(f: Factory, run: str | None) -> Path:
+    from ..monitor.ledger import FLIGHT_DIR, flight_path
+
+    flight_dir = f.config.logs_dir / FLIGHT_DIR
+    if run:
+        as_path = Path(run)
+        if as_path.exists() and as_path.is_file():
+            return as_path
+        exact = flight_path(f.config.logs_dir, run)
+        if exact.exists():
+            return exact
+        # id prefixes are fine as long as they are unambiguous
+        matches = sorted(flight_dir.glob(f"loop-{run}*.jsonl"))
+        if len(matches) == 1:
+            return matches[0]
+        if matches:
+            names = ", ".join(m.stem.removeprefix("loop-") for m in matches)
+            raise click.ClickException(
+                f"run {run!r} is ambiguous: {names}")
+        raise click.ClickException(
+            f"no flight record for run {run!r} under {flight_dir}")
+    latest = max(flight_dir.glob("loop-*.jsonl"), default=None,
+                 key=lambda p: p.stat().st_mtime)
+    if latest is None:
+        raise click.ClickException(
+            f"no flight records under {flight_dir} (runs record one by "
+            "default; check settings telemetry.flight_recorder)")
+    return latest
+
+
+def _fmt_ms(seconds: float) -> str:
+    return f"{seconds * 1000:.1f}ms"
+
+
+def _render_node(node, depth: int, out: list[str]) -> None:
+    rec = node.record
+    pad = "  " * depth
+    if depth == 0:
+        attrs = rec.attrs
+        extra = "".join(
+            f" {k}={attrs[k]}" for k in ("queue_ms", "resumed")
+            if k in attrs)
+        # a non-iteration root is a phase span whose iteration root never
+        # flushed (crashed run): show it, flagged, rather than hide it
+        name = (f"iteration {attrs.get('iteration', '?')}"
+                if rec.name == "iteration"
+                else f"{rec.name} (no iteration root)")
+        out.append(f"{rec.agent}  {name} "
+                   f"[{rec.status}] {_fmt_ms(rec.wall_s)} "
+                   f"worker={rec.worker}{extra}")
+    else:
+        keys = [k for k in sorted(rec.attrs) if k != "iteration"]
+        extra = "".join(f" {k}={rec.attrs[k]}" for k in keys)
+        out.append(f"{pad}{rec.name} {_fmt_ms(rec.wall_s)}{extra}")
+    for child in node.children:
+        _render_node(child, depth + 1, out)
+
+
+@loop_group.command("trace")
+@click.argument("run", required=False)
+@click.option("--json", "as_json", is_flag=True,
+              help="Reconstructed span trees as JSON.")
+@pass_factory
+def loop_trace(f: Factory, run, as_json):
+    """Reconstruct a loop run's iteration span trees.
+
+    RUN is a loop id (as printed by `clawker loop`), an unambiguous id
+    prefix, or a path to a flight-recorder JSONL file; the newest run is
+    traced when omitted.  Shows per-span wall time, lane queue time, and
+    migration hops -- the post-mortem view of what every iteration paid
+    and where it travelled (docs/telemetry.md).
+    """
+    from ..telemetry import build_trees, load_spans, tree_to_dict
+
+    path = _resolve_flight(f, run)
+    spans = load_spans(path.read_text(encoding="utf-8").splitlines())
+    if not spans:
+        raise click.ClickException(f"{path}: no span records")
+    trees = build_trees(spans)
+    run_id = spans[0].trace_id or path.stem.removeprefix("loop-")
+    if as_json:
+        click.echo(json.dumps({
+            "run": run_id,
+            "path": str(path),
+            "iterations": [tree_to_dict(t) for t in trees],
+        }, indent=2))
+        return
+    agents = sorted({s.agent for s in spans})
+    migrations = [s for s in spans if s.name == "migrate"]
+    # a phase span promoted to a root means its iteration root never
+    # flushed -- the writer died before end_iteration/close_open ran
+    promoted = [t for t in trees if t.record.name != "iteration"]
+    n_iters = len(trees) - len(promoted)
+    click.echo(f"run {run_id}: {n_iters} iteration span(s) across "
+               f"{len(agents)} agent(s)  ({path})")
+    out: list[str] = []
+    for tree in trees:
+        _render_node(tree, 0, out)
+    for line in out:
+        click.echo(line)
+    if migrations:
+        click.echo("migration hops:")
+        for m in sorted(migrations, key=lambda s: s.t_start):
+            click.echo(f"  {m.agent} iteration {m.attrs.get('iteration')}: "
+                       f"{m.attrs.get('src')} -> {m.attrs.get('dst')}")
+    if promoted:
+        click.echo(f"warning: {len(promoted)} span(s) without a recorded "
+                   "iteration root (crashed run?)", err=True)
+
+
 def register(cli: click.Group) -> None:
-    cli.add_command(loop_cmd)
+    cli.add_command(loop_group)

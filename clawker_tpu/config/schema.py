@@ -374,6 +374,20 @@ class RuntimeSettings:
 
 
 @dataclass
+class TelemetrySettings:
+    """Fleet telemetry (net-new; docs/telemetry.md).
+
+    Spans + flight recorder are on by default (cheap, and post-mortems
+    exist for the runs nobody planned to debug); the Prometheus scrape
+    port is opt-in because it opens a listener."""
+
+    metrics_port: int = 0           # 127.0.0.1 scrape port; 0 = off
+    otlp: bool = False              # ship registry snapshots over the
+    #                                 CP's OTLP lanes during loop runs
+    flight_recorder: bool = True    # per-run span JSONL under logs/flight
+
+
+@dataclass
 class CredentialSettings:
     """Host-credential staging policy (off by default).
 
@@ -397,6 +411,7 @@ class Settings:
     control_plane: ControlPlaneSettings = field(default_factory=ControlPlaneSettings)
     runtime: RuntimeSettings = field(default_factory=RuntimeSettings)
     loop: LoopSettings = field(default_factory=LoopSettings)
+    telemetry: TelemetrySettings = field(default_factory=TelemetrySettings)
     credentials: CredentialSettings = field(default_factory=CredentialSettings)
 
     @staticmethod

@@ -731,7 +731,8 @@ class FakeDockerAPI:
 
     def pool_stats(self) -> dict:
         """Surface parity with HTTPDockerAPI: no sockets, all zeros."""
-        return {"dials": 0, "reuses": 0, "stale_retries": 0, "idle": 0}
+        return {"dials": 0, "reuses": 0, "stale_retries": 0,
+                "suppressed_retries": 0, "idle": 0}
 
     def close(self) -> None:
         """Surface parity with HTTPDockerAPI.close (drain-on-shutdown)."""

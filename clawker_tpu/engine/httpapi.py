@@ -14,10 +14,15 @@ Unary calls ride a keep-alive connection pool (pool.ConnectionPool):
 checkout an idle persistent connection, send, check it back in on clean
 completion.  A request that fails on a *reused* connection (the daemon
 reaped the idle socket: BrokenPipeError / ECONNRESET / BadStatusLine) is
-retried exactly once on a fresh dial; a first-dial failure raises
+retried exactly once on a fresh dial -- but ONLY for idempotent verbs
+(urllib3-style allowlist): a connection that dies before the status
+line also matches a response lost AFTER the daemon executed the request
+(forward drop, daemon restart), and re-sending a kill/exec_create there
+would double-execute it.  Suppressed retries are counted
+(``engine_retries_suppressed_total``).  A first-dial failure raises
 ``DriverError`` immediately.  Streams, ``/events`` and hijacked
 attach/exec connections use dedicated sockets that are never pooled.
-See docs/engine-connection-pool.md.
+See docs/engine-connection-pool.md and docs/telemetry.md.
 """
 
 from __future__ import annotations
@@ -28,15 +33,31 @@ import json
 import socket
 import struct
 import threading
+import time
 import urllib.parse
 from pathlib import Path
 from typing import Any, Callable, Iterator
 
+from .. import telemetry
 from ..errors import ClawkerError, DriverError
 from .errors_map import raise_for
 from .pool import ConnectionPool, _SockConnection  # noqa: F401 (re-export)
 
 API_PREFIX = "/v1.43"
+
+# Verbs whose daemon-side handlers are safe to re-send after a reused
+# socket died before the status line (urllib3 Retry.DEFAULT_ALLOWED_METHODS
+# minus the ones this client never issues).  POST is deliberately absent:
+# kill / exec_create / create re-sent after a lost response double-execute.
+IDEMPOTENT_METHODS = frozenset({"GET", "HEAD", "PUT", "DELETE",
+                                "OPTIONS", "TRACE"})
+
+# Per-verb unary latency (dial + send + first-byte + body).  Verb, not
+# path: bounded cardinality, and the slow verbs (POST create/start) are
+# exactly the ones worth a distribution.
+_REQUEST_SECONDS = telemetry.histogram(
+    "engine_request_seconds", "Engine-API unary request latency",
+    labels=("verb",))
 
 # Unary calls against a hung daemon must fail, not block a scheduler
 # lane forever; streams/hijacks clear this (pool.dedicated -> unbounded).
@@ -186,9 +207,13 @@ class HTTPDockerAPI:
         (wait / stop / restart); everything else checks a connection out
         of the pool and returns it on clean completion.  A failure on a
         REUSED connection -- the daemon reaped the idle socket between
-        requests -- is retried exactly once on a fresh dial; first-dial
-        failures raise ``DriverError`` unchanged.
+        requests -- is retried exactly once on a fresh dial IF the verb
+        is idempotent; non-idempotent verbs surface the failure (the
+        daemon may have executed the request and lost only the
+        response), counting the suppressed retry.  First-dial failures
+        raise ``DriverError`` unchanged.
         """
+        t_req = time.perf_counter()
         hdrs = {"Host": "docker", "Connection": "keep-alive"}
         data: bytes | None = None
         if raw_body is not None:
@@ -222,9 +247,16 @@ class HTTPDockerAPI:
                     # retry on a guaranteed-fresh dial.  A TimeoutError
                     # is excluded: that is a SLOW daemon still executing
                     # the request, and re-sending would run it twice.
-                    self._pool.note_stale_retry()
-                    retried = True
-                    continue
+                    # Non-idempotent verbs are excluded too -- a socket
+                    # dead before the status line ALSO matches a
+                    # response lost after execution (forward drop,
+                    # daemon restart), and re-sending a kill or an
+                    # exec_create there would run it twice.
+                    if method in IDEMPOTENT_METHODS:
+                        self._pool.note_stale_retry()
+                        retried = True
+                        continue
+                    self._pool.note_suppressed_retry()
                 raise DriverError(f"daemon unreachable ({method} {path}): {e}") from e
             try:
                 payload = resp.read()
@@ -237,6 +269,11 @@ class HTTPDockerAPI:
                 conn.close()
                 raise DriverError(f"daemon unreachable ({method} {path}): {e}") from e
             break
+        if not dedicated:
+            # dedicated ops (wait/stop/put_archive) legitimately block for
+            # container lifetimes -- recording them would drown the verb's
+            # actual daemon latency distribution
+            _REQUEST_SECONDS.labels(method).observe(time.perf_counter() - t_req)
         if dedicated or resp.will_close:
             conn.close()
         else:

@@ -31,8 +31,22 @@ import time
 from typing import Callable
 
 from ..util import phases
+from .. import telemetry
 
 SocketFactory = Callable[[], socket.socket]
+
+# Registry metrics (telemetry subsystem): one process-wide family each,
+# shared by every pool instance -- a process talks to one fleet.
+_DIALS = telemetry.counter(
+    "engine_dials_total", "Engine-API socket dials")
+_REUSES = telemetry.counter(
+    "engine_reuses_total", "Engine-API pooled-connection reuses")
+_STALE_RETRIES = telemetry.counter(
+    "engine_stale_retries_total",
+    "Unary requests retried on a fresh dial after a reaped idle socket")
+_SUPPRESSED_RETRIES = telemetry.counter(
+    "engine_retries_suppressed_total",
+    "Stale-socket retries suppressed because the verb is not idempotent")
 
 # Sized for the loop scheduler's fan-out: 8 per-worker lanes plus the
 # event feeder can share one endpoint without churning sockets.
@@ -87,12 +101,14 @@ class ConnectionPool:
         self._dials = 0
         self._reuses = 0
         self._stale_retries = 0
+        self._suppressed_retries = 0
 
     # ---------------------------------------------------------- lifecycle
 
     def _count_dial(self) -> None:
         with self._lock:
             self._dials += 1
+        _DIALS.inc()
 
     def _new(self) -> _SockConnection:
         return _SockConnection(self._factory, on_dial=self._count_dial)
@@ -112,6 +128,8 @@ class ConnectionPool:
                 self._reuses += 1
                 conn = c
                 break
+        if conn is not None:
+            _REUSES.inc()
         for c in reaped:
             _close_quietly(c)
         if conn is not None:
@@ -151,6 +169,15 @@ class ConnectionPool:
     def note_stale_retry(self) -> None:
         with self._lock:
             self._stale_retries += 1
+        _STALE_RETRIES.inc()
+
+    def note_suppressed_retry(self) -> None:
+        """A reused socket died before the status line under a
+        NON-idempotent verb: the retry the idempotent path would take
+        was suppressed (httpapi's allowlist) and the failure surfaced."""
+        with self._lock:
+            self._suppressed_retries += 1
+        _SUPPRESSED_RETRIES.inc()
 
     # ---------------------------------------------------------- accessors
 
@@ -160,6 +187,7 @@ class ConnectionPool:
                 "dials": self._dials,
                 "reuses": self._reuses,
                 "stale_retries": self._stale_retries,
+                "suppressed_retries": self._suppressed_retries,
                 "idle": len(self._idle),
             }
 

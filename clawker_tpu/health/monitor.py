@@ -27,15 +27,41 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
-from .. import logsetup
+from .. import logsetup, telemetry
 from ..engine.drivers import Worker
 from ..monitor.events import WORKER_HEALTH, EventBus, WorkerHealthEvent
 from ..util import phases
-from .breaker import BREAKER_CLOSED, BreakerConfig, CircuitBreaker
+from .breaker import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    BreakerConfig,
+    CircuitBreaker,
+)
 
 log = logsetup.get("health.monitor")
 
 LATENCY_WINDOW = 256    # per-worker probe-latency samples kept for p50/p95
+
+# Registry metrics (docs/telemetry.md): the breaker-state gauge encodes
+# closed=0 / half_open=1 / open=2 so a flat scrape can alert on any
+# non-zero worker without string matching.
+BREAKER_GAUGE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1, BREAKER_OPEN: 2}
+_PROBE_SECONDS = telemetry.histogram(
+    "health_probe_seconds", "Worker probe round-trip latency (successes)",
+    labels=("worker",))
+_PROBE_FAILURES = telemetry.counter(
+    "health_probe_failures_total", "Failed worker probes", labels=("worker",))
+_BREAKER_STATE = telemetry.gauge(
+    "health_breaker_state",
+    "Worker circuit-breaker state (0=closed 1=half_open 2=open)",
+    labels=("worker",))
+_ORPHANED = telemetry.counter(
+    "health_orphaned_total", "Loops orphaned off a worker by its breaker",
+    labels=("worker",))
+_MIGRATIONS = telemetry.counter(
+    "health_migrations_total", "Loop migrations between workers",
+    labels=("src", "dst"))
 
 
 @dataclass(frozen=True)
@@ -92,6 +118,10 @@ class HealthMonitor:
         # so placement routes around it from tick one instead of burning
         # K probe failures (and a strand per loop slotted there) first
         for w in self.workers:
+            # seed the gauge so a scrape sees every worker from tick one
+            # (pre-opened breakers below overwrite via their transition)
+            _BREAKER_STATE.labels(w.id).set(BREAKER_GAUGE[BREAKER_CLOSED])
+        for w in self.workers:
             if w.engine is None:
                 self.breakers[w.id].trip(
                     w.meta.get("dial_error", "engine not connected"))
@@ -136,8 +166,10 @@ class HealthMonitor:
             else:
                 self._counts[worker.id]["probe_failures"] += 1
         if res.ok:
+            _PROBE_SECONDS.labels(worker.id).observe(res.latency_s)
             br.record_success()
         else:
+            _PROBE_FAILURES.labels(worker.id).inc()
             br.record_failure(res.error)
         return res
 
@@ -231,11 +263,13 @@ class HealthMonitor:
             br.trip(reason or "lane wedged")
 
     def note_orphaned(self, worker_id: str, n: int = 1) -> None:
+        _ORPHANED.labels(worker_id).inc(n)
         with self._lock:
             if worker_id in self._counts:
                 self._counts[worker_id]["orphaned"] += n
 
     def note_migration(self, src_id: str, dst_id: str) -> None:
+        _MIGRATIONS.labels(src_id, dst_id).inc()
         with self._lock:
             if src_id in self._counts:
                 self._counts[src_id]["migrations_out"] += 1
@@ -295,6 +329,7 @@ class HealthMonitor:
                 out.append({
                     "worker": w.id,
                     "state": snap["state"],
+                    "breaker_state_gauge": BREAKER_GAUGE.get(snap["state"], -1),
                     "probe_p50_ms": round(_quantile(lat, 0.50) * 1000, 2),
                     "probe_p95_ms": round(_quantile(lat, 0.95) * 1000, 2),
                     "retry_in_s": round(snap["retry_in_s"], 2),
@@ -308,6 +343,7 @@ class HealthMonitor:
     def _transition(self, worker_id: str, old: str, new: str,
                     reason: str) -> None:
         phases.incr(f"health.{new}")
+        _BREAKER_STATE.labels(worker_id).set(BREAKER_GAUGE.get(new, -1))
         ev = WorkerHealthEvent(worker_id, old, new, reason)
         self.events.emit(worker_id, WORKER_HEALTH, ev.detail())
         log.info("worker %s: %s -> %s (%s)", worker_id, old, new, reason)

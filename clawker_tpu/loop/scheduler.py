@@ -73,16 +73,41 @@ from concurrent.futures import wait as futures_wait
 from dataclasses import dataclass, field
 from pathlib import Path
 
-from .. import consts, logsetup
+from .. import consts, logsetup, telemetry
 from ..config import Config
 from ..engine.drivers import RuntimeDriver, Worker
 from ..errors import ClawkerError, DriverError, NotFoundError
 from ..health import BREAKER_CLOSED, BREAKER_OPEN, HealthConfig, HealthMonitor
-from ..monitor.events import EventBus
+from ..monitor.events import TRACE_SPAN, EventBus
+from ..monitor.ledger import FlightRecorder, flight_path
 from ..runtime.orchestrate import AgentRuntime, CreateOptions
+from ..telemetry.spans import (
+    SPAN_CREATE,
+    SPAN_EXIT,
+    SPAN_MIGRATE,
+    SPAN_ORPHAN,
+    SPAN_START,
+    SPAN_WAIT,
+    Tracer,
+)
 from ..util import ids
 
 log = logsetup.get("loop.scheduler")
+
+# Lane telemetry (docs/telemetry.md): queue-wait vs execute time per
+# worker -- the direct form of the signal wedge detection used to infer
+# from future states (a healthy lane has near-zero queue wait; a wedged
+# one shows queue time exploding while execute time flatlines).
+_LANE_QUEUE_SECONDS = telemetry.histogram(
+    "loop_lane_queue_seconds",
+    "Time a lane task waited queued behind earlier tasks",
+    labels=("worker",))
+_LANE_EXECUTE_SECONDS = telemetry.histogram(
+    "loop_lane_execute_seconds", "Time a lane task spent executing",
+    labels=("worker",))
+_ITERATIONS = telemetry.counter(
+    "loop_iterations_total", "Completed loop iterations",
+    labels=("status",))           # status: ok | failed
 
 FAILURE_CEILING = 3          # consecutive nonzero exits -> loop failed
 LOOP_STATE_DIR = "/run/clawker"
@@ -130,6 +155,9 @@ class LoopSpec:
     agent_prefix: str = "loop"
     env: dict[str, str] = field(default_factory=dict)
     failover: str = "migrate"        # migrate | wait | fail
+    telemetry: bool = True           # iteration spans + flight recorder
+    #                                  (metrics registration is import-time
+    #                                  and stays on either way)
     orphan_grace_s: float | None = None    # None = ORPHAN_GRACE_S; bounds
     #                                  how long an orphan may sit with no
     #                                  healthy placement before failing
@@ -188,6 +216,7 @@ class _WorkerLane:
     """
 
     def __init__(self, name: str):
+        self.name = name
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._thread = threading.Thread(target=self._drain, daemon=True,
                                         name=f"loop-lane-{name}")
@@ -195,7 +224,7 @@ class _WorkerLane:
 
     def submit(self, fn, *args) -> Future:
         fut: Future = Future()
-        self._q.put((fut, fn, args))
+        self._q.put((fut, fn, args, time.monotonic()))
         return fut
 
     def close(self) -> None:
@@ -206,13 +235,18 @@ class _WorkerLane:
             item = self._q.get()
             if item is None:
                 return
-            fut, fn, args = item
+            fut, fn, args, t_submit = item
             if not fut.set_running_or_notify_cancel():
                 continue
+            t_run = time.monotonic()
+            _LANE_QUEUE_SECONDS.labels(self.name).observe(t_run - t_submit)
             try:
                 fut.set_result(fn(*args))
             except BaseException as e:   # the lane must survive any task
                 fut.set_exception(e)
+            finally:
+                _LANE_EXECUTE_SECONDS.labels(self.name).observe(
+                    time.monotonic() - t_run)
 
 
 class LoopScheduler:
@@ -259,6 +293,25 @@ class LoopScheduler:
         #                                       reset on success, orphan, and
         #                                       recovery (a stale count must
         #                                       not condemn a healed worker)
+        # --- telemetry: every iteration is a span tree (iteration ->
+        # create/start/wait/exit|orphan|migrate), flushed to the per-run
+        # flight recorder AND the bus as typed trace.span records.  On by
+        # default: the recorder exists for the runs nobody planned to
+        # debug.  See docs/telemetry.md.
+        self.flight: FlightRecorder | None = None
+        if spec.telemetry:
+            self.flight = FlightRecorder(
+                flight_path(cfg.logs_dir, self.loop_id))
+        self.tracer = Tracer(
+            self.loop_id,
+            on_span=self._record_span if spec.telemetry else None)
+        self._queue_wait: dict[str, float] = {}   # agent -> launch queue s
+        self._iter_started: dict[tuple[str, int], float] = {}  # wait-span t0
+
+    def _record_span(self, rec) -> None:
+        if self.flight is not None:
+            self.flight.append(rec.to_json())
+        self.events.emit(rec.agent, TRACE_SPAN, rec.detail())
 
     def attach_anomaly_watch(self, watch) -> None:
         """Surface fleet anomaly scores (analytics.runtime.AnomalyWatch)
@@ -295,7 +348,16 @@ class LoopScheduler:
         completion wakes the run loop (the tick after a launch/restart
         spawns the iteration's waiter and poll): without the wake, a
         coarse ``poll_s`` would gate every post-launch step."""
-        fut = self._lane(worker).submit(fn, *args)
+        t_submit = time.monotonic()
+
+        def task(*a):
+            # stamp the lane queue wait where the span can pick it up:
+            # the iteration root opens inside fn (create/start), on this
+            # same lane thread
+            self._queue_wait[loop.agent] = time.monotonic() - t_submit
+            return fn(*a)
+
+        fut = self._lane(worker).submit(task, *args)
         fut.add_done_callback(lambda _f: self._wake.set())
         self._inflight[loop.agent] = fut
 
@@ -389,10 +451,23 @@ class LoopScheduler:
             if loop.epoch != epoch:
                 return      # raced an orphan mid-create; rescue owns it
             loop.status = "failed"
+            self.tracer.end_iteration(loop.agent, loop.iteration,
+                                      status="failed", reason=f"create: {e}")
             self.on_event(loop.agent, "create_failed", str(e))
             log.error("loop %s: create failed: %s", loop.agent, e)
             return
         self._guarded_start(loop, epoch, worker)
+
+    def _begin_iter_span(self, loop: AgentLoop, worker: Worker,
+                         epoch: int) -> None:
+        """Open (idempotently) this iteration attempt's root span,
+        attaching the lane queue wait measured at dequeue time."""
+        attrs: dict = {"epoch": epoch}
+        qw = self._queue_wait.pop(loop.agent, None)
+        if qw is not None:
+            attrs["queue_ms"] = round(qw * 1000, 2)
+        self.tracer.begin_iteration(loop.agent, loop.iteration, worker.id,
+                                    **attrs)
 
     def _create(self, loop: AgentLoop, epoch: int, worker: Worker) -> None:
         # worktree setup mutates ONE shared git repo (refs, worktree
@@ -418,6 +493,14 @@ class LoopScheduler:
         # (and the linked .git file only resolves under a live bind)
         mode = self.spec.workspace_mode or ("bind" if self.spec.worktrees
                                             else "snapshot")
+        with self._placement_lock:
+            # epoch re-checked under the lock before opening the span: a
+            # stale create racing its own orphaning must not re-open a
+            # root the orphan path just closed
+            if loop.epoch != epoch:
+                return
+            self._begin_iter_span(loop, worker, epoch)
+        t_create = self.tracer.now()
         cid = rt.create(CreateOptions(
             agent=loop.agent,
             image=self.spec.image,
@@ -438,6 +521,8 @@ class LoopScheduler:
                 return
             loop.container_id = cid
             loop.fresh_container = True
+        self.tracer.child(loop.agent, loop.iteration, SPAN_CREATE,
+                          t_create, self.tracer.now(), worker=worker.id)
         self.on_event(loop.agent, "created", worker.id)
 
     # ----------------------------------------------------------- iteration
@@ -465,6 +550,9 @@ class LoopScheduler:
                 return
             cid = loop.container_id
             fresh = loop.fresh_container
+            # span open shares the epoch check: see _create
+            self._begin_iter_span(loop, worker, epoch)
+        t_start = self.tracer.now()
         try:
             self._write_iteration(loop, engine, cid)
         except ClawkerError:
@@ -491,6 +579,12 @@ class LoopScheduler:
             loop.fresh_container = False
             loop.status = "running"
             loop.strands = 0        # the placement genuinely works
+        now = self.tracer.now()
+        self.tracer.child(loop.agent, loop.iteration, SPAN_START,
+                          t_start, now, worker=worker.id)
+        # the wait span opens here and closes when the poll accounts the
+        # exit -- the container-executing phase of the iteration
+        self._iter_started[(loop.agent, loop.iteration)] = now
         self.on_event(loop.agent, "iteration_start", str(loop.iteration))
 
     def _guarded_start(self, loop: AgentLoop, epoch: int,
@@ -511,6 +605,8 @@ class LoopScheduler:
             if loop.epoch != epoch:
                 return      # raced an orphan mid-start; rescue owns it
             loop.status = "failed"
+            self.tracer.end_iteration(loop.agent, loop.iteration,
+                                      status="failed", reason=f"start: {e}")
             self.on_event(loop.agent, "failed", f"start: {e}")
             log.error("loop %s: start failed: %s", loop.agent, e)
 
@@ -530,6 +626,16 @@ class LoopScheduler:
             if loop.container_id:
                 loop.abandoned.append((loop.worker, loop.container_id))
                 loop.container_id = ""
+            # close this attempt's span BEFORE the status flip publishes
+            # the orphan: the run thread's rescue pass may re-place the
+            # loop the moment it reads "orphaned", and its migrate hop
+            # must open a fresh root, never land on this dying one
+            now = self.tracer.now()
+            self.tracer.child(loop.agent, loop.iteration, SPAN_ORPHAN,
+                              now, now, worker=wid, reason=reason)
+            self.tracer.end_iteration(loop.agent, loop.iteration,
+                                      status="orphaned")
+            self._iter_started.pop((loop.agent, loop.iteration), None)
             loop.status = "orphaned"
             loop.strands += 1
         if self.health is not None:
@@ -540,12 +646,23 @@ class LoopScheduler:
         self._wake.set()
 
     def _finish_iteration(self, loop: AgentLoop, code: int) -> None:
+        finished = loop.iteration
         loop.exit_codes.append(code)
         loop.iteration += 1
         if code == 0:
             loop.consecutive_failures = 0
         else:
             loop.consecutive_failures += 1
+        now = self.tracer.now()
+        t_wait = self._iter_started.pop((loop.agent, finished), now)
+        status = "ok" if code == 0 else "failed"
+        self.tracer.child(loop.agent, finished, SPAN_WAIT, t_wait, now,
+                          worker=loop.worker.id)
+        self.tracer.child(loop.agent, finished, SPAN_EXIT, now, now,
+                          worker=loop.worker.id, status=status, code=code)
+        self.tracer.end_iteration(loop.agent, finished, status=status,
+                                  code=code)
+        _ITERATIONS.labels(status).inc()
         self.on_event(loop.agent, "iteration_done", f"{loop.iteration - 1}:{code}")
         if loop.consecutive_failures >= FAILURE_CEILING:
             loop.status = "failed"
@@ -862,6 +979,11 @@ class LoopScheduler:
                     self._waited.discard((loop.agent, loop.iteration))
                     if code is None:
                         loop.status = "failed"
+                        self._iter_started.pop(
+                            (loop.agent, loop.iteration), None)
+                        self.tracer.end_iteration(
+                            loop.agent, loop.iteration,
+                            status="failed", reason=detail)
                         self.on_event(loop.agent, "failed", detail)
                         continue
                     self._finish_iteration(loop, code)
@@ -875,6 +997,10 @@ class LoopScheduler:
             self.health.stop()
         if self._stop.is_set():
             self._halt_running()
+        # iterations still open (stop(), a failed loop's in-flight span)
+        # must land in the flight record before callers read it
+        self.tracer.close_open(
+            "stopped" if self._stop.is_set() else "failed")
         # callers read final states + their own on_event capture right
         # after run(); make sure every stamped event reached the sink
         self.events.flush()
@@ -938,6 +1064,15 @@ class LoopScheduler:
                 if loop.status not in ("pending", "running"):
                     continue
                 loop.epoch += 1        # stale lane tasks for this placement die
+                # span close precedes the status flip for the same
+                # reason as in _strand (the rescue pass runs on this
+                # thread, but lane tasks read the open-span table too)
+                now = self.tracer.now()
+                self.tracer.child(loop.agent, loop.iteration, SPAN_ORPHAN,
+                                  now, now, worker=wid, reason=reason)
+                self.tracer.end_iteration(loop.agent, loop.iteration,
+                                          status="orphaned")
+                self._iter_started.pop((loop.agent, loop.iteration), None)
                 loop.status = "orphaned"
                 self._waited.discard((loop.agent, loop.iteration))
                 if loop.container_id:
@@ -1012,9 +1147,21 @@ class LoopScheduler:
                 loop.status = "pending"
                 loop.fresh_container = True
             self._orphan_since.pop(loop.agent, None)
+            # the re-placed attempt gets a FRESH root span (the orphaned
+            # attempt's root closed when the worker died); the hop rides
+            # it as a zero-width migrate child so `loop trace` can show
+            # where the iteration travelled
+            self.tracer.begin_iteration(loop.agent, loop.iteration,
+                                        target.id, epoch=loop.epoch,
+                                        resumed=True)
+            now = self.tracer.now()
             if target.id != old.id:
                 loop.migrations += 1
                 self.health.note_migration(old.id, target.id)
+                self.tracer.child(loop.agent, loop.iteration, SPAN_MIGRATE,
+                                  now, now, worker=target.id,
+                                  src=old.id, dst=target.id,
+                                  hop=loop.migrations)
                 self.on_event(loop.agent, "migrated",
                               f"{old.id}->{target.id}")
             else:
@@ -1031,6 +1178,8 @@ class LoopScheduler:
         done.set_result(None)
         self._inflight[loop.agent] = done
         self._orphan_since.pop(loop.agent, None)
+        self.tracer.end_iteration(loop.agent, loop.iteration,
+                                  status="failed", reason=detail)
         self.on_event(loop.agent, "failed", detail)
 
     def _load_by_worker(self) -> dict[str, int]:
@@ -1064,6 +1213,9 @@ class LoopScheduler:
             exc = fut.exception()
             if exc is not None and loop.status in ("pending", "running"):
                 loop.status = "failed"
+                self.tracer.end_iteration(loop.agent, loop.iteration,
+                                          status="failed",
+                                          reason=f"internal: {exc!r}")
                 self.on_event(loop.agent, "failed", f"internal: {exc!r}")
                 log.error("loop %s: lane task crashed: %r", loop.agent, exc)
 
@@ -1130,6 +1282,9 @@ class LoopScheduler:
         for lane in self._lanes.values():
             lane.close()
         self._lanes.clear()
+        self.tracer.close_open("stopped")
+        if self.flight is not None:
+            self.flight.close()
         self.events.flush()
         self.events.close()
 

@@ -39,6 +39,11 @@ HISTORY_LIMIT = 4096    # long unbounded loops must not grow without bound
 # subjects of fleet health, agents of everything else on the bus).
 WORKER_HEALTH = "worker.health"
 
+# Event name completed trace spans ride the bus under (telemetry/spans):
+# the record's agent is the loop agent, the detail the span's compact
+# one-liner.  Consumers wanting structure read the flight recorder.
+TRACE_SPAN = "trace.span"
+
 
 @dataclass(frozen=True)
 class WorkerHealthEvent:
@@ -88,6 +93,11 @@ class EventBus:
         self._agent_seq: dict[str, int] = {}
         self._closed = False
         self.history: deque[EventRecord] = deque(maxlen=history)
+        # per-agent index over the SAME records: for_agent() used to scan
+        # the whole history deque under the stamp lock on every call --
+        # a dashboard polling one agent contended with every hot-path
+        # emit.  Kept in lockstep with history's bounded eviction.
+        self._by_agent: dict[str, deque[EventRecord]] = {}
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         if sink is not None:
             threading.Thread(target=self._drain, daemon=True,
@@ -99,7 +109,24 @@ class EventBus:
             aseq = self._agent_seq.get(agent, 0) + 1
             self._agent_seq[agent] = aseq
             rec = EventRecord(self._seq, aseq, agent, event, detail)
+            maxlen = self.history.maxlen
+            # `maxlen and len(...)`: a maxlen-0 history retains nothing,
+            # so there is nothing to evict (and nothing to index below --
+            # the index must mirror the history exactly)
+            evicted = (self.history[0]
+                       if maxlen and len(self.history) == maxlen else None)
             self.history.append(rec)
+            if evicted is not None:
+                # the global deque just dropped its oldest record; its
+                # agent's index holds records in stamp order, so the
+                # evicted one is necessarily that index's head
+                idx = self._by_agent.get(evicted.agent)
+                if idx:
+                    idx.popleft()
+                    if not idx:
+                        del self._by_agent[evicted.agent]
+            if maxlen != 0:
+                self._by_agent.setdefault(agent, deque()).append(rec)
             if self._sink is not None and not self._closed:
                 # stamped and enqueued under the same lock: queue order
                 # is stamp order, and the single drainer preserves it
@@ -144,5 +171,10 @@ class EventBus:
                 lambda: self._delivered >= target, timeout)
 
     def for_agent(self, agent: str) -> list[EventRecord]:
+        """This agent's records, oldest first.  O(k) copy of the
+        per-agent index -- never a scan of the whole history under the
+        stamp lock (loop-dashboard reads must not contend with hot-path
+        emits beyond the copy itself)."""
         with self._lock:
-            return [r for r in self.history if r.agent == agent]
+            idx = self._by_agent.get(agent)
+            return list(idx) if idx else []

@@ -1,9 +1,18 @@
-"""Seeded-units ledger: which monitoring units this host's stack carries.
+"""Monitoring ledgers: seeded stack units, and the per-run flight recorder.
 
+**Units ledger** -- which monitoring units this host's stack carries.
 A bare unit name is one cluster-wide namespace: the ledger refuses to
 re-seed a name with DIFFERENT content from a DIFFERENT source (a silent
 last-write-wins PUT would let one project's stack artifacts clobber
 another's).  Same source updating in place is always fine.
+
+**Flight recorder** -- the post-mortem half of the telemetry subsystem:
+an append-only JSONL ledger of one loop run's trace spans (and any
+other typed record a subsystem wants preserved), written as events
+happen so a crashed run leaves a readable record up to the crash.
+``clawker loop trace <run>`` reconstructs iteration span trees from it
+(telemetry/spans.py); records may land out of order (lane threads,
+waiter threads, the run loop all append).
 
 Parity reference: internal/monitor/ledger.go:63 (SeededUnit,
 SeedCollisionError, LoadLedger) -- semantics re-derived.
@@ -11,6 +20,8 @@ SeedCollisionError, LoadLedger) -- semantics re-derived.
 
 from __future__ import annotations
 
+import json
+import threading
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -22,6 +33,88 @@ from ..util.fs import atomic_write
 from .unit import MonitoringUnit
 
 LEDGER_FILE = "units-ledger.yaml"
+FLIGHT_DIR = "flight"           # under Config.logs_dir
+
+
+def parse_jsonl(lines) -> list[dict]:
+    """Every parseable JSON object in ``lines``, skipping blanks,
+    corrupt lines, and non-objects.  THE tolerant parse for the
+    flight-record format -- ``telemetry.load_spans`` and
+    :meth:`FlightRecorder.read` both ride it, so a crashed writer's
+    truncated tail degrades identically everywhere."""
+    out: list[dict] = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict):
+            out.append(doc)
+    return out
+
+
+def flight_path(logs_dir: Path, run_id: str) -> Path:
+    """Canonical flight-recorder path for one loop run."""
+    return Path(logs_dir) / FLIGHT_DIR / f"loop-{run_id}.jsonl"
+
+
+class FlightRecorder:
+    """Append-only JSONL record sink for one run.
+
+    Writes are line-atomic under one lock and flushed per record: the
+    recorder exists exactly for the runs that die unexpectedly, so
+    buffering records in memory would lose the most interesting tail.
+    A recorder whose directory cannot be created degrades to a no-op --
+    telemetry must never fail the run it is recording.
+    """
+
+    def __init__(self, path: Path):
+        self.path = Path(path)
+        self._lock = threading.Lock()
+        self._fh = None
+        self.dropped = 0
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._fh = open(self.path, "a", encoding="utf-8")
+        except OSError:
+            self._fh = None
+
+    def append(self, record: dict) -> None:
+        if self._fh is None:
+            self.dropped += 1
+            return
+        line = json.dumps(record, separators=(",", ":"), default=str)
+        with self._lock:
+            if self._fh is None:
+                self.dropped += 1
+                return
+            try:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            except OSError:
+                self.dropped += 1
+
+    def close(self) -> None:
+        with self._lock:
+            fh, self._fh = self._fh, None
+        if fh is not None:
+            try:
+                fh.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def read(path: Path) -> list[dict]:
+        """Every parseable record in the file, skipping a truncated tail
+        (the writer may have died mid-line)."""
+        try:
+            text = Path(path).read_text(encoding="utf-8")
+        except OSError:
+            return []
+        return parse_jsonl(text.splitlines())
 
 
 class SeedCollision(ClawkerError):

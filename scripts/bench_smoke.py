@@ -16,6 +16,9 @@ Gates:
                                    (ISSUE 2 acceptance bar)
 - failover_detect_to_restart_s <= bench.FAILOVER_BUDGET_S with every
   loop reaching its budget  (ISSUE 3 acceptance bar)
+- telemetry_overhead_ns: enabled <= bench.TELEMETRY_BUDGET_NS and
+  disabled <= bench.TELEMETRY_DISABLED_BUDGET_NS  (ISSUE 4 acceptance
+  bar -- instrumentation must never silently regress the cold start)
 
 Prints one JSON line; exit 1 on any gate failure.
 """
@@ -37,11 +40,14 @@ def main() -> int:
     from bench import (
         FAILOVER_BUDGET_S,
         POLL_COST_BUDGET,
+        TELEMETRY_BUDGET_NS,
+        TELEMETRY_DISABLED_BUDGET_NS,
         bench_engine_dials,
         bench_failover,
         bench_fleet_provision,
         bench_loop_fanout,
         bench_loop_poll_cost,
+        bench_telemetry_overhead,
     )
 
     fanout_s = bench_loop_fanout(iters=1)
@@ -49,6 +55,7 @@ def main() -> int:
     provision = bench_fleet_provision()
     failover = bench_failover()
     dials = bench_engine_dials()
+    tele = bench_telemetry_overhead()
 
     failures: list[str] = []
     if fanout_s > FANOUT_BUDGET_S:
@@ -80,6 +87,14 @@ def main() -> int:
         failures.append(
             f"engine_dials_per_run reduction {dials['dial_reduction']}x "
             f"< {DIALS_MIN_REDUCTION}x over dial-per-request")
+    if tele["enabled_ns"] > TELEMETRY_BUDGET_NS:
+        failures.append(
+            f"telemetry_overhead_ns enabled {tele['enabled_ns']}ns "
+            f"> {TELEMETRY_BUDGET_NS}ns budget")
+    if tele["disabled_ns"] > TELEMETRY_DISABLED_BUDGET_NS:
+        failures.append(
+            f"telemetry_overhead_ns disabled {tele['disabled_ns']}ns "
+            f"> {TELEMETRY_DISABLED_BUDGET_NS}ns budget")
 
     print(json.dumps({
         "loop_fanout_p50_n8_ms": round(fanout_s * 1000, 1),
@@ -87,6 +102,7 @@ def main() -> int:
         "fleet_provision_wall_n8": provision,
         "failover_detect_to_restart_s": failover,
         "engine_dials_per_run": dials,
+        "telemetry_overhead_ns": tele,
         "ok": not failures,
         "failures": failures,
     }))

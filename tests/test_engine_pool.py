@@ -113,6 +113,34 @@ def test_request_on_reaped_idle_socket_retried_once_and_succeeds(tmp_path):
         daemon.stop()
 
 
+def test_non_idempotent_verb_on_reaped_socket_is_never_resent(tmp_path):
+    """A reused socket dead before the status line ALSO matches a
+    response lost AFTER the daemon executed the request (forward drop,
+    daemon restart): POSTs like kill/exec_create must surface the
+    failure instead of risking a double execution.  The suppressed
+    retry is counted (urllib3-style idempotent allowlist)."""
+    daemon = StubDockerDaemon(tmp_path / "stub.sock",
+                              max_requests_per_conn=1).start()
+    try:
+        api = HTTPDockerAPI(unix_socket_factory(daemon.sock_path))
+        api.info()                     # full response; conn pooled, then
+        #                                reaped by the 1-request daemon
+        with pytest.raises(DriverError, match="daemon unreachable"):
+            api.container_kill("c1")   # reused conn dies before status
+        stats = api.pool_stats()
+        assert stats["stale_retries"] == 0
+        assert stats["suppressed_retries"] == 1
+        # the kill died on the reaped socket and was NOT re-sent on a
+        # fresh dial: the daemon saw only the original info request
+        assert stats["dials"] == 1
+        assert daemon.requests == 1
+        # idempotent verbs on the same client still work (fresh dial)
+        assert api.info() is not None
+        api.close()
+    finally:
+        daemon.stop()
+
+
 def test_first_dial_failure_raises_driver_error_without_retry(tmp_path):
     factory, dials = counting_factory(tmp_path / "nothing-listens-here.sock")
     api = HTTPDockerAPI(factory)
@@ -261,8 +289,8 @@ def test_fake_api_matches_close_surface():
     from clawker_tpu.engine.fake import FakeDockerAPI
 
     eng = Engine(FakeDockerAPI())
-    assert eng.pool_stats() == {"dials": 0, "reuses": 0,
-                                "stale_retries": 0, "idle": 0}
+    assert eng.pool_stats() == {"dials": 0, "reuses": 0, "stale_retries": 0,
+                                "suppressed_retries": 0, "idle": 0}
     eng.close()  # must not raise
     assert eng.api.calls_named("close")
 

@@ -1,0 +1,540 @@
+"""Unified fleet telemetry: registry semantics under concurrency, the
+Prometheus exposition contract, span-tree reconstruction from an
+out-of-order flight record, the exporters, the EventBus per-agent
+index, and the flagship end-to-end: an 8-loop FakeDriver pod run (with
+an injected wedge -> migrate) whose every iteration must yield a
+complete span tree.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from clawker_tpu import consts, telemetry
+from clawker_tpu.config import load_config
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.health import BreakerConfig, HealthConfig
+from clawker_tpu.loop import LoopScheduler, LoopSpec
+from clawker_tpu.monitor.events import EventBus
+from clawker_tpu.monitor.ledger import FlightRecorder, flight_path
+from clawker_tpu.telemetry import (
+    MetricsOtlpShipper,
+    MetricsRegistry,
+    MetricsServer,
+    SpanRecord,
+    Tracer,
+    build_trees,
+    load_spans,
+)
+from clawker_tpu.testenv import TestEnv
+
+IMAGE = "clawker-teleproj:default"
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: teleproj\n")
+        cfg = load_config(proj)
+        yield tenv, proj, cfg
+
+
+def driver_with(n_workers: int, behavior=None):
+    drv = FakeDriver(n_workers=n_workers)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, behavior or exit_behavior(b"done\n", 0))
+    return drv
+
+
+# ----------------------------------------------------------------- registry
+
+
+def test_registry_concurrent_mutation_from_eight_threads():
+    """8+ writer threads on shared and per-thread series: every record
+    lands exactly once (the lock-striping must never lose increments)."""
+    reg = MetricsRegistry()
+    shared = reg.counter("t_shared_total", "shared")
+    per = reg.counter("t_per_total", "per-thread", labels=("t",))
+    hist = reg.histogram("t_lat_seconds", "lat", labels=("t",))
+    gauge = reg.gauge("t_gauge", "gauge")
+    n_threads, per_thread = 10, 2000
+    start = threading.Barrier(n_threads)
+
+    def writer(idx: int) -> None:
+        start.wait()
+        mine = per.labels(str(idx))
+        h = hist.labels(str(idx))
+        for i in range(per_thread):
+            shared.inc()
+            mine.inc()
+            h.observe(0.001 * (i % 7))
+            gauge.set(idx)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    snap = {(r["metric"], tuple(sorted(r["labels"].items()))): r
+            for r in reg.snapshot()}
+    assert snap[("t_shared_total", ())]["value"] == n_threads * per_thread
+    for i in range(n_threads):
+        key = ("t_per_total", (("t", str(i)),))
+        assert snap[key]["value"] == per_thread
+        hkey = ("t_lat_seconds", (("t", str(i)),))
+        assert snap[hkey]["value"] == per_thread
+        assert sum(snap[hkey]["buckets"].values()) == per_thread
+    assert snap[("t_gauge", ())]["value"] in set(range(n_threads))
+
+
+def test_registry_disabled_records_are_dropped_and_reset_zeroes():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "")
+    c.inc(5)
+    reg.set_enabled(False)
+    c.inc(100)
+    reg.set_enabled(True)
+    assert reg.snapshot()[0]["value"] == 5
+    reg.reset()
+    assert reg.snapshot()[0]["value"] == 0
+    c.inc()     # the handle survives reset
+    assert reg.snapshot()[0]["value"] == 1
+
+
+def test_registry_rejects_kind_conflict_and_is_idempotent():
+    reg = MetricsRegistry()
+    a = reg.counter("t_total", "", labels=("x",))
+    assert reg.counter("t_total", "", labels=("x",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("t_total", "")
+    with pytest.raises(ValueError):
+        a.labels("1", "2")      # wrong label arity
+
+
+def test_prometheus_exposition_golden():
+    """The exact text-format contract a scraper parses: HELP/TYPE lines,
+    label escaping, cumulative histogram buckets with le and +Inf,
+    _sum/_count."""
+    reg = MetricsRegistry()
+    c = reg.counter("engine_dials_total", "Engine-API socket dials")
+    c.inc(3)
+    g = reg.gauge("health_breaker_state", "Breaker state", labels=("worker",))
+    g.labels("fake-0").set(0)
+    g.labels("fake-1").set(2)
+    h = reg.histogram("probe_seconds", "Probe latency", labels=("worker",),
+                      buckets=(0.1, 1.0))
+    h.labels("fake-0").observe(0.05)
+    h.labels("fake-0").observe(0.5)
+    h.labels("fake-0").observe(5.0)
+    assert reg.exposition() == (
+        "# HELP engine_dials_total Engine-API socket dials\n"
+        "# TYPE engine_dials_total counter\n"
+        "engine_dials_total 3\n"
+        "# HELP health_breaker_state Breaker state\n"
+        "# TYPE health_breaker_state gauge\n"
+        'health_breaker_state{worker="fake-0"} 0\n'
+        'health_breaker_state{worker="fake-1"} 2\n'
+        "# HELP probe_seconds Probe latency\n"
+        "# TYPE probe_seconds histogram\n"
+        'probe_seconds_bucket{worker="fake-0",le="0.1"} 1\n'
+        'probe_seconds_bucket{worker="fake-0",le="1"} 2\n'
+        'probe_seconds_bucket{worker="fake-0",le="+Inf"} 3\n'
+        'probe_seconds_sum{worker="fake-0"} 5.55\n'
+        'probe_seconds_count{worker="fake-0"} 3\n'
+    )
+
+
+def test_exposition_escapes_label_values():
+    reg = MetricsRegistry()
+    reg.counter("t_total", "", labels=("w",)).labels('a"b\\c\nd').inc()
+    text = reg.exposition()
+    assert 't_total{w="a\\"b\\\\c\\nd"} 1' in text
+
+
+# ----------------------------------------------------------- scrape server
+
+
+def test_metrics_server_serves_exposition():
+    reg = MetricsRegistry()
+    reg.counter("t_scraped_total", "scrape me").inc(7)
+    srv = MetricsServer(0, registry=reg).start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=5).read().decode()
+        assert "t_scraped_total 7" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=5)
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------ otlp shipper
+
+
+def test_otlp_shipper_ships_snapshots_and_final_flush():
+    reg = MetricsRegistry()
+    reg.counter("t_shipped_total", "").inc(2)
+    batches: list[list[dict]] = []
+
+    class Lane:
+        def ship(self, records):
+            batches.append(records)
+            return True
+
+    shipper = MetricsOtlpShipper(Lane(), registry=reg, interval_s=3600.0)
+    shipper.start()
+    shipper.stop()          # final flush must land without the interval
+    assert shipper.shipped_batches >= 1
+    rec = next(r for r in batches[-1] if r["metric"] == "t_shipped_total")
+    assert rec["value"] == 2 and rec["kind"] == "counter"
+
+
+# -------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_append_read_and_truncated_tail(tmp_path):
+    path = tmp_path / "flight" / "loop-abc.jsonl"
+    rec = FlightRecorder(path)
+    rec.append({"kind": "span", "span_id": "s1"})
+    rec.append({"kind": "note", "x": 1})
+    rec.close()
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('{"kind": "span", "span_id": "trunc')   # crashed writer
+    docs = FlightRecorder.read(path)
+    assert [d.get("kind") for d in docs] == ["span", "note"]
+    assert flight_path(tmp_path, "abc").name == "loop-abc.jsonl"
+
+
+# ------------------------------------------------- span tree reconstruction
+
+
+def _span(span_id, parent, name, agent="a0", t0=0.0, t1=1.0, status="ok",
+          **attrs):
+    return SpanRecord(trace_id="run1", span_id=span_id, parent_id=parent,
+                      name=name, agent=agent, worker="fake-0",
+                      t_start=t0, t_end=t1, status=status, attrs=attrs)
+
+
+def test_build_trees_from_out_of_order_ledger():
+    """Children recorded before their root (lane threads flush phase
+    spans long before the run thread closes the iteration), interleaved
+    across agents, plus an orphan child whose parent never flushed."""
+    records = [
+        _span("w0", "i0", "wait", t0=2.0, t1=4.0, iteration=0),
+        _span("e1", "i1", "exit", agent="a1", t0=4.0, t1=4.0, iteration=0),
+        _span("c0", "i0", "create", t0=0.5, t1=1.0, iteration=0),
+        _span("i1", "", "iteration", agent="a1", t0=0.0, t1=4.0, iteration=0),
+        _span("s0", "i0", "start", t0=1.0, t1=2.0, iteration=0),
+        _span("lost", "never-flushed", "wait", agent="a2", t0=9.0, t1=9.5),
+        _span("i0", "", "iteration", t0=0.0, t1=4.0, iteration=0),
+        _span("x0", "i0", "exit", t0=4.0, t1=4.0, iteration=0),
+    ]
+    roots = build_trees(records)
+    by_id = {r.record.span_id: r for r in roots}
+    assert set(by_id) == {"i0", "i1", "lost"}   # orphan child promoted
+    i0 = by_id["i0"]
+    assert [c.record.name for c in i0.children] == [
+        "create", "start", "wait", "exit"]      # start-time order
+    assert i0.record.wall_s == 4.0
+    # round-trips through JSONL identically
+    lines = [json.dumps(r.to_json()) for r in records]
+    assert build_trees(load_spans(lines))[0].record == roots[0].record
+
+
+def test_load_spans_skips_corrupt_and_foreign_lines():
+    lines = ['{"kind": "span", "span_id": "s", "trace_id": "t", '
+             '"parent_id": "", "name": "iteration", "agent": "a", '
+             '"worker": "w", "t_start": 1, "t_end": 2}',
+             "not json at all", '{"kind": "other"}', ""]
+    spans = load_spans(lines)
+    assert len(spans) == 1 and spans[0].wall_s == 1.0
+
+
+def test_tracer_idempotent_begin_and_close_open():
+    flushed: list[SpanRecord] = []
+    tr = Tracer("run1", on_span=flushed.append)
+    a = tr.begin_iteration("a0", 0, "fake-0", epoch=0)
+    # repeat begin: same root, attrs merge with first-value-wins (the
+    # rescue pass opens a root before the lane measures its queue wait)
+    assert tr.begin_iteration("a0", 0, "fake-9",
+                              epoch=9, queue_ms=1.5) == a
+    tr.child("a0", 0, "create", 0.0, 1.0)
+    root = tr.end_iteration("a0", 0, status="ok")
+    assert root.span_id == a
+    assert root.attrs["epoch"] == 0 and root.attrs["queue_ms"] == 1.5
+    assert tr.child("a0", 0, "late", 0.0, 1.0) is None  # closed: no orphans
+    tr.begin_iteration("a0", 1, "fake-0")
+    assert tr.close_open("stopped") == 1
+    assert [r.name for r in flushed] == ["create", "iteration", "iteration"]
+    assert flushed[-1].status == "stopped"
+
+
+# ------------------------------------------------------ event bus index
+
+
+def test_event_bus_zero_history_neither_indexes_nor_raises():
+    bus = EventBus(None, history=0)
+    bus.emit("a", "e", "0")     # must not IndexError on the empty deque
+    bus.emit("a", "e", "1")
+    assert len(bus.history) == 0
+    assert bus.for_agent("a") == []   # the index mirrors the history
+
+
+def test_event_bus_for_agent_index_tracks_bounded_eviction():
+    bus = EventBus(None, history=8)
+    for i in range(6):
+        bus.emit("a", "e", str(i))
+        bus.emit("b", "e", str(i))
+    # 12 emits through a maxlen-8 history: the oldest 4 were evicted
+    assert len(bus.history) == 8
+    a_recs = bus.for_agent("a")
+    assert [r.detail for r in a_recs] == ["2", "3", "4", "5"]
+    assert [r.detail for r in bus.for_agent("b")] == ["2", "3", "4", "5"]
+    # the index returns the SAME records the history holds, in order
+    assert [r for r in bus.history if r.agent == "a"] == a_recs
+    assert bus.for_agent("nobody") == []
+
+
+# ----------------------------------------------------- end-to-end span run
+
+
+def test_eight_loop_run_with_migration_yields_complete_span_trees(env):
+    """BASELINE-shaped pod run: 8 loops on 4 fake workers, 2 iterations
+    each, one worker WEDGED mid-run (hung daemon: probes hit their
+    deadline, lanes freeze) under --failover migrate.  EVERY accounted
+    iteration must reconstruct to a complete span tree (start + wait +
+    exit under its root), the migrated loops' hops must appear as
+    migrate spans, and the orphaned attempts must close as orphaned --
+    the acceptance bar for `clawker loop trace`."""
+    tenv, proj, cfg = env
+    drv = driver_with(4, behavior=exit_behavior(b"", 0, delay=0.1))
+    iterations = 2
+    victim = drv.workers()[1].id
+    sched = LoopScheduler(
+        cfg, drv, LoopSpec(parallel=8, iterations=iterations,
+                           failover="migrate"),
+        health_config=HealthConfig(
+            probe_interval_s=0.05, probe_deadline_s=0.5,
+            breaker=BreakerConfig(failure_threshold=3, backoff_base_s=0.05,
+                                  backoff_max_s=0.2)))
+    sched.start()
+    runner = threading.Thread(target=sched.run, kwargs={"poll_s": 0.05},
+                              daemon=True)
+    runner.start()
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:       # victim must be mid-loop
+        if any(l.status == "running" and l.worker.id == victim
+               for l in sched.loops):
+            break
+        time.sleep(0.01)
+    drv.inject_fault(1, "wedge")
+    runner.join(30.0)
+    assert not runner.is_alive()
+    assert all(l.status == "done" and l.iteration == iterations
+               for l in sched.loops)
+    migrated = [l for l in sched.loops if l.migrations]
+    assert migrated, "the wedged worker's loops must have migrated"
+    flight = sched.flight.path
+    drv.clear_fault(1)      # revive so cleanup's removals don't block
+    sched.cleanup(remove_containers=True)
+
+    spans = load_spans(flight.read_text().splitlines())
+    trees = build_trees(spans)
+    roots = [t for t in trees if t.record.name == "iteration"]
+    assert all(t.record.name == "iteration" for t in trees), \
+        "no span may lose its parent in a clean run"
+    # every accounted iteration of every agent has exactly one OK tree
+    ok_roots: dict[tuple[str, int], list] = {}
+    for t in roots:
+        key = (t.record.agent, t.record.attrs.get("iteration"))
+        if t.record.status == "ok":
+            ok_roots.setdefault(key, []).append(t)
+    for loop in sched.loops:
+        for i in range(iterations):
+            (tree,) = ok_roots[(loop.agent, i)]
+            names = [c.record.name for c in tree.children]
+            assert names.count("start") == 1, (loop.agent, i, names)
+            assert names.count("wait") == 1, (loop.agent, i, names)
+            assert names.count("exit") == 1, (loop.agent, i, names)
+            exit_span = next(c.record for c in tree.children
+                             if c.record.name == "exit")
+            assert exit_span.attrs.get("code") == 0
+            assert tree.record.worker      # placement attribute present
+            assert tree.record.attrs.get("epoch") is not None
+        # iteration 0 of a fresh placement includes the create span
+        first = ok_roots[(loop.agent, 0)][0]
+        first_names = [c.record.name for c in first.children]
+        if not loop.migrations:
+            assert "create" in first_names
+    # the injected death shows up as orphaned attempts + migrate hops
+    orphaned = [t for t in roots if t.record.status == "orphaned"]
+    assert orphaned
+    assert all(any(c.record.name == "orphan" for c in t.children)
+               for t in orphaned)
+    hops = [s for s in spans if s.name == "migrate"]
+    assert hops and all(s.attrs["src"] != s.attrs["dst"] for s in hops)
+    assert {s.agent for s in hops} == {l.agent for l in migrated}
+    # a migrated attempt re-creates on the new worker: its OK tree holds
+    # both the migrate hop and a fresh create
+    for l in migrated:
+        resumed = [t for ts in ok_roots.items() if ts[0][0] == l.agent
+                   for t in ts[1]
+                   if any(c.record.name == "migrate" for c in t.children)]
+        assert resumed
+        assert all(any(c.record.name == "create" for c in t.children)
+                   for t in resumed)
+        # the re-placed launch's lane queue wait must reach the fresh
+        # root even though the rescue pass opened it first
+        assert all(t.record.attrs.get("queue_ms") is not None
+                   for t in resumed)
+
+
+def test_loop_run_exports_documented_metric_names(env):
+    """After a real (fake-driver) loop run, the process registry serves
+    every metric family docs/telemetry.md documents."""
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=1))
+    sched.start()
+    sched.run(poll_s=0.05)
+    sched.cleanup(remove_containers=True)
+    text = telemetry.REGISTRY.exposition()
+    for family in ("engine_dials_total", "engine_reuses_total",
+                   "engine_stale_retries_total",
+                   "engine_retries_suppressed_total",
+                   "loop_lane_queue_seconds", "loop_lane_execute_seconds",
+                   "loop_iterations_total", "health_breaker_state"):
+        assert f"# TYPE {family} " in text, family
+
+
+# ------------------------------------------------------------- trace CLI
+
+
+def test_cli_loop_trace_renders_tree_and_json(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    res = CliRunner().invoke(
+        cli, ["loop", "--parallel", "2", "--iterations", "2", "--json"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    loop_id = json.loads(res.stdout)["loop_id"]
+
+    res = CliRunner().invoke(
+        cli, ["loop", "trace", loop_id],
+        obj=Factory(cwd=proj, driver=driver_with(2)), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert f"run {loop_id}: 4 iteration span(s) across 2 agent(s)" \
+        in res.output
+    assert "  start " in res.output and "  wait " in res.output
+    assert "  exit " in res.output and "code=0" in res.output
+
+    res = CliRunner().invoke(
+        cli, ["loop", "trace", loop_id, "--json"],
+        obj=Factory(cwd=proj, driver=driver_with(2)), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    doc = json.loads(res.stdout)
+    assert doc["run"] == loop_id and len(doc["iterations"]) == 4
+    assert all(i["name"] == "iteration" and i["children"]
+               for i in doc["iterations"])
+
+    # unknown and ambiguous runs fail with a clean CLI error
+    res = CliRunner().invoke(
+        cli, ["loop", "trace", "nosuchrun"],
+        obj=Factory(cwd=proj, driver=driver_with(2)))
+    assert res.exit_code != 0
+    assert "no flight record" in res.output
+
+
+def test_cli_loop_trace_flags_crashed_run_without_iteration_root(env, tmp_path):
+    """A run killed before end_iteration flushed leaves phase spans with
+    no root: trace must show them flagged, not hide them or count them
+    as iterations."""
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    crashed = tmp_path / "loop-dead.jsonl"
+    rec = FlightRecorder(crashed)
+    rec.append(_span("c1", "never-flushed", "create", t0=1.0, t1=2.0,
+                     iteration=0).to_json())
+    rec.close()
+    res = CliRunner().invoke(
+        cli, ["loop", "trace", str(crashed)],
+        obj=Factory(cwd=proj, driver=driver_with(1)), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert "0 iteration span(s)" in res.output
+    assert "create (no iteration root)" in res.output
+    assert "1 span(s) without a recorded iteration root" in res.output
+
+
+def test_cli_loop_metrics_port_serves_scrape_during_run(env):
+    """--metrics-port: the run serves /metrics while loops iterate."""
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    import socket
+
+    tenv, proj, cfg = env
+    drv = driver_with(1, behavior=exit_behavior(b"", 0, delay=0.2))
+    scraped: list[str] = []
+    port_holder: list[int] = []
+    orig_start = telemetry.MetricsServer.start
+    # 0 means "off" on the flag; grab a free real port for the test
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        free_port = s.getsockname()[1]
+
+    def spy_start(self):
+        orig_start(self)
+        port_holder.append(self.port)
+        return self
+
+    def scrape_later():
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not port_holder:
+            time.sleep(0.02)
+        if not port_holder:
+            return
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            try:
+                scraped.append(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port_holder[0]}/metrics",
+                    timeout=2).read().decode())
+                return
+            except OSError:
+                time.sleep(0.05)
+
+    t = threading.Thread(target=scrape_later, daemon=True)
+    t.start()
+    try:
+        telemetry.MetricsServer.start = spy_start
+        res = CliRunner().invoke(
+            cli, ["loop", "--parallel", "1", "--iterations", "2",
+                  "--metrics-port", str(free_port), "--json"],
+            obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    finally:
+        telemetry.MetricsServer.start = orig_start
+    t.join(15.0)
+    assert res.exit_code == 0, res.output
+    assert scraped and "loop_lane_execute_seconds" in scraped[0]
