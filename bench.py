@@ -51,6 +51,14 @@ Added (telemetry PR):
   path pays; the smoke gate keeps it bounded so instrumentation can
   never silently regress the cold-start headline.
 
+Added (run journal / resume PR):
+- resume_reattach_wall_n8 -- kill the scheduler of a running
+  8-loop/4-worker fake pod mid-wait, then measure the `--resume`
+  invocation (journal replay + reconcile) until all 8 loops are live
+  again via container ADOPTION; vs_baseline is the speedup over the
+  cold fan-out the resume avoided (adoption makes zero engine
+  mutations, so it must beat re-creating 8 containers).
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "extra": [...]}.  vs_baseline > 1 (or == 1.0 for pass rates) means
 within budget; bigger is better.
@@ -416,6 +424,90 @@ def bench_failover(n_loops: int = 8, n_workers: int = 4,
     }
 
 
+def bench_resume_reattach(n_loops: int = 8, n_workers: int = 4) -> dict:
+    """resume_reattach_wall_n8: kill a mid-run scheduler, then measure
+    the wall time from the ``--resume`` invocation (journal read +
+    replay + reconcile) until all N loops are live again.  Adoption
+    reattaches to still-running containers with ZERO engine mutations,
+    so the resume must beat the cold fan-out it replaces (``speedup`` =
+    cold create+start wall / reattach wall); the smoke gate also pins
+    zero duplicate creates and a full adoption count.
+    """
+    import threading
+
+    from clawker_tpu import consts
+    from clawker_tpu.config import load_config
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.loop import LoopScheduler, LoopSpec
+    from clawker_tpu.loop.journal import RunJournal, journal_path, replay
+    from clawker_tpu.testenv import TestEnv
+
+    hold = threading.Event()
+
+    def behavior(io) -> int:
+        if not hold.is_set():
+            hold.wait(30.0)
+        return 0
+
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: benchloop\n")
+        cfg = load_config(proj)
+        drv = FakeDriver(n_workers=n_workers)
+        for api in drv.apis:
+            api.add_image("clawker-benchloop:default")
+            api.set_behavior("clawker-benchloop:default", behavior)
+        sched1 = LoopScheduler(cfg, drv,
+                               LoopSpec(parallel=n_loops, iterations=1))
+        t_cold = time.perf_counter()
+        sched1.start()
+        runner = threading.Thread(target=sched1.run,
+                                  kwargs={"poll_s": 0.05}, daemon=True)
+        runner.start()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if sched1.loops and all(l.status == "running"
+                                    for l in sched1.loops):
+                break
+            time.sleep(0.005)
+        cold_wall = time.perf_counter() - t_cold
+        creates_before = sum(len(api.calls_named("container_create"))
+                             for api in drv.apis)
+        sched1.kill()
+        runner.join(10.0)
+
+        t_resume = time.perf_counter()
+        image = replay(RunJournal.read(
+            journal_path(cfg.logs_dir, sched1.loop_id)))
+        sched2 = LoopScheduler.resume(cfg, drv, image)
+        summary = sched2.reconcile()
+        reattach_wall = time.perf_counter() - t_resume
+        live = sum(1 for l in sched2.loops if l.status == "running")
+        creates_after = sum(len(api.calls_named("container_create"))
+                            for api in drv.apis)
+        runner2 = threading.Thread(target=sched2.run,
+                                   kwargs={"poll_s": 0.05}, daemon=True)
+        runner2.start()
+        hold.set()
+        runner2.join(30.0)
+        all_done = bool(sched2.loops) and all(
+            l.status == "done" and l.iteration == 1 for l in sched2.loops)
+        sched2.cleanup(remove_containers=True)
+    return {
+        "reattach_wall_s": round(reattach_wall, 4),
+        "cold_fanout_wall_s": round(cold_wall, 4),
+        "speedup": round(cold_wall / reattach_wall, 2) if reattach_wall > 0
+        else 0.0,
+        "adopted": summary["adopted"],
+        "live_after_reconcile": live,
+        "duplicate_creates": creates_after - creates_before,
+        "all_loops_done": all_done,
+        "loops": n_loops,
+        "workers": n_workers,
+    }
+
+
 def bench_engine_dials(per_dial_delay: float = 0.01) -> dict:
     """Engine-API socket dials behind one `clawker run` orchestration.
 
@@ -646,6 +738,10 @@ def previous_round_p50() -> float:
 
 POLL_COST_BUDGET = 12.0       # control-plane calls per agent iteration
 FAILOVER_BUDGET_S = 5.0       # worker death -> first migrated iteration
+RESUME_BUDGET_S = 5.0         # --resume invocation -> all loops live again
+#                               (adoption path; must undercut the 10 s
+#                               cold-start budget or resuming would be
+#                               no better than starting over)
 TELEMETRY_BUDGET_NS = 20_000  # per-record registry cost, enabled (a
 #                               run() orchestration makes O(100) records:
 #                               20us/record keeps the total well under
@@ -663,6 +759,7 @@ def main() -> None:
     poll_cost = bench_loop_poll_cost()
     provision = bench_fleet_provision()
     failover = bench_failover()
+    resume = bench_resume_reattach()
     dials = bench_engine_dials()
     tele = bench_telemetry_overhead()
     anom = bench_anomaly()
@@ -701,6 +798,16 @@ def main() -> None:
              if failover["all_loops_done"]
              and failover["detect_to_restart_s"] > 0 else 0.0),
          "detail": failover},
+        {"metric": "resume_reattach_wall_n8",
+         "value": resume["reattach_wall_s"], "unit": "s",
+         # vs_baseline IS the adoption speedup over the cold fan-out the
+         # resume avoided; a failed scenario (missed adoptions, duplicate
+         # creates, loops short of budget) must read as FAILED
+         "vs_baseline": (resume["speedup"]
+                         if resume["all_loops_done"]
+                         and resume["adopted"] == resume["loops"]
+                         and not resume["duplicate_creates"] else 0.0),
+         "detail": resume},
         {"metric": "engine_dials_per_run", "value": dials["dials_pooled"],
          "unit": "dials",
          # vs_baseline IS the dial reduction over the dial-per-request

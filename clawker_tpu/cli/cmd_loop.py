@@ -8,12 +8,15 @@ status output.
 ``loop`` is a group whose bare invocation runs the loops (the original
 verb shape, so ``clawker loop -p 8`` keeps working); ``loop trace``
 reconstructs a finished run's iteration span trees from its flight
-recorder (docs/telemetry.md).
+recorder (docs/telemetry.md); ``loop --resume <run>`` replays a run's
+write-ahead journal after a scheduler death and reconciles against the
+containers still on the workers (docs/loop-resume.md).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import signal
 from pathlib import Path
 
@@ -23,6 +26,30 @@ from ..loop import LoopScheduler, LoopSpec
 from .factory import Factory
 
 pass_factory = click.make_pass_decorator(Factory)
+
+_hard_exit = os._exit       # seam: tests stub the second-stage exit
+
+
+class _TwoStageInterrupt:
+    """First Ctrl-C drains gracefully -- journal a clean ``shutdown``
+    record and print the ``--resume`` hint; a second Ctrl-C hard-exits.
+    Previously both signals raced ``sched.stop()`` with no feedback."""
+
+    def __init__(self, sched: LoopScheduler):
+        self.sched = sched
+        self.hits = 0
+
+    def __call__(self, signum=None, frame=None) -> None:
+        self.hits += 1
+        if self.hits == 1:
+            click.echo(
+                f"\ninterrupt: draining loops (resume later with "
+                f"`clawker loop --resume {self.sched.loop_id}`; "
+                "Ctrl-C again to hard-exit)", err=True)
+            self.sched.request_shutdown("sigint")
+        else:
+            click.echo("\nsecond interrupt: hard exit", err=True)
+            _hard_exit(130)
 
 
 @click.group("loop", invoke_without_command=True)
@@ -49,6 +76,14 @@ pass_factory = click.make_pass_decorator(Factory)
                    "placement before failing (default 600, 0 = fail "
                    "immediately; bounds a run against a fleet that "
                    "never recovers).")
+@click.option("--resume", "resume_run", default=None, metavar="RUN",
+              help="Resume a journaled run (id, unambiguous prefix, or "
+                   "journal path) instead of starting a new one: adopts "
+                   "still-running agent containers in place, accounts "
+                   "exits the dead scheduler missed, re-launches lost "
+                   "placements, sweeps ghosts.  The journal fixes the "
+                   "run's shape; shape flags (-p/--placement/--image/"
+                   "--prompt/...) are ignored.")
 @click.option("--metrics-port", type=int, default=None,
               help="Serve Prometheus metrics on 127.0.0.1:<port>/metrics "
                    "for the run (default: settings telemetry.metrics_port; "
@@ -59,17 +94,18 @@ pass_factory = click.make_pass_decorator(Factory)
 @click.pass_context
 def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                placement, image, prompt, worktrees, env_kv, failover,
-               orphan_grace, metrics_port, as_json, keep):
+               orphan_grace, resume_run, metrics_port, as_json, keep):
     """Fan autonomous agent loops across the runtime's workers."""
     if ctx.invoked_subcommand is not None:
         return
     _run_loops(f, parallel, iterations, placement, image, prompt, worktrees,
-               env_kv, failover, orphan_grace, metrics_port, as_json, keep)
+               env_kv, failover, orphan_grace, metrics_port, as_json, keep,
+               resume_run=resume_run)
 
 
 def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
                worktrees, env_kv, failover, orphan_grace, metrics_port,
-               as_json, keep):
+               as_json, keep, resume_run=None):
     from .. import telemetry
 
     env = {}
@@ -80,18 +116,6 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
         env[k] = v
     defaults = f.config.settings.loop
     tele = f.config.settings.telemetry
-    spec = LoopSpec(
-        parallel=parallel or defaults.parallel,
-        iterations=iterations if iterations >= 0 else defaults.max_iterations,
-        placement=placement or defaults.placement,
-        image=image,
-        prompt=prompt,
-        worktrees=worktrees,
-        env=env,
-        failover=failover or defaults.failover,
-        orphan_grace_s=orphan_grace,
-        telemetry=tele.flight_recorder,
-    )
 
     live = f.streams.is_stdout_tty() and not as_json
     dashboard = None
@@ -107,7 +131,40 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
         line = f"[{agent}] {event}" + (f" {detail}" if detail else "")
         click.echo(line, err=True)
 
-    sched = LoopScheduler(f.config, f.driver, spec, on_event=on_event)
+    if resume_run:
+        if parallel or placement or prompt or env_kv or image != "@":
+            click.echo("note: --resume takes the run shape from the "
+                       "journal; shape flags are ignored", err=True)
+        from ..loop.journal import RunJournal, replay
+
+        jpath = _resolve_journal(f, resume_run)
+        run_image = replay(RunJournal.read(jpath))
+        if not run_image.run_id:
+            raise click.ClickException(
+                f"{jpath}: no usable run header -- the journal is too "
+                "damaged to resume; start a fresh run")
+        sched = LoopScheduler.resume(
+            f.config, f.driver, run_image, on_event=on_event,
+            failover=failover,
+            iterations=iterations if iterations >= 0 else None,
+            orphan_grace_s=orphan_grace,
+            telemetry=tele.flight_recorder)
+        spec = sched.spec
+    else:
+        spec = LoopSpec(
+            parallel=parallel or defaults.parallel,
+            iterations=(iterations if iterations >= 0
+                        else defaults.max_iterations),
+            placement=placement or defaults.placement,
+            image=image,
+            prompt=prompt,
+            worktrees=worktrees,
+            env=env,
+            failover=failover or defaults.failover,
+            orphan_grace_s=orphan_grace,
+            telemetry=tele.flight_recorder,
+        )
+        sched = LoopScheduler(f.config, f.driver, spec, on_event=on_event)
     feed = None
     watch = None
     metrics_server = None
@@ -148,15 +205,25 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             egress_path=local_log,
             egress_feed=feed,
         )
-    signal.signal(signal.SIGINT, lambda *_: sched.stop())
-    signal.signal(signal.SIGTERM, lambda *_: sched.stop())
+    signal.signal(signal.SIGINT, _TwoStageInterrupt(sched))
+    signal.signal(signal.SIGTERM,
+                  lambda *_: sched.request_shutdown("sigterm"))
     click.echo(
         f"loop {sched.loop_id}: {spec.parallel} agent(s), "
         f"{spec.iterations or 'unbounded'} iteration(s), {spec.placement} "
-        f"placement, {spec.failover} failover",
+        f"placement, {spec.failover} failover"
+        + (" (resumed)" if resume_run else ""),
         err=True,
     )
-    sched.start()
+    if resume_run:
+        summary = sched.reconcile()
+        click.echo(
+            "resume: {adopted} adopted, {continued} continued, "
+            "{relaunched} relaunched, {exits_accounted} exit(s) accounted, "
+            "{ghosts} ghost(s) swept, {orphaned} orphaned".format(**summary),
+            err=True)
+    else:
+        sched.start()
     try:
         if dashboard is not None:
             with dashboard:
@@ -184,10 +251,37 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
                        f"iters={l.iteration}\texits={codes}")
         if sched.flight is not None:
             click.echo(f"trace: clawker loop trace {sched.loop_id}", err=True)
+        if sched.journal is not None and any(
+                l.status == "stopped" for l in loops):
+            click.echo(f"resume: clawker loop --resume {sched.loop_id}",
+                       err=True)
     # orphaned loops never completed their budget (worker died, no
     # failover outcome before stop): that is not a success either
     if any(l.status in ("failed", "orphaned") for l in loops):
         raise SystemExit(1)
+
+
+def _resolve_journal(f: Factory, run: str) -> Path:
+    """RUN (an id, an unambiguous prefix, or a journal file path) -> the
+    run journal to resume from."""
+    from ..loop.journal import RUNS_DIR, journal_path
+
+    runs_dir = f.config.logs_dir / RUNS_DIR
+    as_path = Path(run)
+    if as_path.exists() and as_path.is_file():
+        return as_path
+    exact = journal_path(f.config.logs_dir, run)
+    if exact.exists():
+        return exact
+    matches = sorted(runs_dir.glob(f"{run}*.journal"))
+    if len(matches) == 1:
+        return matches[0]
+    if matches:
+        names = ", ".join(m.stem for m in matches)
+        raise click.ClickException(f"run {run!r} is ambiguous: {names}")
+    raise click.ClickException(
+        f"no run journal for {run!r} under {runs_dir} (runs journal one "
+        "by default; check settings loop.journal.enable)")
 
 
 # ------------------------------------------------------------------- trace
@@ -233,7 +327,7 @@ def _render_node(node, depth: int, out: list[str]) -> None:
     if depth == 0:
         attrs = rec.attrs
         extra = "".join(
-            f" {k}={attrs[k]}" for k in ("queue_ms", "resumed")
+            f" {k}={attrs[k]}" for k in ("queue_ms", "resumed", "adopted")
             if k in attrs)
         # a non-iteration root is a phase span whose iteration root never
         # flushed (crashed run): show it, flagged, rather than hide it

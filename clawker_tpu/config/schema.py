@@ -356,6 +356,21 @@ class TPUSettings:
 
 
 @dataclass
+class LoopJournalSettings:
+    """Durable run journal for ``clawker loop`` (docs/loop-resume.md).
+
+    On by default: the journal exists exactly for the scheduler deaths
+    nobody planned for, and its cost is one fsync-batched JSONL append
+    per state transition.  ``fsync_batch_n`` / ``fsync_interval_s``
+    bound how much un-synced tail a HOST crash may lose (a CLI crash
+    loses nothing -- every record is flushed to the OS on append)."""
+
+    enable: bool = True
+    fsync_batch_n: int = 8          # records per group-commit fsync
+    fsync_interval_s: float = 0.25  # max age of an un-synced tail
+
+
+@dataclass
 class LoopSettings:
     """Autonomous-loop scheduler defaults (net-new)."""
 
@@ -364,6 +379,7 @@ class LoopSettings:
     idle_exit_s: float = 300.0
     placement: str = "spread"       # spread | pack
     failover: str = "migrate"       # migrate | wait | fail (worker death)
+    journal: LoopJournalSettings = field(default_factory=LoopJournalSettings)
 
 
 @dataclass

@@ -62,6 +62,10 @@ LABEL_VOLUME_PURPOSE = f"{LABEL_NS}.volume.purpose"  # workspace | config | hist
 LABEL_IMAGE_KIND = f"{LABEL_NS}.image.kind"          # base | harness | infra
 LABEL_CONTENT_SHA = f"{LABEL_NS}.content-sha"        # content-derived infra image cache key
 LABEL_LOOP = f"{LABEL_NS}.loop"          # loop-run id for `clawker loop` members
+LABEL_LOOP_EPOCH = f"{LABEL_NS}.loop-epoch"  # placement epoch that created the
+#                                          container: --resume adopts a
+#                                          current-epoch copy and sweeps
+#                                          stale ones as ghosts
 
 MANAGED_VALUE = "true"
 

@@ -249,6 +249,40 @@ class FakeDockerAPI:
     def set_behavior(self, image: str, behavior: Behavior) -> None:
         self.image_behaviors[image] = behavior
 
+    def add_container(self, name: str, *, image: str = "",
+                      labels: dict[str, str] | None = None,
+                      state: str = "created", exit_code: int = 0,
+                      behavior: Behavior | None = None) -> str:
+        """Seed a PRE-EXISTING container, bypassing the create/start API
+        (and the call recorder): the state a daemon is in when a new CLI
+        process arrives -- e.g. loop containers left running by a killed
+        scheduler that ``--resume`` must adopt without re-creating.
+        ``state`` is created | running | exited; a running container
+        gets a live simulated process."""
+        if state not in ("created", "running", "exited"):
+            raise ValueError(f"add_container: unknown state {state!r}")
+        with self._lock:
+            for c in self.containers.values():
+                if c.name == name:
+                    raise ConflictError(f"container name {name} already in use")
+            cid = short_id(64)
+            config = {"Image": image, "Labels": dict(labels or {})}
+            c = FakeContainer(
+                id=cid, name=name, config=config,
+                behavior=behavior or self.image_behaviors.get(image,
+                                                              idle_behavior))
+            self.containers[cid] = c
+        if state == "running":
+            c.state = "running"
+            c.ip = c.networks.get("bridge", "") or self._next_ip()
+            self._spawn(c)
+        elif state == "exited":
+            c.state = "exited"
+            c.exit_code = exit_code
+            c.stdout.close()
+            c.exited.set()
+        return cid
+
     def emit_event(self, ev: dict) -> None:
         with self._lock:
             for q in self._event_subs:
@@ -335,6 +369,13 @@ class FakeDockerAPI:
         if not c.ip:
             c.ip = c.networks.get("bridge", "") or self._next_ip()
 
+        # start event precedes any possible die (real daemons order it so)
+        self._event("container", "start", c.id, {"name": c.name})
+        self._spawn(c)
+
+    def _spawn(self, c: FakeContainer) -> None:
+        """Run the container's simulated process on a daemon thread."""
+
         def run() -> None:
             io = FakeProcessIO(c.stdin, c.stdout, c.kill_event, c.log_buf)
             try:
@@ -348,8 +389,6 @@ class FakeDockerAPI:
             c.exited.set()
             self._event("container", "die", c.id, {"name": c.name, "exitCode": str(code)})
 
-        # start event precedes any possible die (real daemons order it so)
-        self._event("container", "start", c.id, {"name": c.name})
         threading.Thread(target=run, daemon=True, name=f"fake-{c.name}").start()
 
     def container_stop(self, cid: str, timeout: int = 10) -> None:

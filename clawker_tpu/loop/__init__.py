@@ -6,6 +6,8 @@ loops across the worker VMs of a TPU pod, restart each agent per
 iteration, aggregate status.
 """
 
+from .journal import RunImage, RunJournal, journal_path, replay
 from .scheduler import AgentLoop, LoopScheduler, LoopSpec
 
-__all__ = ["AgentLoop", "LoopScheduler", "LoopSpec"]
+__all__ = ["AgentLoop", "LoopScheduler", "LoopSpec",
+           "RunImage", "RunJournal", "journal_path", "replay"]

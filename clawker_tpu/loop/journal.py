@@ -1,0 +1,276 @@
+"""Durable run journal: the loop scheduler's write-ahead log.
+
+The scheduler's whole fleet state -- placements, iteration counts, exit
+histories -- used to live in one CLI process: a killed ``clawker loop``
+(OOM, SIGKILL, host reboot, dropped SSH session) evaporated it while
+the agent containers kept running on the workers.  The journal makes
+scheduler death survivable: every state transition is appended as one
+JSONL record to ``logs/runs/<run>.journal`` *before* the engine call it
+describes, and ``clawker loop --resume <run>`` replays the file into a
+:class:`RunImage` that the scheduler reconciles against what is
+actually running on each worker (docs/loop-resume.md).
+
+Durability model -- **fsync-batched write-ahead**:
+
+- Every append is written + flushed immediately (the OS has it even if
+  the CLI dies; only a host crash can lose an unsynced tail).
+- Records that gate *idempotent rediscovery* (``placement`` before a
+  create is submitted, ``created`` once the engine returned a container
+  id) are appended ``durable=True``: the append fsyncs before
+  returning, and -- group commit -- that one fsync also covers every
+  batched record written before it by any thread.
+- Bookkeeping records (``started``/``exited``/...) batch: they fsync
+  every ``fsync_batch_n`` records or ``fsync_interval_s`` seconds,
+  whichever comes first.  Losing such a tail is safe because the
+  reconcile pass re-derives the same facts from engine container state.
+
+The read side rides the shared crash-tolerant tail-reader
+(:func:`~clawker_tpu.monitor.ledger.read_jsonl`): a writer killed
+mid-line degrades to "one torn record skipped", identically to the
+flight recorder.
+
+A journal whose directory cannot be created degrades to a counting
+no-op -- journaling must never fail the run it protects.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..monitor.ledger import read_jsonl
+
+RUNS_DIR = "runs"               # under Config.logs_dir
+
+# record kinds (the `kind` field of every journal record)
+REC_RUN = "run"                 # run header: spec + worker set
+REC_PLACEMENT = "placement"     # agent placed on a worker (pre-create WAL)
+REC_CREATED = "created"         # engine returned a container id
+REC_STARTED = "started"         # iteration N started executing
+REC_EXITED = "exited"           # iteration N's exit accounted
+REC_ORPHANED = "orphaned"       # worker died under the loop
+REC_MIGRATED = "migrated"       # failover moved the loop src -> dst
+REC_ADOPTED = "adopted"         # resume adopted a still-running container
+REC_GHOST = "ghost"             # resume swept an unjournaled leftover
+REC_LOOP_END = "loop_end"       # terminal loop status (done|failed|stopped)
+REC_SHUTDOWN = "shutdown"       # clean scheduler drain (SIGINT/SIGTERM/stop)
+REC_RESUME = "resume"           # a --resume generation picked the run up
+
+
+def journal_path(logs_dir: Path, run_id: str) -> Path:
+    """Canonical journal path for one loop run."""
+    return Path(logs_dir) / RUNS_DIR / f"{run_id}.journal"
+
+
+class RunJournal:
+    """Append-only JSONL write-ahead journal for one loop run.
+
+    Thread-safe: lane threads, waiter threads, and the run thread all
+    append.  ``seq`` totally orders records even when ``ts`` ties.
+    """
+
+    def __init__(self, path: Path, *, fsync_batch_n: int = 8,
+                 fsync_interval_s: float = 0.25, clock=time.time):
+        self.path = Path(path)
+        self.fsync_batch_n = max(1, int(fsync_batch_n))
+        self.fsync_interval_s = float(fsync_interval_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._pending = 0           # records flushed but not yet fsynced
+        self._last_sync = 0.0
+        self.dropped = 0
+        self._fh = None
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self._fh = open(self.path, "a", encoding="utf-8")
+        except OSError:
+            self._fh = None
+        if self._fh is not None:
+            # a resume generation REOPENS the dead run's journal: seq must
+            # continue from the existing tail, not restart at 1 -- a
+            # second resume would otherwise interleave generations when
+            # ordering by seq
+            for rec in read_jsonl(self.path):
+                seq = rec.get("seq", 0)
+                if isinstance(seq, (int, float)) and int(seq) > self._seq:
+                    self._seq = int(seq)
+
+    def append(self, kind: str, *, durable: bool = False, **fields) -> None:
+        """Append one record; with ``durable`` the record (and every
+        batched record before it) is fsynced before returning."""
+        with self._lock:
+            if self._fh is None:
+                self.dropped += 1
+                return
+            self._seq += 1
+            rec = {"kind": kind, "seq": self._seq, "ts": self._clock(),
+                   **fields}
+            try:
+                self._fh.write(
+                    json.dumps(rec, separators=(",", ":"), default=str) + "\n")
+                self._fh.flush()
+            except OSError:
+                self.dropped += 1
+                return
+            self._pending += 1
+            now = time.monotonic()
+            if (durable or self._pending >= self.fsync_batch_n
+                    or now - self._last_sync >= self.fsync_interval_s):
+                self._fsync_locked(now)
+
+    def sync(self) -> None:
+        """Force the batched tail to disk (graceful-shutdown barrier)."""
+        with self._lock:
+            if self._fh is not None and self._pending:
+                self._fsync_locked(time.monotonic())
+
+    def _fsync_locked(self, now: float) -> None:
+        try:
+            os.fsync(self._fh.fileno())
+        except OSError:
+            self.dropped += self._pending
+        self._pending = 0
+        self._last_sync = now
+
+    def close(self) -> None:
+        with self._lock:
+            fh, self._fh = self._fh, None
+        if fh is not None:
+            try:
+                if self._pending:
+                    os.fsync(fh.fileno())
+                fh.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def read(path: Path) -> list[dict]:
+        """Every parseable record, skipping a truncated tail (shared
+        crash-tolerant reader -- monitor.ledger.read_jsonl)."""
+        return read_jsonl(path)
+
+
+# --------------------------------------------------------------------------
+# replay: journal records -> the run image --resume reconciles from
+# --------------------------------------------------------------------------
+
+# statuses a resume picks back up ("stopped" is the clean-drain state --
+# resuming after a graceful Ctrl-C is the whole point of journaling it)
+RESUMABLE_STATUSES = ("pending", "running", "orphaned", "stopped")
+
+
+@dataclass
+class LoopImage:
+    """One agent loop's journaled state, folded to the latest record."""
+
+    agent: str
+    worker: str = ""
+    epoch: int = 0
+    iteration: int = 0
+    exit_codes: list[int] = field(default_factory=list)
+    consecutive_failures: int = 0
+    status: str = "pending"
+    container_id: str = ""
+    started: bool = False       # current iteration journaled as started
+    migrations: int = 0
+    abandoned: list[tuple[str, str]] = field(default_factory=list)
+
+    @property
+    def resumable(self) -> bool:
+        return self.status in RESUMABLE_STATUSES
+
+
+@dataclass
+class RunImage:
+    """A whole run's journaled state: what replay() hands the scheduler."""
+
+    run_id: str = ""
+    project: str = ""
+    spec: dict = field(default_factory=dict)
+    workers: list[str] = field(default_factory=list)
+    loops: dict[str, LoopImage] = field(default_factory=dict)
+    clean_shutdown: bool = False
+    generation: int = 0         # how many resumes already hit this run
+
+
+def replay(records: list[dict]) -> RunImage:
+    """Fold journal records (in FILE order) into a :class:`RunImage`.
+
+    File order is chronological by construction -- the journal is
+    append-only under one lock, across resume generations too -- so no
+    re-sort happens here (sorting by ``seq`` would interleave a journal
+    whose earlier generations were written by a pre-continuation-seq
+    writer).  Tolerant by design: unknown kinds are skipped (a newer
+    CLI's journal must still resume under an older one as far as it
+    can), and every field read is defaulted -- a torn record that parsed
+    as JSON but lost fields must not kill the replay.
+    """
+    img = RunImage()
+    for rec in records:
+        kind = rec.get("kind", "")
+        if kind == REC_RUN:
+            img.run_id = str(rec.get("run", ""))
+            img.project = str(rec.get("project", ""))
+            img.spec = dict(rec.get("spec") or {})
+            img.workers = [str(w) for w in rec.get("workers") or []]
+            continue
+        if kind == REC_SHUTDOWN:
+            img.clean_shutdown = True
+            continue
+        if kind == REC_RESUME:
+            img.generation = int(rec.get("generation", img.generation + 1))
+            continue
+        agent = str(rec.get("agent", ""))
+        if not agent:
+            continue
+        loop = img.loops.setdefault(agent, LoopImage(agent=agent))
+        if kind == REC_PLACEMENT:
+            loop.worker = str(rec.get("worker", loop.worker))
+            loop.epoch = int(rec.get("epoch", loop.epoch))
+            loop.status = "pending"
+            loop.container_id = ""
+            loop.started = False
+        elif kind == REC_CREATED:
+            loop.container_id = str(rec.get("cid", ""))
+        elif kind == REC_STARTED:
+            loop.iteration = int(rec.get("iteration", loop.iteration))
+            loop.started = True
+            loop.status = "running"
+        elif kind == REC_EXITED:
+            code = rec.get("code")
+            if code is not None:
+                loop.exit_codes.append(int(code))
+                loop.consecutive_failures = (
+                    0 if int(code) == 0 else loop.consecutive_failures + 1)
+            loop.iteration = int(rec.get("iteration", loop.iteration)) + 1
+            loop.started = False
+            loop.status = "running"
+        elif kind == REC_ADOPTED:
+            loop.container_id = str(rec.get("cid", loop.container_id))
+            loop.iteration = int(rec.get("iteration", loop.iteration))
+            loop.started = True
+            loop.status = "running"
+        elif kind == REC_ORPHANED:
+            cid = str(rec.get("cid", ""))
+            wid = str(rec.get("worker", loop.worker))
+            if cid:
+                loop.abandoned.append((wid, cid))
+            loop.container_id = ""
+            loop.started = False
+            loop.status = "orphaned"
+        elif kind == REC_MIGRATED:
+            loop.migrations += 1
+        elif kind == REC_LOOP_END:
+            loop.status = str(rec.get("status", "stopped"))
+            if loop.status == "stopped":
+                # the drain deliberately halted any in-flight iteration:
+                # billing its docker-stop kill code as a real exit would
+                # burn budget and failure ceiling for work the scheduler
+                # itself interrupted -- resume re-runs the iteration
+                loop.started = False
+    return img

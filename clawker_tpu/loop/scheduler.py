@@ -59,6 +59,23 @@ what happens next:
 
 A recovered worker (open -> half-open -> closed) rejoins the placement
 set automatically.
+
+Durability (``loop/journal.py``, docs/loop-resume.md): every state
+transition -- placement chosen, container created, started, exited,
+orphaned, migrated, budget reached -- is appended to a write-ahead
+fsync-batched JSONL journal under ``logs/runs/<run>.journal`` BEFORE
+the engine call it describes, with deterministic per-(run, slot)
+container names and a placement-epoch label.  ``clawker loop --resume``
+replays the journal and reconciles it against one label-scoped
+``list_containers`` per worker: still-running containers are ADOPTED in
+place (waiter threads re-attach, nothing restarts), exits the dead
+scheduler never saw are accounted exactly once, created-but-never-
+started launches finish, journaled-but-never-created placements
+re-launch, unclaimed leftovers are swept as ghosts, and workers that
+died while the CLI was down flow into the breaker/failover machinery
+above.  The scheduler process is thereby no longer a single point of
+failure: kill -9 mid-run costs at most the batched journal tail, which
+reconcile re-derives from engine state.
 """
 
 from __future__ import annotations
@@ -80,17 +97,36 @@ from ..errors import ClawkerError, DriverError, NotFoundError
 from ..health import BREAKER_CLOSED, BREAKER_OPEN, HealthConfig, HealthMonitor
 from ..monitor.events import TRACE_SPAN, EventBus
 from ..monitor.ledger import FlightRecorder, flight_path
+from ..runtime.names import container_name
 from ..runtime.orchestrate import AgentRuntime, CreateOptions
 from ..telemetry.spans import (
     SPAN_CREATE,
     SPAN_EXIT,
     SPAN_MIGRATE,
     SPAN_ORPHAN,
+    SPAN_RESUME,
     SPAN_START,
     SPAN_WAIT,
     Tracer,
 )
 from ..util import ids
+from .journal import (
+    REC_ADOPTED,
+    REC_CREATED,
+    REC_EXITED,
+    REC_GHOST,
+    REC_LOOP_END,
+    REC_MIGRATED,
+    REC_ORPHANED,
+    REC_PLACEMENT,
+    REC_RESUME,
+    REC_RUN,
+    REC_SHUTDOWN,
+    REC_STARTED,
+    RunImage,
+    RunJournal,
+    journal_path,
+)
 
 log = logsetup.get("loop.scheduler")
 
@@ -108,6 +144,19 @@ _LANE_EXECUTE_SECONDS = telemetry.histogram(
 _ITERATIONS = telemetry.counter(
     "loop_iterations_total", "Completed loop iterations",
     labels=("status",))           # status: ok | failed
+# resume telemetry (docs/loop-resume.md): how a journal replay landed --
+# adoption is the cheap path (container kept running, zero engine
+# mutations), everything else re-pays part of a cold start
+_RESUMES = telemetry.counter(
+    "loop_resumes_total", "Journal-replay resumes of loop runs")
+_ADOPTIONS = telemetry.counter(
+    "loop_adoptions_total",
+    "Still-running containers adopted in place by --resume",
+    labels=("worker",))
+_GHOSTS = telemetry.counter(
+    "loop_ghosts_swept_total",
+    "Unjournaled leftover containers swept at resume reconcile",
+    labels=("worker",))
 
 FAILURE_CEILING = 3          # consecutive nonzero exits -> loop failed
 LOOP_STATE_DIR = "/run/clawker"
@@ -155,6 +204,10 @@ class LoopSpec:
     agent_prefix: str = "loop"
     env: dict[str, str] = field(default_factory=dict)
     failover: str = "migrate"        # migrate | wait | fail
+    journal: bool = True             # write-ahead run journal under
+    #                                  logs/runs/<run>.journal: what
+    #                                  `loop --resume` replays after a
+    #                                  scheduler death (docs/loop-resume.md)
     telemetry: bool = True           # iteration spans + flight recorder
     #                                  (metrics registration is import-time
     #                                  and stays on either way)
@@ -251,7 +304,8 @@ class _WorkerLane:
 
 class LoopScheduler:
     def __init__(self, cfg: Config, driver: RuntimeDriver, spec: LoopSpec,
-                 *, on_event=None, health_config: HealthConfig | None = None):
+                 *, on_event=None, health_config: HealthConfig | None = None,
+                 run_id: str | None = None):
         if spec.failover not in FAILOVER_POLICIES:
             raise ClawkerError(
                 f"loop: unknown failover policy {spec.failover!r} "
@@ -259,7 +313,9 @@ class LoopScheduler:
         self.cfg = cfg
         self.driver = driver
         self.spec = spec
-        self.loop_id = ids.short_id()
+        # an explicit run_id is a RESUME: the journal, flight record, and
+        # container names of the dead scheduler's run are all keyed by it
+        self.loop_id = run_id or ids.short_id()
         self.loops: list[AgentLoop] = []
         # every event (lane threads, waiter threads, anomaly watch) rides
         # the bus so consumers see per-agent order despite the fan-out
@@ -307,11 +363,37 @@ class LoopScheduler:
             on_span=self._record_span if spec.telemetry else None)
         self._queue_wait: dict[str, float] = {}   # agent -> launch queue s
         self._iter_started: dict[tuple[str, int], float] = {}  # wait-span t0
+        # --- durability: the write-ahead run journal (docs/loop-resume.md).
+        # Every placement/create/start/exit/orphan/migrate transition is
+        # appended BEFORE the engine call it describes; `--resume`
+        # replays it and reconciles against live container state.  A
+        # resume APPENDS to the dead run's journal (run_id keys the path).
+        self.journal: RunJournal | None = None
+        if spec.journal:
+            js = cfg.settings.loop.journal
+            if js.enable:
+                self.journal = RunJournal(
+                    journal_path(cfg.logs_dir, self.loop_id),
+                    fsync_batch_n=js.fsync_batch_n,
+                    fsync_interval_s=js.fsync_interval_s)
+        self._aborted = False       # kill(): crash seam, skip all shutdown
+        self._image: RunImage | None = None   # journal image being resumed
+        self._extra_workers: list[Worker] = []  # journaled workers missing
+        #                           from the current fleet: engine-less
+        #                           stand-ins whose pre-opened breakers
+        #                           route their loops into failover
+        self._shutdown_journaled = False
 
     def _record_span(self, rec) -> None:
         if self.flight is not None:
             self.flight.append(rec.to_json())
         self.events.emit(rec.agent, TRACE_SPAN, rec.detail())
+
+    def _journal(self, kind: str, *, durable: bool = False, **fields) -> None:
+        """Append one journal record; a disabled/degraded journal no-ops
+        (journaling must never fail the run it protects)."""
+        if self.journal is not None:
+            self.journal.append(kind, durable=durable, **fields)
 
     def attach_anomaly_watch(self, watch) -> None:
         """Surface fleet anomaly scores (analytics.runtime.AnomalyWatch)
@@ -407,13 +489,41 @@ class LoopScheduler:
         slots = place(workers, self.spec.parallel, self.spec.placement)
         for i, worker in enumerate(slots):
             # loop id in the agent name: two concurrent runs in one project
-            # must never collide (replace=True would kill the other run)
-            agent = f"{self.spec.agent_prefix}-{self.loop_id[:6]}-{i}"
+            # must never collide (replace=True would kill the other run);
+            # the name is DETERMINISTIC per (run, slot) so a resume can
+            # re-derive it from the journal alone
+            agent = self._agent_name(i)
             loop = AgentLoop(agent=agent, worker=worker)
             self.loops.append(loop)
+        # write-ahead: the run header and every placement hit the journal
+        # (one group-commit fsync) BEFORE any launch is submitted -- a
+        # crash past this point leaves enough to reconcile from
+        self._journal(REC_RUN, run=self.loop_id,
+                      project=self.cfg.project_name(),
+                      spec=self._spec_doc(), workers=[w.id for w in workers])
+        for loop in self.loops:
+            self._journal(REC_PLACEMENT, agent=loop.agent,
+                          worker=loop.worker.id, epoch=loop.epoch)
+        if self.journal is not None:
+            self.journal.sync()
         for loop in self.loops:
             self._submit_inflight(loop, loop.worker,
                                   self._launch, loop, loop.epoch)
+
+    def _agent_name(self, slot: int) -> str:
+        return f"{self.spec.agent_prefix}-{self.loop_id[:6]}-{slot}"
+
+    def _spec_doc(self) -> dict:
+        """The journaled run shape: everything a resume needs to rebuild
+        an equivalent LoopSpec without the original command line."""
+        s = self.spec
+        return {
+            "parallel": s.parallel, "iterations": s.iterations,
+            "placement": s.placement, "image": s.image, "prompt": s.prompt,
+            "worktrees": s.worktrees, "workspace_mode": s.workspace_mode,
+            "agent_prefix": s.agent_prefix, "env": dict(s.env),
+            "failover": s.failover,
+        }
 
     def wait_launched(self, timeout: float | None = None) -> bool:
         """Block until every submitted launch (create + first start) has
@@ -424,6 +534,327 @@ class LoopScheduler:
         done, not_done = futures_wait(list(self._inflight.values()),
                                       timeout=timeout)
         return not not_done
+
+    # -------------------------------------------------------------- resume
+
+    @classmethod
+    def resume(cls, cfg: Config, driver: RuntimeDriver, image: RunImage, *,
+               on_event=None, health_config: HealthConfig | None = None,
+               failover: str | None = None, iterations: int | None = None,
+               orphan_grace_s: float | None = None,
+               telemetry: bool = True) -> "LoopScheduler":
+        """Rebuild a scheduler from a replayed run journal.
+
+        The journal is the authority for the run's SHAPE (slot count,
+        image, prompt, placement policy, per-loop iteration counts and
+        exit histories); ``failover`` / ``iterations`` /
+        ``orphan_grace_s`` may be overridden for the resumed leg.  The
+        caller must run :meth:`reconcile` before :meth:`run` -- that is
+        where journaled state meets live container state.
+        """
+        if not image.run_id:
+            raise ClawkerError(
+                "loop resume: journal has no run header -- the previous "
+                "scheduler died before its first record landed; start a "
+                "fresh run instead")
+        sd = image.spec
+        spec = LoopSpec(
+            parallel=int(sd.get("parallel") or len(image.loops) or 1),
+            iterations=(iterations if iterations is not None
+                        else int(sd.get("iterations") or 0)),
+            placement=str(sd.get("placement") or "spread"),
+            image=str(sd.get("image") or "@"),
+            prompt=str(sd.get("prompt") or ""),
+            worktrees=bool(sd.get("worktrees") or False),
+            workspace_mode=str(sd.get("workspace_mode") or ""),
+            agent_prefix=str(sd.get("agent_prefix") or "loop"),
+            env={str(k): str(v) for k, v in (sd.get("env") or {}).items()},
+            failover=failover or str(sd.get("failover") or "migrate"),
+            orphan_grace_s=orphan_grace_s,
+            telemetry=telemetry,
+        )
+        sched = cls(cfg, driver, spec, on_event=on_event,
+                    health_config=health_config, run_id=image.run_id)
+        sched._image = image
+        sched._build_resumed_loops(image)
+        sched._journal(REC_RESUME, durable=True,
+                       generation=image.generation + 1,
+                       clean=image.clean_shutdown)
+        _RESUMES.inc()
+        sched.on_event("scheduler", "resume",
+                       f"run {image.run_id} generation {image.generation + 1}")
+        return sched
+
+    def _build_resumed_loops(self, image: RunImage) -> None:
+        """Journal images -> AgentLoop objects on the CURRENT fleet.
+
+        A journaled worker the fleet no longer has gets an engine-less
+        stand-in ``Worker``: the health monitor pre-opens its breaker,
+        so its loops flow through the ordinary orphan/failover path on
+        the first verdict drain instead of needing a parallel mechanism.
+        """
+        workers_by_id = {w.id: w for w in self.driver.workers()}
+        synthesized: dict[str, Worker] = {}
+
+        def worker_for(wid: str) -> Worker:
+            w = workers_by_id.get(wid)
+            if w is not None:
+                return w
+            if wid not in synthesized:
+                stand_in = Worker(
+                    id=wid, index=len(workers_by_id) + len(synthesized),
+                    hostname=wid, engine=None,
+                    meta={"dial_error": "worker absent from resumed fleet"})
+                synthesized[wid] = stand_in
+                self._extra_workers.append(stand_in)
+            return synthesized[wid]
+
+        # agent names are deterministic per (run, slot): slots the journal
+        # never recorded (crash inside start() before the placement batch
+        # synced) get fresh placements on the live fleet
+        slots = place(self.driver.workers(), self.spec.parallel,
+                      self.spec.placement)
+        for i in range(self.spec.parallel):
+            agent = self._agent_name(i)
+            img = image.loops.get(agent)
+            if img is None:
+                self._journal(REC_PLACEMENT, agent=agent,
+                              worker=slots[i].id, epoch=0)
+                self.loops.append(AgentLoop(agent=agent, worker=slots[i]))
+                continue
+            worker = worker_for(img.worker) if img.worker else slots[i]
+            status = img.status
+            if status in ("running", "stopped"):
+                # "running" is a claim about the DEAD scheduler's world;
+                # reconcile re-earns it.  "stopped" is the clean-drain
+                # state a resume exists to pick back up.
+                status = "pending"
+            if (self.spec.iterations
+                    and img.iteration >= self.spec.iterations
+                    and status in ("pending", "orphaned")):
+                # budget reached; the crash beat the terminal record
+                status = "done"
+            loop = AgentLoop(
+                agent=agent, worker=worker, iteration=img.iteration,
+                consecutive_failures=img.consecutive_failures,
+                exit_codes=list(img.exit_codes), status=status,
+                fresh_container=False, migrations=img.migrations,
+                epoch=img.epoch)
+            loop.abandoned = [(workers_by_id[wid], cid)
+                              for wid, cid in img.abandoned
+                              if wid in workers_by_id]
+            self.loops.append(loop)
+        if self.journal is not None:
+            self.journal.sync()
+
+    def reconcile(self, *, deadline_s: float = 60.0) -> dict:
+        """Reconcile journaled placements against live container state:
+        ONE label-scoped ``list_containers`` per worker (on its lane),
+        then per loop -- adopt a still-running container in place,
+        account an exit the dead scheduler never saw, finish a created-
+        but-never-started launch, re-launch a journaled-but-never-created
+        placement, and sweep unclaimed leftovers as ghosts.  Workers
+        whose listing fails or overruns ``deadline_s`` strand their
+        loops into the normal breaker/failover machinery.
+
+        Returns a summary dict (adopted/continued/relaunched/
+        exits_accounted/ghosts/orphaned counts).  Must run after
+        :meth:`resume` and before :meth:`run`.
+        """
+        image = self._image
+        if image is None:
+            raise ClawkerError("loop resume: reconcile() before resume()")
+        summary = {"adopted": 0, "continued": 0, "relaunched": 0,
+                   "exits_accounted": 0, "ghosts": 0, "orphaned": 0}
+        lock = threading.Lock()     # summary is mutated from lane threads
+        by_worker: dict[str, list[AgentLoop]] = {}
+        for loop in self.loops:
+            if loop.status != "pending" or loop.worker.engine is None:
+                # engine-less stand-ins are handled by the health
+                # pre-trip at run(); terminal loops need nothing
+                continue
+            by_worker.setdefault(loop.worker.id, []).append(loop)
+        futs: dict[str, Future] = {}
+        for wid, group in by_worker.items():
+            futs[wid] = self._lane(group[0].worker).submit(
+                self._reconcile_worker, group[0].worker, list(group),
+                image, summary, lock)
+        futures_wait(list(futs.values()), timeout=deadline_s)
+        for wid, fut in futs.items():
+            if not fut.done() or fut.exception() is not None:
+                # wedged or crashed reconcile: its un-adopted loops go to
+                # failover now; the epoch bump no-ops the late lane task
+                for loop in by_worker[wid]:
+                    if loop.status == "pending":
+                        self._strand(loop, loop.epoch,
+                                     "resume reconcile "
+                                     + ("timed out" if not fut.done() else
+                                        f"crashed: {fut.exception()!r}"))
+                        with lock:
+                            summary["orphaned"] += 1
+        with lock:
+            return dict(summary)
+
+    def _reconcile_worker(self, worker: Worker, group: list[AgentLoop],
+                          image: RunImage, summary: dict, lock) -> None:
+        engine = worker.require_engine()
+        try:
+            rows = engine.list_containers(all=True, filters={
+                "label": [f"{consts.LABEL_LOOP}={self.loop_id}"]})
+        except ClawkerError as e:
+            # the worker died while the CLI was down: strand its loops
+            # into the breaker/failover machinery
+            for loop in group:
+                self._strand(loop, loop.epoch, f"resume: list failed: {e}")
+            with lock:
+                summary["orphaned"] += len(group)
+            return
+        project = self.cfg.project_name()
+        by_name: dict[str, dict] = {}
+        for row in rows:
+            names = row.get("Names") or []
+            if names:
+                by_name[str(names[0]).lstrip("/")] = row
+        claimed: set[str] = set()
+        for loop in group:
+            row = by_name.get(container_name(project, loop.agent))
+            if row is not None:
+                row_epoch = (row.get("Labels") or {}).get(
+                    consts.LABEL_LOOP_EPOCH, "")
+                if row_epoch and row_epoch != str(loop.epoch):
+                    row = None      # superseded placement's copy: a ghost
+            if row is None:
+                # journaled placement, no current container -- the crash
+                # landed between the WAL record and the create (or the
+                # container was lost with its worker): re-launch
+                self._journal(REC_PLACEMENT, durable=True, agent=loop.agent,
+                              worker=worker.id, epoch=loop.epoch)
+                self._submit_inflight(loop, worker,
+                                      self._launch, loop, loop.epoch, worker)
+                with lock:
+                    summary["relaunched"] += 1
+                continue
+            claimed.add(str(row.get("Id", "")))
+            try:
+                self._reconcile_loop(loop, worker, row,
+                                     image.loops.get(loop.agent),
+                                     summary, lock)
+            except ClawkerError as e:
+                self._strand(loop, loop.epoch, f"resume: {e}")
+                with lock:
+                    summary["orphaned"] += 1
+        # ghost sweep: this run's containers on this worker that no
+        # resumed loop claims -- lost-create-response leftovers, stale
+        # epochs, copies of loops placed elsewhere, finished loops'
+        # remains.  Only a label-scoped list finds these.
+        for row in rows:
+            cid = str(row.get("Id", ""))
+            if cid and cid not in claimed:
+                self._remove_cid(worker, cid)
+                self._journal(REC_GHOST, agent="", worker=worker.id, cid=cid)
+                _GHOSTS.labels(worker.id).inc()
+                with lock:
+                    summary["ghosts"] += 1
+
+    def _reconcile_loop(self, loop: AgentLoop, worker: Worker, row: dict,
+                        hint, summary: dict, lock) -> None:
+        """One loop vs its live container.  Runs on the worker's lane."""
+        cid = str(row.get("Id", ""))
+        state = str(row.get("State") or "").lower()
+        epoch = loop.epoch
+        if state in _ACTIVE_STATES and state != "created":
+            # ADOPT in place: the agent kept working while the scheduler
+            # was dead -- no restart, no create; the ordinary waiter/poll
+            # machinery attaches to the live container from here
+            with self._placement_lock:
+                if loop.epoch != epoch or self._stop.is_set():
+                    return
+                loop.container_id = cid
+                loop.fresh_container = False
+                loop.status = "running"
+            self.tracer.begin_iteration(loop.agent, loop.iteration,
+                                        worker.id, epoch=epoch,
+                                        resumed=True, adopted=True)
+            now = self.tracer.now()
+            self.tracer.child(loop.agent, loop.iteration, SPAN_RESUME,
+                              now, now, worker=worker.id, adopted=True)
+            self._iter_started[(loop.agent, loop.iteration)] = now
+            self._journal(REC_ADOPTED, agent=loop.agent, worker=worker.id,
+                          cid=cid, iteration=loop.iteration)
+            _ADOPTIONS.labels(worker.id).inc()
+            done: Future = Future()
+            done.set_result(None)
+            self._inflight[loop.agent] = done
+            self.on_event(loop.agent, "adopted", f"{worker.id}:{cid[:12]}")
+            with lock:
+                summary["adopted"] += 1
+            return
+        if state == "created":
+            # created but never started (crash between the create and the
+            # first start): finish the launch -- full bootstrap, and
+            # crucially NOT a second create
+            with self._placement_lock:
+                if loop.epoch != epoch or self._stop.is_set():
+                    return
+                loop.container_id = cid
+                loop.fresh_container = True
+            self._submit_inflight(loop, worker,
+                                  self._guarded_start, loop, epoch, worker)
+            with lock:
+                summary["continued"] += 1
+            return
+        # exited while the scheduler was dead
+        if hint is not None and hint.started:
+            # the journaled iteration ran to exit unaccounted: account it
+            # exactly once, then continue at the next iteration
+            with self._placement_lock:
+                if loop.epoch != epoch or self._stop.is_set():
+                    return
+                loop.container_id = cid
+                loop.fresh_container = False
+                loop.status = "running"
+            code, detail = self._read_exit(loop)
+            if code is None and not detail:
+                # the list row raced the container back to life: it is
+                # effectively still running -- adopt instead
+                self._reconcile_loop(loop, worker,
+                                     {**row, "State": "running"},
+                                     hint, summary, lock)
+                return
+            self.tracer.begin_iteration(loop.agent, loop.iteration,
+                                        worker.id, epoch=epoch, resumed=True)
+            now = self.tracer.now()
+            self.tracer.child(loop.agent, loop.iteration, SPAN_RESUME,
+                              now, now, worker=worker.id, adopted=False)
+            if code is None:
+                loop.status = "failed"
+                self._journal(REC_LOOP_END, agent=loop.agent,
+                              status="failed", reason=detail)
+                self.tracer.end_iteration(loop.agent, loop.iteration,
+                                          status="failed", reason=detail)
+                self.on_event(loop.agent, "failed", detail)
+                with lock:
+                    summary["exits_accounted"] += 1
+                return
+            self._finish_iteration(loop, code)
+            with lock:
+                summary["exits_accounted"] += 1
+            if loop.status == "running":    # budget left: next iteration
+                self._submit_inflight(loop, worker,
+                                      self._guarded_start, loop, epoch,
+                                      worker)
+            return
+        # exit already journaled (crash landed between iterations):
+        # restart the same container into the next iteration
+        with self._placement_lock:
+            if loop.epoch != epoch or self._stop.is_set():
+                return
+            loop.container_id = cid
+            loop.fresh_container = False
+        self._submit_inflight(loop, worker,
+                              self._guarded_start, loop, epoch, worker)
+        with lock:
+            summary["continued"] += 1
 
     def _launch(self, loop: AgentLoop, epoch: int,
                 worker: Worker | None = None) -> None:
@@ -451,6 +882,8 @@ class LoopScheduler:
             if loop.epoch != epoch:
                 return      # raced an orphan mid-create; rescue owns it
             loop.status = "failed"
+            self._journal(REC_LOOP_END, agent=loop.agent, status="failed",
+                          reason=f"create: {e}")
             self.tracer.end_iteration(loop.agent, loop.iteration,
                                       status="failed", reason=f"create: {e}")
             self.on_event(loop.agent, "create_failed", str(e))
@@ -509,10 +942,18 @@ class LoopScheduler:
             workspace_mode=mode,
             worker=worker.id,
             loop_id=self.loop_id,
+            # the epoch label makes the container self-describing for a
+            # resume: a copy from a superseded placement reads as stale
+            # and is swept instead of adopted
+            extra_labels={consts.LABEL_LOOP_EPOCH: str(epoch)},
             replace=True,
             workspace_root=workspace_root,
             worktree_git_dir=git_dir,
         ))
+        # durable before anything acts on the cid: a crash here must find
+        # the container again by (deterministic name, journaled cid)
+        self._journal(REC_CREATED, durable=True, agent=loop.agent,
+                      worker=worker.id, epoch=epoch, cid=cid)
         with self._placement_lock:
             if loop.epoch != epoch:
                 # orphaned mid-create: the new placement owns the loop
@@ -579,6 +1020,11 @@ class LoopScheduler:
             loop.fresh_container = False
             loop.status = "running"
             loop.strands = 0        # the placement genuinely works
+        # journaled AFTER the engine start returned: a crash in between
+        # reads as started=False with a running container, which the
+        # reconcile pass adopts at this same iteration anyway
+        self._journal(REC_STARTED, agent=loop.agent, worker=worker.id,
+                      epoch=epoch, iteration=loop.iteration)
         now = self.tracer.now()
         self.tracer.child(loop.agent, loop.iteration, SPAN_START,
                           t_start, now, worker=worker.id)
@@ -605,6 +1051,8 @@ class LoopScheduler:
             if loop.epoch != epoch:
                 return      # raced an orphan mid-start; rescue owns it
             loop.status = "failed"
+            self._journal(REC_LOOP_END, agent=loop.agent, status="failed",
+                          reason=f"start: {e}")
             self.tracer.end_iteration(loop.agent, loop.iteration,
                                       status="failed", reason=f"start: {e}")
             self.on_event(loop.agent, "failed", f"start: {e}")
@@ -623,6 +1071,7 @@ class LoopScheduler:
             # accounting below must hit the worker that FAILED, not the
             # healthy migration target
             wid = loop.worker.id
+            stranded_cid = loop.container_id
             if loop.container_id:
                 loop.abandoned.append((loop.worker, loop.container_id))
                 loop.container_id = ""
@@ -638,6 +1087,8 @@ class LoopScheduler:
             self._iter_started.pop((loop.agent, loop.iteration), None)
             loop.status = "orphaned"
             loop.strands += 1
+        self._journal(REC_ORPHANED, agent=loop.agent, worker=wid,
+                      cid=stranded_cid, reason=reason)
         if self.health is not None:
             self.health.report_failure(wid, reason)
             self.health.note_orphaned(wid)
@@ -664,13 +1115,22 @@ class LoopScheduler:
                                   code=code)
         _ITERATIONS.labels(status).inc()
         self.on_event(loop.agent, "iteration_done", f"{loop.iteration - 1}:{code}")
+        # journal records follow the event emits: a batched append may
+        # fsync (milliseconds on slow filesystems), and consumers that
+        # saw the status flip must not wait that long for the event --
+        # replay only needs record ORDER, which is preserved
+        self._journal(REC_EXITED, agent=loop.agent, iteration=finished,
+                      code=code)
         if loop.consecutive_failures >= FAILURE_CEILING:
             loop.status = "failed"
             self.on_event(loop.agent, "failed",
                           f"{FAILURE_CEILING} consecutive failures")
+            self._journal(REC_LOOP_END, agent=loop.agent, status="failed",
+                          reason=f"{FAILURE_CEILING} consecutive failures")
         elif self.spec.iterations and loop.iteration >= self.spec.iterations:
             loop.status = "done"
             self.on_event(loop.agent, "done", f"{loop.iteration} iterations")
+            self._journal(REC_LOOP_END, agent=loop.agent, status="done")
 
     # ------------------------------------------------------------- polling
 
@@ -803,8 +1263,15 @@ class LoopScheduler:
                     done: Future = Future()
                     done.set_result(None)
                     self._inflight[loop.agent] = done
+        # a resume may carry loops journaled onto workers the current
+        # fleet no longer has: engine-less stand-ins join the monitored
+        # set so their pre-opened breakers orphan those loops into the
+        # normal failover machinery on the first verdict drain
+        fleet = list(self.driver.workers())
+        known = {w.id for w in fleet}
+        fleet.extend(w for w in self._extra_workers if w.id not in known)
         self.health = HealthMonitor(
-            self.driver, self.driver.workers(),
+            self.driver, fleet,
             config=self._health_config, events=self.events,
             on_verdict=lambda wid, old, new, reason: (
                 self._verdicts.put((wid, old, new, reason)),
@@ -979,6 +1446,8 @@ class LoopScheduler:
                     self._waited.discard((loop.agent, loop.iteration))
                     if code is None:
                         loop.status = "failed"
+                        self._journal(REC_LOOP_END, agent=loop.agent,
+                                      status="failed", reason=detail)
                         self._iter_started.pop(
                             (loop.agent, loop.iteration), None)
                         self.tracer.end_iteration(
@@ -995,6 +1464,11 @@ class LoopScheduler:
                     self._wake.wait(poll_s)
         finally:
             self.health.stop()
+        if self._aborted:
+            # kill(): the crash seam -- return exactly what SIGKILL would
+            # leave behind (no halts, no span flush, no shutdown records;
+            # the journal's batched tail stays wherever it was)
+            return self.loops
         if self._stop.is_set():
             self._halt_running()
         # iterations still open (stop(), a failed loop's in-flight span)
@@ -1004,6 +1478,8 @@ class LoopScheduler:
         # callers read final states + their own on_event capture right
         # after run(); make sure every stamped event reached the sink
         self.events.flush()
+        if self.journal is not None:
+            self.journal.sync()
         return self.loops
 
     # ----------------------------------------------------------- failover
@@ -1034,6 +1510,18 @@ class LoopScheduler:
             if new == BREAKER_OPEN:
                 self._orphan_worker(wid, reason)
             elif new == BREAKER_CLOSED:
+                # retire the worker's lane at recovery too (the same
+                # mechanism quarantine uses at open): a lane brought up
+                # while the breaker cycled may still be wedged inside a
+                # dedicated read-unbounded engine call that queued tasks
+                # never trip wedge detection for -- launches resumed
+                # under `--failover wait` must start on a FRESH thread,
+                # never queue behind the stuck call (ROADMAP: PR-3 known
+                # limitation).  Queued tasks on the old lane are
+                # epoch-guarded and no-op when (if) the thread unblocks.
+                stale_lane = self._lanes.pop(wid, None)
+                if stale_lane is not None:
+                    stale_lane.close()
                 self._unreach.pop(wid, None)   # a fresh episode starts clean
                 # the halt attempted at orphan time ran against a dead
                 # daemon and likely failed: a recovered worker may still
@@ -1079,6 +1567,8 @@ class LoopScheduler:
                     loop.abandoned.append((loop.worker, loop.container_id))
                     halt_cid = loop.container_id
                     loop.container_id = ""
+            self._journal(REC_ORPHANED, agent=loop.agent, worker=wid,
+                          cid=halt_cid, reason=reason)
             if halt_cid:
                 # best-effort halt OFF the wedged lane: stop rides a
                 # dedicated never-pooled socket (engine/httpapi), so a
@@ -1147,6 +1637,14 @@ class LoopScheduler:
                 loop.status = "pending"
                 loop.fresh_container = True
             self._orphan_since.pop(loop.agent, None)
+            # write-ahead: the new placement is durable before its launch
+            # is submitted, so a crash mid-migration resumes at the NEW
+            # worker instead of resurrecting the dead placement
+            if target.id != old.id:
+                self._journal(REC_MIGRATED, agent=loop.agent,
+                              src=old.id, dst=target.id)
+            self._journal(REC_PLACEMENT, durable=True, agent=loop.agent,
+                          worker=target.id, epoch=loop.epoch)
             # the re-placed attempt gets a FRESH root span (the orphaned
             # attempt's root closed when the worker died); the hop rides
             # it as a zero-width migrate child so `loop trace` can show
@@ -1178,6 +1676,8 @@ class LoopScheduler:
         done.set_result(None)
         self._inflight[loop.agent] = done
         self._orphan_since.pop(loop.agent, None)
+        self._journal(REC_LOOP_END, agent=loop.agent, status="failed",
+                      reason=detail)
         self.tracer.end_iteration(loop.agent, loop.iteration,
                                   status="failed", reason=detail)
         self.on_event(loop.agent, "failed", detail)
@@ -1213,6 +1713,8 @@ class LoopScheduler:
             exc = fut.exception()
             if exc is not None and loop.status in ("pending", "running"):
                 loop.status = "failed"
+                self._journal(REC_LOOP_END, agent=loop.agent, status="failed",
+                              reason=f"internal: {exc!r}")
                 self.tracer.end_iteration(loop.agent, loop.iteration,
                                           status="failed",
                                           reason=f"internal: {exc!r}")
@@ -1223,6 +1725,26 @@ class LoopScheduler:
         self._stop.set()
         self._wake.set()
 
+    def request_shutdown(self, reason: str = "stop") -> None:
+        """Graceful drain with a durable ``shutdown`` journal record --
+        the marker that tells a later ``--resume`` this run ended
+        cleanly (stopped loops, not a crash).  Idempotent: the CLI's
+        first Ctrl-C and its SIGTERM path both land here."""
+        if not self._shutdown_journaled:
+            self._shutdown_journaled = True
+            self._journal(REC_SHUTDOWN, durable=True, reason=reason)
+        self.stop()
+
+    def kill(self) -> None:
+        """Simulate scheduler death (tests + the resume bench): cease all
+        activity WITHOUT journaling, halting containers, flushing spans,
+        or cleaning up -- exactly the state SIGKILL leaves for
+        ``--resume`` to reconcile.  Lane guards see the stop flag, so
+        queued tasks die the way a killed process's threads would."""
+        self._aborted = True
+        self._stop.set()
+        self._wake.set()
+
     def _halt_running(self) -> None:
         futs = []
         for loop in self.loops:
@@ -1230,6 +1752,7 @@ class LoopScheduler:
                 continue
             futs.append(self._lane(loop.worker).submit(self._halt_one, loop))
             loop.status = "stopped"
+            self._journal(REC_LOOP_END, agent=loop.agent, status="stopped")
             self.on_event(loop.agent, "stopped")
         if futs:
             futures_wait(futs, timeout=HALT_DEADLINE_S)
@@ -1285,6 +1808,8 @@ class LoopScheduler:
         self.tracer.close_open("stopped")
         if self.flight is not None:
             self.flight.close()
+        if self.journal is not None:
+            self.journal.close()
         self.events.flush()
         self.events.close()
 

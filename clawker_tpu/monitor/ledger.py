@@ -56,6 +56,20 @@ def parse_jsonl(lines) -> list[dict]:
     return out
 
 
+def read_jsonl(path: Path) -> list[dict]:
+    """Crash-tolerant JSONL *file* read: every parseable record in
+    ``path``, skipping blanks, corrupt lines, and the truncated tail a
+    writer that died mid-line leaves behind.  THE shared tail-reader for
+    every append-only crash-evidence format (the flight recorder and the
+    loop run journal both ride it), so a torn write degrades identically
+    everywhere instead of each reader inventing its own tolerance."""
+    try:
+        text = Path(path).read_text(encoding="utf-8")
+    except OSError:
+        return []
+    return parse_jsonl(text.splitlines())
+
+
 def flight_path(logs_dir: Path, run_id: str) -> Path:
     """Canonical flight-recorder path for one loop run."""
     return Path(logs_dir) / FLIGHT_DIR / f"loop-{run_id}.jsonl"
@@ -110,11 +124,7 @@ class FlightRecorder:
     def read(path: Path) -> list[dict]:
         """Every parseable record in the file, skipping a truncated tail
         (the writer may have died mid-line)."""
-        try:
-            text = Path(path).read_text(encoding="utf-8")
-        except OSError:
-            return []
-        return parse_jsonl(text.splitlines())
+        return read_jsonl(path)
 
 
 class SeedCollision(ClawkerError):

@@ -37,6 +37,9 @@ class CreateOptions:
     harness: str = ""
     worker: str = ""                  # tpu_vm worker id (label only here)
     loop_id: str = ""
+    extra_labels: dict[str, str] = field(default_factory=dict)  # caller-scoped
+    #                                 labels (loop epoch, ...) on top of the
+    #                                 standard agent label set
     replace: bool = False             # remove an existing same-name container
     mount_docker_socket: bool | None = None
     worktree_git_dir: Path | None = None
@@ -133,6 +136,7 @@ class AgentRuntime:
             worker=opts.worker,
             loop_id=opts.loop_id,
         )
+        labels.update(opts.extra_labels)
         cmd = opts.cmd or (pconf.agent.cmd if pconf else [])
         spec = ContainerSpec(
             image=image,

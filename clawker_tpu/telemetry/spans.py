@@ -7,6 +7,7 @@ Every loop iteration becomes a span tree::
       +- start                      (engine start + bootstrap)
       +- wait                       (container executing the harness)
       +- exit | orphan | migrate    (how the iteration ended / moved)
+      +- resume                     (zero-width: --resume adopted it)
 
 Spans are recorded COMPLETE (start + end timestamps known at record
 time) because the scheduler knows both ends of every phase it drives;
@@ -41,6 +42,8 @@ SPAN_WAIT = "wait"
 SPAN_EXIT = "exit"
 SPAN_ORPHAN = "orphan"
 SPAN_MIGRATE = "migrate"
+SPAN_RESUME = "resume"      # zero-width hop: --resume adopted/continued
+#                             this iteration across a scheduler death
 
 
 @dataclass(frozen=True)

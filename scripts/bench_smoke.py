@@ -16,6 +16,9 @@ Gates:
                                    (ISSUE 2 acceptance bar)
 - failover_detect_to_restart_s <= bench.FAILOVER_BUDGET_S with every
   loop reaching its budget  (ISSUE 3 acceptance bar)
+- resume_reattach_wall_n8 <= bench.RESUME_BUDGET_S with all 8 loops
+  adopted (zero duplicate creates) and reaching their budget
+                                   (ISSUE 5 acceptance bar)
 - telemetry_overhead_ns: enabled <= bench.TELEMETRY_BUDGET_NS and
   disabled <= bench.TELEMETRY_DISABLED_BUDGET_NS  (ISSUE 4 acceptance
   bar -- instrumentation must never silently regress the cold start)
@@ -40,6 +43,7 @@ def main() -> int:
     from bench import (
         FAILOVER_BUDGET_S,
         POLL_COST_BUDGET,
+        RESUME_BUDGET_S,
         TELEMETRY_BUDGET_NS,
         TELEMETRY_DISABLED_BUDGET_NS,
         bench_engine_dials,
@@ -47,6 +51,7 @@ def main() -> int:
         bench_fleet_provision,
         bench_loop_fanout,
         bench_loop_poll_cost,
+        bench_resume_reattach,
         bench_telemetry_overhead,
     )
 
@@ -54,6 +59,7 @@ def main() -> int:
     poll = bench_loop_poll_cost()
     provision = bench_fleet_provision()
     failover = bench_failover()
+    resume = bench_resume_reattach()
     dials = bench_engine_dials()
     tele = bench_telemetry_overhead()
 
@@ -79,6 +85,22 @@ def main() -> int:
         failures.append(
             f"failover_detect_to_restart_s {failover['detect_to_restart_s']}s"
             f" outside (0, {FAILOVER_BUDGET_S}]s budget")
+    if resume["adopted"] != resume["loops"]:
+        failures.append(
+            f"resume_reattach_wall_n8: only {resume['adopted']}/"
+            f"{resume['loops']} containers adopted")
+    if resume["duplicate_creates"]:
+        failures.append(
+            f"resume_reattach_wall_n8: {resume['duplicate_creates']} "
+            "duplicate container create(s) on resume")
+    if not resume["all_loops_done"]:
+        failures.append(
+            "resume_reattach_wall_n8: loops missed their budget after "
+            "the resume")
+    if resume["reattach_wall_s"] > RESUME_BUDGET_S:
+        failures.append(
+            f"resume_reattach_wall_n8 {resume['reattach_wall_s']}s > "
+            f"{RESUME_BUDGET_S}s budget")
     if dials["stale_retries"]:
         failures.append(
             f"engine_dials_per_run: {dials['stale_retries']} stale retries "
@@ -101,6 +123,7 @@ def main() -> int:
         "loop_poll_cost_n8": poll,
         "fleet_provision_wall_n8": provision,
         "failover_detect_to_restart_s": failover,
+        "resume_reattach_wall_n8": resume,
         "engine_dials_per_run": dials,
         "telemetry_overhead_ns": tele,
         "ok": not failures,

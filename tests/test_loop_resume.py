@@ -1,0 +1,651 @@
+"""Crash-resume suite: the run journal, `--resume` reconciliation, and
+container adoption across scheduler death.
+
+The torture shape (ISSUE 5 acceptance): kill the scheduler of an
+8-loop/4-worker fake pod at injected points -- post-journal/pre-create,
+post-create/pre-start, mid-wait -- restart with ``--resume``, and
+assert every loop reaches its budget with ZERO duplicate creates and
+adopted containers never restarted.  Plus the fsync-batched journal's
+truncated-tail replay (shared ledger reader), ghost sweeping, dead-
+worker failover on resume, the two-stage SIGINT drain, and the wedged-
+lane retirement at breaker close (PR-3 known limitation).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.config import load_config
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.errors import DriverError
+from clawker_tpu.health import (
+    BREAKER_CLOSED,
+    BREAKER_OPEN,
+    BreakerConfig,
+    HealthConfig,
+)
+from clawker_tpu.loop import LoopScheduler, LoopSpec
+from clawker_tpu.loop.journal import (
+    REC_ADOPTED,
+    REC_CREATED,
+    REC_EXITED,
+    REC_GHOST,
+    REC_LOOP_END,
+    REC_PLACEMENT,
+    REC_RESUME,
+    REC_RUN,
+    REC_SHUTDOWN,
+    REC_STARTED,
+    RunJournal,
+    journal_path,
+    replay,
+)
+from clawker_tpu.testenv import TestEnv
+
+IMAGE = "clawker-loopproj:default"
+
+FAST_HEALTH = HealthConfig(
+    probe_interval_s=0.05, probe_deadline_s=0.5,
+    breaker=BreakerConfig(failure_threshold=2, backoff_base_s=0.05,
+                          backoff_max_s=0.2))
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: loopproj\n")
+        cfg = load_config(proj)
+        yield tenv, proj, cfg
+
+
+def driver_with(n_workers: int, behavior=None):
+    drv = FakeDriver(n_workers=n_workers)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, behavior or exit_behavior(b"iter done\n", 0))
+    return drv
+
+
+def hold_behavior(hold: threading.Event):
+    """Container process that blocks until ``hold`` is set (so a test
+    can kill the scheduler while containers are genuinely mid-run),
+    then exits 0; once released, later iterations exit immediately."""
+
+    def run(io) -> int:
+        if not hold.is_set():
+            hold.wait(20.0)
+        return 0
+
+    return run
+
+
+def wait_for(pred, timeout=10.0, interval=0.01) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def journal_of(cfg, sched) -> list[dict]:
+    return RunJournal.read(journal_path(cfg.logs_dir, sched.loop_id))
+
+
+def resume_from(cfg, drv, sched1, **kw) -> LoopScheduler:
+    image = replay(journal_of(cfg, sched1))
+    return LoopScheduler.resume(cfg, drv, image, **kw)
+
+
+def total_creates(drv) -> int:
+    return sum(len(api.calls_named("container_create")) for api in drv.apis)
+
+
+def total_starts(drv) -> int:
+    return sum(len(api.calls_named("container_start")) for api in drv.apis)
+
+
+# ------------------------------------------------------------------ journal
+
+
+def test_journal_records_and_replay_roundtrip(env):
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=2))
+    sched.start()
+    sched.run(poll_s=0.05)
+    recs = journal_of(cfg, sched)
+    kinds = [r["kind"] for r in recs]
+    for want in (REC_RUN, REC_PLACEMENT, REC_CREATED, REC_STARTED,
+                 REC_EXITED, REC_LOOP_END):
+        assert want in kinds, f"missing {want} in {kinds}"
+    # seq totally orders the records
+    assert [r["seq"] for r in recs] == sorted(r["seq"] for r in recs)
+    head = next(r for r in recs if r["kind"] == REC_RUN)
+    assert head["project"] == "loopproj"
+    assert head["spec"]["parallel"] == 1 and head["spec"]["iterations"] == 2
+    img = replay(recs)
+    assert img.run_id == sched.loop_id
+    loop_img = img.loops[sched.loops[0].agent]
+    assert loop_img.status == "done"
+    assert loop_img.iteration == 2 and loop_img.exit_codes == [0, 0]
+    assert not img.clean_shutdown
+    sched.cleanup(remove_containers=True)
+
+
+def test_journal_truncated_tail_and_garbage_tolerated(env):
+    """A journal whose writer died mid-line must replay everything
+    before the torn record -- the shared ledger tail-reader contract."""
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=1))
+    sched.start()
+    sched.run(poll_s=0.05)
+    sched.cleanup(remove_containers=True)
+    path = journal_path(cfg.logs_dir, sched.loop_id)
+    base = replay(RunJournal.read(path))
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write('not json at all\n')
+        fh.write('{"kind":"exited","agent":"loop-x","iteration":9,"co')
+    img = replay(RunJournal.read(path))
+    assert img.run_id == base.run_id
+    assert {a: l.status for a, l in img.loops.items()} == \
+           {a: l.status for a, l in base.loops.items()}
+
+
+def test_journal_seq_continues_across_reopen(tmp_path):
+    """A resume generation reopens the dead run's journal: seq must
+    continue from the tail (and replay folds in file order), or a
+    second resume would interleave generations and double-account."""
+    p = tmp_path / "x.journal"
+    j1 = RunJournal(p)
+    j1.append("run", run="r")
+    j1.append("placement", agent="a", worker="w0", epoch=0)
+    j1.append("started", agent="a", worker="w0", iteration=4)
+    j1.close()
+    j2 = RunJournal(p)          # generation 1 picks the run up
+    j2.append("resume", generation=1)
+    j2.append("exited", agent="a", iteration=4, code=0)
+    j2.close()
+    recs = RunJournal.read(p)
+    seqs = [r["seq"] for r in recs]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    img = replay(recs)
+    assert img.loops["a"].iteration == 5
+    assert not img.loops["a"].started
+    assert img.loops["a"].exit_codes == [0]
+
+
+def test_double_resume_no_double_accounting(env):
+    """Resume-of-a-resume: generation 1 dies too (right after its
+    reconcile journaled adoptions); generation 2 must still fold the
+    journal chronologically -- every exit accounted exactly once."""
+    tenv, proj, cfg = env
+    hold = threading.Event()
+    drv = driver_with(2, behavior=hold_behavior(hold))
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=2))
+    sched1.start()
+    t = threading.Thread(target=sched1.run, kwargs={"poll_s": 0.05},
+                         daemon=True)
+    t.start()
+    assert wait_for(lambda: all(l.status == "running" for l in sched1.loops))
+    sched1.kill()
+    t.join(10.0)
+
+    sched2 = resume_from(cfg, drv, sched1)      # generation 1...
+    assert sched2.reconcile()["adopted"] == 2
+    sched2.kill()                               # ...dies before run()
+    hold.set()
+    assert wait_for(lambda: all(
+        c.state == "exited"
+        for api in drv.apis for c in api.containers.values()))
+
+    sched3 = resume_from(cfg, drv, sched1)      # generation 2
+    summary = sched3.reconcile()
+    assert summary["exits_accounted"] == 2, summary
+    loops = sched3.run(poll_s=0.05)
+    for l in loops:
+        assert l.status == "done" and l.iteration == 2
+        assert l.exit_codes == [0, 0]           # never double-accounted
+    assert total_creates(drv) == 2
+    recs = journal_of(cfg, sched3)
+    assert sum(1 for r in recs if r["kind"] == REC_RESUME) == 2
+    sched3.cleanup(remove_containers=True)
+
+
+def test_resume_does_not_bill_drain_halted_iteration(env):
+    """An iteration the drain itself halted (docker-stop kill code) must
+    be RE-RUN on resume, not accounted as a failed exit -- repeated
+    Ctrl-C/resume cycles must never burn the failure ceiling."""
+    tenv, proj, cfg = env
+    hold = threading.Event()
+
+    def beh(io) -> int:
+        while not hold.is_set():
+            if io.kill_event.wait(0.05):
+                return 137      # what a docker stop looks like
+        return 0
+
+    drv = driver_with(1, behavior=beh)
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=2))
+    sched1.start()
+    t = threading.Thread(target=sched1.run, kwargs={"poll_s": 0.05},
+                         daemon=True)
+    t.start()
+    assert wait_for(lambda: sched1.loops
+                    and sched1.loops[0].status == "running")
+    sched1.request_shutdown("sigint")
+    t.join(10.0)
+    assert sched1.loops[0].status == "stopped"
+    sched1.cleanup()
+
+    sched2 = resume_from(cfg, drv, sched1)
+    summary = sched2.reconcile()
+    assert summary["exits_accounted"] == 0, summary   # 137 never billed
+    hold.set()
+    loops = sched2.run(poll_s=0.05)
+    assert loops[0].status == "done" and loops[0].iteration == 2
+    assert loops[0].exit_codes == [0, 0]
+    assert loops[0].consecutive_failures == 0
+    sched2.cleanup(remove_containers=True)
+
+
+def test_journal_degrades_to_noop_on_unwritable_dir(env, tmp_path):
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a file where the runs dir should be")
+    j = RunJournal(blocker / "sub" / "x.journal")   # mkdir must fail
+    j.append("run", run="x")         # must not raise
+    assert j.dropped == 1
+    j.close()
+
+
+# --------------------------------------------------- crash-resume torture
+
+
+def test_resume_adopts_running_containers_mid_wait_kill(env):
+    """kill -9 mid-wait on the 8-loop/4-worker pod: --resume adopts all
+    still-running containers (no restart, no duplicate create) and
+    every loop completes its budget."""
+    tenv, proj, cfg = env
+    hold = threading.Event()
+    drv = driver_with(4, behavior=hold_behavior(hold))
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=8, iterations=2))
+    sched1.start()
+    t = threading.Thread(target=sched1.run, kwargs={"poll_s": 0.05},
+                         daemon=True)
+    t.start()
+    assert wait_for(lambda: all(l.status == "running" for l in sched1.loops))
+    creates_at_kill = total_creates(drv)
+    starts_at_kill = total_starts(drv)
+    assert creates_at_kill == 8
+    sched1.kill()
+    t.join(10.0)
+    assert not t.is_alive()
+    # the containers kept running across the scheduler death
+    running = sum(1 for api in drv.apis for c in api.containers.values()
+                  if c.state == "running")
+    assert running == 8
+
+    sched2 = resume_from(cfg, drv, sched1)
+    assert sched2.loop_id == sched1.loop_id
+    summary = sched2.reconcile()
+    assert summary["adopted"] == 8, summary
+    # adoption is pure bookkeeping: zero engine mutations
+    assert total_creates(drv) == creates_at_kill
+    assert total_starts(drv) == starts_at_kill
+    assert all(l.status == "running" for l in sched2.loops)
+
+    t2 = threading.Thread(target=sched2.run, kwargs={"poll_s": 0.05},
+                          daemon=True)
+    t2.start()
+    time.sleep(0.2)
+    hold.set()                      # adopted iterations finish now
+    t2.join(15.0)
+    assert not t2.is_alive()
+    for l in sched2.loops:
+        assert l.status == "done" and l.iteration == 2
+        assert l.exit_codes == [0, 0]       # each exit accounted ONCE
+    # exactly one extra create-less restart per loop (iteration 1)
+    assert total_creates(drv) == 8
+    recs = journal_of(cfg, sched2)
+    assert sum(1 for r in recs if r["kind"] == REC_ADOPTED) == 8
+    assert sum(1 for r in recs if r["kind"] == REC_RESUME) == 1
+    sched2.cleanup(remove_containers=True)
+
+
+def test_resume_relaunches_journaled_but_never_created(env):
+    """crash point: post-journal / pre-create.  The WAL has placements,
+    the engines have nothing -- resume re-launches every slot with
+    exactly one create per agent."""
+    tenv, proj, cfg = env
+    drv = driver_with(4)
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=8, iterations=1))
+    originals = [api.container_create for api in drv.apis]
+
+    def crash_create(name, config):
+        sched1.kill()
+        raise DriverError("injected: killed before create reached daemon")
+
+    for api in drv.apis:
+        api.container_create = crash_create
+    sched1.start()
+    assert wait_for(sched1._stop.is_set)
+    # let the lanes drain their guarded no-op tasks
+    time.sleep(0.1)
+    for api, orig in zip(drv.apis, originals):
+        api.container_create = orig
+    assert total_creates(drv) == 0
+
+    sched2 = resume_from(cfg, drv, sched1)
+    summary = sched2.reconcile()
+    assert summary["relaunched"] == 8, summary
+    loops = sched2.run(poll_s=0.05)
+    assert all(l.status == "done" and l.iteration == 1 for l in loops)
+    # one create per agent, ever
+    names = [a[0] for api in drv.apis
+             for a, _k in api.calls_named("container_create")]
+    assert len(names) == 8 and len(set(names)) == 8
+    sched2.cleanup(remove_containers=True)
+
+
+def test_resume_finishes_created_but_never_started(env):
+    """crash point: post-create / pre-start.  Containers exist in state
+    'created'; resume must start them WITHOUT a second create."""
+    tenv, proj, cfg = env
+    drv = driver_with(4)
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=8, iterations=1))
+
+    def crash_start(cid):
+        sched1.kill()
+        raise DriverError("injected: killed before start reached daemon")
+
+    for api in drv.apis:
+        api.container_start = crash_start
+    sched1.start()
+    assert wait_for(sched1._stop.is_set)
+    time.sleep(0.1)
+    for api in drv.apis:
+        del api.container_start      # restore the class method
+    creates_before = total_creates(drv)
+    assert creates_before >= 1       # at least one lane reached create
+
+    sched2 = resume_from(cfg, drv, sched1)
+    summary = sched2.reconcile()
+    # every slot is either continued (container existed, state created)
+    # or relaunched (its lane was killed before create) -- never adopted,
+    # never failed
+    assert summary["continued"] + summary["relaunched"] == 8, summary
+    assert summary["continued"] == creates_before
+    loops = sched2.run(poll_s=0.05)
+    assert all(l.status == "done" and l.iteration == 1 for l in loops)
+    # no agent was ever created twice
+    names = [a[0] for api in drv.apis
+             for a, _k in api.calls_named("container_create")]
+    assert len(names) == len(set(names)) == 8
+    sched2.cleanup(remove_containers=True)
+
+
+def test_resume_accounts_missed_exits_exactly_once(env):
+    """crash point: mid-wait, with the exits landing while the scheduler
+    is dead.  Resume accounts each journaled-started iteration exactly
+    once and drives the remaining budget."""
+    tenv, proj, cfg = env
+    hold = threading.Event()
+    drv = driver_with(4, behavior=hold_behavior(hold))
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=8, iterations=2))
+    sched1.start()
+    t = threading.Thread(target=sched1.run, kwargs={"poll_s": 0.05},
+                         daemon=True)
+    t.start()
+    assert wait_for(lambda: all(l.status == "running" for l in sched1.loops))
+    sched1.kill()
+    t.join(10.0)
+    hold.set()                      # exits happen with no scheduler alive
+    assert wait_for(lambda: all(
+        c.state == "exited"
+        for api in drv.apis for c in api.containers.values()))
+
+    sched2 = resume_from(cfg, drv, sched1)
+    summary = sched2.reconcile()
+    assert summary["exits_accounted"] == 8, summary
+    loops = sched2.run(poll_s=0.05)
+    for l in loops:
+        assert l.status == "done" and l.iteration == 2
+        assert l.exit_codes == [0, 0]
+    assert total_creates(drv) == 8          # no re-create anywhere
+    sched2.cleanup(remove_containers=True)
+
+
+def test_resume_sweeps_unjournaled_ghosts(env):
+    """A container carrying this run's loop label that no journaled
+    placement claims (lost-create-response leftover) is swept."""
+    tenv, proj, cfg = env
+    hold = threading.Event()
+    drv = driver_with(2, behavior=hold_behavior(hold))
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=1))
+    sched1.start()
+    t = threading.Thread(target=sched1.run, kwargs={"poll_s": 0.05},
+                         daemon=True)
+    t.start()
+    assert wait_for(lambda: all(l.status == "running" for l in sched1.loops))
+    sched1.kill()
+    t.join(10.0)
+    ghost_id = drv.apis[0].add_container(
+        "clawker.loopproj.intruder",
+        labels={consts.LABEL_MANAGED: consts.MANAGED_VALUE,
+                consts.LABEL_LOOP: sched1.loop_id}, state="exited")
+
+    sched2 = resume_from(cfg, drv, sched1)
+    summary = sched2.reconcile()
+    assert summary["adopted"] == 2 and summary["ghosts"] == 1, summary
+    assert ghost_id not in drv.apis[0].containers
+    assert any(r["kind"] == REC_GHOST and r["cid"] == ghost_id
+               for r in journal_of(cfg, sched2))
+    hold.set()
+    loops = sched2.run(poll_s=0.05)
+    assert all(l.status == "done" for l in loops)
+    sched2.cleanup(remove_containers=True)
+
+
+def test_resume_stale_epoch_copy_not_adopted(env):
+    """A same-name container whose loop-epoch label predates the
+    journaled placement is a superseded copy: swept + relaunched, never
+    adopted."""
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=1))
+    # fabricate: placement journaled at epoch 2, container labeled epoch 0
+    agent = f"loop-{sched1.loop_id[:6]}-0"
+    sched1.loops.append(  # only to mirror start()'s journaling shape
+        __import__("clawker_tpu.loop.scheduler", fromlist=["AgentLoop"])
+        .AgentLoop(agent=agent, worker=drv.workers()[0], epoch=2))
+    sched1._journal("run", run=sched1.loop_id, project="loopproj",
+                    spec=sched1._spec_doc(),
+                    workers=[w.id for w in drv.workers()])
+    sched1._journal("placement", agent=agent, worker="fake-0", epoch=2)
+    sched1.journal.sync()
+    stale = drv.apis[0].add_container(
+        f"clawker.loopproj.{agent}",
+        labels={consts.LABEL_MANAGED: consts.MANAGED_VALUE,
+                consts.LABEL_LOOP: sched1.loop_id,
+                consts.LABEL_LOOP_EPOCH: "0"},
+        state="running")
+    sched1.kill()
+
+    sched2 = resume_from(cfg, drv, sched1)
+    summary = sched2.reconcile()
+    assert summary["relaunched"] == 1 and summary["ghosts"] == 1, summary
+    assert stale not in drv.apis[0].containers
+    loops = sched2.run(poll_s=0.05)
+    assert loops[0].status == "done" and loops[0].iteration == 1
+    sched2.cleanup(remove_containers=True)
+
+
+def test_resume_dead_worker_flows_into_failover(env):
+    """Loops journaled onto a worker the current fleet no longer has
+    (it died with the CLI) flow through the breaker/failover machinery:
+    migrate re-places them and they complete."""
+    records = [
+        {"kind": "run", "seq": 1, "run": "deadbeefcafe",
+         "project": "loopproj",
+         "spec": {"parallel": 1, "iterations": 2, "failover": "migrate",
+                  "image": "@", "agent_prefix": "loop"},
+         "workers": ["gone-0"]},
+        {"kind": "placement", "seq": 2, "agent": "loop-deadbe-0",
+         "worker": "gone-0", "epoch": 0},
+    ]
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    image = replay(records)
+    sched = LoopScheduler.resume(cfg, drv, image,
+                                 health_config=FAST_HEALTH)
+    sched.orphan_grace_s = 10.0
+    summary = sched.reconcile()
+    assert summary == {"adopted": 0, "continued": 0, "relaunched": 0,
+                       "exits_accounted": 0, "ghosts": 0, "orphaned": 0}
+    loops = sched.run(poll_s=0.05)
+    assert loops[0].status == "done" and loops[0].iteration == 2
+    assert loops[0].worker.id == "fake-0"
+    assert loops[0].migrations >= 1
+    sched.cleanup(remove_containers=True)
+
+
+def test_resume_after_clean_drain_continues_budget(env):
+    """request_shutdown (the CLI's first Ctrl-C) journals a durable
+    shutdown record; --resume picks the stopped loops back up and
+    drives them to their original budget."""
+    tenv, proj, cfg = env
+    drv = driver_with(2, behavior=exit_behavior(b"", 0, delay=0.05))
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=3))
+    sched1.start()
+    t = threading.Thread(target=sched1.run, kwargs={"poll_s": 0.05},
+                         daemon=True)
+    t.start()
+    assert wait_for(lambda: all(l.iteration >= 1 for l in sched1.loops))
+    sched1.request_shutdown("sigint")
+    t.join(10.0)
+    assert not t.is_alive()
+    assert all(l.status in ("stopped", "done") for l in sched1.loops)
+    sched1.cleanup()                 # keep containers; close the journal
+    image = replay(journal_of(cfg, sched1))
+    assert image.clean_shutdown
+
+    sched2 = LoopScheduler.resume(cfg, drv, image)
+    sched2.reconcile()
+    loops = sched2.run(poll_s=0.05)
+    for l in loops:
+        assert l.status == "done"
+        assert l.iteration == 3 and len(l.exit_codes) == 3
+    sched2.cleanup(remove_containers=True)
+
+
+# ------------------------------------------------- satellites: lane + CLI
+
+
+def test_lane_retired_at_breaker_close(env):
+    """PR-3 known limitation (ROADMAP): a lane wedged inside a dedicated
+    read-unbounded call must be RETIRED at breaker close, so launches
+    resumed under --failover wait run on a fresh thread instead of
+    queueing behind the stuck call."""
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=1))
+    worker = drv.workers()[0]
+    blocked, release = threading.Event(), threading.Event()
+
+    def wedge():
+        blocked.set()
+        release.wait(10.0)
+
+    old_lane = sched._lane(worker)
+    old_lane.submit(wedge)
+    assert blocked.wait(2.0)
+    sched._verdicts.put((worker.id, BREAKER_OPEN, BREAKER_CLOSED,
+                         "recovered"))
+    sched._drain_verdicts()
+    assert sched._lanes.get(worker.id) is not old_lane
+    ran = threading.Event()
+    sched._lane(worker).submit(ran.set)
+    # the resumed task executes while the old call is still stuck
+    assert ran.wait(2.0)
+    assert not release.is_set()
+    release.set()
+    sched.cleanup()
+
+
+def test_two_stage_sigint_drains_then_hard_exits(env, monkeypatch):
+    from clawker_tpu.cli import cmd_loop
+
+    exits = []
+    monkeypatch.setattr(cmd_loop, "_hard_exit", exits.append)
+
+    class SchedStub:
+        loop_id = "abc123def"
+
+        def __init__(self):
+            self.requests = []
+
+        def request_shutdown(self, reason):
+            self.requests.append(reason)
+
+    stub = SchedStub()
+    handler = cmd_loop._TwoStageInterrupt(stub)
+    handler()
+    assert stub.requests == ["sigint"] and not exits
+    handler()
+    assert exits == [130]
+    assert stub.requests == ["sigint"]   # the drain fired exactly once
+
+
+def test_cli_loop_resume_end_to_end(env):
+    """`clawker loop --resume <prefix>` adopts a killed run's containers
+    and exits 0 with every loop done."""
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    hold = threading.Event()
+    drv = driver_with(2, behavior=hold_behavior(hold))
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=1))
+    sched1.start()
+    t = threading.Thread(target=sched1.run, kwargs={"poll_s": 0.05},
+                         daemon=True)
+    t.start()
+    assert wait_for(lambda: all(l.status == "running" for l in sched1.loops))
+    sched1.kill()
+    t.join(10.0)
+    hold.set()
+
+    res = CliRunner().invoke(
+        cli, ["loop", "--resume", sched1.loop_id[:6], "--json"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    out = json.loads(res.stdout)
+    assert out["loop_id"] == sched1.loop_id
+    assert all(a["status"] == "done" for a in out["agents"])
+    assert total_creates(drv) == 2       # resume never re-created
+
+
+def test_cli_loop_resume_unknown_run_errors(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    res = CliRunner().invoke(
+        cli, ["loop", "--resume", "nosuchrun"],
+        obj=Factory(cwd=proj, driver=drv))
+    assert res.exit_code != 0
+    assert "no run journal" in res.output
