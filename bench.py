@@ -73,12 +73,18 @@ import time
 from pathlib import Path
 
 
-def bench_cold_start(iters: int = 40) -> tuple[float, dict[str, float]]:
-    """-> (p50 seconds, mean per-stage milliseconds).
+def bench_cold_start(iters: int = 40) -> tuple[float, dict[str, float], dict]:
+    """-> (p50 seconds, mean per-stage milliseconds, identity split).
 
     Stages come from the in-tree phase stopwatch (util/phases) wired
     through factory config load and the orchestrator's create/start
     path, so the breakdown attributes the SAME run the headline times.
+
+    The identity split reports the CA session cache's effect on the
+    ``identity_bootstrap`` stage (BENCH_r05: 7.0ms, 78% of framework
+    cold start): each agent name runs once COLD (leaf minted) and once
+    WARM (leaf reused from the session cache -- the loop-restart /
+    migration / resume shape), with the per-create stage cost for both.
     """
     from click.testing import CliRunner
 
@@ -89,31 +95,62 @@ def bench_cold_start(iters: int = 40) -> tuple[float, dict[str, float]]:
     from clawker_tpu.util import phases
 
     samples: list[float] = []
+    identity: dict = {}
     with TestEnv() as tenv:
         proj = tenv.base / "proj"
         tenv.make_project(proj, "project: bench\n")
         runner = CliRunner()
-        phases.enable()
-        for i in range(iters):
+
+        def one_run(i: int, agent: str) -> float:
             driver = FakeDriver()
             driver.api.add_image("clawker-bench:default")
             factory = Factory(cwd=proj, driver=driver)
             t0 = time.perf_counter()
             res = runner.invoke(
                 cli,
-                ["run", "--detach", "--agent", f"a{i}", "--workspace", "snapshot"],
+                ["run", "--detach", "--agent", agent, "--workspace", "snapshot"],
                 obj=factory,
                 catch_exceptions=False,
             )
             dt = time.perf_counter() - t0
             assert res.exit_code == 0, res.output
-            samples.append(dt)
+            return dt
+
+        phases.enable()
+        for i in range(iters):
+            samples.append(one_run(i, f"a{i}"))
         stage_totals = phases.disable()
+        stage_counts = phases.counts()
+
+        # warm-placement leg: the SAME agent names re-created -- their
+        # leaves are session-cached, so identity_bootstrap pays only the
+        # assertion JWT + install (docs/loop-placement.md satellite)
+        phases.enable()
+        for i in range(iters):
+            one_run(i, f"a{i}")
+        warm_totals = phases.disable()
+        warm_counts = phases.counts()
+        identity = {
+            "cold_identity_bootstrap_ms": round(
+                stage_totals.get("identity_bootstrap", 0.0) * 1000 / iters, 3),
+            "warm_identity_bootstrap_ms": round(
+                warm_totals.get("identity_bootstrap", 0.0) * 1000 / iters, 3),
+            "cold_mint_leaf_ms": round(
+                stage_totals.get("identity_mint_leaf", 0.0) * 1000 / iters, 3),
+            "warm_mint_leaf_ms": round(
+                warm_totals.get("identity_mint_leaf", 0.0) * 1000 / iters, 3),
+            "cold_leaf_cache_hits": stage_counts.get(
+                "identity.leaf_cache_hit", 0),
+            "warm_leaf_cache_hits": warm_counts.get(
+                "identity.leaf_cache_hit", 0),
+            "warm_leaf_cache_misses": warm_counts.get(
+                "identity.leaf_cache_miss", 0),
+        }
     stages = {name: round(total * 1000.0 / iters, 3)
               for name, total in sorted(stage_totals.items())}
     stages["other"] = round(
         statistics.mean(samples) * 1000 - sum(stages.values()), 3)
-    return statistics.median(samples), stages
+    return statistics.median(samples), stages, identity
 
 
 def bench_parity() -> tuple[float, int, int]:
@@ -245,6 +282,151 @@ def bench_loop_fanout(n: int = 8, iters: int = 3) -> float:
             sched.run(poll_s=0.02)
             sched.cleanup(remove_containers=True)
     return statistics.median(samples)
+
+
+def bench_loop_fanout_n64(n_loops: int = 64, n_workers: int = 4,
+                          iters: int = 2, cap: int = 4) -> dict:
+    """loop_fanout_p50_n64: p50 seconds from scheduler.start() until the
+    64th loop container is created on the 4-worker fake pod, ADMISSION
+    ENABLED (ISSUE 6 acceptance).  The burst drains through per-worker
+    token buckets instead of flooding the lanes; the sample also
+    verifies no bucket ever exceeded its cap and every loop reached its
+    budget."""
+    import threading
+
+    from clawker_tpu import consts
+    from clawker_tpu.config import load_config
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.engine.fake import exit_behavior
+    from clawker_tpu.loop import LoopScheduler, LoopSpec
+    from clawker_tpu.testenv import TestEnv
+
+    samples = []
+    hwm_ok = True
+    all_done = True
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: benchloop\n")
+        cfg = load_config(proj)
+        for trial in range(iters + 1):      # one warmup eats lazy imports
+            drv = FakeDriver(n_workers=n_workers)
+            for api in drv.apis:
+                api.add_image("clawker-benchloop:default")
+                api.set_behavior("clawker-benchloop:default",
+                                 exit_behavior(b"done\n", 0))
+            all_created = threading.Event()
+            t_created = [0.0]
+            remaining = [n_loops]
+
+            def on_event(agent, event, detail=""):
+                if event == "created":
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        t_created[0] = time.perf_counter()
+                        all_created.set()
+
+            sched = LoopScheduler(
+                cfg, drv,
+                LoopSpec(parallel=n_loops, iterations=1,
+                         max_inflight_per_worker=cap),
+                on_event=on_event)
+            t0 = time.perf_counter()
+            sched.start()
+            runner = threading.Thread(target=sched.run,
+                                      kwargs={"poll_s": 0.05}, daemon=True)
+            runner.start()
+            all_created.wait(60.0)
+            if trial > 0:
+                samples.append((t_created[0] or time.perf_counter()) - t0)
+            runner.join(60.0)
+            stats = sched.admission.stats()
+            if trial > 0:
+                hwm_ok = hwm_ok and all(
+                    w["inflight_hwm"] <= cap
+                    for w in stats["workers"].values())
+                all_done = all_done and all(
+                    l.status == "done" for l in sched.loops)
+            sched.cleanup(remove_containers=True)
+    return {
+        "fanout_p50_s": round(statistics.median(samples), 3),
+        "loops": n_loops,
+        "workers": n_workers,
+        "cap": cap,
+        "cap_respected": hwm_ok,
+        "all_loops_done": all_done,
+    }
+
+
+def bench_placement_admission_stampede(n_loops: int = 64,
+                                       create_delay: float = 0.03) -> dict:
+    """placement_admission_stampede: a 64-loop burst PACKED onto one
+    slow worker (every create pays ``create_delay``) must drain at the
+    daemon's sustainable rate -- admission bucket never exceeded, the
+    worker's breaker never opens, every loop completes (ISSUE 6
+    acceptance: a burst cannot stampede a daemon into quarantine)."""
+    from clawker_tpu import consts
+    from clawker_tpu.config import load_config
+    from clawker_tpu.engine.api import Engine
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.engine.drivers.fakedriver import _FaultGate
+    from clawker_tpu.engine.fake import FakeDockerAPI, exit_behavior
+    from clawker_tpu.health import BREAKER_OPEN, BreakerConfig, HealthConfig
+    from clawker_tpu.loop import LoopScheduler, LoopSpec
+    from clawker_tpu.testenv import TestEnv
+
+    class SlowCreate(FakeDockerAPI):
+        def container_create(self, name, config):
+            time.sleep(create_delay)
+            return super().container_create(name, config)
+
+    cap = 4
+    breaker_opened = [False]
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: benchloop\n")
+        cfg = load_config(proj)
+        drv = FakeDriver(n_workers=1)
+        api = SlowCreate()
+        drv.apis[0] = api
+        drv.gates[0] = _FaultGate(api)
+        drv._workers[0].engine = Engine(drv.gates[0])
+        api.add_image("clawker-benchloop:default")
+        api.set_behavior("clawker-benchloop:default",
+                         exit_behavior(b"done\n", 0))
+
+        def on_event(agent, event, detail=""):
+            if event == "worker.health" and "open" in detail.split(":")[0]:
+                breaker_opened[0] = True
+
+        sched = LoopScheduler(
+            cfg, drv,
+            LoopSpec(parallel=n_loops, iterations=1, placement="pack",
+                     max_inflight_per_worker=cap),
+            on_event=on_event,
+            health_config=HealthConfig(
+                probe_interval_s=0.05, probe_deadline_s=1.0,
+                breaker=BreakerConfig(failure_threshold=3,
+                                      backoff_base_s=0.05)))
+        t0 = time.perf_counter()
+        sched.start()
+        loops = sched.run(poll_s=0.05)
+        wall = time.perf_counter() - t0
+        stats = sched.admission.stats()
+        state = sched.health.state(drv.workers()[0].id)
+        breaker_opened[0] = breaker_opened[0] or state == BREAKER_OPEN
+        sched.cleanup(remove_containers=True)
+    wstats = stats["workers"].get("fake-0", {})
+    return {
+        "wall_s": round(wall, 3),
+        "loops": n_loops,
+        "cap": cap,
+        "all_loops_done": all(l.status == "done" for l in loops),
+        "cap_respected": wstats.get("inflight_hwm", 0) <= cap,
+        "dispatched": wstats.get("dispatched", 0),
+        "breaker_opened": breaker_opened[0],
+    }
 
 
 def bench_loop_poll_cost(n: int = 8, iterations: int = 2) -> dict:
@@ -737,6 +919,10 @@ def previous_round_p50() -> float:
 
 
 POLL_COST_BUDGET = 12.0       # control-plane calls per agent iteration
+FANOUT64_BUDGET_S = 10.0      # submit -> 64th created on the 4-worker fake
+#                               pod with admission enabled (ISSUE 6)
+STAMPEDE_BUDGET_S = 20.0      # 64-loop burst against one slow worker must
+#                               drain to budget without tripping its breaker
 FAILOVER_BUDGET_S = 5.0       # worker death -> first migrated iteration
 RESUME_BUDGET_S = 5.0         # --resume invocation -> all loops live again
 #                               (adoption path; must undercut the 10 s
@@ -751,11 +937,13 @@ TELEMETRY_DISABLED_BUDGET_NS = 4_000   # disabled = one attr check; it
 
 
 def main() -> None:
-    p50_s, stages = bench_cold_start()
+    p50_s, stages, identity_split = bench_cold_start()
     parity_wall, parity_passed, parity_total = bench_parity()
     decisions = bench_policy_oracle()
     qps = bench_dnsgate_qps()
     fanout_s = bench_loop_fanout()
+    fanout64 = bench_loop_fanout_n64()
+    stampede = bench_placement_admission_stampede()
     poll_cost = bench_loop_poll_cost()
     provision = bench_fleet_provision()
     failover = bench_failover()
@@ -778,6 +966,24 @@ def main() -> None:
          "vs_baseline": round(qps / 1_000, 1)},
         {"metric": "loop_fanout_p50_n8", "value": round(fanout_s * 1000, 1),
          "unit": "ms", "vs_baseline": round(10.0 / max(fanout_s, 1e-9), 1)},
+        {"metric": "loop_fanout_p50_n64",
+         "value": round(fanout64["fanout_p50_s"] * 1000, 1), "unit": "ms",
+         # a run that blew an admission cap or missed its budget must
+         # read FAILED, never as merely fast
+         "vs_baseline": (round(
+             FANOUT64_BUDGET_S / max(fanout64["fanout_p50_s"], 1e-9), 1)
+             if fanout64["cap_respected"] and fanout64["all_loops_done"]
+             else 0.0),
+         "detail": fanout64},
+        {"metric": "placement_admission_stampede",
+         "value": stampede["wall_s"], "unit": "s",
+         # the gate IS the invariant set: burst drained, cap held, and
+         # the slow-but-healthy worker was never quarantined
+         "vs_baseline": (round(
+             STAMPEDE_BUDGET_S / max(stampede["wall_s"], 1e-9), 1)
+             if stampede["all_loops_done"] and stampede["cap_respected"]
+             and not stampede["breaker_opened"] else 0.0),
+         "detail": stampede},
         {"metric": "loop_poll_cost_n8",
          "value": poll_cost["calls_per_iteration"], "unit": "calls/iter",
          "vs_baseline": round(
@@ -839,6 +1045,10 @@ def main() -> None:
         "unit": "ms",
         "vs_baseline": round(budget_s / p50_s, 1),
         "stages_ms": stages,
+        # CA session cache effect on the identity_bootstrap stage: the
+        # warm leg re-creates the same agents, so leaves come from the
+        # cache (the loop-restart/migration/resume placement shape)
+        "identity_split": identity_split,
         "prev_round_ms": prev_ms,
         "extra": extra,
     }

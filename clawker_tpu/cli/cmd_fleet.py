@@ -196,6 +196,163 @@ def fleet_health(f: Factory, probes, watch, interval, fmt):
         raise SystemExit(1)
 
 
+_PLACEMENT_COLUMNS = ("WORKER", "STATE", "COORD", "GROUP", "P50MS",
+                      "WEIGHT", "SLOTS", "TOKENS", "REJECTS")
+
+
+@fleet_group.command("placement")
+@click.option("--policy", type=click.Choice(["spread", "pack", "topology"]),
+              default=None,
+              help="Policy to preview (default: settings "
+                   "loop.placement.policy).")
+@click.option("--slots", type=int, default=0,
+              help="Loop slots to plan in the preview (default: settings "
+                   "loop.parallel).")
+@click.option("--probes", type=int, default=1,
+              help="Probe rounds before planning (latency weights and "
+                   "breaker states come from these).")
+@click.option("--metrics-url", default="",
+              help="Scrape a running loop's --metrics-port endpoint "
+                   "(e.g. http://127.0.0.1:9464/metrics) for live queue "
+                   "depth, in-flight tokens, and rejection counts.")
+@click.option("--format", "fmt", type=click.Choice(["table", "json"]),
+              default="table")
+@pass_factory
+def fleet_placement(f: Factory, policy, slots, probes, metrics_url, fmt):
+    """Placement & admission view: per-worker tokens, shares, queue depth.
+
+    Probes every worker of the active runtime driver (the same breakers
+    `clawker loop` places against), derives the pod topology, and shows
+    how the chosen policy would spread N loop slots -- plus the
+    admission token/queue configuration and per-tenant fairness shares
+    (docs/loop-placement.md).  With ``--metrics-url`` pointing at a live
+    run's metrics port, the static view is joined by the run's actual
+    queue depths and in-flight token counts.
+    """
+    import json as _json
+    from collections import Counter
+
+    from ..fleet.inventory import pod_topology
+    from ..health import BreakerConfig, HealthConfig, HealthMonitor
+    from ..placement import PlacementContext, get_policy
+
+    settings = f.config.settings
+    pdef = settings.loop.placement
+    policy_name = policy or pdef.policy
+    n_slots = slots or settings.loop.parallel
+    # same clamp as fleet health: the breaker must be able to open
+    # within the probe rounds requested, or --probes 1 would preview a
+    # dead fleet as healthy (and plan slots onto it)
+    threshold = max(1, min(BreakerConfig.failure_threshold, probes))
+    mon = HealthMonitor(f.driver, config=HealthConfig(
+        breaker=BreakerConfig(failure_threshold=threshold)))
+    for _ in range(max(1, probes)):
+        mon.probe_all()
+    workers = mon.workers
+    topo = pod_topology(settings.runtime.tpu, len(workers))
+    ctx = PlacementContext(
+        workers=workers, breaker_state=mon.state,
+        latency_s=mon.latency_p50_s, topology=topo)
+    eng = get_policy(policy_name)
+    try:
+        plan = Counter(w.id for w in eng.plan(ctx, n_slots))
+    except Exception as e:      # noqa: BLE001 -- preview must still render
+        plan = Counter()
+        click.echo(f"plan: {e}", err=True)
+    live = _scrape_placement_metrics(metrics_url) if metrics_url else {}
+    rows = []
+    for w in workers:
+        coord = topo.coords.get(w.index) if topo.known else None
+        rows.append({
+            "worker": w.id,
+            "state": mon.state(w.id),
+            "coord": f"{coord[0]},{coord[1]}" if coord else "-",
+            "group": topo.group_of(w.index) if topo.known else "-",
+            "probe_p50_ms": round(mon.latency_p50_s(w.id) * 1000, 2),
+            "weight": round(ctx.weight(w.id), 2),
+            "planned_slots": plan.get(w.id, 0),
+            "tokens": (f"{live['inflight'].get(w.id, 0)}"
+                       f"/{pdef.max_inflight_per_worker}" if live
+                       else f"-/{pdef.max_inflight_per_worker}"),
+            "rejections": live.get("rejections", {}).get(w.id, 0)
+            if live else 0,
+        })
+    doc = {
+        "policy": policy_name,
+        "slots": n_slots,
+        "topology": ({"rows": topo.rows, "cols": topo.cols}
+                     if topo.known else None),
+        "admission": {
+            "max_inflight_per_worker": pdef.max_inflight_per_worker,
+            "max_pending_per_worker": pdef.max_pending_per_worker,
+        },
+        "tenants": ({t: {"queue_depth": d}
+                     for t, d in live.get("queue_depth", {}).items()}
+                    if live else
+                    {pdef.tenant: {"weight": pdef.tenant_weight,
+                                   "max_inflight": pdef.tenant_max_inflight}}),
+        "workers": rows,
+    }
+    unhealthy = any(r["state"] != "closed" for r in rows)
+    if fmt == "json":
+        click.echo(_json.dumps(doc, indent=2))
+        if unhealthy:       # same exit contract as the table (and fleet
+            raise SystemExit(1)                         # health): both
+        return              # formats must gate CI identically
+    click.echo(f"policy={policy_name} slots={n_slots} "
+               f"topology={'%dx%d' % (topo.rows, topo.cols) if topo.known else 'unknown (spread fallback)'} "
+               f"admission={pdef.max_inflight_per_worker} in-flight / "
+               f"{pdef.max_pending_per_worker} pending per worker")
+    lines = ["\t".join(_PLACEMENT_COLUMNS)]
+    for r in rows:
+        lines.append("\t".join(str(x) for x in (
+            r["worker"], r["state"], r["coord"], r["group"],
+            r["probe_p50_ms"], r["weight"], r["planned_slots"],
+            r["tokens"], r["rejections"])))
+    for line in lines:
+        click.echo(line)
+    for t, info in doc["tenants"].items():
+        pairs = " ".join(f"{k}={v}" for k, v in info.items())
+        click.echo(f"tenant {t}: {pairs}")
+    if unhealthy:
+        raise SystemExit(1)
+
+
+def _scrape_placement_metrics(url: str) -> dict:
+    """Pull placement_* gauges/counters off a live run's Prometheus
+    endpoint; {} when unreachable (the static view still renders)."""
+    from urllib import request as urlrequest
+
+    try:
+        with urlrequest.urlopen(url, timeout=3.0) as r:
+            text = r.read().decode()
+    except Exception as e:      # noqa: BLE001
+        click.echo(f"metrics scrape failed: {e}", err=True)
+        return {}
+    out: dict = {"inflight": {}, "queue_depth": {}, "rejections": {}}
+    wanted = {
+        "placement_inflight_launches": ("inflight", "worker"),
+        "placement_queue_depth": ("queue_depth", "tenant"),
+        "admission_rejections_total": ("rejections", "worker"),
+    }
+    for line in text.splitlines():
+        if line.startswith("#") or "{" not in line:
+            continue
+        name, _, rest = line.partition("{")
+        key = wanted.get(name)
+        if key is None:
+            continue
+        labels_raw, _, value = rest.partition("}")
+        labels = dict(
+            p.split("=", 1) for p in labels_raw.split(",") if "=" in p)
+        label = labels.get(key[1], "").strip('"')
+        try:
+            out[key[0]][label] = int(float(value.strip()))
+        except ValueError:
+            continue
+    return out
+
+
 @fleet_group.command("status")
 @click.option("--format", "fmt", type=click.Choice(["table", "json"]), default="table")
 @pass_factory

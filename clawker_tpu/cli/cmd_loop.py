@@ -58,9 +58,24 @@ class _TwoStageInterrupt:
 @click.option("--iterations", "-n", type=int, default=-1,
               help="Iterations per agent (0 = until interrupted; "
                    "default: settings loop.max_iterations).")
-@click.option("--placement", type=click.Choice(["spread", "pack"]), default=None,
-              help="spread = round-robin over pod workers (default); "
-                   "pack = all on worker 0.")
+@click.option("--placement",
+              type=click.Choice(["spread", "pack", "topology"]), default=None,
+              help="spread = latency-weighted round-robin over pod workers "
+                   "(default); pack = all on the first healthy worker; "
+                   "topology = prefer pod-local ICI groups (falls back to "
+                   "spread when the pod topology is unknown).")
+@click.option("--tenant", default=None,
+              help="Fairness class this run bills launches under "
+                   "(default: settings loop.placement.tenant).  Runs "
+                   "sharing a pod split each worker's admission tokens "
+                   "by tenant weight instead of first-burst-wins.")
+@click.option("--tenant-weight", type=float, default=None,
+              help="Weighted-fair-queue share vs co-tenants "
+                   "(default: settings loop.placement.tenant_weight).")
+@click.option("--max-inflight-per-worker", type=int, default=None,
+              help="Admission token bucket: concurrent in-flight "
+                   "create/start launches allowed per worker (default: "
+                   "settings loop.placement.max_inflight_per_worker).")
 @click.option("--image", default="@", help="Agent image ('@' = project default).")
 @click.option("--prompt", default="", help="Prompt handed to each harness loop.")
 @click.option("--worktrees/--no-worktrees", default=False,
@@ -93,19 +108,23 @@ class _TwoStageInterrupt:
 @pass_factory
 @click.pass_context
 def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
-               placement, image, prompt, worktrees, env_kv, failover,
+               placement, tenant, tenant_weight, max_inflight_per_worker,
+               image, prompt, worktrees, env_kv, failover,
                orphan_grace, resume_run, metrics_port, as_json, keep):
     """Fan autonomous agent loops across the runtime's workers."""
     if ctx.invoked_subcommand is not None:
         return
     _run_loops(f, parallel, iterations, placement, image, prompt, worktrees,
                env_kv, failover, orphan_grace, metrics_port, as_json, keep,
-               resume_run=resume_run)
+               resume_run=resume_run, tenant=tenant,
+               tenant_weight=tenant_weight,
+               max_inflight_per_worker=max_inflight_per_worker)
 
 
 def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
                worktrees, env_kv, failover, orphan_grace, metrics_port,
-               as_json, keep, resume_run=None):
+               as_json, keep, resume_run=None, tenant=None,
+               tenant_weight=None, max_inflight_per_worker=None):
     from .. import telemetry
 
     env = {}
@@ -132,7 +151,9 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
         click.echo(line, err=True)
 
     if resume_run:
-        if parallel or placement or prompt or env_kv or image != "@":
+        if (parallel or placement or prompt or env_kv or image != "@"
+                or tenant or tenant_weight is not None
+                or max_inflight_per_worker):
             click.echo("note: --resume takes the run shape from the "
                        "journal; shape flags are ignored", err=True)
         from ..loop.journal import RunJournal, replay
@@ -151,11 +172,16 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             telemetry=tele.flight_recorder)
         spec = sched.spec
     else:
+        pdef = defaults.placement
         spec = LoopSpec(
             parallel=parallel or defaults.parallel,
             iterations=(iterations if iterations >= 0
                         else defaults.max_iterations),
-            placement=placement or defaults.placement,
+            placement=placement or pdef.policy,
+            tenant=tenant or pdef.tenant,
+            tenant_weight=(tenant_weight if tenant_weight is not None
+                           else pdef.tenant_weight),
+            max_inflight_per_worker=max_inflight_per_worker or 0,
             image=image,
             prompt=prompt,
             worktrees=worktrees,
@@ -212,6 +238,7 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
         f"loop {sched.loop_id}: {spec.parallel} agent(s), "
         f"{spec.iterations or 'unbounded'} iteration(s), {spec.placement} "
         f"placement, {spec.failover} failover"
+        + (f", tenant {spec.tenant}" if spec.tenant != "default" else "")
         + (" (resumed)" if resume_run else ""),
         err=True,
     )

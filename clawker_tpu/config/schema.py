@@ -47,6 +47,11 @@ def from_dict(cls, data: Any):
 def _coerce(ft, raw):
     origin = get_origin(ft)
     if is_dataclass(ft):
+        # a schema class may accept legacy scalar forms (e.g.
+        # ``loop.placement: spread`` predating the placement block)
+        conv = getattr(ft, "from_raw", None)
+        if conv is not None:
+            return conv(raw)
         return from_dict(ft, raw)
     if origin is list:
         (elem,) = get_args(ft)
@@ -353,6 +358,9 @@ class TPUSettings:
     ssh_key: str = ""               # path to private key; empty = agent/default
     workers: list[str] = field(default_factory=list)  # explicit host list override
     accelerator: str = "v5litepod-8"
+    topology: str = ""              # worker grid "RxC" (e.g. "2x4") for the
+    #                                 topology placement policy; "" = infer a
+    #                                 near-square grid from the worker count
 
 
 @dataclass
@@ -371,13 +379,45 @@ class LoopJournalSettings:
 
 
 @dataclass
+class LoopPlacementSettings:
+    """Pod-scale placement & admission defaults (docs/loop-placement.md).
+
+    ``max_inflight_per_worker`` is the per-worker admission token
+    bucket: how many create/start launches may be outstanding against
+    one daemon at once -- a 64-loop burst drains at each worker's
+    sustainable rate instead of flooding its lane.  ``max_pending_per_
+    worker`` bounds the admission queue (beyond it, submissions are
+    REJECTED and counted, and the scheduler re-places or retries).
+    Tenant weight/caps drive the weighted fair queue that keeps two
+    runs sharing a pod from starving each other.
+
+    Back-compat: ``loop.placement`` used to be a bare policy string
+    (``placement: spread``); that form still parses as
+    ``{policy: spread}`` (see ``from_raw``)."""
+
+    policy: str = "spread"          # spread | pack | topology
+    max_inflight_per_worker: int = 4
+    max_pending_per_worker: int = 256
+    tenant: str = "default"         # tenant id new runs bill under
+    tenant_weight: float = 1.0      # weighted-fair-queue share
+    tenant_max_inflight: int = 0    # per-tenant in-flight launch cap; 0 = none
+
+    @classmethod
+    def from_raw(cls, raw) -> "LoopPlacementSettings":
+        if isinstance(raw, str):
+            return cls(policy=raw)
+        return from_dict(cls, raw)
+
+
+@dataclass
 class LoopSettings:
     """Autonomous-loop scheduler defaults (net-new)."""
 
     parallel: int = 1
     max_iterations: int = 0         # 0 = unbounded
     idle_exit_s: float = 300.0
-    placement: str = "spread"       # spread | pack
+    placement: LoopPlacementSettings = field(
+        default_factory=LoopPlacementSettings)
     failover: str = "migrate"       # migrate | wait | fail (worker death)
     journal: LoopJournalSettings = field(default_factory=LoopJournalSettings)
 

@@ -17,6 +17,7 @@ import io
 import json
 import secrets
 import tarfile
+import threading
 import time
 from dataclasses import dataclass
 
@@ -30,8 +31,62 @@ from cryptography.hazmat.primitives.asymmetric.utils import (
 from .. import consts
 from ..errors import ClawkerError
 from ..firewall import pki
+from ..util import phases
 
 ASSERTION_TTL_S = 24 * 3600
+
+# --- CA session cache: per-agent leaf certs keyed by (CA cert, agent
+# full name).  Leaf minting (EC keygen + cert sign) dominated the
+# identity_bootstrap cold-start stage (BENCH_r05: 7.0ms of an 8.95ms
+# framework cold start); the leaf's CN/SAN is project.agent -- no
+# container id -- so a warm placement (loop restart, migration,
+# re-create, resume) can reuse it while the assertion JWT and session
+# key stay per-container.  Keying by the CA cert PEM makes rotation
+# self-invalidating: rotate_ca yields a new PEM, so every cached leaf
+# of the retired root simply stops being found.
+_LEAF_CACHE: dict[tuple[bytes, str], "pki.CertPair"] = {}
+_LEAF_CACHE_MAX = 1024          # ~1KB/entry; a 64-agent pod uses 64
+_leaf_lock = threading.Lock()
+
+
+def _leaf_for(ca: pki.CA, fname: str, *, reuse: bool = True) -> pki.CertPair:
+    if not reuse:
+        return pki.generate_agent_cert(ca, fname)
+    key = (ca.cert_pem, fname)
+    with _leaf_lock:
+        leaf = _LEAF_CACHE.get(key)
+    phases.incr("identity.leaf_cache_hit" if leaf is not None
+                else "identity.leaf_cache_miss")
+    if leaf is None:
+        leaf = pki.generate_agent_cert(ca, fname)
+        with _leaf_lock:
+            if len(_LEAF_CACHE) >= _LEAF_CACHE_MAX:
+                _LEAF_CACHE.clear()
+            _LEAF_CACHE[key] = leaf
+    return leaf
+
+
+def prewarm_identities(ca: pki.CA, project: str, agents) -> int:
+    """Pre-mint leaf certs into the session cache for the given agent
+    names (fleet fan-outs call this once up front so every placement's
+    identity_bootstrap is a cache hit).  Returns how many were minted
+    (already-warm agents cost nothing)."""
+    minted = 0
+    for agent in agents:
+        fname = full_name(project, agent)
+        key = (ca.cert_pem, fname)
+        with _leaf_lock:
+            warm = key in _LEAF_CACHE
+        if not warm:
+            _leaf_for(ca, fname)
+            minted += 1
+    return minted
+
+
+def clear_identity_cache() -> None:
+    """Drop every cached leaf (tests; explicit revocation sweeps)."""
+    with _leaf_lock:
+        _LEAF_CACHE.clear()
 
 
 class IdentityError(ClawkerError):
@@ -131,11 +186,18 @@ def full_name(project: str, agent: str) -> str:
 
 
 def mint_bootstrap_material(
-    ca: pki.CA, project: str, agent: str, *, container_id: str = ""
+    ca: pki.CA, project: str, agent: str, *, container_id: str = "",
+    reuse_leaf: bool = True
 ) -> BootstrapMaterial:
-    """Mint the per-agent identity bundle (leaf + assertion + session key)."""
+    """Mint the per-agent identity bundle (leaf + assertion + session key).
+
+    The mTLS leaf rides the CA session cache (warm placements reuse it;
+    ``reuse_leaf=False`` forces a fresh keypair); the assertion JWT and
+    session key are ALWAYS fresh -- they bind the container id and the
+    per-container audit secret."""
     fname = full_name(project, agent)
-    leaf = pki.generate_agent_cert(ca, fname)
+    with phases.phase("identity_mint_leaf"):
+        leaf = _leaf_for(ca, fname, reuse=reuse_leaf)
     now = int(time.time())
     claims = {
         "iss": consts.PRODUCT,
@@ -176,7 +238,8 @@ def make_bootstrapper(cfg, engine, registry=None):
     def hook(container_id: str, project: str, agent: str) -> None:
         ca = pki.ensure_ca(cfg.pki_dir)
         material = mint_bootstrap_material(ca, project, agent, container_id=container_id)
-        install_bootstrap_material(engine, container_id, material)
+        with phases.phase("identity_install"):
+            install_bootstrap_material(engine, container_id, material)
         if registry is not None:
             registry.bind(
                 full_name(project, agent),

@@ -40,6 +40,11 @@ class _FaultGate:
     """
 
     _UNGATED = {"close", "close_events", "pool_stats"}
+    # calls that begin launch work against the daemon: what the
+    # admission token bucket meters (docs/loop-placement.md); the gate
+    # tracks their concurrency high-water mark so tests can assert a
+    # worker's daemon never saw more than its cap at once
+    _LAUNCH_CALLS = {"container_create", "container_start"}
 
     def __init__(self, inner: FakeDockerAPI):
         self.inner = inner
@@ -48,6 +53,10 @@ class _FaultGate:
         self._cleared.set()
         self._lock = threading.Lock()
         self._calls = 0
+        self._inflight = 0
+        self._launch_inflight = 0
+        self.call_hwm = 0           # concurrent daemon calls, any kind
+        self.launch_hwm = 0         # concurrent create/start calls
 
     def set_fault(self, mode: str | None) -> None:
         if mode is not None and mode not in FAULT_KINDS:
@@ -80,10 +89,24 @@ class _FaultGate:
         attr = getattr(self.inner, name)
         if not callable(attr) or name in self._UNGATED:
             return attr
+        is_launch = name in self._LAUNCH_CALLS
 
         def call(*args, **kwargs):
             self._gate()
-            return attr(*args, **kwargs)
+            with self._lock:
+                self._inflight += 1
+                self.call_hwm = max(self.call_hwm, self._inflight)
+                if is_launch:
+                    self._launch_inflight += 1
+                    self.launch_hwm = max(self.launch_hwm,
+                                          self._launch_inflight)
+            try:
+                return attr(*args, **kwargs)
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+                    if is_launch:
+                        self._launch_inflight -= 1
 
         return call
 

@@ -301,6 +301,15 @@ class HealthMonitor:
                                + self.config.probe_deadline_s)
         return time.monotonic() - ts <= max_age_s
 
+    def latency_p50_s(self, worker_id: str) -> float:
+        """Median recent probe latency in seconds (0.0 = no samples).
+        The placement policies read this to rebalance slot shares: a
+        slow-but-alive worker gets proportionally fewer placements."""
+        with self._lock:
+            lat = self._latency.get(worker_id)
+            samples = list(lat) if lat else []
+        return _quantile(samples, 0.50)
+
     def healthy_ids(self) -> list[str]:
         return [w.id for w in self.workers
                 if self.breakers[w.id].state == BREAKER_CLOSED]

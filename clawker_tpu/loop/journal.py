@@ -49,6 +49,9 @@ RUNS_DIR = "runs"               # under Config.logs_dir
 # record kinds (the `kind` field of every journal record)
 REC_RUN = "run"                 # run header: spec + worker set
 REC_PLACEMENT = "placement"     # agent placed on a worker (pre-create WAL)
+REC_ADMIT_QUEUED = "admission_queued"  # launch entered the admission queue
+#                                 (pre-submit WAL: --resume rebuilds the
+#                                 pending queue in this order)
 REC_CREATED = "created"         # engine returned a container id
 REC_STARTED = "started"         # iteration N started executing
 REC_EXITED = "exited"           # iteration N's exit accounted
@@ -196,6 +199,13 @@ class RunImage:
     loops: dict[str, LoopImage] = field(default_factory=dict)
     clean_shutdown: bool = False
     generation: int = 0         # how many resumes already hit this run
+    queued_order: list[str] = field(default_factory=list)
+    #                             agents whose latest launch entered the
+    #                             admission queue but never reached a
+    #                             create/adopt/terminal record, in queue
+    #                             order -- what --resume re-enqueues
+    #                             FIRST so pending-queue order survives
+    #                             a scheduler death
 
 
 def replay(records: list[dict]) -> RunImage:
@@ -229,6 +239,19 @@ def replay(records: list[dict]) -> RunImage:
         if not agent:
             continue
         loop = img.loops.setdefault(agent, LoopImage(agent=agent))
+        if kind == REC_ADMIT_QUEUED:
+            # latest queue entry wins its position (a re-placement
+            # re-enqueues at the back, exactly like the live queue)
+            if agent in img.queued_order:
+                img.queued_order.remove(agent)
+            img.queued_order.append(agent)
+            continue
+        if kind in (REC_CREATED, REC_STARTED, REC_EXITED, REC_ADOPTED,
+                    REC_ORPHANED, REC_LOOP_END):
+            # the queued launch either dispatched (create/adopt) or the
+            # placement it belonged to died: it is no longer pending
+            if agent in img.queued_order:
+                img.queued_order.remove(agent)
         if kind == REC_PLACEMENT:
             loop.worker = str(rec.get("worker", loop.worker))
             loop.epoch = int(rec.get("epoch", loop.epoch))

@@ -94,8 +94,16 @@ from .. import consts, logsetup, telemetry
 from ..config import Config
 from ..engine.drivers import RuntimeDriver, Worker
 from ..errors import ClawkerError, DriverError, NotFoundError
+from ..fleet.inventory import pod_topology
 from ..health import BREAKER_CLOSED, BREAKER_OPEN, HealthConfig, HealthMonitor
-from ..monitor.events import TRACE_SPAN, EventBus
+from ..monitor.events import PLACEMENT_DECISION, TRACE_SPAN, EventBus, PlacementEvent
+from ..placement import (
+    ADMISSION_REJECTED,
+    AdmissionController,
+    PlacementContext,
+    get_policy,
+    note_decision,
+)
 from ..monitor.ledger import FlightRecorder, flight_path
 from ..runtime.names import container_name
 from ..runtime.orchestrate import AgentRuntime, CreateOptions
@@ -111,6 +119,7 @@ from ..telemetry.spans import (
 )
 from ..util import ids
 from .journal import (
+    REC_ADMIT_QUEUED,
     REC_ADOPTED,
     REC_CREATED,
     REC_EXITED,
@@ -196,7 +205,12 @@ class _EngineUnreachable(ClawkerError):
 class LoopSpec:
     parallel: int = 1
     iterations: int = 0              # per-agent budget; 0 = until stop()
-    placement: str = "spread"        # spread | pack
+    placement: str = "spread"        # spread | pack | topology
+    tenant: str = "default"          # fairness class this run bills under
+    tenant_weight: float = 1.0       # weighted-fair-queue share vs co-tenants
+    tenant_max_inflight: int = 0     # per-tenant in-flight launch cap; 0 = none
+    max_inflight_per_worker: int = 0  # admission token bucket; 0 = settings
+    #                                  loop.placement.max_inflight_per_worker
     image: str = "@"
     prompt: str = ""                 # handed to the harness via env
     worktrees: bool = False          # one git worktree per agent loop
@@ -246,14 +260,13 @@ class AgentLoop:
 
 
 def place(workers: list[Worker], n: int, policy: str) -> list[Worker]:
-    """n loop slots -> workers.  spread follows TPU worker order."""
+    """n loop slots -> workers (legacy helper: a bare context with no
+    health/latency/topology signal).  The scheduler itself plans through
+    the placement subsystem with the live context -- see
+    clawker_tpu/placement/policy.py and docs/loop-placement.md."""
     if not workers:
         raise ClawkerError("loop: no workers available")
-    if policy == "pack":
-        return [workers[0]] * n
-    if policy == "spread":
-        return [workers[i % len(workers)] for i in range(n)]
-    raise ClawkerError(f"loop: unknown placement {policy!r} (spread|pack)")
+    return get_policy(policy).plan(PlacementContext(workers=workers), n)
 
 
 class _WorkerLane:
@@ -305,7 +318,8 @@ class _WorkerLane:
 class LoopScheduler:
     def __init__(self, cfg: Config, driver: RuntimeDriver, spec: LoopSpec,
                  *, on_event=None, health_config: HealthConfig | None = None,
-                 run_id: str | None = None):
+                 run_id: str | None = None,
+                 admission: AdmissionController | None = None):
         if spec.failover not in FAILOVER_POLICIES:
             raise ClawkerError(
                 f"loop: unknown failover policy {spec.failover!r} "
@@ -313,6 +327,22 @@ class LoopScheduler:
         self.cfg = cfg
         self.driver = driver
         self.spec = spec
+        # --- placement & admission (docs/loop-placement.md): the policy
+        # plans/picks workers, the admission controller rates launches.
+        # A SHARED controller (the `admission` param) is how two runs
+        # co-tenant one pod in-process: both bill the same token buckets
+        # and the weighted fair queue arbitrates between their tenants.
+        ps = cfg.settings.loop.placement
+        self.policy = get_policy(spec.placement)     # raises on unknown
+        self.admission = admission if admission is not None else (
+            AdmissionController(
+                max_inflight_per_worker=(spec.max_inflight_per_worker
+                                         or ps.max_inflight_per_worker),
+                max_pending_per_worker=ps.max_pending_per_worker))
+        self.admission.register_tenant(
+            spec.tenant, weight=spec.tenant_weight,
+            max_inflight=spec.tenant_max_inflight or ps.tenant_max_inflight)
+        self._topology = None       # resolved lazily (driver worker count)
         # an explicit run_id is a RESUME: the journal, flight record, and
         # container names of the dead scheduler's run are all keyed by it
         self.loop_id = run_id or ids.short_id()
@@ -334,7 +364,14 @@ class LoopScheduler:
         # leak a container into neither container_id nor abandoned
         self._placement_lock = threading.Lock()
         self._lanes: dict[str, _WorkerLane] = {}
-        self._inflight: dict[str, Future] = {}   # agent -> create/start task
+        self._lanes_lock = threading.Lock()
+        self._inflight: dict[str, Future] = {}   # agent -> launch HANDLE: the
+        #                                          admission-to-completion
+        #                                          future busy-tracking reads
+        self._lane_task: dict[str, Future] = {}  # agent -> the dispatched
+        #                                          lane future (wedge scan
+        #                                          needs running(), which a
+        #                                          queued handle can't know)
         self._waited: set[tuple[str, int]] = set()
         self._exit_hints: set[str] = set()    # workers with a fresh exit
         self._verdicts: queue.SimpleQueue = queue.SimpleQueue()
@@ -417,31 +454,148 @@ class LoopScheduler:
 
     # -------------------------------------------------------------- set up
 
-    def _lane(self, worker: Worker) -> _WorkerLane:
-        lane = self._lanes.get(worker.id)
-        if lane is None:
-            lane = _WorkerLane(worker.id)
-            self._lanes[worker.id] = lane
-        return lane
+    def _ensure_health(self) -> HealthMonitor:
+        """Construct the fleet HealthMonitor on first use (probe threads
+        start in run()).  Built this early so PLACEMENT sees live
+        breaker state: engine-less workers pre-open their breakers at
+        construction, and tests/resumes can trip breakers before
+        start() -- a quarantined worker must receive zero placements,
+        including the initial ones."""
+        if self.health is not None:
+            return self.health
+        fleet = list(self.driver.workers())
+        known = {w.id for w in fleet}
+        # a resume may carry loops journaled onto workers the current
+        # fleet no longer has: engine-less stand-ins join the monitored
+        # set so their pre-opened breakers orphan those loops into the
+        # normal failover machinery on the first verdict drain
+        fleet.extend(w for w in self._extra_workers if w.id not in known)
+        self.health = HealthMonitor(
+            self.driver, fleet,
+            config=self._health_config, events=self.events,
+            on_verdict=lambda wid, old, new, reason: (
+                self._verdicts.put((wid, old, new, reason)),
+                self._wake.set()))
+        return self.health
 
-    def _submit_inflight(self, loop: AgentLoop, worker: Worker,
-                         fn, *args) -> None:
-        """Submit a create/start task as the loop's inflight work.  Its
-        completion wakes the run loop (the tick after a launch/restart
-        spawns the iteration's waiter and poll): without the wake, a
-        coarse ``poll_s`` would gate every post-launch step."""
+    def _placement_ctx(self, workers: list[Worker] | None = None
+                       ) -> PlacementContext:
+        """The LIVE context every placement decision reads: current
+        fleet, breaker states, recent probe latency, load, topology."""
+        ws = list(workers if workers is not None else self.driver.workers())
+        known = {w.id for w in ws}
+        ws.extend(w for w in self._extra_workers if w.id not in known)
+        if self._topology is None:
+            # shape from the REAL pod only: resume stand-ins (journaled
+            # workers absent from the fleet) have no coordinates, and
+            # counting them would mis-infer the grid (or invalidate an
+            # explicit topology) for the whole cached run
+            self._topology = pod_topology(
+                self.cfg.settings.runtime.tpu, len(self.driver.workers()))
+        health = self.health
+        return PlacementContext(
+            workers=ws,
+            breaker_state=(health.state if health is not None
+                           else (lambda wid: BREAKER_CLOSED)),
+            latency_s=(health.latency_p50_s if health is not None
+                       else (lambda wid: 0.0)),
+            load=self._load_by_worker(),
+            topology=self._topology)
+
+    def _lane(self, worker: Worker) -> _WorkerLane:
+        # admission dispatch runs on whichever thread released a token
+        # (run thread, lane done-callbacks): get-or-create must not race
+        # two lanes into existence for one worker
+        with self._lanes_lock:
+            lane = self._lanes.get(worker.id)
+            if lane is None:
+                lane = _WorkerLane(worker.id)
+                self._lanes[worker.id] = lane
+            return lane
+
+    def _submit_launch(self, loop: AgentLoop, worker: Worker, epoch: int,
+                       fn) -> None:
+        """Route a create/start/restart through admission onto the
+        worker's lane (docs/loop-placement.md).
+
+        The loop's in-flight HANDLE future settles when the launch
+        completes (or its ticket is cancelled); while the launch waits
+        in the admission queue there is no lane task yet, so busy
+        tracking reads the handle and wedge detection reads
+        ``_lane_task`` (set at dispatch).  The per-worker token is
+        released in the lane future's done-callback -- covering create
+        AND first start, the whole burst a daemon actually feels.
+
+        A REJECTED submission (pending queue full) strands the loop
+        WITHOUT penalizing the worker's breaker: a full queue is
+        backpressure, not sickness -- the rescue pass re-places it
+        through the policy at tick cadence.
+        """
+        agent = loop.agent
+        handle: Future = Future()
+        handle.add_done_callback(lambda _f: self._wake.set())
+        self._inflight[agent] = handle
+        # drop any stale lane task now: a re-placed loop must not have
+        # its OLD placement's (possibly wedged) task attributed to the
+        # new worker by the launch-wedge scan while the new launch is
+        # still queued in admission
+        self._lane_task.pop(agent, None)
         t_submit = time.monotonic()
 
-        def task(*a):
-            # stamp the lane queue wait where the span can pick it up:
-            # the iteration root opens inside fn (create/start), on this
-            # same lane thread
-            self._queue_wait[loop.agent] = time.monotonic() - t_submit
-            return fn(*a)
+        def cancelled() -> bool:
+            return self._stop.is_set() or loop.epoch != epoch
 
-        fut = self._lane(worker).submit(task, *args)
-        fut.add_done_callback(lambda _f: self._wake.set())
-        self._inflight[loop.agent] = fut
+        def on_cancel() -> None:
+            if not handle.done():
+                handle.set_result(None)
+
+        def dispatch(release) -> None:
+            def task():
+                # stamp the full pre-create wait (admission queue + lane
+                # queue) where the iteration span can pick it up: the
+                # root opens inside fn, on this same lane thread
+                self._queue_wait[agent] = time.monotonic() - t_submit
+                return fn(loop, epoch, worker)
+
+            fut = self._lane(worker).submit(task)
+            self._lane_task[agent] = fut
+
+            def done(f: Future) -> None:
+                release()
+                if self._lane_task.get(agent) is fut:
+                    self._lane_task.pop(agent, None)
+                if handle.done():
+                    return
+                exc = f.exception()
+                if exc is not None:
+                    handle.set_exception(exc)
+                else:
+                    handle.set_result(None)
+
+            fut.add_done_callback(done)
+
+        # write-ahead: the queue entry is journaled before the ticket
+        # exists, so a resume can rebuild the pending queue in order
+        self._journal(REC_ADMIT_QUEUED, agent=agent, worker=worker.id,
+                      tenant=self.spec.tenant, epoch=epoch)
+        st = self.admission.submit(worker.id, self.spec.tenant, dispatch,
+                                   cancelled=cancelled, on_cancel=on_cancel)
+        if st == ADMISSION_REJECTED:
+            self.on_event(agent, PLACEMENT_DECISION, PlacementEvent(
+                agent, worker.id, self.policy.name, self.spec.tenant,
+                "rejected", "admission queue full").detail())
+            self._strand(loop, epoch,
+                         f"admission queue full on {worker.id}",
+                         penalize=False)
+            if not handle.done():
+                handle.set_result(None)
+            return
+        # ADMITTED (dispatched or queued): the loop made real progress,
+        # so its orphan-grace clock resets.  A REJECTED re-submission
+        # keeps the clock running -- rejection strands skip the strand
+        # ceiling (penalize=False is flow control, not sickness), so
+        # --orphan-grace is the only bound on a queue that never drains
+        self._orphan_since.pop(agent, None)
 
     def _runtime(self, worker: Worker) -> AgentRuntime:
         from ..controlplane.bootstrap import post_start_services, pre_start_services
@@ -480,13 +634,21 @@ class LoopScheduler:
     def start(self) -> None:
         """Place loops and fan create+first-start across worker lanes.
 
-        Returns once every launch is SUBMITTED: the old serial create
-        loop stacked O(N * RTT) on SSH engines, and one wedged worker
-        blocked the whole pod's fan-out.  run() drives the launches to
-        completion (and accounts their failures).
+        Returns once every launch is SUBMITTED to admission: the old
+        serial create loop stacked O(N * RTT) on SSH engines, and one
+        wedged worker blocked the whole pod's fan-out.  Each worker's
+        admission token bucket then drains its launches at the daemon's
+        sustainable rate; run() drives them to completion (and accounts
+        their failures).
+
+        Placement rides the policy engine with the LIVE context: a
+        worker whose breaker is already open (known-dead dial, a test
+        pre-trip) receives zero slots.
         """
+        self._ensure_health()
         workers = self.driver.workers()
-        slots = place(workers, self.spec.parallel, self.spec.placement)
+        slots = self.policy.plan(self._placement_ctx(workers),
+                                 self.spec.parallel)
         for i, worker in enumerate(slots):
             # loop id in the agent name: two concurrent runs in one project
             # must never collide (replace=True would kill the other run);
@@ -503,12 +665,16 @@ class LoopScheduler:
                       spec=self._spec_doc(), workers=[w.id for w in workers])
         for loop in self.loops:
             self._journal(REC_PLACEMENT, agent=loop.agent,
-                          worker=loop.worker.id, epoch=loop.epoch)
+                          worker=loop.worker.id, epoch=loop.epoch,
+                          tenant=self.spec.tenant)
         if self.journal is not None:
             self.journal.sync()
         for loop in self.loops:
-            self._submit_inflight(loop, loop.worker,
-                                  self._launch, loop, loop.epoch)
+            note_decision(self.policy.name, loop.worker.id)
+            self.on_event(loop.agent, PLACEMENT_DECISION, PlacementEvent(
+                loop.agent, loop.worker.id, self.policy.name,
+                self.spec.tenant, "placed").detail())
+            self._submit_launch(loop, loop.worker, loop.epoch, self._launch)
 
     def _agent_name(self, slot: int) -> str:
         return f"{self.spec.agent_prefix}-{self.loop_id[:6]}-{slot}"
@@ -523,6 +689,9 @@ class LoopScheduler:
             "worktrees": s.worktrees, "workspace_mode": s.workspace_mode,
             "agent_prefix": s.agent_prefix, "env": dict(s.env),
             "failover": s.failover,
+            "tenant": s.tenant, "tenant_weight": s.tenant_weight,
+            "tenant_max_inflight": s.tenant_max_inflight,
+            "max_inflight_per_worker": s.max_inflight_per_worker,
         }
 
     def wait_launched(self, timeout: float | None = None) -> bool:
@@ -542,7 +711,9 @@ class LoopScheduler:
                on_event=None, health_config: HealthConfig | None = None,
                failover: str | None = None, iterations: int | None = None,
                orphan_grace_s: float | None = None,
-               telemetry: bool = True) -> "LoopScheduler":
+               telemetry: bool = True,
+               admission: AdmissionController | None = None
+               ) -> "LoopScheduler":
         """Rebuild a scheduler from a replayed run journal.
 
         The journal is the authority for the run's SHAPE (slot count,
@@ -572,9 +743,15 @@ class LoopScheduler:
             failover=failover or str(sd.get("failover") or "migrate"),
             orphan_grace_s=orphan_grace_s,
             telemetry=telemetry,
+            tenant=str(sd.get("tenant") or "default"),
+            tenant_weight=float(sd.get("tenant_weight") or 1.0),
+            tenant_max_inflight=int(sd.get("tenant_max_inflight") or 0),
+            max_inflight_per_worker=int(
+                sd.get("max_inflight_per_worker") or 0),
         )
         sched = cls(cfg, driver, spec, on_event=on_event,
-                    health_config=health_config, run_id=image.run_id)
+                    health_config=health_config, run_id=image.run_id,
+                    admission=admission)
         sched._image = image
         sched._build_resumed_loops(image)
         sched._journal(REC_RESUME, durable=True,
@@ -664,11 +841,21 @@ class LoopScheduler:
         image = self._image
         if image is None:
             raise ClawkerError("loop resume: reconcile() before resume()")
+        self._ensure_health()
         summary = {"adopted": 0, "continued": 0, "relaunched": 0,
                    "exits_accounted": 0, "ghosts": 0, "orphaned": 0}
         lock = threading.Lock()     # summary is mutated from lane threads
         by_worker: dict[str, list[AgentLoop]] = {}
-        for loop in self.loops:
+        # journaled pending-queue order first: loops whose launch was
+        # queued in admission when the scheduler died re-enter each
+        # worker's queue in the order they originally held (satellite
+        # guarantee: --resume restores pending-queue order)
+        queue_rank = {a: i for i, a in enumerate(image.queued_order)}
+        ordered = sorted(
+            self.loops,
+            key=lambda l: (queue_rank.get(l.agent, len(queue_rank)),
+                           self.loops.index(l)))
+        for loop in ordered:
             if loop.status != "pending" or loop.worker.engine is None:
                 # engine-less stand-ins are handled by the health
                 # pre-trip at run(); terminal loops need nothing
@@ -728,9 +915,9 @@ class LoopScheduler:
                 # landed between the WAL record and the create (or the
                 # container was lost with its worker): re-launch
                 self._journal(REC_PLACEMENT, durable=True, agent=loop.agent,
-                              worker=worker.id, epoch=loop.epoch)
-                self._submit_inflight(loop, worker,
-                                      self._launch, loop, loop.epoch, worker)
+                              worker=worker.id, epoch=loop.epoch,
+                              tenant=self.spec.tenant)
+                self._submit_launch(loop, worker, loop.epoch, self._launch)
                 with lock:
                     summary["relaunched"] += 1
                 continue
@@ -798,8 +985,7 @@ class LoopScheduler:
                     return
                 loop.container_id = cid
                 loop.fresh_container = True
-            self._submit_inflight(loop, worker,
-                                  self._guarded_start, loop, epoch, worker)
+            self._submit_launch(loop, worker, epoch, self._guarded_start)
             with lock:
                 summary["continued"] += 1
             return
@@ -840,9 +1026,7 @@ class LoopScheduler:
             with lock:
                 summary["exits_accounted"] += 1
             if loop.status == "running":    # budget left: next iteration
-                self._submit_inflight(loop, worker,
-                                      self._guarded_start, loop, epoch,
-                                      worker)
+                self._submit_launch(loop, worker, epoch, self._guarded_start)
             return
         # exit already journaled (crash landed between iterations):
         # restart the same container into the next iteration
@@ -851,8 +1035,7 @@ class LoopScheduler:
                 return
             loop.container_id = cid
             loop.fresh_container = False
-        self._submit_inflight(loop, worker,
-                              self._guarded_start, loop, epoch, worker)
+        self._submit_launch(loop, worker, epoch, self._guarded_start)
         with lock:
             summary["continued"] += 1
 
@@ -1058,10 +1241,13 @@ class LoopScheduler:
             self.on_event(loop.agent, "failed", f"start: {e}")
             log.error("loop %s: start failed: %s", loop.agent, e)
 
-    def _strand(self, loop: AgentLoop, epoch: int, reason: str) -> None:
+    def _strand(self, loop: AgentLoop, epoch: int, reason: str,
+                *, penalize: bool = True) -> None:
         """Mark a loop orphaned after its worker's engine refused a
         create/start.  Runs on a lane thread; the run loop's rescue pass
-        (_rescue_orphans) re-places it under the failover policy."""
+        (_rescue_orphans) re-places it under the failover policy.
+        ``penalize=False`` skips the breaker failure report: admission
+        backpressure (a full queue) is flow control, not sickness."""
         with self._placement_lock:
             if loop.epoch != epoch or self._stop.is_set():
                 return
@@ -1086,15 +1272,24 @@ class LoopScheduler:
                                       status="orphaned")
             self._iter_started.pop((loop.agent, loop.iteration), None)
             loop.status = "orphaned"
-            loop.strands += 1
+            if penalize:
+                # backpressure rejections do NOT burn the strand
+                # ceiling: a busy-but-healthy worker's queue draining is
+                # not a deterministic daemon fault
+                loop.strands += 1
         self._journal(REC_ORPHANED, agent=loop.agent, worker=wid,
                       cid=stranded_cid, reason=reason)
         if self.health is not None:
-            self.health.report_failure(wid, reason)
+            if penalize:
+                self.health.report_failure(wid, reason)
             self.health.note_orphaned(wid)
         self.on_event(loop.agent, "orphaned", f"{wid}: {reason}")
         log.info("loop %s stranded on %s: %s", loop.agent, wid, reason)
-        self._wake.set()
+        if penalize:
+            self._wake.set()
+        # a backpressure strand retries at the fallback tick cadence
+        # instead: an immediate wake would spin rescue->reject->rescue
+        # at CPU speed until the queue drains
 
     def _finish_iteration(self, loop: AgentLoop, code: int) -> None:
         finished = loop.iteration
@@ -1257,26 +1452,13 @@ class LoopScheduler:
             # compat: loops registered without start() still launch here
             if loop.agent not in self._inflight:
                 if loop.status == "pending":
-                    self._submit_inflight(loop, loop.worker,
-                                          self._launch, loop, loop.epoch)
+                    self._submit_launch(loop, loop.worker, loop.epoch,
+                                        self._launch)
                 else:
                     done: Future = Future()
                     done.set_result(None)
                     self._inflight[loop.agent] = done
-        # a resume may carry loops journaled onto workers the current
-        # fleet no longer has: engine-less stand-ins join the monitored
-        # set so their pre-opened breakers orphan those loops into the
-        # normal failover machinery on the first verdict drain
-        fleet = list(self.driver.workers())
-        known = {w.id for w in fleet}
-        fleet.extend(w for w in self._extra_workers if w.id not in known)
-        self.health = HealthMonitor(
-            self.driver, fleet,
-            config=self._health_config, events=self.events,
-            on_verdict=lambda wid, old, new, reason: (
-                self._verdicts.put((wid, old, new, reason)),
-                self._wake.set()))
-        self.health.start()
+        self._ensure_health().start()
         wedge_after = max(4.0 * poll_s, LANE_WEDGE_FLOOR_S)
         polls: dict[str, Future] = {}
         poll_running_since: dict[str, float] = {}    # first tick seen EXECUTING
@@ -1293,6 +1475,9 @@ class LoopScheduler:
                 self._harvest_inflight()
                 self._drain_verdicts()
                 self._rescue_orphans()
+                # queue hygiene: melt cancelled tickets (orphaned/stopped
+                # placements) and dispatch anything their removal unblocks
+                self.admission.sweep()
                 # a loop is busy while running or orphaned (awaiting
                 # failover), or while its create/start/restart is still
                 # queued on a (possibly wedged) worker lane
@@ -1315,7 +1500,10 @@ class LoopScheduler:
                 # answer probes -- without this, such a loop would hang
                 # forever with no poll ever submitted for it
                 for l in self.loops:
-                    fut = self._inflight.get(l.agent)
+                    # wedge detection reads the dispatched LANE task --
+                    # a launch still waiting in the admission queue has
+                    # no lane task and is by definition not wedging one
+                    fut = self._lane_task.get(l.agent)
                     if (l.status not in ("pending", "running")
                             or fut is None or fut.done()
                             or not fut.running()):
@@ -1457,13 +1645,16 @@ class LoopScheduler:
                         continue
                     self._finish_iteration(loop, code)
                     if loop.status == "running":  # budget left: next iteration
-                        self._submit_inflight(
-                            loop, loop.worker,
-                            self._guarded_start, loop, loop.epoch, loop.worker)
+                        self._submit_launch(loop, loop.worker, loop.epoch,
+                                            self._guarded_start)
                 if not progressed:
                     self._wake.wait(poll_s)
         finally:
             self.health.stop()
+            # settle tickets a stop/abort left in the admission queue:
+            # their cancelled() now reads true, and sweeping completes
+            # their handles so wait_launched callers never hang
+            self.admission.sweep()
         if self._aborted:
             # kill(): the crash seam -- return exactly what SIGKILL would
             # leave behind (no halts, no span flush, no shutdown records;
@@ -1519,7 +1710,8 @@ class LoopScheduler:
                 # never queue behind the stuck call (ROADMAP: PR-3 known
                 # limitation).  Queued tasks on the old lane are
                 # epoch-guarded and no-op when (if) the thread unblocks.
-                stale_lane = self._lanes.pop(wid, None)
+                with self._lanes_lock:
+                    stale_lane = self._lanes.pop(wid, None)
                 if stale_lane is not None:
                     stale_lane.close()
                 self._unreach.pop(wid, None)   # a fresh episode starts clean
@@ -1540,7 +1732,8 @@ class LoopScheduler:
         # after recovery must get a FRESH lane thread, not queue behind
         # the wedged one.  Tasks already queued on the old lane are
         # epoch-guarded, so they no-op when (if) the thread unblocks.
-        stale_lane = self._lanes.pop(wid, None)
+        with self._lanes_lock:
+            stale_lane = self._lanes.pop(wid, None)
         if stale_lane is not None:
             stale_lane.close()
         self._unreach.pop(wid, None)   # the episode ends with the orphaning
@@ -1577,6 +1770,12 @@ class LoopScheduler:
             if self.health is not None:
                 self.health.note_orphaned(wid)
             self.on_event(loop.agent, "orphaned", f"{wid}: {reason}")
+        # zero the worker's admission bucket LAST (epochs above are
+        # bumped, so its pending tickets read stale and melt in the
+        # reset's pump): launches admitted there strand on the retired
+        # lane, and their eventual releases must not free tokens in a
+        # recovered worker's fresh bucket
+        self.admission.reset_worker(wid)
 
     def _rescue_orphans(self) -> None:
         """Re-place orphaned loops under the failover policy.  Runs every
@@ -1602,8 +1801,9 @@ class LoopScheduler:
             # a loop that keeps stranding across placements while the
             # breakers read healthy is hitting a DETERMINISTIC daemon
             # failure (bad image, disk full): stop churning, fail it --
-            # re-placements reset the grace timer, so only this ceiling
-            # bounds that cycle
+            # ADMITTED re-placements reset the grace timer
+            # (_submit_launch), so this ceiling bounds that cycle while
+            # the grace bounds rejection churn (which never burns it)
             if loop.strands >= STRAND_CEILING:
                 self._fail_orphan(loop, f"{loop.strands} consecutive "
                                         "stranded create/starts")
@@ -1622,11 +1822,13 @@ class LoopScheduler:
                 # still read closed (one stranded create is below the
                 # breaker threshold) yet just refused a create -- but
                 # fall back to it rather than strand the only worker of
-                # a one-worker fleet behind a transient blip
-                load = self._load_by_worker()
-                target = (self.health.pick_target(
-                    load, exclude={loop.worker.id})
-                    or self.health.pick_target(load))
+                # a one-worker fleet behind a transient blip.  The
+                # policy picks (topology prefers the ICI-closest healthy
+                # worker; everyone weighs load by probe latency).
+                ctx = self._placement_ctx()
+                target = (self.policy.pick(
+                    ctx, exclude={loop.worker.id}, near=loop.worker)
+                    or self.policy.pick(ctx, near=loop.worker))
                 if target is None:
                     continue            # no healthy worker right now
             with self._placement_lock:
@@ -1636,7 +1838,10 @@ class LoopScheduler:
                 loop.worker = target
                 loop.status = "pending"
                 loop.fresh_container = True
-            self._orphan_since.pop(loop.agent, None)
+            # NOTE: _orphan_since is NOT cleared here -- only an ADMITTED
+            # re-submission clears it (_submit_launch), so a loop cycling
+            # orphan -> re-place -> admission-rejected stays on the
+            # grace clock and --orphan-grace bounds the churn
             # write-ahead: the new placement is durable before its launch
             # is submitted, so a crash mid-migration resumes at the NEW
             # worker instead of resurrecting the dead placement
@@ -1644,7 +1849,12 @@ class LoopScheduler:
                 self._journal(REC_MIGRATED, agent=loop.agent,
                               src=old.id, dst=target.id)
             self._journal(REC_PLACEMENT, durable=True, agent=loop.agent,
-                          worker=target.id, epoch=loop.epoch)
+                          worker=target.id, epoch=loop.epoch,
+                          tenant=self.spec.tenant)
+            note_decision(self.policy.name, target.id)
+            self.on_event(loop.agent, PLACEMENT_DECISION, PlacementEvent(
+                loop.agent, target.id, self.policy.name, self.spec.tenant,
+                "replaced", f"from {old.id}").detail())
             # the re-placed attempt gets a FRESH root span (the orphaned
             # attempt's root closed when the worker died); the hop rides
             # it as a zero-width migrate child so `loop trace` can show
@@ -1664,8 +1874,7 @@ class LoopScheduler:
                               f"{old.id}->{target.id}")
             else:
                 self.on_event(loop.agent, "resumed", target.id)
-            self._submit_inflight(loop, target,
-                                  self._launch, loop, loop.epoch, target)
+            self._submit_launch(loop, target, loop.epoch, self._launch)
 
     def _fail_orphan(self, loop: AgentLoop, detail: str) -> None:
         loop.status = "failed"
@@ -1802,9 +2011,10 @@ class LoopScheduler:
                         for w in sweep_workers.values())
             if futs:
                 futures_wait(futs, timeout=HALT_DEADLINE_S)
-        for lane in self._lanes.values():
+        with self._lanes_lock:
+            lanes, self._lanes = list(self._lanes.values()), {}
+        for lane in lanes:
             lane.close()
-        self._lanes.clear()
         self.tracer.close_open("stopped")
         if self.flight is not None:
             self.flight.close()

@@ -44,6 +44,42 @@ WORKER_HEALTH = "worker.health"
 # one-liner.  Consumers wanting structure read the flight recorder.
 TRACE_SPAN = "trace.span"
 
+# Event name placement decisions ride the bus under (placement/ +
+# docs/loop-placement.md): where a loop landed (or why it could not),
+# typed so the fleet placement view and tests can round-trip it.
+PLACEMENT_DECISION = "placement.decision"
+
+
+@dataclass(frozen=True)
+class PlacementEvent:
+    """Typed payload of a ``placement.decision`` event.
+
+    ``action`` is one of ``placed`` (initial slot), ``replaced``
+    (failover/rescue re-placement), or ``rejected`` (admission queue
+    full -- the loop went back to the rescue pass).  Same stance as
+    :class:`WorkerHealthEvent`: rides as the detail string so every
+    existing sink renders it unchanged; structured consumers parse.
+    """
+
+    agent: str
+    worker: str
+    policy: str
+    tenant: str
+    action: str
+    reason: str = ""
+
+    def detail(self) -> str:
+        base = f"{self.action} {self.worker} [{self.policy}/{self.tenant}]"
+        return f"{base}: {self.reason}" if self.reason else base
+
+    @classmethod
+    def parse(cls, agent: str, detail: str) -> "PlacementEvent":
+        head, _, reason = detail.partition(": ")
+        action, _, rest = head.partition(" ")
+        worker, _, tagged = rest.partition(" [")
+        policy, _, tenant = tagged.rstrip("]").partition("/")
+        return cls(agent, worker, policy, tenant, action, reason)
+
 
 @dataclass(frozen=True)
 class WorkerHealthEvent:

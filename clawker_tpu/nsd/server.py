@@ -188,12 +188,36 @@ class NsDaemon:
 
     # ---------------------------------------------------------- lifecycle
 
+    # shared parent dirs whose modes nsd must never narrow (a socket
+    # configured directly under one of these is the operator's call;
+    # the DEFAULT layout is a dedicated /run/clawker)
+    _SHARED_DIRS = frozenset(
+        {"/", "/run", "/var", "/var/run", "/var/lib", "/tmp", "/var/tmp",
+         "/dev", "/dev/shm", "/home", "/root"})
+
     def serve(self) -> None:
-        self.socket_path.parent.mkdir(parents=True, exist_ok=True)
+        # The socket is ROOT-EQUIVALENT (full container control on a
+        # daemon that runs as root with namespaces): it must never
+        # inherit a permissive umask.  Bind under umask 0o177 (no
+        # group/other bits even for the creation instant), then pin the
+        # socket to 0600 and its dedicated parent dir to 0700 --
+        # ADVICE round 5.
+        parent = self.socket_path.parent
+        parent.mkdir(parents=True, exist_ok=True)
         if self.socket_path.exists():
             self.socket_path.unlink()
         srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        srv.bind(str(self.socket_path))
+        old_umask = os.umask(0o177)
+        try:
+            srv.bind(str(self.socket_path))
+        finally:
+            os.umask(old_umask)
+        os.chmod(self.socket_path, 0o600)
+        if str(parent) not in self._SHARED_DIRS:
+            try:
+                os.chmod(parent, 0o700)
+            except OSError:
+                pass    # not ours to narrow (ro mount, foreign owner)
         srv.listen(64)
         srv.settimeout(0.5)
         self._server_sock = srv

@@ -22,6 +22,11 @@ Gates:
 - telemetry_overhead_ns: enabled <= bench.TELEMETRY_BUDGET_NS and
   disabled <= bench.TELEMETRY_DISABLED_BUDGET_NS  (ISSUE 4 acceptance
   bar -- instrumentation must never silently regress the cold start)
+- loop_fanout_p50_n64 <= bench.FANOUT64_BUDGET_S with every admission
+  cap respected and all 64 loops at budget  (ISSUE 6 acceptance bar)
+- placement_admission_stampede: a 64-loop burst against one slow
+  worker drains within bench.STAMPEDE_BUDGET_S, never exceeds the
+  admission cap, and never trips the worker's breaker (ISSUE 6)
 
 Prints one JSON line; exit 1 on any gate failure.
 """
@@ -42,20 +47,26 @@ DIALS_MIN_REDUCTION = 2.0
 def main() -> int:
     from bench import (
         FAILOVER_BUDGET_S,
+        FANOUT64_BUDGET_S,
         POLL_COST_BUDGET,
         RESUME_BUDGET_S,
+        STAMPEDE_BUDGET_S,
         TELEMETRY_BUDGET_NS,
         TELEMETRY_DISABLED_BUDGET_NS,
         bench_engine_dials,
         bench_failover,
         bench_fleet_provision,
         bench_loop_fanout,
+        bench_loop_fanout_n64,
         bench_loop_poll_cost,
+        bench_placement_admission_stampede,
         bench_resume_reattach,
         bench_telemetry_overhead,
     )
 
     fanout_s = bench_loop_fanout(iters=1)
+    fanout64 = bench_loop_fanout_n64(iters=1)
+    stampede = bench_placement_admission_stampede()
     poll = bench_loop_poll_cost()
     provision = bench_fleet_provision()
     failover = bench_failover()
@@ -67,6 +78,28 @@ def main() -> int:
     if fanout_s > FANOUT_BUDGET_S:
         failures.append(
             f"loop_fanout_p50_n8 {fanout_s:.2f}s > {FANOUT_BUDGET_S}s budget")
+    if not fanout64["all_loops_done"]:
+        failures.append("loop_fanout_p50_n64: loops missed their budget")
+    elif not fanout64["cap_respected"]:
+        failures.append("loop_fanout_p50_n64: a worker exceeded its "
+                        "admission cap")
+    elif fanout64["fanout_p50_s"] > FANOUT64_BUDGET_S:
+        failures.append(
+            f"loop_fanout_p50_n64 {fanout64['fanout_p50_s']}s > "
+            f"{FANOUT64_BUDGET_S}s budget")
+    if not stampede["all_loops_done"]:
+        failures.append("placement_admission_stampede: loops missed "
+                        "their budget")
+    elif stampede["breaker_opened"]:
+        failures.append("placement_admission_stampede: the slow worker's "
+                        "breaker tripped under the burst")
+    elif not stampede["cap_respected"]:
+        failures.append("placement_admission_stampede: admission cap "
+                        "exceeded")
+    elif stampede["wall_s"] > STAMPEDE_BUDGET_S:
+        failures.append(
+            f"placement_admission_stampede {stampede['wall_s']}s > "
+            f"{STAMPEDE_BUDGET_S}s budget")
     if poll["calls_per_iteration"] > POLL_COST_BUDGET:
         failures.append(
             f"loop_poll_cost_n8 {poll['calls_per_iteration']} calls/iter "
@@ -120,6 +153,8 @@ def main() -> int:
 
     print(json.dumps({
         "loop_fanout_p50_n8_ms": round(fanout_s * 1000, 1),
+        "loop_fanout_p50_n64": fanout64,
+        "placement_admission_stampede": stampede,
         "loop_poll_cost_n8": poll,
         "fleet_provision_wall_n8": provision,
         "failover_detect_to_restart_s": failover,

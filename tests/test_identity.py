@@ -171,3 +171,50 @@ class TestCreatePathIntegration:
             rec = factory.agent_registry.get("demo.dev")
             assert rec is not None and rec.container_id == c.id
             assert rec.cert_sha256
+
+
+class TestLeafSessionCache:
+    """CA session cache (docs/loop-placement.md satellite): warm
+    placements reuse the per-agent mTLS leaf; per-container material
+    (assertion JWT, session key) stays fresh; rotation invalidates."""
+
+    def setup_method(self):
+        identity.clear_identity_cache()
+
+    def test_warm_mint_reuses_leaf(self, ca):
+        m1 = identity.mint_bootstrap_material(ca, "p", "dev", container_id="c1")
+        m2 = identity.mint_bootstrap_material(ca, "p", "dev", container_id="c2")
+        assert m1.agent_cert == m2.agent_cert
+        assert m1.agent_key == m2.agent_key
+        # container-bound material must NOT be cached
+        assert m1.assertion_jwt != m2.assertion_jwt
+        assert m1.session_key != m2.session_key
+        claims = identity.verify_jwt_es256(ca.cert.public_key(), m2.assertion_jwt)
+        assert claims["container_id"] == "c2"
+
+    def test_distinct_agents_distinct_leaves(self, ca):
+        m1 = identity.mint_bootstrap_material(ca, "p", "dev")
+        m2 = identity.mint_bootstrap_material(ca, "p", "ops")
+        assert m1.agent_cert != m2.agent_cert
+
+    def test_rotation_invalidates(self, ca):
+        m1 = identity.mint_bootstrap_material(ca, "p", "dev")
+        other = pki.generate_ca()     # a rotated CA is a new cert PEM
+        m2 = identity.mint_bootstrap_material(other, "p", "dev")
+        assert m1.agent_cert != m2.agent_cert
+        assert m2.ca_cert == other.cert_pem
+
+    def test_reuse_opt_out_forces_fresh_leaf(self, ca):
+        m1 = identity.mint_bootstrap_material(ca, "p", "dev")
+        m2 = identity.mint_bootstrap_material(ca, "p", "dev",
+                                              reuse_leaf=False)
+        assert m1.agent_cert != m2.agent_cert
+
+    def test_prewarm_marks_agents_warm(self, ca):
+        minted = identity.prewarm_identities(ca, "p", ["a0", "a1", "a2"])
+        assert minted == 3
+        assert identity.prewarm_identities(ca, "p", ["a0", "a1", "a2"]) == 0
+        # the warm mint must hand back exactly the prewarmed leaf
+        m = identity.mint_bootstrap_material(ca, "p", "a1")
+        again = identity.mint_bootstrap_material(ca, "p", "a1")
+        assert m.agent_cert == again.agent_cert

@@ -217,3 +217,38 @@ def test_concurrent_lifecycles_do_not_interfere(api):
     assert not errors, errors
     rows = api.container_list(all=True)
     assert not any(r["Names"][0].startswith("/race") for r in rows)
+
+
+def test_socket_modes_are_restrictive_at_bind(tmp_path_factory):
+    """The nsd unix socket is root-equivalent: it must come up 0600 with
+    a 0700 parent dir regardless of the inherited umask (ADVICE round 5
+    -- a 0755 socket dir + umask-mode socket hands container control to
+    every local user)."""
+    from clawker_tpu.nsd.server import NsDaemon
+
+    td = tmp_path_factory.mktemp("nsd-sock")
+    sock_dir = td / "run" / "clawker-nsd"
+    sock = sock_dir / "nsd.sock"
+    old_umask = os.umask(0o022)        # deliberately permissive
+    try:
+        daemon = NsDaemon(td / "state", sock)
+        t = threading.Thread(target=daemon.serve, daemon=True)
+        t.start()
+        try:
+            # the parent chmod is serve()'s LAST pre-listen step: poll
+            # for it (not bare socket existence) or the assert can race
+            # the daemon thread between bind and chmod
+            for _ in range(200):
+                if (sock.exists()
+                        and (sock_dir.stat().st_mode & 0o777) == 0o700):
+                    break
+                time.sleep(0.01)
+            assert sock.exists(), "daemon never bound its socket"
+            assert (sock.stat().st_mode & 0o777) == 0o600
+            assert (sock_dir.stat().st_mode & 0o777) == 0o700
+            # the bind must not have leaked the narrow umask back out
+            assert os.umask(0o022) == 0o022
+        finally:
+            daemon.shutdown()
+    finally:
+        os.umask(old_umask)

@@ -414,7 +414,10 @@ def test_loop_run_exports_documented_metric_names(env):
                    "engine_stale_retries_total",
                    "engine_retries_suppressed_total",
                    "loop_lane_queue_seconds", "loop_lane_execute_seconds",
-                   "loop_iterations_total", "health_breaker_state"):
+                   "loop_iterations_total", "health_breaker_state",
+                   "placement_decisions_total", "placement_queue_depth",
+                   "placement_inflight_launches",
+                   "placement_admission_wait_seconds"):
         assert f"# TYPE {family} " in text, family
 
 
