@@ -153,15 +153,45 @@ def bench_cold_start(iters: int = 40) -> tuple[float, dict[str, float], dict]:
     return statistics.median(samples), stages, identity
 
 
-def bench_parity() -> tuple[float, int, int]:
-    """(wall_s, passed, total) over e2e scenarios + adversarial corpus."""
-    from clawker_tpu.parity.redteam import run_corpus
-    from clawker_tpu.parity.scenarios import run_all
+def bench_parity(jobs: int | None = None) -> tuple[float, int, int]:
+    """(wall_s, passed, total) over e2e scenarios + adversarial corpus.
 
+    The 52-surface suite used to run strictly serially (20.5s
+    ``parity_suite_wall``, BENCH_r05).  Independent cases now fan
+    across a bounded process pool (per-case tmpdir subtrees + per-world
+    capture stores keep isolation identical to the serial run), and the
+    scenario corpus overlaps the redteam corpus: BOTH halves' cases go
+    into ONE shared fork pool submitted from this (main) thread.  Two
+    thread-driven pools would fork each half's workers from a thread
+    while the sibling pool's management threads run -- the classic
+    fork-under-threads child deadlock; one pool keeps every fork on the
+    main thread and interleaves the halves for free."""
+    import multiprocessing
+    from concurrent.futures import ProcessPoolExecutor
+
+    from clawker_tpu.parity.__main__ import default_parity_jobs
+    from clawker_tpu.parity.redteam import (
+        _corpus_shard,
+        corpus_shards,
+        merge_shards,
+    )
+    from clawker_tpu.parity.scenarios import _scenario_case, scenario_cases
+
+    if jobs is None:
+        jobs = default_parity_jobs()
     t0 = time.perf_counter()
     with tempfile.TemporaryDirectory(prefix="clawker-bench-parity-") as td:
-        rows = run_all(Path(td))
-        red = run_corpus(Path(td) / "redteam")
+        cases = scenario_cases(Path(td))
+        shards = corpus_shards(Path(td) / "redteam", jobs)
+        with ProcessPoolExecutor(
+                max_workers=min(2 * jobs, len(cases) + len(shards)),
+                mp_context=multiprocessing.get_context("fork")) as ex:
+            # corpus shards first: they are the long poles, and the
+            # scenario cases backfill the remaining workers
+            shard_futs = [ex.submit(_corpus_shard, s) for s in shards]
+            case_futs = [ex.submit(_scenario_case, c) for c in cases]
+            rows = [f.result() for f in case_futs]
+            red = merge_shards([f.result() for f in shard_futs])
     wall = time.perf_counter() - t0
     passed = sum(1 for r in rows if r["pass"])
     if red["captures"] == 0:  # any capture voids the whole corpus
@@ -690,6 +720,188 @@ def bench_resume_reattach(n_loops: int = 8, n_workers: int = 4) -> dict:
     }
 
 
+def bench_warm_pool_hit(iters: int = 30) -> dict:
+    """warm_pool_hit_p50: framework cost of a warm-pool HIT vs the cold
+    create it replaces (ISSUE 7 acceptance: <= 1ms on a hit).
+
+    Cold leg: the full create path -- engine_create + workspace_seed +
+    harness_seed + identity_bootstrap (where the cryptography stack is
+    available) + engine_start -- under fresh agent names, so every leaf
+    is a cache miss (the 8.95ms-shaped cold start of BENCH_r05).
+
+    Warm leg: the pool shape -- members pre-created through the SAME
+    create path under placeholder names (untimed; that is the pool
+    fill's whole point), identities prewarmed for the upcoming agent
+    names, then the timed hit = WarmPool.checkout + adopt_pooled
+    (relabel + env fixup + warm identity + rename) + engine_start.
+    ``harness_seed`` and the expensive half of ``identity_bootstrap``
+    are OFF this path by construction; the reported split proves it.
+    """
+    from clawker_tpu.config import load_config
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.loop.warmpool import WarmPool
+    from clawker_tpu.runtime.orchestrate import (
+        AgentRuntime,
+        CreateOptions,
+        clear_harness_seed_cache,
+    )
+    from clawker_tpu.testenv import TestEnv
+    from clawker_tpu.util import phases
+
+    try:        # identity needs the cryptography stack; degrade visibly
+        from clawker_tpu.controlplane.identity import (
+            clear_identity_cache,
+            make_bootstrapper,
+            prewarm_identities,
+        )
+        from clawker_tpu.firewall import pki
+        identity_wired = True
+    except ImportError:
+        identity_wired = False
+
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        tenv.make_project(proj, "project: benchpool\n")
+        cfg = load_config(proj)
+        driver = FakeDriver()
+        driver.api.add_image("clawker-benchpool:default")
+        engine = driver.engine()
+        bootstrap = (make_bootstrapper(cfg, engine)
+                     if identity_wired else None)
+        rt = AgentRuntime(engine, cfg, bootstrap=bootstrap)
+        worker = driver.workers()[0]
+        if identity_wired:
+            clear_identity_cache()
+        clear_harness_seed_cache()
+
+        def opts(agent: str) -> CreateOptions:
+            return CreateOptions(agent=agent, workspace_mode="snapshot",
+                                 tty=False, replace=True)
+
+        # --- cold leg: full create+start per fresh agent.  The staging
+        # tar cache keys on (harness, root, creds), NOT the agent --
+        # clear it each iteration (outside the timer) so every cold
+        # create pays the real staging walk the warm pool is up against.
+        cold: list[float] = []
+        phases.enable()
+        for i in range(iters):
+            clear_harness_seed_cache()
+            t0 = time.perf_counter()
+            cid = rt.create(opts(f"cold{i}"))
+            rt.start(cid)
+            cold.append(time.perf_counter() - t0)
+        cold_stages = phases.disable()
+
+        # --- warm leg: pool fill (untimed) -> checkout+adopt+start (timed)
+        import gc
+        gc.collect()    # the 30 true-cold staging walks leave garbage;
+        # a gen-2 pause inside the ~1ms timed hits would be cold-leg debt
+        pool = WarmPool("benchrun", depth=iters)
+        for _ in range(iters):
+            agent = pool.begin_refill(worker)
+            cid = rt.create(CreateOptions(agent=agent,
+                                          workspace_mode="snapshot",
+                                          tty=False, replace=True))
+            pool.fill_done(worker, agent, cid)
+        if identity_wired:
+            prewarm_identities(pki.ensure_ca(cfg.pki_dir),
+                               cfg.project_name(),
+                               [f"warm{i}" for i in range(iters)])
+        warm: list[float] = []
+        phases.enable()
+        for i in range(iters):
+            t0 = time.perf_counter()
+            entry = pool.checkout(worker.id, by=f"warm{i}", epoch=0)
+            rt.adopt_pooled(entry.cid, opts(f"warm{i}"))
+            rt.start(entry.cid)
+            warm.append(time.perf_counter() - t0)
+        warm_stages = phases.disable()
+        stats = pool.stats()
+
+    def per_iter_ms(stages: dict, name: str) -> float:
+        return round(stages.get(name, 0.0) * 1000 / iters, 3)
+
+    hit_p50 = statistics.median(warm)
+    cold_p50 = statistics.median(cold)
+    return {
+        "hit_p50_ms": round(hit_p50 * 1000, 3),
+        "cold_p50_ms": round(cold_p50 * 1000, 3),
+        "speedup": round(cold_p50 / hit_p50, 1) if hit_p50 > 0 else 0.0,
+        "hits": stats["hits"],
+        "misses": stats["misses"],
+        "iters": iters,
+        "identity_wired": identity_wired,
+        # the cold/warm split, bench_cold_start identity_split style:
+        # what the hit path still pays vs what moved to the fill
+        "split": {
+            "cold_harness_seed_ms": per_iter_ms(cold_stages, "harness_seed"),
+            "hit_harness_seed_ms": per_iter_ms(warm_stages, "harness_seed"),
+            "cold_identity_bootstrap_ms": per_iter_ms(
+                cold_stages, "identity_bootstrap"),
+            "hit_identity_bootstrap_ms": per_iter_ms(
+                warm_stages, "identity_bootstrap"),
+            "hit_env_fixup_ms": per_iter_ms(warm_stages, "pool_adopt_env"),
+            "hit_finalize_ms": per_iter_ms(warm_stages,
+                                           "pool_adopt_finalize"),
+            "hit_rename_ms": per_iter_ms(warm_stages, "pool_adopt_rename"),
+            "hit_engine_start_ms": per_iter_ms(warm_stages, "engine_start"),
+        },
+    }
+
+
+def bench_warm_pool_refill_burst(n_loops: int = 32, n_workers: int = 4,
+                                 depth: int = 2, cap: int = 4) -> dict:
+    """warm_pool_refill_burst: a full fan-out burst over a pool-enabled
+    scheduler must (a) complete every loop within the fan-out budget --
+    refills ride a low-weight admission tenant, so they may never
+    starve live placements -- (b) leave every worker's pool refilled to
+    target depth, and (c) leak zero pool containers after drain."""
+    from clawker_tpu import consts
+    from clawker_tpu.config import load_config
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.engine.fake import exit_behavior
+    from clawker_tpu.loop import LoopScheduler, LoopSpec
+    from clawker_tpu.testenv import TestEnv
+
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: benchloop\n")
+        cfg = load_config(proj)
+        drv = FakeDriver(n_workers=n_workers)
+        for api in drv.apis:
+            api.add_image("clawker-benchloop:default")
+            api.set_behavior("clawker-benchloop:default",
+                             exit_behavior(b"done\n", 0))
+        sched = LoopScheduler(
+            cfg, drv,
+            LoopSpec(parallel=n_loops, iterations=1, warm_pool_depth=depth,
+                     max_inflight_per_worker=cap))
+        t0 = time.perf_counter()
+        sched.start()
+        loops = sched.run(poll_s=0.05)
+        wall = time.perf_counter() - t0
+        stats = sched.warmpool.stats()
+        refilled = all(
+            sched.warmpool.depth_of(w.id) == depth for w in drv.workers())
+        sched.cleanup(remove_containers=True)
+        leaked = sum(
+            len(api.container_list(all=True, filters={
+                "label": [f"{consts.LABEL_LOOP}={sched.loop_id}"]}))
+            for api in drv.apis)
+    return {
+        "wall_s": round(wall, 3),
+        "loops": n_loops,
+        "workers": n_workers,
+        "depth": depth,
+        "all_loops_done": all(l.status == "done" for l in loops),
+        "pool_refilled": refilled,
+        "hits": stats["hits"],
+        "refills": stats["refills"],
+        "leaked_containers": leaked,
+    }
+
+
 def bench_engine_dials(per_dial_delay: float = 0.01) -> dict:
     """Engine-API socket dials behind one `clawker run` orchestration.
 
@@ -928,6 +1140,18 @@ RESUME_BUDGET_S = 5.0         # --resume invocation -> all loops live again
 #                               (adoption path; must undercut the 10 s
 #                               cold-start budget or resuming would be
 #                               no better than starting over)
+WARM_POOL_HIT_BUDGET_MS = 1.0  # framework time of a warm-pool hit
+#                               (checkout + relabel/env-fixup/rename +
+#                               warm identity + engine_start) -- vs the
+#                               8.95ms cold p50 at r05, with harness
+#                               seed + leaf minting off the hit path
+WARM_POOL_BURST_BUDGET_S = 10.0  # pool-enabled full fan-out burst must
+#                               drain within the cold-start fan-out
+#                               budget AND leave every pool refilled:
+#                               refills never starve live placements
+PARITY_WALL_BUDGET_S = 10.0   # parallel parity suite wall (serial was
+#                               20.5s at BENCH_r05: the bounded worker
+#                               pool must hold >= 2x)
 TELEMETRY_BUDGET_NS = 20_000  # per-record registry cost, enabled (a
 #                               run() orchestration makes O(100) records:
 #                               20us/record keeps the total well under
@@ -948,6 +1172,8 @@ def main() -> None:
     provision = bench_fleet_provision()
     failover = bench_failover()
     resume = bench_resume_reattach()
+    pool_hit = bench_warm_pool_hit()
+    pool_burst = bench_warm_pool_refill_burst()
     dials = bench_engine_dials()
     tele = bench_telemetry_overhead()
     anom = bench_anomaly()
@@ -1014,6 +1240,23 @@ def main() -> None:
                          and resume["adopted"] == resume["loops"]
                          and not resume["duplicate_creates"] else 0.0),
          "detail": resume},
+        {"metric": "warm_pool_hit_p50", "value": pool_hit["hit_p50_ms"],
+         "unit": "ms",
+         # vs_baseline is headroom under the 1ms hit budget; a leg that
+         # missed the pool (hits < iters) must read FAILED, never fast
+         "vs_baseline": (round(
+             WARM_POOL_HIT_BUDGET_MS / max(pool_hit["hit_p50_ms"], 1e-9), 1)
+             if pool_hit["hits"] == pool_hit["iters"] else 0.0),
+         "detail": pool_hit},
+        {"metric": "warm_pool_refill_burst", "value": pool_burst["wall_s"],
+         "unit": "s",
+         # the gate IS the invariant set: burst drained, pools refilled
+         # behind it, zero members leaked after drain
+         "vs_baseline": (round(
+             WARM_POOL_BURST_BUDGET_S / max(pool_burst["wall_s"], 1e-9), 1)
+             if pool_burst["all_loops_done"] and pool_burst["pool_refilled"]
+             and not pool_burst["leaked_containers"] else 0.0),
+         "detail": pool_burst},
         {"metric": "engine_dials_per_run", "value": dials["dials_pooled"],
          "unit": "dials",
          # vs_baseline IS the dial reduction over the dial-per-request

@@ -353,6 +353,116 @@ def _scrape_placement_metrics(url: str) -> dict:
     return out
 
 
+@fleet_group.command("warmpool")
+@click.option("--metrics-url", default="",
+              help="Scrape a running loop's --metrics-port endpoint for "
+                   "live per-worker pool depth and hit/miss/refill "
+                   "counters.")
+@click.option("--run", "run_ref", default="",
+              help="Replay a run journal (id, unambiguous prefix, or "
+                   "path) and show its journaled pool membership.")
+@click.option("--format", "fmt", type=click.Choice(["table", "json"]),
+              default="table")
+@pass_factory
+def fleet_warmpool(f: Factory, metrics_url, run_ref, fmt):
+    """Warm-pool view: settings, live depth/hit counters, membership.
+
+    The warm pool keeps pre-created agent containers per worker that
+    loop placements adopt instead of paying a full create
+    (docs/loop-warmpool.md).  With ``--metrics-url`` pointing at a live
+    run's metrics port this shows the run's actual per-worker depth and
+    hit/miss/refill counters; with ``--run`` it replays that run's
+    journal and lists every pool member's journaled state (what a
+    ``--resume`` would restore or sweep).
+    """
+    import json as _json
+
+    wps = f.config.settings.loop.warm_pool
+    doc: dict = {
+        "settings": {
+            "enable": wps.enable,
+            "depth": wps.depth,
+            "max_age_s": wps.max_age_s,
+            "tenant_weight": wps.tenant_weight,
+        },
+    }
+    if metrics_url:
+        doc["live"] = _scrape_warmpool_metrics(metrics_url)
+    if run_ref:
+        from .cmd_loop import _resolve_journal
+        from ..loop.journal import RunJournal, replay
+
+        image = replay(RunJournal.read(_resolve_journal(f, run_ref)))
+        doc["run"] = image.run_id
+        doc["members"] = [
+            {"agent": m.agent, "worker": m.worker, "cid": m.cid[:12],
+             "state": m.state,
+             **({"adopted_by": m.adopted_by} if m.adopted_by else {})}
+            for m in image.pool.values()
+        ]
+    if fmt == "json":
+        click.echo(_json.dumps(doc, indent=2))
+        return
+    s = doc["settings"]
+    click.echo(f"warm-pool: enable={s['enable']} depth={s['depth']} "
+               f"max_age_s={s['max_age_s']} "
+               f"tenant_weight={s['tenant_weight']}")
+    live = doc.get("live")
+    if live is not None:
+        click.echo("WORKER\tDEPTH\tHITS\tMISSES\tREFILLS\tRECYCLED")
+        workers = sorted(set(live["depth"]) | set(live["hits"])
+                         | set(live["misses"]) | set(live["refills"]))
+        for w in workers:
+            click.echo("\t".join(str(x) for x in (
+                w, live["depth"].get(w, 0), live["hits"].get(w, 0),
+                live["misses"].get(w, 0), live["refills"].get(w, 0),
+                live["recycled"].get(w, 0))))
+    for m in doc.get("members", []):
+        by = f" by={m['adopted_by']}" if m.get("adopted_by") else ""
+        click.echo(f"member {m['agent']}\t{m['worker']}\t{m['cid']}\t"
+                   f"{m['state']}{by}")
+
+
+def _scrape_warmpool_metrics(url: str) -> dict:
+    """Pull warm_pool_* gauges/counters off a live run's Prometheus
+    endpoint; zeroed tables when unreachable (settings still render)."""
+    from urllib import request as urlrequest
+
+    out: dict = {"depth": {}, "hits": {}, "misses": {}, "refills": {},
+                 "recycled": {}}
+    try:
+        with urlrequest.urlopen(url, timeout=3.0) as r:
+            text = r.read().decode()
+    except Exception as e:      # noqa: BLE001
+        click.echo(f"metrics scrape failed: {e}", err=True)
+        return out
+    wanted = {
+        "warm_pool_depth": "depth",
+        "warm_pool_hits_total": "hits",
+        "warm_pool_misses_total": "misses",
+        "warm_pool_refills_total": "refills",
+        "warm_pool_recycled_total": "recycled",
+    }
+    for line in text.splitlines():
+        if line.startswith("#") or "{" not in line:
+            continue
+        name, _, rest = line.partition("{")
+        key = wanted.get(name)
+        if key is None:
+            continue
+        labels_raw, _, value = rest.partition("}")
+        labels = dict(
+            p.split("=", 1) for p in labels_raw.split(",") if "=" in p)
+        worker = labels.get("worker", "").strip('"')
+        try:
+            val = int(float(value.strip()))
+        except ValueError:
+            continue
+        # recycled carries a reason label too: sum per worker
+        out[key][worker] = out[key].get(worker, 0) + val
+    return out
+
+
 @fleet_group.command("status")
 @click.option("--format", "fmt", type=click.Choice(["table", "json"]), default="table")
 @pass_factory

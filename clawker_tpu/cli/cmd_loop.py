@@ -76,6 +76,12 @@ class _TwoStageInterrupt:
               help="Admission token bucket: concurrent in-flight "
                    "create/start launches allowed per worker (default: "
                    "settings loop.placement.max_inflight_per_worker).")
+@click.option("--warm-pool", "warm_pool", type=int, default=None,
+              help="Per-worker warm pool depth: keep N pre-created agent "
+                   "containers per worker that placements adopt (relabel/"
+                   "env-fixup + start) instead of paying a full create "
+                   "(default: settings loop.warm_pool; 0 = off; ignored "
+                   "with --worktrees).")
 @click.option("--image", default="@", help="Agent image ('@' = project default).")
 @click.option("--prompt", default="", help="Prompt handed to each harness loop.")
 @click.option("--worktrees/--no-worktrees", default=False,
@@ -109,7 +115,7 @@ class _TwoStageInterrupt:
 @click.pass_context
 def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                placement, tenant, tenant_weight, max_inflight_per_worker,
-               image, prompt, worktrees, env_kv, failover,
+               warm_pool, image, prompt, worktrees, env_kv, failover,
                orphan_grace, resume_run, metrics_port, as_json, keep):
     """Fan autonomous agent loops across the runtime's workers."""
     if ctx.invoked_subcommand is not None:
@@ -118,13 +124,15 @@ def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                env_kv, failover, orphan_grace, metrics_port, as_json, keep,
                resume_run=resume_run, tenant=tenant,
                tenant_weight=tenant_weight,
-               max_inflight_per_worker=max_inflight_per_worker)
+               max_inflight_per_worker=max_inflight_per_worker,
+               warm_pool=warm_pool)
 
 
 def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
                worktrees, env_kv, failover, orphan_grace, metrics_port,
                as_json, keep, resume_run=None, tenant=None,
-               tenant_weight=None, max_inflight_per_worker=None):
+               tenant_weight=None, max_inflight_per_worker=None,
+               warm_pool=None):
     from .. import telemetry
 
     env = {}
@@ -173,6 +181,7 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
         spec = sched.spec
     else:
         pdef = defaults.placement
+        wps = defaults.warm_pool
         spec = LoopSpec(
             parallel=parallel or defaults.parallel,
             iterations=(iterations if iterations >= 0
@@ -182,6 +191,8 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             tenant_weight=(tenant_weight if tenant_weight is not None
                            else pdef.tenant_weight),
             max_inflight_per_worker=max_inflight_per_worker or 0,
+            warm_pool_depth=(warm_pool if warm_pool is not None
+                             else (wps.depth if wps.enable else 0)),
             image=image,
             prompt=prompt,
             worktrees=worktrees,
@@ -239,6 +250,8 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
         f"{spec.iterations or 'unbounded'} iteration(s), {spec.placement} "
         f"placement, {spec.failover} failover"
         + (f", tenant {spec.tenant}" if spec.tenant != "default" else "")
+        + (f", warm-pool {spec.warm_pool_depth}"
+           if spec.warm_pool_depth else "")
         + (" (resumed)" if resume_run else ""),
         err=True,
     )
@@ -247,7 +260,8 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
         click.echo(
             "resume: {adopted} adopted, {continued} continued, "
             "{relaunched} relaunched, {exits_accounted} exit(s) accounted, "
-            "{ghosts} ghost(s) swept, {orphaned} orphaned".format(**summary),
+            "{ghosts} ghost(s) swept, {orphaned} orphaned, "
+            "{pool_restored} pool member(s) restored".format(**summary),
             err=True)
     else:
         sched.start()

@@ -410,6 +410,27 @@ class LoopPlacementSettings:
 
 
 @dataclass
+class LoopWarmPoolSettings:
+    """Per-worker warm pool of created-not-yet-started agent containers
+    (docs/loop-warmpool.md).
+
+    With ``enable``, each worker keeps ``depth`` pre-created containers
+    with the expensive create-time stages (engine create, workspace
+    seed, harness seed, identity prewarm) already paid; a placement
+    ADOPTS one -- relabel/env-fixup + start -- instead of a full
+    bootstrap.  Refills bill a dedicated low-weight admission tenant so
+    they never starve live placements; ``max_age_s`` bounds how stale a
+    pre-staged workspace/harness snapshot may get before the member is
+    recycled."""
+
+    enable: bool = False
+    depth: int = 2                  # target pool depth per worker
+    max_age_s: float = 600.0        # recycle members older than this
+    tenant_weight: float = 0.25     # WFQ share of the refill tenant vs
+    #                                 real placements (weight 1.0)
+
+
+@dataclass
 class LoopSettings:
     """Autonomous-loop scheduler defaults (net-new)."""
 
@@ -420,6 +441,8 @@ class LoopSettings:
         default_factory=LoopPlacementSettings)
     failover: str = "migrate"       # migrate | wait | fail (worker death)
     journal: LoopJournalSettings = field(default_factory=LoopJournalSettings)
+    warm_pool: LoopWarmPoolSettings = field(
+        default_factory=LoopWarmPoolSettings)
 
 
 @dataclass

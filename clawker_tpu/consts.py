@@ -66,6 +66,13 @@ LABEL_LOOP_EPOCH = f"{LABEL_NS}.loop-epoch"  # placement epoch that created the
 #                                          container: --resume adopts a
 #                                          current-epoch copy and sweeps
 #                                          stale ones as ghosts
+LABEL_WARMPOOL = f"{LABEL_NS}.warmpool"  # warm-pool placeholder agent name:
+#                                          set at pool fill, KEPT through
+#                                          adoption so volume sweeps and
+#                                          resumes can trace a container
+#                                          back to its pool origin
+POOL_EPOCH = "pool"                      # LABEL_LOOP_EPOCH value of an
+#                                          unadopted warm-pool member
 
 MANAGED_VALUE = "true"
 
@@ -88,6 +95,8 @@ DNS_PORT = 53
 # In-container paths
 # ---------------------------------------------------------------------------
 
+RUN_STATE_DIR = "/run/clawker"             # in-container advisory state files
+#                                            (loop-state, agent-env fixup)
 BOOTSTRAP_DIR = "/run/clawker/bootstrap"   # cert/key/ca/assertion delivered pre-start
 READY_FILE = "/var/run/clawker/ready"      # agentd healthcheck marker
 INIT_MARKER = "/var/lib/clawker/initialized"

@@ -234,6 +234,44 @@ class Engine:
         self._assert_managed_container(ref)
         self.api.container_rename(ref, new_name)
 
+    @property
+    def supports_relabel(self) -> bool:
+        """True when the backing api can mutate container labels in
+        place (the fake/nsd engines; real Docker cannot -- labels are
+        create-time immutable there)."""
+        return hasattr(self.api, "container_relabel")
+
+    def relabel_container(self, ref: str, labels: dict[str, str]) -> bool:
+        """Merge ``labels`` into a managed container's label set.
+        Returns False (no-op) on engines without relabel support --
+        warm-pool adoption then relies on the run journal instead of
+        the labels being authoritative (docs/loop-warmpool.md)."""
+        self._assert_managed_container(ref)
+        if not self.supports_relabel:
+            return False
+        self.api.container_relabel(ref, labels)
+        return True
+
+    def finalize_adoption(self, ref: str, *, labels: dict[str, str],
+                          archive_path: str = "", archive: bytes = b"",
+                          new_name: str = "") -> bool:
+        """Warm-pool adoption fixups under ONE jail check: relabel
+        (where the api supports it), optional archive injection (the
+        env-fixup file), and rename, in that order.  Batched because
+        every managed assert is a full inspect -- a remote daemon pays
+        a round-trip per call, and the warm-pool hit budget is 1ms
+        (docs/loop-warmpool.md).  Returns whether the relabel landed."""
+        self._assert_managed_container(ref)
+        relabeled = False
+        if labels and self.supports_relabel:
+            self.api.container_relabel(ref, labels)
+            relabeled = True
+        if archive:
+            self.api.put_archive(ref, archive_path, archive)
+        if new_name:
+            self.api.container_rename(ref, new_name)
+        return relabeled
+
     def inspect_container(self, ref: str) -> dict:
         return self._assert_managed_container(ref)
 

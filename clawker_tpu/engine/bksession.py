@@ -7,9 +7,11 @@ client's services mid-build (secret mounts, ssh-agent forwarding, auth).
 `RUN --mount=type=secret` and `--mount=type=ssh` only work on this lane.
 
 Implementation: grpcio cannot serve on an already-connected socket, so
-the session server listens on loopback and a byte pump bridges the
-hijacked connection to it -- the daemon's h2c traffic flows through the
-pump into a stock gRPC server.  Service payloads are hand-coded
+the session server listens on a private unix socket (inside a 0700
+tmpdir -- never loopback TCP, which any local user could dial; ADVICE
+r5) and a byte pump bridges the hijacked connection to it -- the
+daemon's h2c traffic flows through the pump into a stock gRPC server.
+Service payloads are hand-coded
 protobufs (tiny messages; field numbers below are the wire contract):
 
   moby.buildkit.secrets.v1.Secrets/GetSecret
@@ -33,7 +35,9 @@ from __future__ import annotations
 
 import os
 import secrets as _secrets
+import shutil
 import socket
+import tempfile
 import threading
 import uuid
 from concurrent import futures
@@ -221,7 +225,14 @@ def _grpc_handler(services: SessionServices):
 
 
 class Session:
-    """One client session: loopback gRPC server + hijack bridge."""
+    """One client session: private-socket gRPC server + hijack bridge.
+
+    The bridge's gRPC server used to listen unauthenticated on loopback
+    TCP (``127.0.0.1:0``) -- any local user could dial the ephemeral
+    port and read build secrets or drive the ssh-agent forwarder while
+    a build ran (ADVICE r5).  It now binds a unix socket inside a fresh
+    ``0700`` tmpdir: filesystem permissions ARE the authentication, and
+    nothing is reachable from the host's TCP namespace at all."""
 
     def __init__(self, services: SessionServices, *, name: str = "clawker"):
         import grpc
@@ -230,10 +241,15 @@ class Session:
         self.session_id = uuid.uuid4().hex
         self.name = name
         self.shared_key = _secrets.token_hex(16)
+        # mkdtemp creates the dir 0700 already; chmod pins it against a
+        # permissive umask-less override and documents the contract
+        self._sock_dir = tempfile.mkdtemp(prefix="clawker-bk-")
+        os.chmod(self._sock_dir, 0o700)
+        self.socket_path = os.path.join(self._sock_dir, "session.sock")
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=8),
             handlers=(_grpc_handler(services),))
-        self._port = self._server.add_insecure_port("127.0.0.1:0")
+        self._server.add_insecure_port(f"unix:{self.socket_path}")
         self._server.start()
         self._pumps: list[threading.Thread] = []
         self._hijack = None
@@ -255,9 +271,10 @@ class Session:
 
     def attach(self, hijacked) -> None:
         """Bridge a hijacked /session connection to the gRPC server: the
-        daemon's h2c bytes flow into a loopback connection and back."""
+        daemon's h2c bytes flow into the private unix socket and back."""
         self._hijack = hijacked
-        local = socket.create_connection(("127.0.0.1", self._port))
+        local = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        local.connect(self.socket_path)
 
         def daemon_to_grpc():
             try:
@@ -304,6 +321,7 @@ class Session:
         self._server.stop(grace=0.5)
         for t in self._pumps:
             t.join(timeout=1.0)
+        shutil.rmtree(self._sock_dir, ignore_errors=True)
 
 
 def default_ssh_auth_sock() -> str:

@@ -447,7 +447,28 @@ class FakeDockerAPI:
     def container_rename(self, cid: str, new_name: str) -> None:
         self._record("container_rename", cid, new_name)
         c = self._find(cid)
-        c.name = new_name
+        with self._lock:
+            for other in self.containers.values():
+                if other.name == new_name and other is not c:
+                    # real daemons 409 here; adoption's replace path
+                    # depends on seeing the conflict, not a dup name
+                    raise ConflictError(
+                        f"container name {new_name} already in use")
+            c.name = new_name
+
+    def container_relabel(self, cid: str, labels: dict) -> None:
+        """Merge ``labels`` into the container's label set.  Real Docker
+        has no relabel endpoint (labels are create-time immutable);
+        engines that can do it (this fake; an nsd-style first-party
+        daemon could) expose it so warm-pool adoption can finalize the
+        agent/epoch labels in place -- Engine.relabel_container degrades
+        gracefully where the api lacks the method."""
+        self._record("container_relabel", cid, labels)
+        c = self._find(cid)
+        with self._lock:
+            merged = dict(c.config.get("Labels") or {})
+            merged.update({str(k): str(v) for k, v in labels.items()})
+            c.config["Labels"] = merged
 
     def container_inspect(self, cid: str) -> dict:
         self._record("container_inspect", cid)

@@ -8,6 +8,8 @@ iteration, aggregate status.
 
 from .journal import RunImage, RunJournal, journal_path, replay
 from .scheduler import AgentLoop, LoopScheduler, LoopSpec
+from .warmpool import POOL_TENANT, PoolEntry, WarmPool
 
 __all__ = ["AgentLoop", "LoopScheduler", "LoopSpec",
+           "POOL_TENANT", "PoolEntry", "WarmPool",
            "RunImage", "RunJournal", "journal_path", "replay"]

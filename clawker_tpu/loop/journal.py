@@ -62,6 +62,15 @@ REC_GHOST = "ghost"             # resume swept an unjournaled leftover
 REC_LOOP_END = "loop_end"       # terminal loop status (done|failed|stopped)
 REC_SHUTDOWN = "shutdown"       # clean scheduler drain (SIGINT/SIGTERM/stop)
 REC_RESUME = "resume"           # a --resume generation picked the run up
+# warm-pool membership (docs/loop-warmpool.md): journaled write-ahead so
+# --resume adopts still-usable pool members back into the pool and
+# sweeps the rest -- a pre-created container must never leak as an
+# untracked ghost just because the scheduler died mid-fill
+REC_POOL_ADD = "pool_add"       # refill admitted (pre-create WAL)
+REC_POOL_READY = "pool_ready"   # pool member created; cid known
+REC_POOL_ADOPT = "pool_adopt"   # member consumed by a placement (pre-
+#                                 finalize WAL: `by` names the adopter)
+REC_POOL_REMOVE = "pool_remove"  # member recycled/swept/drained
 
 
 def journal_path(logs_dir: Path, run_id: str) -> Path:
@@ -189,6 +198,20 @@ class LoopImage:
 
 
 @dataclass
+class PoolImage:
+    """One warm-pool member's journaled state, folded to the latest
+    record.  ``pending`` = admitted but never created (mid-refill
+    crash); ``ready`` = created and adoptable; ``adopted`` /
+    ``removed`` = consumed -- reconcile must not hand it out again."""
+
+    agent: str                  # pool placeholder agent name
+    worker: str = ""
+    cid: str = ""
+    state: str = "pending"      # pending | ready | adopted | removed
+    adopted_by: str = ""
+
+
+@dataclass
 class RunImage:
     """A whole run's journaled state: what replay() hands the scheduler."""
 
@@ -197,6 +220,7 @@ class RunImage:
     spec: dict = field(default_factory=dict)
     workers: list[str] = field(default_factory=list)
     loops: dict[str, LoopImage] = field(default_factory=dict)
+    pool: dict[str, PoolImage] = field(default_factory=dict)
     clean_shutdown: bool = False
     generation: int = 0         # how many resumes already hit this run
     queued_order: list[str] = field(default_factory=list)
@@ -234,6 +258,25 @@ def replay(records: list[dict]) -> RunImage:
             continue
         if kind == REC_RESUME:
             img.generation = int(rec.get("generation", img.generation + 1))
+            continue
+        if kind in (REC_POOL_ADD, REC_POOL_READY, REC_POOL_ADOPT,
+                    REC_POOL_REMOVE):
+            # pool members fold into their own table -- their placeholder
+            # agent names must never materialize as loops
+            pa = str(rec.get("agent", ""))
+            if not pa:
+                continue
+            member = img.pool.setdefault(pa, PoolImage(agent=pa))
+            member.worker = str(rec.get("worker", member.worker))
+            if kind == REC_POOL_READY:
+                member.cid = str(rec.get("cid", member.cid))
+                member.state = "ready"
+            elif kind == REC_POOL_ADOPT:
+                member.cid = str(rec.get("cid", member.cid))
+                member.state = "adopted"
+                member.adopted_by = str(rec.get("by", ""))
+            elif kind == REC_POOL_REMOVE:
+                member.state = "removed"
             continue
         agent = str(rec.get("agent", ""))
         if not agent:

@@ -80,6 +80,7 @@ reconcile re-derives from engine state.
 
 from __future__ import annotations
 
+import dataclasses
 import io
 import queue
 import tarfile
@@ -128,6 +129,7 @@ from .journal import (
     REC_MIGRATED,
     REC_ORPHANED,
     REC_PLACEMENT,
+    REC_POOL_REMOVE,
     REC_RESUME,
     REC_RUN,
     REC_SHUTDOWN,
@@ -136,6 +138,7 @@ from .journal import (
     RunJournal,
     journal_path,
 )
+from .warmpool import WarmPool
 
 log = logsetup.get("loop.scheduler")
 
@@ -229,6 +232,12 @@ class LoopSpec:
     #                                  how long an orphan may sit with no
     #                                  healthy placement before failing
     #                                  (0 = fail at the first rescue tick)
+    warm_pool_depth: int = 0         # per-worker warm pool of pre-created
+    #                                  containers placements adopt; 0 =
+    #                                  disabled (docs/loop-warmpool.md).
+    #                                  Ignored with --worktrees: a pool
+    #                                  member's mounts are staged before
+    #                                  the adopting agent's worktree exists
 
 
 @dataclass
@@ -413,6 +422,18 @@ class LoopScheduler:
                     journal_path(cfg.logs_dir, self.loop_id),
                     fsync_batch_n=js.fsync_batch_n,
                     fsync_interval_s=js.fsync_interval_s)
+        # --- warm pool (docs/loop-warmpool.md): pre-created containers
+        # this run's placements adopt instead of paying a full create.
+        # Refills bill a dedicated low-weight admission tenant so the
+        # WFQ hands real placements the worker's tokens first.
+        self.warmpool: WarmPool | None = None
+        if spec.warm_pool_depth > 0 and not spec.worktrees:
+            wps = cfg.settings.loop.warm_pool
+            self.warmpool = WarmPool(
+                self.loop_id, depth=spec.warm_pool_depth,
+                max_age_s=wps.max_age_s, journal=self._journal)
+            self.admission.register_tenant(
+                self.warmpool.tenant, weight=wps.tenant_weight)
         self._aborted = False       # kill(): crash seam, skip all shutdown
         self._image: RunImage | None = None   # journal image being resumed
         self._extra_workers: list[Worker] = []  # journaled workers missing
@@ -597,6 +618,143 @@ class LoopScheduler:
         # --orphan-grace is the only bound on a queue that never drains
         self._orphan_since.pop(agent, None)
 
+    # ------------------------------------------------------------ warm pool
+
+    def _pool_tick(self) -> None:
+        """Keep every healthy worker's warm pool at target depth
+        (docs/loop-warmpool.md).  Runs on the run thread each tick:
+        expired members are recycled, and refills are submitted through
+        admission under the pool's low-weight tenant -- the WFQ hands
+        real placements the tokens first, so a refill burst can never
+        starve live launches."""
+        wp = self.warmpool
+        if wp is None or self._stop.is_set() or wp.draining:
+            return
+        for entry in wp.take_expired():
+            self._lane(entry.worker).submit(
+                self._remove_cid, entry.worker, entry.cid)
+        for worker in self.driver.workers():
+            if worker.engine is None:
+                continue
+            if (self.health is not None
+                    and self.health.state(worker.id) != BREAKER_CLOSED):
+                continue
+            while wp.want(worker.id) > 0:
+                pool_agent = wp.begin_refill(worker)
+                if pool_agent is None:
+                    break
+                if not self._submit_refill(worker, pool_agent):
+                    # admission pending queue saturated: the released
+                    # reservation would make want() > 0 again, so retry
+                    # next tick instead of spinning durable journal
+                    # records on the run thread
+                    break
+
+    def prefill_pool(self, timeout: float = 0.0) -> int:
+        """Kick one refill round now and (optionally) wait until every
+        worker's pool reads target depth or ``timeout`` elapses.
+        Returns the number of adoptable members.  Callers that want the
+        FIRST placements to hit the pool (benches, tests, a CLI warm
+        start) call this before :meth:`start`; during a run the tick
+        does the same thing continuously."""
+        if self.warmpool is None:
+            return 0
+        self._pool_tick()
+        deadline = time.monotonic() + max(0.0, timeout)
+        workers = [w for w in self.driver.workers() if w.engine is not None]
+
+        def ready() -> int:
+            return sum(self.warmpool.depth_of(w.id) for w in workers)
+
+        target = self.warmpool.depth * len(workers)
+        while timeout and ready() < target and time.monotonic() < deadline:
+            time.sleep(0.005)
+        return ready()
+
+    def _submit_refill(self, worker: Worker, pool_agent: str) -> bool:
+        """Route one pool fill through admission onto the worker's lane.
+        A REJECTED or failed fill just releases the reservation --
+        refills are opportunistic, never a loop failure and never a
+        breaker report (probes judge the worker).  Returns False on a
+        synchronous admission rejection so the tick stops refilling
+        this worker (the queue is saturated; retrying now would spin)."""
+        wp = self.warmpool
+
+        def cancelled() -> bool:
+            return self._stop.is_set() or wp.draining
+
+        def on_cancel() -> None:
+            wp.fill_done(worker, pool_agent, None, "cancelled")
+
+        def dispatch(release) -> None:
+            fut = self._lane(worker).submit(
+                self._pool_fill, worker, pool_agent)
+
+            def done(f: Future) -> None:
+                release()
+                exc = f.exception()
+                if exc is not None:
+                    wp.fill_done(worker, pool_agent, None, f"{exc}")
+                    log.info("pool refill on %s failed: %s", worker.id, exc)
+                    return
+                cid = f.result()
+                if cid is None:
+                    wp.fill_done(worker, pool_agent, None, "skipped")
+                elif not wp.fill_done(worker, pool_agent, cid):
+                    # the pool started draining while the fill was on
+                    # the lane: discard on this same lane (ordered
+                    # after us), so drain can never leak it
+                    self._lane(worker).submit(
+                        self._remove_cid, worker, cid)
+
+            fut.add_done_callback(done)
+
+        st = self.admission.submit(worker.id, wp.tenant, dispatch,
+                                   cancelled=cancelled, on_cancel=on_cancel)
+        if st == ADMISSION_REJECTED:
+            wp.fill_done(worker, pool_agent, None, "admission rejected")
+            return False
+        return True
+
+    def _pool_fill(self, worker: Worker, pool_agent: str) -> str | None:
+        """Create one pool member (the expensive create-time stages) on
+        the owning worker's lane.  Runs under the pool placeholder
+        agent name; adoption finalizes the real agent's surface."""
+        wp = self.warmpool
+        if wp is None or self._stop.is_set() or wp.draining:
+            return None
+        rt = self._runtime(worker)
+        # the fill's own harness seed populates the (harness, root,
+        # credentials) staging-tar cache, so every subsequent create on
+        # this worker -- warm or cold -- reuses the staged tar
+        env = {
+            "CLAWKER_LOOP_ID": self.loop_id,
+            **({"CLAWKER_LOOP_PROMPT": self.spec.prompt}
+               if self.spec.prompt else {}),
+            **self.spec.env,
+        }
+        return rt.create(CreateOptions(
+            agent=pool_agent,
+            image=self.spec.image,
+            env=env,
+            tty=False,
+            workspace_mode=self.spec.workspace_mode or "snapshot",
+            worker=worker.id,
+            loop_id=self.loop_id,
+            extra_labels={consts.LABEL_LOOP_EPOCH: consts.POOL_EPOCH,
+                          consts.LABEL_WARMPOOL: pool_agent},
+            replace=True,
+        ))
+
+    def _drain_pool_worker(self, worker: Worker) -> None:
+        """Remove every pool member on ``worker`` (runs on its lane,
+        AFTER any queued fills -- nothing can be added behind it)."""
+        wp = self.warmpool
+        if wp is None:
+            return
+        for entry in wp.drain_worker(worker.id):
+            self._remove_cid(worker, entry.cid)
+
     def _runtime(self, worker: Worker) -> AgentRuntime:
         from ..controlplane.bootstrap import post_start_services, pre_start_services
         from ..fleet.channels import open_side_channels
@@ -692,6 +850,7 @@ class LoopScheduler:
             "tenant": s.tenant, "tenant_weight": s.tenant_weight,
             "tenant_max_inflight": s.tenant_max_inflight,
             "max_inflight_per_worker": s.max_inflight_per_worker,
+            "warm_pool_depth": s.warm_pool_depth,
         }
 
     def wait_launched(self, timeout: float | None = None) -> bool:
@@ -748,6 +907,7 @@ class LoopScheduler:
             tenant_max_inflight=int(sd.get("tenant_max_inflight") or 0),
             max_inflight_per_worker=int(
                 sd.get("max_inflight_per_worker") or 0),
+            warm_pool_depth=int(sd.get("warm_pool_depth") or 0),
         )
         sched = cls(cfg, driver, spec, on_event=on_event,
                     health_config=health_config, run_id=image.run_id,
@@ -843,8 +1003,19 @@ class LoopScheduler:
             raise ClawkerError("loop resume: reconcile() before resume()")
         self._ensure_health()
         summary = {"adopted": 0, "continued": 0, "relaunched": 0,
-                   "exits_accounted": 0, "ghosts": 0, "orphaned": 0}
+                   "exits_accounted": 0, "ghosts": 0, "orphaned": 0,
+                   "pool_restored": 0}
         lock = threading.Lock()     # summary is mutated from lane threads
+        # journaled pool members that may still be adoptable: matched by
+        # their deterministic pool name on the owning worker's listing --
+        # restored into this generation's pool while still `created`
+        # (and under target depth), swept as ghosts otherwise
+        pool_by_worker: dict[str, list] = {}
+        workers_by_id = {w.id: w for w in self.driver.workers()}
+        for member in image.pool.values():
+            if (member.state in ("pending", "ready")
+                    and member.worker in workers_by_id):
+                pool_by_worker.setdefault(member.worker, []).append(member)
         by_worker: dict[str, list[AgentLoop]] = {}
         # journaled pending-queue order first: loops whose launch was
         # queued in admission when the scheduler died re-enter each
@@ -861,11 +1032,17 @@ class LoopScheduler:
                 # pre-trip at run(); terminal loops need nothing
                 continue
             by_worker.setdefault(loop.worker.id, []).append(loop)
+        # workers hosting only journaled pool members (no pending loops)
+        # still need a listing: their members must be restored or swept
+        for wid in pool_by_worker:
+            if wid not in by_worker:
+                by_worker[wid] = []
         futs: dict[str, Future] = {}
         for wid, group in by_worker.items():
-            futs[wid] = self._lane(group[0].worker).submit(
-                self._reconcile_worker, group[0].worker, list(group),
-                image, summary, lock)
+            worker = group[0].worker if group else workers_by_id[wid]
+            futs[wid] = self._lane(worker).submit(
+                self._reconcile_worker, worker, list(group),
+                image, summary, lock, pool_by_worker.get(wid, []))
         futures_wait(list(futs.values()), timeout=deadline_s)
         for wid, fut in futs.items():
             if not fut.done() or fut.exception() is not None:
@@ -883,7 +1060,8 @@ class LoopScheduler:
             return dict(summary)
 
     def _reconcile_worker(self, worker: Worker, group: list[AgentLoop],
-                          image: RunImage, summary: dict, lock) -> None:
+                          image: RunImage, summary: dict, lock,
+                          pool_members: list | None = None) -> None:
         engine = worker.require_engine()
         try:
             rows = engine.list_containers(all=True, filters={
@@ -909,7 +1087,15 @@ class LoopScheduler:
                 row_epoch = (row.get("Labels") or {}).get(
                     consts.LABEL_LOOP_EPOCH, "")
                 if row_epoch and row_epoch != str(loop.epoch):
-                    row = None      # superseded placement's copy: a ghost
+                    # engines without in-place relabel leave an adopted
+                    # warm-pool member's create-time epoch label
+                    # ("pool") behind; there the journal is
+                    # authoritative -- the durable REC_CREATED cid
+                    # names the exact container this placement owns
+                    li = image.loops.get(loop.agent)
+                    jcid = li.container_id if li is not None else ""
+                    if not jcid or str(row.get("Id", "")) != jcid:
+                        row = None  # superseded placement's copy: a ghost
             if row is None:
                 # journaled placement, no current container -- the crash
                 # landed between the WAL record and the create (or the
@@ -930,6 +1116,28 @@ class LoopScheduler:
                 self._strand(loop, loop.epoch, f"resume: {e}")
                 with lock:
                     summary["orphaned"] += 1
+        # journaled pool members on this worker: a member still sitting
+        # `created` under its pool name is re-adopted into THIS
+        # generation's pool (exactly once -- checkout/adopt journaled
+        # it consumed otherwise); anything else -- started, exited,
+        # half-adopted, over target depth, pool disabled now -- is left
+        # unclaimed for the ghost sweep below, which counts it in
+        # loop_ghosts_swept_total like every other stale leftover
+        for member in pool_members or []:
+            row = by_name.get(container_name(project, member.agent))
+            if row is None:
+                continue        # never created, or lost with the worker
+            cid = str(row.get("Id", ""))
+            state = str(row.get("State") or "").lower()
+            if (self.warmpool is not None and state == "created"
+                    and self.warmpool.restore(worker, member.agent, cid)):
+                claimed.add(cid)
+                with lock:
+                    summary["pool_restored"] += 1
+            else:
+                self._journal(REC_POOL_REMOVE, agent=member.agent,
+                              worker=worker.id, cid=cid,
+                              reason="stale at resume")
         # ghost sweep: this run's containers on this worker that no
         # resumed loop claims -- lost-create-response leftovers, stale
         # epochs, copies of loops placed elsewhere, finished loops'
@@ -1117,7 +1325,7 @@ class LoopScheduler:
                 return
             self._begin_iter_span(loop, worker, epoch)
         t_create = self.tracer.now()
-        cid = rt.create(CreateOptions(
+        opts = CreateOptions(
             agent=loop.agent,
             image=self.spec.image,
             env=env,
@@ -1132,11 +1340,39 @@ class LoopScheduler:
             replace=True,
             workspace_root=workspace_root,
             worktree_git_dir=git_dir,
-        ))
+        )
+        # warm-pool checkout (docs/loop-warmpool.md): an adoptable
+        # pre-created container turns this create into a
+        # relabel/env-fixup + rename -- the expensive stages were paid
+        # at pool fill.  Any adoption failure falls back to the cold
+        # create below, transparently.
+        cid = ""
+        pool_hit = False
+        if self.warmpool is not None and worker.engine is not None:
+            entry = self.warmpool.checkout(worker.id, by=loop.agent,
+                                           epoch=epoch)
+            if entry is not None:
+                aopts = dataclasses.replace(
+                    opts, extra_labels=dict(opts.extra_labels))
+                # pool-origin marker survives adoption so volume sweeps
+                # can trace the placeholder's volumes back to it
+                aopts.extra_labels[consts.LABEL_WARMPOOL] = entry.agent
+                try:
+                    rt.adopt_pooled(entry.cid, aopts)
+                    cid = entry.cid
+                    pool_hit = True
+                except ClawkerError as e:
+                    self.warmpool.adoption_failed(entry, str(e))
+                    self._remove_cid(worker, entry.cid)
+                    log.info("loop %s: pool adoption on %s failed (%s); "
+                             "cold create", loop.agent, worker.id, e)
+        if not pool_hit:
+            cid = rt.create(opts)
         # durable before anything acts on the cid: a crash here must find
         # the container again by (deterministic name, journaled cid)
         self._journal(REC_CREATED, durable=True, agent=loop.agent,
-                      worker=worker.id, epoch=epoch, cid=cid)
+                      worker=worker.id, epoch=epoch, cid=cid,
+                      pool=pool_hit)
         with self._placement_lock:
             if loop.epoch != epoch:
                 # orphaned mid-create: the new placement owns the loop
@@ -1146,7 +1382,8 @@ class LoopScheduler:
             loop.container_id = cid
             loop.fresh_container = True
         self.tracer.child(loop.agent, loop.iteration, SPAN_CREATE,
-                          t_create, self.tracer.now(), worker=worker.id)
+                          t_create, self.tracer.now(), worker=worker.id,
+                          pool=pool_hit)
         self.on_event(loop.agent, "created", worker.id)
 
     # ----------------------------------------------------------- iteration
@@ -1478,6 +1715,7 @@ class LoopScheduler:
                 # queue hygiene: melt cancelled tickets (orphaned/stopped
                 # placements) and dispatch anything their removal unblocks
                 self.admission.sweep()
+                self._pool_tick()
                 # a loop is busy while running or orphaned (awaiting
                 # failover), or while its create/start/restart is still
                 # queued on a (possibly wedged) worker lane
@@ -1985,6 +2223,18 @@ class LoopScheduler:
         return out
 
     def cleanup(self, *, remove_containers: bool = False) -> None:
+        # the warm pool drains unconditionally (even under --keep): its
+        # members are framework plumbing, not user containers, and
+        # "zero leaked pool containers after drain" is the contract.
+        # The per-lane drain task runs AFTER queued fills; fills that
+        # complete past the flag discard their own container.
+        if self.warmpool is not None:
+            self.warmpool.begin_drain()
+            pool_futs = [self._lane(w).submit(self._drain_pool_worker, w)
+                         for w in self.warmpool.workers()
+                         if w.engine is not None]
+            if pool_futs:
+                futures_wait(pool_futs, timeout=HALT_DEADLINE_S)
         if remove_containers:
             # submit a removal for EVERY loop: it rides the same lane as
             # the loop's launch, so by the time it runs the launch has

@@ -23,22 +23,33 @@ from .redteam import run_corpus
 from .scenarios import SCENARIOS, run_all
 
 
+def default_parity_jobs() -> int:
+    """Bounded worker-pool size for the parallel parity suite."""
+    import os
+
+    return max(2, min(8, os.cpu_count() or 4))
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="python -m clawker_tpu.parity")
     ap.add_argument("--json", action="store_true", help="emit JSON scorecard")
     ap.add_argument("--workdir", help="keep scenario artifacts here")
+    ap.add_argument("--jobs", "-j", type=int, default=0,
+                    help="fan independent scenario/corpus cases across N "
+                         "worker processes (0 = auto, 1 = serial)")
     args = ap.parse_args(argv)
+    jobs = args.jobs if args.jobs > 0 else default_parity_jobs()
 
     t0 = time.monotonic()
     if args.workdir:
         base = Path(args.workdir)
         base.mkdir(parents=True, exist_ok=True)
-        rows = run_all(base)
-        red = run_corpus(base / "redteam")
+        rows = run_all(base, jobs=jobs)
+        red = run_corpus(base / "redteam", jobs=jobs)
     else:
         with tempfile.TemporaryDirectory(prefix="clawker-parity-") as td:
-            rows = run_all(Path(td))
-            red = run_corpus(Path(td) / "redteam")
+            rows = run_all(Path(td), jobs=jobs)
+            red = run_corpus(Path(td) / "redteam", jobs=jobs)
     wall_s = time.monotonic() - t0
     passed = sum(1 for r in rows if r["pass"])
     all_ok = passed == len(rows) and red["passed"] == red["total"] \

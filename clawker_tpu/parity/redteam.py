@@ -454,7 +454,8 @@ def t32(w):
         except OSError:
             out = b""
         time.sleep(0.1)
-        return f"proxy answered: {out.split(b'\r\n', 1)[0].decode('latin-1', 'replace')}"
+        status = out.split(b"\r\n", 1)[0].decode("latin-1", "replace")
+        return f"proxy answered: {status}"
     finally:
         sock.close()
 
@@ -640,14 +641,17 @@ def kernel_regrade(tag: str = "redteam-kernel") -> dict | None:
     return out
 
 
-def run_corpus(base: Path) -> dict:
-    """Drive every technique (30 reference classes + the beyond-reference
-    31+ set) through one World; grade per-technique capture counts.
-    Returns the scorecard dict (never raises)."""
-    w = build_world(base / "world")
-    results = []
+def _corpus_shard(args: tuple[list[int], str]) -> dict:
+    """Drive one shard of technique indices through its OWN World (its
+    own tmpdir subtree, DNS gate socket, attacker listeners).  Top-level
+    so a process pool can dispatch it; rows carry their original index
+    so the merged scorecard keeps corpus order."""
+    indices, base_str = args
+    w = build_world(Path(base_str))
+    rows = []
     try:
-        for name, fn in TECHNIQUES:
+        for i in indices:
+            name, fn = TECHNIQUES[i]
             w.attacker.set_technique(name)
             before = w.attacker.store.count()
             try:
@@ -660,14 +664,60 @@ def run_corpus(base: Path) -> dict:
             time.sleep(0.02)
             captured = w.attacker.store.count() - before
             ok = captured == 0 and not err
-            results.append({
+            rows.append({
+                "index": i,
                 "technique": name, "pass": ok, "captures": captured,
                 "grading": grading_of(name), "detail": err or detail,
             })
-        total_captures = w.attacker.store.count()
-        evidence = w.attacker.store.all()
+        return {"rows": rows, "captures": w.attacker.store.count(),
+                "evidence": [list(r) for r in w.attacker.store.all()]}
     finally:
         w.close()
+
+
+def corpus_shards(base: Path, jobs: int) -> list[tuple[list[int], str]]:
+    """Round-robin technique-index shards, one World subtree each;
+    every entry is a ready-to-dispatch :func:`_corpus_shard` arg."""
+    n = len(TECHNIQUES)
+    if jobs <= 1:
+        return [(list(range(n)), str(base / "world"))]
+    jobs = min(jobs, n)
+    return [(list(range(j, n, jobs)), str(base / f"world-{j}"))
+            for j in range(jobs)]
+
+
+def run_corpus(base: Path, jobs: int = 1) -> dict:
+    """Drive every technique (30 reference classes + the beyond-reference
+    31+ set) through capture-graded Worlds; per-technique capture counts.
+    Returns the scorecard dict (never raises).
+
+    ``jobs > 1`` shards the techniques round-robin across N worlds run
+    in parallel PROCESSES (each world binds only ephemeral ports and
+    owns its tmpdir subtree; the capture store stays per-world, so
+    per-technique before/after counting is exactly as isolated as the
+    serial single-world run).  The kernel regrade still runs once, in
+    the parent, over the merged rows."""
+    shards = corpus_shards(base, jobs)
+    if len(shards) == 1:
+        shard_docs = [_corpus_shard(shards[0])]
+    else:
+        import multiprocessing
+        from concurrent.futures import ProcessPoolExecutor
+
+        with ProcessPoolExecutor(
+                max_workers=len(shards),
+                mp_context=multiprocessing.get_context("fork")) as ex:
+            shard_docs = list(ex.map(_corpus_shard, shards))
+    return merge_shards(shard_docs)
+
+
+def merge_shards(shard_docs: list[dict]) -> dict:
+    """Fold shard scorecards back into corpus order and run the one
+    parent-side kernel regrade over the merged rows."""
+    results = sorted((r for doc in shard_docs for r in doc["rows"]),
+                     key=lambda r: r.pop("index"))
+    total_captures = sum(doc["captures"] for doc in shard_docs)
+    evidence = [row for doc in shard_docs for row in doc["evidence"]]
     kernel_error = ""
     try:
         kernel = kernel_regrade()

@@ -625,18 +625,45 @@ def s_wildcard_and_exact_coexist(tmp: Path) -> dict:
         w.close()
 
 
-def run_all(base: Path) -> list[dict]:
-    """Run every scenario; returns scorecard rows (never raises)."""
-    rows = []
-    for i, (name, fn) in enumerate(SCENARIOS, 1):
-        t0 = time.monotonic()
-        try:
-            evidence = fn(base / f"{i:02d}-{name}")
-            rows.append({"name": name, "pass": True,
-                         "ms": round((time.monotonic() - t0) * 1000),
-                         "evidence": evidence})
-        except Exception as e:  # noqa: BLE001 - scorecard must finish
-            rows.append({"name": name, "pass": False,
-                         "ms": round((time.monotonic() - t0) * 1000),
-                         "evidence": {"error": f"{e.__class__.__name__}: {e}"}})
-    return rows
+def _scenario_case(args: tuple[int, str]) -> dict:
+    """Run scenario ``i`` (1-based) under ``base``; one scorecard row,
+    never raises.  Top-level so a process pool can dispatch it."""
+    i, base_str = args
+    name, fn = SCENARIOS[i - 1]
+    t0 = time.monotonic()
+    try:
+        evidence = fn(Path(base_str) / f"{i:02d}-{name}")
+        return {"name": name, "pass": True,
+                "ms": round((time.monotonic() - t0) * 1000),
+                "evidence": evidence}
+    except Exception as e:  # noqa: BLE001 - scorecard must finish
+        return {"name": name, "pass": False,
+                "ms": round((time.monotonic() - t0) * 1000),
+                "evidence": {"error": f"{e.__class__.__name__}: {e}"}}
+
+
+def scenario_cases(base: Path) -> list[tuple[int, str]]:
+    """One ready-to-dispatch :func:`_scenario_case` arg per scenario."""
+    return [(i, str(base)) for i in range(1, len(SCENARIOS) + 1)]
+
+
+def run_all(base: Path, jobs: int = 1) -> list[dict]:
+    """Run every scenario; returns scorecard rows (never raises).
+
+    ``jobs > 1`` fans the independent cases across a bounded PROCESS
+    pool (BENCH_r05: 20.5s serial ``parity_suite_wall``).  Processes,
+    not threads: every case already owns its own tmpdir subtree, but
+    the control-plane cases enter a :class:`~clawker_tpu.testenv.TestEnv`
+    that swaps process-global XDG env vars -- per-process isolation
+    keeps that safe, and each case binds only ephemeral ports so
+    parallel worlds never collide."""
+    cases = scenario_cases(base)
+    if jobs <= 1:
+        return [_scenario_case(c) for c in cases]
+    import multiprocessing
+    from concurrent.futures import ProcessPoolExecutor
+
+    with ProcessPoolExecutor(
+            max_workers=min(jobs, len(cases)),
+            mp_context=multiprocessing.get_context("fork")) as ex:
+        return list(ex.map(_scenario_case, cases))

@@ -9,8 +9,11 @@ callables so this module stays below the CP layer in the import DAG.
 
 from __future__ import annotations
 
+import io
 import sys
+import tarfile
 import threading
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import BinaryIO, Callable
@@ -24,6 +27,25 @@ from . import attach as attach_mod
 from .labels import agent_labels
 from .names import container_name
 from .resolve import resolve_image
+
+# --- harness-seed staging cache (docs/loop-warmpool.md) -------------------
+# Building the harness staging tar (walk host harness state, copy into a
+# staging dir, tar it) was 3.3ms of an 8.95ms framework cold start
+# (BENCH_r05 harness_seed) and its content depends only on
+# (harness, project root, credential staging policy) -- NOT on the agent
+# or container.  Cache the finished tar bytes per key so a loop fan-out
+# (or a warm-pool fill) stages once and every create after it pays one
+# put_archive.  TTL-bounded: host harness state may change under a
+# long-lived process, and a warm pool must not serve hour-old seeds.
+_HARNESS_TAR_TTL_S = 30.0
+_harness_tar_cache: dict[tuple, tuple[float, bytes]] = {}
+_harness_tar_lock = threading.Lock()
+
+
+def clear_harness_seed_cache() -> None:
+    """Drop cached harness staging tars (tests; explicit invalidation)."""
+    with _harness_tar_lock:
+        _harness_tar_cache.clear()
 
 
 @dataclass
@@ -179,11 +201,129 @@ class AgentRuntime:
                 self.bootstrap(cid, project, opts.agent)
         return cid
 
+    # ------------------------------------------------------- pool adoption
+
+    def adopt_pooled(self, cid: str, opts: CreateOptions) -> None:
+        """Finalize a warm-pool container for a real agent placement
+        (docs/loop-warmpool.md).
+
+        The pool fill already paid the expensive create-time stages
+        (engine create, workspace seed, harness seed, identity prewarm)
+        under a placeholder agent name; adoption finalizes the
+        agent-specific surface -- labels, env, name -- in place:
+
+        - **relabel**: the full agent label set (plus ``extra_labels``,
+          e.g. the loop epoch) replaces the placeholder's, where the
+          engine supports in-place relabel; the pool-origin marker
+          (``LABEL_WARMPOOL``) survives so volume sweeps can trace the
+          placeholder's volumes.
+        - **env fixup**: create-time env is immutable, so the
+          agent-specific env lands as ``/run/clawker/agent-env``
+          (KEY=VAL lines) -- the same advisory-file channel the loop
+          scheduler already uses for per-iteration context.
+        - **identity**: the bootstrap hook re-runs under the REAL agent
+          name; with the CA session cache prewarmed this is the warm
+          path (leaf reused, only the per-container assertion JWT and
+          session key are fresh).
+        - **rename** (LAST): the deterministic agent name lands only
+          after every other fixup, so a crash mid-adoption leaves
+          either a pool-named container (swept) or a fully-finalized
+          one (continued) -- never a half-adopted name.
+
+        Raises ClawkerError subclasses on failure; the caller owns the
+        fallback to a cold create.
+        """
+        project = self.cfg.project_name()
+        name = container_name(project, opts.agent)
+        pconf = self.cfg.project
+        harness = opts.harness or (pconf.build.harness if pconf else "")
+        labels = agent_labels(
+            project, opts.agent, harness=harness,
+            worker=opts.worker, loop_id=opts.loop_id)
+        labels.update(opts.extra_labels)
+        with phases.phase("pool_adopt_env"):
+            env = self._build_env(project, opts)
+            body = "".join(f"{k}={v}\n" for k, v in sorted(env.items())).encode()
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w") as tf:
+                ti = tarfile.TarInfo("agent-env")
+                ti.size = len(body)
+                ti.mode = 0o600
+                tf.addfile(ti, io.BytesIO(body))
+            env_tar = buf.getvalue()
+        # without a bootstrap hook the whole fixup batches under ONE
+        # jail check (rename included); with one, the rename waits for
+        # the identity install so a crash mid-adoption can never leave
+        # an agent-named container without identity material
+        with phases.phase("pool_adopt_finalize"):
+            self._finalize_replacing(
+                cid, name, opts.replace, labels=labels,
+                archive_path=consts.RUN_STATE_DIR, archive=env_tar,
+                new_name="" if self.bootstrap else name)
+        if self.bootstrap:
+            with phases.phase("identity_bootstrap"):
+                self.bootstrap(cid, project, opts.agent)
+            with phases.phase("pool_adopt_rename"):
+                self._rename_replacing(cid, name, opts.replace)
+
+    def _finalize_replacing(self, cid: str, name: str, replace: bool,
+                            **kw) -> None:
+        """finalize_adoption with replace-on-conflict semantics: the
+        conflict path (a leftover same-name container) pays the extra
+        remove, the common path pays nothing."""
+        try:
+            self.engine.finalize_adoption(cid, **kw)
+        except ConflictError:
+            if not replace:
+                raise
+            self.engine.remove_container(name, force=True, volumes=False)
+            self.engine.finalize_adoption(cid, **kw)
+
+    def _rename_replacing(self, cid: str, name: str, replace: bool) -> None:
+        try:
+            self.engine.rename_container(cid, name)
+        except ConflictError:
+            if not replace:
+                raise
+            self.engine.remove_container(name, force=True, volumes=False)
+            self.engine.rename_container(cid, name)
+
     def _seed_harness_config(self, cid: str, harness: str, root: Path) -> None:
         """Stage host harness state into the config volume per the harness
         bundle's staging manifest (containerfs; reference
         container_create.go:1907 initConfigVolume).  A host with zero
-        harness state, or no staging manifest, degrades to a no-op."""
+        harness state, or no staging manifest, degrades to a no-op.
+        The staging tar is built once per (harness, root, credentials)
+        and reused (see the module cache above)."""
+        tar = self.harness_seed_tar(harness, root)
+        if tar:
+            self.engine.put_archive(cid, consts.CONTAINER_HOME, tar)
+
+    def harness_seed_tar(self, harness: str, root: Path) -> bytes:
+        """The staging tar for (harness, root, credential policy), built
+        once and served from the TTL-bounded module cache afterwards --
+        a warm-pool fill's own seed pays this cost off the hot path for
+        every later create on the worker.  Returns b"" when the harness
+        has nothing to stage."""
+        stage_creds = self.cfg.settings.credentials.stage
+        key = (harness or "claude", str(root), bool(stage_creds),
+               consts.CONTAINER_HOME, consts.WORKSPACE_DIR)
+        now = time.monotonic()
+        with _harness_tar_lock:
+            hit = _harness_tar_cache.get(key)
+            if hit is not None and now - hit[0] < _HARNESS_TAR_TTL_S:
+                phases.incr("harness_seed.tar_cache_hit")
+                return hit[1]
+        phases.incr("harness_seed.tar_cache_miss")
+        tar = self._build_harness_seed_tar(harness, root, stage_creds)
+        with _harness_tar_lock:
+            if len(_harness_tar_cache) > 64:
+                _harness_tar_cache.clear()
+            _harness_tar_cache[key] = (now, tar)
+        return tar
+
+    def _build_harness_seed_tar(self, harness: str, root: Path,
+                                stage_creds: bool) -> bytes:
         from .. import containerfs
         from ..bundle.resolver import Resolver
         from ..errors import NotFoundError
@@ -191,11 +331,10 @@ class AgentRuntime:
         try:
             h = Resolver(self.cfg).harness(harness or "claude")
         except NotFoundError:
-            return
+            return b""
         staging = containerfs.Staging.from_raw(h.staging)
-        stage_creds = self.cfg.settings.credentials.stage
         if not staging.copy and not (stage_creds and staging.credentials):
-            return
+            return b""
         sdir, cleanup = containerfs.prepare_config(
             staging,
             container_home=consts.CONTAINER_HOME,
@@ -204,9 +343,7 @@ class AgentRuntime:
             include_credentials=stage_creds,
         )
         try:
-            tar = containerfs.staging_tar(sdir)
-            if tar:
-                self.engine.put_archive(cid, consts.CONTAINER_HOME, tar)
+            return containerfs.staging_tar(sdir)
         finally:
             cleanup()
 

@@ -133,6 +133,31 @@ def test_session_headers_carry_identity():
         s.close()
 
 
+def test_session_server_binds_private_unix_socket_not_tcp():
+    """ADVICE r5 regression: the session gRPC server must not listen on
+    loopback TCP (any local user could read build secrets / drive the
+    ssh-agent forwarder).  It binds a unix socket whose parent dir is a
+    fresh 0700 tmpdir, and the dir is removed at close."""
+    import os
+    import stat
+
+    s = B.Session(B.SessionServices(secrets={"t": b"x"}))
+    try:
+        assert not hasattr(s, "_port")      # the TCP port attr is GONE
+        st_dir = os.stat(s._sock_dir)
+        assert stat.S_IMODE(st_dir.st_mode) == 0o700
+        st_sock = os.stat(s.socket_path)
+        assert stat.S_ISSOCK(st_sock.st_mode)
+        # the socket actually serves: a raw unix connect succeeds
+        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        probe.connect(s.socket_path)
+        probe.close()
+        sock_dir = s._sock_dir
+    finally:
+        s.close()
+    assert not os.path.exists(sock_dir)
+
+
 def test_secret_round_trip_over_hijack_bridge(wired):
     ch, _ = wired(B.SessionServices(secrets={"apitoken": b"s3cr3t-bytes"}))
     resp = _unary(ch, B.SECRETS_GET, B._field_bytes(1, b"apitoken"))

@@ -510,7 +510,8 @@ def test_resume_dead_worker_flows_into_failover(env):
     sched.orphan_grace_s = 10.0
     summary = sched.reconcile()
     assert summary == {"adopted": 0, "continued": 0, "relaunched": 0,
-                       "exits_accounted": 0, "ghosts": 0, "orphaned": 0}
+                       "exits_accounted": 0, "ghosts": 0, "orphaned": 0,
+                       "pool_restored": 0}
     loops = sched.run(poll_s=0.05)
     assert loops[0].status == "done" and loops[0].iteration == 2
     assert loops[0].worker.id == "fake-0"
@@ -544,6 +545,215 @@ def test_resume_after_clean_drain_continues_budget(env):
     for l in loops:
         assert l.status == "done"
         assert l.iteration == 3 and len(l.exit_codes) == 3
+    sched2.cleanup(remove_containers=True)
+
+
+# ----------------------------------------------- warm-pool crash seams
+
+
+def pool_container_labels(loop_id: str, pool_agent: str) -> dict:
+    return {consts.LABEL_MANAGED: consts.MANAGED_VALUE,
+            consts.LABEL_LOOP: loop_id,
+            consts.LABEL_LOOP_EPOCH: consts.POOL_EPOCH,
+            consts.LABEL_WARMPOOL: pool_agent}
+
+
+def test_resume_restores_pool_members_after_kill(env):
+    """kill mid-run with filled pools: --resume restores every
+    journaled-ready member that is still `created` back into the pool
+    -- pure bookkeeping, zero engine mutations, zero duplicate creates
+    -- and drains them all at cleanup (no leaks)."""
+    tenv, proj, cfg = env
+    hold = threading.Event()
+    drv = driver_with(2, behavior=hold_behavior(hold))
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=1,
+                                              warm_pool_depth=1))
+    sched1.start()
+    t = threading.Thread(target=sched1.run, kwargs={"poll_s": 0.05},
+                         daemon=True)
+    t.start()
+    assert wait_for(lambda: all(l.status == "running" for l in sched1.loops))
+    assert wait_for(lambda: all(
+        sched1.warmpool.depth_of(w.id) == 1 for w in drv.workers()))
+    sched1.kill()
+    t.join(10.0)
+    image = replay(journal_of(cfg, sched1))
+    ready = [m for m in image.pool.values() if m.state == "ready"]
+    assert len(ready) == 2                 # the WAL captured both fills
+    creates_at_kill = total_creates(drv)
+
+    sched2 = resume_from(cfg, drv, sched1)
+    summary = sched2.reconcile()
+    assert summary["adopted"] == 2
+    assert summary["pool_restored"] == 2, summary
+    assert summary["ghosts"] == 0
+    assert total_creates(drv) == creates_at_kill   # bookkeeping only
+    assert all(sched2.warmpool.depth_of(w.id) == 1 for w in drv.workers())
+    t2 = threading.Thread(target=sched2.run, kwargs={"poll_s": 0.05},
+                          daemon=True)
+    t2.start()
+    time.sleep(0.2)
+    hold.set()
+    t2.join(15.0)
+    assert not t2.is_alive()
+    assert all(l.status == "done" for l in sched2.loops)
+    sched2.cleanup(remove_containers=True)
+    leaked = [c for api in drv.apis for c in api.containers.values()
+              if (c.config.get("Labels") or {}).get(consts.LABEL_LOOP)
+              == sched1.loop_id]
+    assert leaked == []
+
+
+def test_resume_restores_member_from_midrefill_kill(env):
+    """crash point: mid-refill -- the create reached the daemon but the
+    scheduler died before journaling pool_ready.  The pending member's
+    container is found `created` under its deterministic pool name and
+    restored; the relaunched placement then ADOPTS it (zero creates for
+    the agent, the member consumed exactly once)."""
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=1,
+                                              warm_pool_depth=1))
+    agent = f"loop-{sched1.loop_id[:6]}-0"
+    pool_agent = f"pool-{sched1.loop_id[:6]}-p1"
+    sched1._journal("run", run=sched1.loop_id, project="loopproj",
+                    spec=sched1._spec_doc(),
+                    workers=[w.id for w in drv.workers()])
+    sched1._journal("placement", agent=agent, worker="fake-0", epoch=0)
+    sched1._journal("pool_add", agent=pool_agent, worker="fake-0")
+    cid = drv.apis[0].add_container(
+        f"clawker.loopproj.{pool_agent}", image=IMAGE,
+        labels=pool_container_labels(sched1.loop_id, pool_agent),
+        state="created")
+    sched1.journal.sync()
+    sched1.kill()
+
+    sched2 = resume_from(cfg, drv, sched1)
+    summary = sched2.reconcile()
+    assert summary["pool_restored"] == 1, summary
+    assert summary["relaunched"] == 1 and summary["ghosts"] == 0
+    loops = sched2.run(poll_s=0.05)
+    assert loops[0].status == "done" and loops[0].iteration == 1
+    # the relaunch adopted the restored member: the daemon never saw a
+    # create for the agent, nor a second one for the restored member
+    # (the run tick MAY refill the pool with a fresh create)
+    names = [a[0] for a, _k in drv.apis[0].calls_named("container_create")]
+    assert names.count(f"clawker.loopproj.{agent}") == 0
+    assert names.count(f"clawker.loopproj.{pool_agent}") == 0
+    assert drv.apis[0].containers[cid].name == f"clawker.loopproj.{agent}"
+    assert sched2.warmpool.stats()["hits"] == 1
+    sched2.cleanup(remove_containers=True)
+
+
+def test_resume_sweeps_half_adopted_pool_member(env):
+    """crash point: mid-adoption -- pool_adopt journaled, the finalize
+    fixups died before the rename.  The member is consumed (never
+    handed out again); its half-finalized container is swept as a ghost
+    exactly once, counted in loop_ghosts_swept_total, and the placement
+    relaunches cold with exactly one create."""
+    from clawker_tpu.loop.scheduler import _GHOSTS
+
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=1,
+                                              warm_pool_depth=1))
+    agent = f"loop-{sched1.loop_id[:6]}-0"
+    pool_agent = f"pool-{sched1.loop_id[:6]}-p1"
+    sched1._journal("run", run=sched1.loop_id, project="loopproj",
+                    spec=sched1._spec_doc(),
+                    workers=[w.id for w in drv.workers()])
+    sched1._journal("placement", agent=agent, worker="fake-0", epoch=0)
+    sched1._journal("pool_add", agent=pool_agent, worker="fake-0")
+    cid = drv.apis[0].add_container(
+        f"clawker.loopproj.{pool_agent}", image=IMAGE,
+        labels=pool_container_labels(sched1.loop_id, pool_agent),
+        state="created")
+    sched1._journal("pool_ready", agent=pool_agent, worker="fake-0", cid=cid)
+    sched1._journal("pool_adopt", agent=pool_agent, worker="fake-0",
+                    cid=cid, by=agent, epoch=0)
+    sched1.journal.sync()
+    sched1.kill()
+    n_records_at_kill = len(journal_of(cfg, sched1))
+
+    ghosts_before = _GHOSTS.labels("fake-0").peek()
+    sched2 = resume_from(cfg, drv, sched1)
+    summary = sched2.reconcile()
+    assert summary["ghosts"] == 1 and summary["pool_restored"] == 0, summary
+    assert summary["relaunched"] == 1
+    assert cid not in drv.apis[0].containers       # swept, exactly once
+    assert _GHOSTS.labels("fake-0").peek() == ghosts_before + 1
+    loops = sched2.run(poll_s=0.05)
+    assert loops[0].status == "done" and loops[0].iteration == 1
+    # the agent's cold create ran exactly once, and the consumed
+    # member's cid never re-entered the pool (pool_ready for a NEW fill
+    # may reuse the name -- never the swept container)
+    names = [a[0] for a, _k in drv.apis[0].calls_named("container_create")]
+    assert names.count(f"clawker.loopproj.{agent}") == 1
+    assert not any(r["kind"] == "pool_ready" and r.get("cid") == cid
+                   for r in journal_of(cfg, sched2)[n_records_at_kill:])
+    sched2.cleanup(remove_containers=True)
+
+
+def test_resume_sweeps_stale_pool_member_started(env):
+    """A journaled-ready member whose container is no longer `created`
+    (someone started it while the scheduler was dead) is stale: never
+    restored, journaled pool_remove, swept as a ghost and counted in
+    loop_ghosts_swept_total like every other stale-epoch leftover."""
+    from clawker_tpu.loop.journal import REC_POOL_REMOVE
+    from clawker_tpu.loop.scheduler import _GHOSTS
+
+    tenv, proj, cfg = env
+    hold = threading.Event()
+    drv = driver_with(1, behavior=hold_behavior(hold))
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=1,
+                                              warm_pool_depth=1))
+    pool_agent = f"pool-{sched1.loop_id[:6]}-p1"
+    sched1._journal("run", run=sched1.loop_id, project="loopproj",
+                    spec=sched1._spec_doc(),
+                    workers=[w.id for w in drv.workers()])
+    sched1._journal("pool_add", agent=pool_agent, worker="fake-0")
+    cid = drv.apis[0].add_container(
+        f"clawker.loopproj.{pool_agent}", image=IMAGE,
+        labels=pool_container_labels(sched1.loop_id, pool_agent),
+        state="running")
+    sched1._journal("pool_ready", agent=pool_agent, worker="fake-0", cid=cid)
+    sched1.journal.sync()
+    sched1.kill()
+    hold.set()
+
+    ghosts_before = _GHOSTS.labels("fake-0").peek()
+    sched2 = resume_from(cfg, drv, sched1)
+    summary = sched2.reconcile()
+    assert summary["pool_restored"] == 0 and summary["ghosts"] == 1, summary
+    assert cid not in drv.apis[0].containers
+    assert _GHOSTS.labels("fake-0").peek() == ghosts_before + 1
+    assert any(r["kind"] == REC_POOL_REMOVE
+               and r.get("reason") == "stale at resume"
+               for r in journal_of(cfg, sched2))
+    sched2.run(poll_s=0.05)
+    sched2.cleanup(remove_containers=True)
+
+
+def test_resume_pending_pool_member_never_created_is_noop(env):
+    """crash point: post-pool_add / pre-create.  The WAL has the
+    reservation, the daemon has nothing: resume neither restores nor
+    sweeps anything for it."""
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched1 = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=1,
+                                              warm_pool_depth=1))
+    sched1._journal("run", run=sched1.loop_id, project="loopproj",
+                    spec=sched1._spec_doc(),
+                    workers=[w.id for w in drv.workers()])
+    sched1._journal("pool_add", agent=f"pool-{sched1.loop_id[:6]}-p1",
+                    worker="fake-0")
+    sched1.journal.sync()
+    sched1.kill()
+
+    sched2 = resume_from(cfg, drv, sched1)
+    summary = sched2.reconcile()
+    assert summary["pool_restored"] == 0 and summary["ghosts"] == 0, summary
+    sched2.run(poll_s=0.05)
     sched2.cleanup(remove_containers=True)
 
 

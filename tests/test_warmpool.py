@@ -1,0 +1,371 @@
+"""Warm-pool suite (ISSUE 7 tentpole): pool bookkeeping, scheduler
+adoption with transparent cold-create fallback, refill health gating,
+drain hygiene, journal folding, and the `clawker fleet warmpool` view.
+
+Crash seams (kill mid-refill / mid-adoption + --resume) live in
+tests/test_loop_resume.py next to the rest of the resume torture suite.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.config import load_config
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.errors import ClawkerError
+from clawker_tpu.health import BREAKER_CLOSED, BREAKER_OPEN
+from clawker_tpu.loop import LoopScheduler, LoopSpec
+from clawker_tpu.loop.journal import (
+    REC_POOL_ADD,
+    REC_POOL_ADOPT,
+    REC_POOL_READY,
+    REC_POOL_REMOVE,
+    RunJournal,
+    replay,
+)
+from clawker_tpu.loop.warmpool import POOL_TENANT, WarmPool
+from clawker_tpu.runtime.names import container_name
+from clawker_tpu.testenv import TestEnv
+
+IMAGE = "clawker-loopproj:default"
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: loopproj\n")
+        cfg = load_config(proj)
+        yield tenv, proj, cfg
+
+
+def driver_with(n_workers: int, behavior=None):
+    drv = FakeDriver(n_workers=n_workers)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, behavior or exit_behavior(b"iter done\n", 0))
+    return drv
+
+
+def wait_for(pred, timeout=10.0, interval=0.01) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def run_containers(drv, loop_id):
+    return [c for api in drv.apis for c in api.containers.values()
+            if (c.config.get("Labels") or {}).get(consts.LABEL_LOOP)
+            == loop_id]
+
+
+# ------------------------------------------------------------ bookkeeping
+
+
+def test_pool_bookkeeping_roundtrip():
+    journaled = []
+    pool = WarmPool("abcdef123", depth=2,
+                    journal=lambda kind, **f: journaled.append((kind, f)))
+    w = FakeDriver().workers()[0]
+    # reserve up to depth, then refuse
+    a1 = pool.begin_refill(w)
+    a2 = pool.begin_refill(w)
+    assert a1 and a2 and a1 != a2
+    assert pool.begin_refill(w) is None
+    assert pool.want(w.id) == 0            # both reservations in flight
+    assert pool.fill_done(w, a1, "cid-1")
+    assert pool.fill_done(w, a2, "cid-2")
+    assert pool.depth_of(w.id) == 2
+    # checkout pops oldest-first and journals the adoption write-ahead
+    e = pool.checkout(w.id, by="loop-x-0", epoch=0)
+    assert e.cid == "cid-1" and pool.depth_of(w.id) == 1
+    assert pool.checkout(w.id, by="loop-x-1", epoch=0).cid == "cid-2"
+    assert pool.checkout(w.id, by="loop-x-2", epoch=0) is None   # miss
+    kinds = [k for k, _f in journaled]
+    assert kinds == [REC_POOL_ADD, REC_POOL_ADD, REC_POOL_READY,
+                     REC_POOL_READY, REC_POOL_ADOPT, REC_POOL_ADOPT]
+    s = pool.stats()
+    assert s["hits"] == 2 and s["misses"] == 1 and s["refills"] == 2
+
+
+def test_fill_completing_after_drain_is_discarded():
+    pool = WarmPool("abcdef123", depth=1)
+    w = FakeDriver().workers()[0]
+    agent = pool.begin_refill(w)
+    pool.begin_drain()
+    # the create finished on the lane after drain began: caller must
+    # remove the container itself
+    assert pool.fill_done(w, agent, "cid-late") is False
+    assert pool.depth_of(w.id) == 0
+    assert pool.begin_refill(w) is None
+
+
+def test_failed_fill_releases_reservation():
+    pool = WarmPool("abcdef123", depth=1)
+    w = FakeDriver().workers()[0]
+    agent = pool.begin_refill(w)
+    assert pool.fill_done(w, agent, None, "engine exploded") is True
+    assert pool.depth_of(w.id) == 0
+    assert pool.want(w.id) == 1            # slot freed for the next tick
+
+
+def test_restore_refuses_past_target_depth():
+    pool = WarmPool("abcdef123", depth=1)
+    w = FakeDriver().workers()[0]
+    assert pool.restore(w, "pool-abc-p1", "cid-1")
+    assert not pool.restore(w, "pool-abc-p2", "cid-2")   # caller sweeps
+    assert pool.depth_of(w.id) == 1
+
+
+def test_take_expired_recycles_members():
+    now = [100.0]
+    pool = WarmPool("abcdef123", depth=2, max_age_s=10.0,
+                    clock=lambda: now[0])
+    w = FakeDriver().workers()[0]
+    for cid in ("cid-1", "cid-2"):
+        agent = pool.begin_refill(w)
+        pool.fill_done(w, agent, cid)
+    now[0] += 5.0
+    assert pool.take_expired() == []
+    now[0] += 6.0
+    expired = pool.take_expired()
+    assert sorted(e.cid for e in expired) == ["cid-1", "cid-2"]
+    assert pool.depth_of(w.id) == 0
+    assert pool.stats()["recycled"] == 2
+
+
+# ------------------------------------------------------- scheduler adoption
+
+
+def test_scheduler_pool_hit_adopts_and_finalizes(env):
+    """Prefilled pool: every placement adopts (hits == loops, zero
+    misses), adopted containers end up under the REAL agent name with
+    the agent's labels plus the pool-origin marker, and got the env
+    fixup archive instead of create-time env."""
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched = LoopScheduler(
+        cfg, drv, LoopSpec(parallel=2, iterations=1, warm_pool_depth=2))
+    assert sched.prefill_pool(timeout=5.0) == 2
+    api = drv.apis[0]
+    creates_prefill = len(api.calls_named("container_create"))
+    assert creates_prefill == 2
+    sched.start()
+    loops = sched.run(poll_s=0.05)
+    assert all(l.status == "done" and l.iteration == 1 for l in loops)
+    stats = sched.warmpool.stats()
+    assert stats["hits"] == 2 and stats["misses"] == 0
+    # adopted containers carry the real agent name + labels, and the
+    # pool-origin marker survives adoption
+    for l in sched.loops:
+        c = api.containers[l.container_id]
+        assert c.name == container_name("loopproj", l.agent)
+        labels = c.config["Labels"]
+        assert labels[consts.LABEL_AGENT] == l.agent
+        assert labels[consts.LABEL_WARMPOOL].startswith("pool-")
+        assert labels[consts.LABEL_LOOP_EPOCH] == "0"
+    # the agent-specific env landed as the advisory fixup file
+    fixups = [a for a, _k in api.calls_named("put_archive")
+              if a[1] == consts.RUN_STATE_DIR]
+    assert len(fixups) >= 2
+    sched.cleanup(remove_containers=True)
+    assert run_containers(drv, sched.loop_id) == []
+
+
+def test_scheduler_refills_back_to_depth_during_run(env):
+    """Checked-out members are replaced by the run-thread tick; drain
+    at cleanup leaves zero pool containers even under --keep."""
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    sched = LoopScheduler(
+        cfg, drv, LoopSpec(parallel=4, iterations=1, warm_pool_depth=1))
+    sched.start()
+    sched.run(poll_s=0.05)
+    assert all(sched.warmpool.depth_of(w.id) == 1 for w in drv.workers())
+    sched.cleanup()                       # --keep shape: containers stay
+    # ...but pool members are framework plumbing: always drained
+    leftover = [c for c in run_containers(drv, sched.loop_id)
+                if consts.LABEL_WARMPOOL in (c.config.get("Labels") or {})
+                and c.state == "created"]
+    assert leftover == []
+    assert sched.warmpool.draining
+
+
+def test_adoption_failure_falls_back_to_cold_create(env, monkeypatch):
+    from clawker_tpu.runtime.orchestrate import AgentRuntime
+
+    def boom(self, cid, opts):
+        raise ClawkerError("injected: adoption fixup failed")
+
+    monkeypatch.setattr(AgentRuntime, "adopt_pooled", boom)
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched = LoopScheduler(
+        cfg, drv, LoopSpec(parallel=1, iterations=1, warm_pool_depth=1))
+    assert sched.prefill_pool(timeout=5.0) == 1
+    sched.start()
+    loops = sched.run(poll_s=0.05)
+    assert loops[0].status == "done" and loops[0].iteration == 1
+    stats = sched.warmpool.stats()
+    assert stats["hits"] == 1             # checkout happened...
+    assert stats["recycled"] >= 1         # ...the member was recycled...
+    agent_name = container_name("loopproj", sched.loops[0].agent)
+    names = [a[0] for a, _k in drv.apis[0].calls_named("container_create")]
+    assert names.count(agent_name) == 1   # ...and the cold create ran
+    sched.cleanup(remove_containers=True)
+    assert run_containers(drv, sched.loop_id) == []
+
+
+def test_refill_skips_open_breaker_worker(env):
+    """The tick never fills a quarantined worker's pool: a dead daemon
+    must not eat refill creates (probes own the recovery signal)."""
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    sched = LoopScheduler(
+        cfg, drv, LoopSpec(parallel=1, iterations=1, warm_pool_depth=1))
+
+    class HealthStub:
+        def state(self, worker_id):
+            return BREAKER_OPEN if worker_id == "fake-1" else BREAKER_CLOSED
+
+    sched.health = HealthStub()
+    sched._pool_tick()
+    assert wait_for(lambda: sched.warmpool.depth_of("fake-0") == 1)
+    time.sleep(0.1)
+    assert sched.warmpool.depth_of("fake-1") == 0
+    sched.cleanup(remove_containers=True)
+
+
+def test_refill_admission_rejection_stops_tick(env):
+    """A saturated admission pending queue rejects refills synchronously.
+    The tick must stop refilling that worker until the next tick --
+    fill_done releases the reservation, so retrying inside the tick's
+    want() loop would spin durable journal records (one fsynced
+    REC_POOL_ADD per attempt) on the run thread forever."""
+    from clawker_tpu.placement.admission import ADMISSION_REJECTED
+
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched = LoopScheduler(
+        cfg, drv, LoopSpec(parallel=1, iterations=1, warm_pool_depth=3))
+    rejections = []
+
+    class SaturatedAdmission:
+        def submit(self, worker_id, tenant, dispatch, *,
+                   cancelled=None, on_cancel=None):
+            rejections.append(worker_id)
+            return ADMISSION_REJECTED
+
+    sched.admission = SaturatedAdmission()
+    sched._pool_tick()
+    # one reservation attempted and released, not depth (3) or a spin
+    assert rejections == ["fake-0"]
+    assert sched.warmpool.depth_of("fake-0") == 0
+    assert sched.warmpool.stats()["workers"]["fake-0"]["inflight"] == 0
+    adds = [r for r in RunJournal.read(sched.journal.path)
+            if r.get("kind") == REC_POOL_ADD]
+    assert len(adds) == 1
+
+
+def test_pool_disabled_with_worktrees(env):
+    """A pool member's mounts are staged before the adopting agent's
+    worktree exists: --worktrees runs keep the cold path."""
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched = LoopScheduler(cfg, drv, LoopSpec(
+        parallel=1, iterations=1, warm_pool_depth=2, worktrees=True))
+    assert sched.warmpool is None
+
+
+# ------------------------------------------------------------- journal fold
+
+
+def test_journal_pool_records_fold_into_pool_image(tmp_path):
+    j = RunJournal(tmp_path / "x.journal")
+    j.append("run", run="r1", project="p", spec={}, workers=["w0"])
+    j.append(REC_POOL_ADD, agent="pool-r1-p1", worker="w0")
+    j.append(REC_POOL_ADD, agent="pool-r1-p2", worker="w0")
+    j.append(REC_POOL_ADD, agent="pool-r1-p3", worker="w0")
+    j.append(REC_POOL_READY, agent="pool-r1-p1", worker="w0", cid="c1")
+    j.append(REC_POOL_READY, agent="pool-r1-p2", worker="w0", cid="c2")
+    j.append(REC_POOL_ADOPT, agent="pool-r1-p2", worker="w0", cid="c2",
+             by="loop-r1-0", epoch=0)
+    j.append(REC_POOL_REMOVE, agent="pool-r1-p1", worker="w0", cid="c1",
+             reason="expired")
+    j.close()
+    img = replay(RunJournal.read(j.path))
+    assert img.pool["pool-r1-p1"].state == "removed"
+    adopted = img.pool["pool-r1-p2"]
+    assert adopted.state == "adopted" and adopted.adopted_by == "loop-r1-0"
+    pending = img.pool["pool-r1-p3"]
+    assert pending.state == "pending" and pending.cid == ""
+    # placeholder agents never materialize as loops
+    assert not any(a.startswith("pool-") for a in img.loops)
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def test_fleet_warmpool_cli_journal_view(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched = LoopScheduler(
+        cfg, drv, LoopSpec(parallel=1, iterations=1, warm_pool_depth=1))
+    sched.prefill_pool(timeout=5.0)
+    sched.start()
+    sched.run(poll_s=0.05)
+    sched.cleanup(remove_containers=True)
+
+    res = CliRunner().invoke(
+        cli, ["fleet", "warmpool", "--run", sched.loop_id[:6],
+              "--format", "json"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    doc = json.loads(res.output)
+    assert doc["run"] == sched.loop_id
+    assert doc["settings"]["depth"] == 2      # defaults echoed
+    states = {m["state"] for m in doc["members"]}
+    assert states <= {"adopted", "removed"}   # clean drain leaves no ready
+    assert any(m["state"] == "adopted" and m["adopted_by"]
+               for m in doc["members"])
+
+
+def test_fleet_warmpool_cli_settings_table(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    res = CliRunner().invoke(
+        cli, ["fleet", "warmpool"],
+        obj=Factory(cwd=proj, driver=FakeDriver()), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert "warm-pool: enable=False depth=2" in res.output
+
+
+def test_pool_tenant_registered_low_weight(env):
+    """Refills bill the dedicated low-weight admission tenant, so the
+    WFQ hands real placements a contended worker's tokens first."""
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched = LoopScheduler(
+        cfg, drv, LoopSpec(parallel=1, iterations=1, warm_pool_depth=1))
+    assert sched.warmpool.tenant == POOL_TENANT
+    tenants = sched.admission.stats()["tenants"]
+    assert tenants[POOL_TENANT]["weight"] < 1.0
