@@ -3,8 +3,8 @@
 
 PY ?= python
 
-.PHONY: all test test-fast test-e2e parity bench bench-smoke native \
-        ebpf-check docs docs-check adversarial graft clean
+.PHONY: all test test-fast test-e2e parity bench bench-smoke chaos-smoke \
+        native ebpf-check docs docs-check adversarial graft clean
 
 all: native test
 
@@ -31,7 +31,13 @@ bench:
 # provision wall vs serial) under a hard timeout -- regressions in the
 # concurrent control plane fail in-repo, not in the next bench round.
 bench-smoke:
-	timeout -k 10 300 $(PY) scripts/bench_smoke.py
+	timeout -k 10 600 $(PY) scripts/bench_smoke.py
+
+# Just the fixed-seed chaos soak gate (25 compound-fault scenarios,
+# zero invariant violations; docs/chaos.md) -- the fast robustness
+# regression check for scheduler/journal/admission/warm-pool changes.
+chaos-smoke:
+	timeout -k 10 420 $(PY) scripts/bench_smoke.py --only chaos
 
 native:
 	$(MAKE) -C native

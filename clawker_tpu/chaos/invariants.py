@@ -1,0 +1,187 @@
+"""Post-scenario fleet invariants: engine vs journal vs telemetry.
+
+Each invariant cross-audits two independent records of the same run --
+what the (fake) daemons actually executed (call recorder + live
+container tables), what the write-ahead journal claims happened
+(``replay()``), and what telemetry observed (flight-recorder span
+trees, admission/gate high-water marks).  A violation means the
+robustness composition (breakers + journal/resume + admission + warm
+pools) lost track of reality under the injected faults -- exactly the
+class of bug no single-layer test catches.
+
+Invariant catalogue (names are the strings violations are prefixed
+with; docs/chaos.md#invariants):
+
+- ``terminal-accounting``: every (run, slot) loop ends in exactly one
+  terminal state (done|failed|stopped), and the journal's last word per
+  agent agrees with the scheduler's.
+- ``exit-accounted-once``: no (agent, iteration) exit is journaled
+  twice -- the double-accounting a kill/resume cycle must never cause.
+- ``duplicate-create``: per worker daemon, container creates for one
+  agent name never exceed that agent's journaled placements onto the
+  worker (pool members: their journaled refills) -- every real create
+  has a write-ahead record that authorized it.
+- ``leaked-container``: after cleanup, no daemon holds ANY container
+  labeled with the run id (warm-pool members included).
+- ``admission-cap``: no worker daemon ever saw more concurrent
+  create/start calls than the admission token bucket allows (gate
+  high-water mark, measured daemon-side).
+- ``spurious-quarantine``: a worker the plan never faulted ends with a
+  CLOSED breaker -- faults must not splash onto healthy workers.
+- ``span-tree``: the flight record parses, and (for scenarios without
+  CLI kills) every span tree is rooted at a terminally-statused
+  iteration root.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from .. import consts
+from ..health import BREAKER_CLOSED
+
+TERMINAL_STATUSES = ("done", "failed", "stopped")
+
+
+def check_invariants(driver, cfg, run_id: str, *, loops=None,
+                     cap: int = 0, unfaulted: set[str] | None = None,
+                     health=None, kills: int = 0) -> list[str]:
+    """Audit one finished scenario; returns human-readable violations
+    (empty list = all invariants hold).
+
+    ``driver`` must be a :class:`~...engine.drivers.FakeDriver` (the
+    call recorder and fault gates are the daemon-side evidence).
+    ``loops`` are the FINAL generation's AgentLoop objects; ``cap`` the
+    admission ``max_inflight_per_worker`` (0 skips the cap audit);
+    ``unfaulted`` the worker ids the plan never touched; ``kills`` how
+    many CLI SIGKILLs the scenario injected (crashed generations
+    legitimately lose un-flushed spans, so the span audit loosens).
+    """
+    from ..loop.journal import (
+        REC_EXITED,
+        REC_LOOP_END,
+        REC_PLACEMENT,
+        REC_POOL_ADD,
+        RunJournal,
+        journal_path,
+        replay,
+    )
+    from ..monitor.ledger import flight_path
+    from ..runtime.names import container_name
+    from ..telemetry.spans import SPAN_ITERATION, build_trees, load_spans
+
+    violations: list[str] = []
+    records = RunJournal.read(journal_path(cfg.logs_dir, run_id))
+    image = replay(records)
+    project = cfg.project_name()
+    loops = list(loops or [])
+
+    # --- terminal-accounting: scheduler statuses x journal last word
+    for loop in loops:
+        if loop.status not in TERMINAL_STATUSES:
+            violations.append(
+                f"terminal-accounting: loop {loop.agent} ended "
+                f"{loop.status!r}, not a terminal state")
+    by_agent_end: dict[str, list[str]] = {}
+    for rec in records:
+        if rec.get("kind") == REC_LOOP_END:
+            by_agent_end.setdefault(str(rec.get("agent", "")), []).append(
+                str(rec.get("status", "")))
+    for loop in loops:
+        ends = by_agent_end.get(loop.agent, [])
+        if loop.status in TERMINAL_STATUSES and ends and \
+                ends[-1] != loop.status:
+            violations.append(
+                f"terminal-accounting: journal says {loop.agent} ended "
+                f"{ends[-1]!r} but the scheduler says {loop.status!r}")
+
+    # --- exit-accounted-once: no (agent, iteration) journaled twice
+    seen_exits: dict[tuple[str, int], int] = {}
+    for rec in records:
+        if rec.get("kind") == REC_EXITED:
+            key = (str(rec.get("agent", "")), int(rec.get("iteration", -1)))
+            seen_exits[key] = seen_exits.get(key, 0) + 1
+    for (agent, iteration), n in sorted(seen_exits.items()):
+        if n > 1:
+            violations.append(
+                f"exit-accounted-once: {agent} iteration {iteration} "
+                f"accounted {n} times")
+
+    # --- duplicate-create: daemon-side creates vs write-ahead records
+    placements: dict[tuple[str, str], int] = {}   # (agent, worker) -> n
+    for rec in records:
+        if rec.get("kind") == REC_PLACEMENT:
+            key = (str(rec.get("agent", "")), str(rec.get("worker", "")))
+            placements[key] = placements.get(key, 0) + 1
+        elif rec.get("kind") == REC_POOL_ADD:
+            key = (str(rec.get("agent", "")), str(rec.get("worker", "")))
+            placements[key] = placements.get(key, 0) + 1
+    name_to_agent = {}
+    for (agent, _w) in placements:
+        name_to_agent[container_name(project, agent)] = agent
+    for worker, api in zip(driver.workers(), driver.apis):
+        creates: dict[str, int] = {}
+        for (args, _kw) in api.calls_named("container_create"):
+            cname = str(args[0]) if args else ""
+            creates[cname] = creates.get(cname, 0) + 1
+        for cname, n in sorted(creates.items()):
+            agent = name_to_agent.get(cname)
+            if agent is None:
+                continue        # not this run's container
+            allowed = placements.get((agent, worker.id), 0)
+            if n > allowed:
+                violations.append(
+                    f"duplicate-create: {worker.id} executed {n} creates "
+                    f"for {agent} but only {allowed} journaled "
+                    "placement(s) authorized one")
+
+    # --- leaked-container: nothing labeled with the run id survives
+    for worker, api in zip(driver.workers(), driver.apis):
+        for c in list(api.containers.values()):
+            if c.labels.get(consts.LABEL_LOOP) == run_id:
+                violations.append(
+                    f"leaked-container: {worker.id} still holds "
+                    f"{c.name} ({c.state}) after cleanup"
+                    + (" [warm-pool]" if consts.LABEL_WARMPOOL in c.labels
+                       else ""))
+
+    # --- admission-cap: daemon-side concurrency high-water vs the bucket
+    if cap > 0:
+        for worker, gate in zip(driver.workers(), driver.gates):
+            if gate.launch_hwm > cap:
+                violations.append(
+                    f"admission-cap: {worker.id} daemon saw "
+                    f"{gate.launch_hwm} concurrent launches "
+                    f"(cap {cap})")
+
+    # --- spurious-quarantine: untouched workers end healthy
+    if health is not None and unfaulted:
+        for wid in sorted(unfaulted):
+            state = health.state(wid)
+            if state != BREAKER_CLOSED:
+                violations.append(
+                    f"spurious-quarantine: {wid} was never faulted but "
+                    f"its breaker reads {state!r}")
+
+    # --- span-tree: flight record parses; kill-free runs close every root
+    fpath = Path(flight_path(cfg.logs_dir, run_id))
+    if fpath.exists():
+        try:
+            spans = load_spans(
+                fpath.read_text(encoding="utf-8").splitlines())
+        except Exception as e:      # noqa: BLE001 -- corruption IS a finding
+            violations.append(f"span-tree: flight record unreadable: {e}")
+            spans = []
+        if spans and kills == 0:
+            for tree in build_trees(spans):
+                rec = tree.record
+                if rec.name != SPAN_ITERATION:
+                    violations.append(
+                        f"span-tree: {rec.agent} span {rec.name!r} has no "
+                        "iteration root (writer died mid-flush?)")
+                elif rec.status not in ("ok", "failed", "orphaned",
+                                        "stopped"):
+                    violations.append(
+                        f"span-tree: {rec.agent} iteration root ended "
+                        f"with status {rec.status!r}")
+    return violations

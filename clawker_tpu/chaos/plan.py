@@ -1,0 +1,247 @@
+"""Seeded, serializable fault plans: every soak failure is a repro.
+
+A :class:`FaultPlan` is the complete description of one chaos scenario:
+the fleet shape (workers/loops/iterations/warm-pool/failover) plus a
+time-ordered schedule of :class:`FaultEvent` injections.  Plans are
+generated deterministically from ``(seed, scenario)`` --
+``generate_plan(seed, i)`` always yields the same plan on every
+machine -- and serialize to/from JSON, so a failure found during a
+1000-scenario soak replays from either its ``--seed``/``--scenario``
+pair or its saved plan file (``clawker chaos replay``).
+
+Event kinds and where they inject:
+
+======================  ====================================================
+kind                    injection point
+======================  ====================================================
+``worker_kill``         _FaultGate ``refuse``: every call dials ECONNREFUSED
+``worker_wedge``        _FaultGate ``wedge``: every call hangs until revive
+``worker_flap``         _FaultGate ``flap``: every other call refused
+``worker_slow``         _FaultGate ``slow``: slow-loris, +``arg`` s per call
+``engine_burst``        _FaultGate ``burst``: next ``arg`` calls fail like a
+                        daemon 5xx / mid-response ECONNRESET, then self-heal
+``probe_drop``          _FaultGate ``probe_drop``: ``ping`` fails (dropped
+                        SSH-mux probe), data-path calls still succeed
+``worker_revive``       clear the worker's fault
+``cli_sigkill``         arm crash seam ``arg`` (chaos/seams.py): the
+                        scheduler dies there mid-flight, optionally with
+                        ``torn_tail`` bytes truncated off the journal, and
+                        the runner resumes the run (`--resume` semantics)
+======================  ====================================================
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..errors import ClawkerError
+from .seams import SEAM_NAMES
+
+EVENT_KINDS = (
+    "worker_kill", "worker_wedge", "worker_flap", "worker_slow",
+    "engine_burst", "probe_drop", "worker_revive", "cli_sigkill",
+)
+
+# fault gate modes the worker_* / engine_* / probe_* kinds map onto
+GATE_MODE = {
+    "worker_kill": "refuse",
+    "worker_wedge": "wedge",
+    "worker_flap": "flap",
+    "worker_slow": "slow",
+    "engine_burst": "burst",
+    "probe_drop": "probe_drop",
+}
+
+
+@dataclass
+class FaultEvent:
+    """One injection: ``at_s`` seconds into the scenario, ``kind``
+    against worker index ``worker`` (ignored for ``cli_sigkill``).
+    ``arg`` is kind-specific: burst length for ``engine_burst``,
+    per-call delay for ``worker_slow``, seam name for ``cli_sigkill``.
+    ``torn_tail`` (cli_sigkill only) truncates that many bytes off the
+    journal tail after the kill -- the host-crash torn-write case."""
+
+    at_s: float
+    kind: str
+    worker: int = 0
+    arg: object = None
+    torn_tail: int = 0
+
+    def to_doc(self) -> dict:
+        doc = {"at_s": round(self.at_s, 3), "kind": self.kind,
+               "worker": self.worker}
+        if self.arg is not None:
+            doc["arg"] = self.arg
+        if self.torn_tail:
+            doc["torn_tail"] = self.torn_tail
+        return doc
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "FaultEvent":
+        kind = str(doc.get("kind", ""))
+        if kind not in EVENT_KINDS:
+            raise ClawkerError(
+                f"chaos plan: unknown event kind {kind!r} "
+                f"(expected {'|'.join(EVENT_KINDS)})")
+        return cls(at_s=float(doc.get("at_s", 0.0)), kind=kind,
+                   worker=int(doc.get("worker", 0)),
+                   arg=doc.get("arg"),
+                   torn_tail=int(doc.get("torn_tail", 0)))
+
+
+@dataclass
+class FaultPlan:
+    """One scenario: fleet shape + injection schedule."""
+
+    seed: int
+    scenario: int = 0
+    n_workers: int = 4
+    n_loops: int = 6
+    iterations: int = 2
+    failover: str = "migrate"
+    warm_pool_depth: int = 0
+    max_inflight_per_worker: int = 2
+    events: list[FaultEvent] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return f"chaos-s{self.seed}-{self.scenario}"
+
+    def to_doc(self) -> dict:
+        return {
+            "seed": self.seed, "scenario": self.scenario,
+            "n_workers": self.n_workers, "n_loops": self.n_loops,
+            "iterations": self.iterations, "failover": self.failover,
+            "warm_pool_depth": self.warm_pool_depth,
+            "max_inflight_per_worker": self.max_inflight_per_worker,
+            "events": [e.to_doc() for e in sorted(self.events,
+                                                  key=lambda e: e.at_s)],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_doc(), indent=2) + "\n"
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "FaultPlan":
+        plan = cls(
+            seed=int(doc.get("seed", 0)),
+            scenario=int(doc.get("scenario", 0)),
+            n_workers=max(1, int(doc.get("n_workers", 4))),
+            n_loops=max(1, int(doc.get("n_loops", 6))),
+            iterations=max(1, int(doc.get("iterations", 2))),
+            failover=str(doc.get("failover", "migrate")),
+            warm_pool_depth=int(doc.get("warm_pool_depth", 0)),
+            max_inflight_per_worker=int(
+                doc.get("max_inflight_per_worker", 2)),
+            events=[FaultEvent.from_doc(e) for e in doc.get("events") or []],
+        )
+        _validate(plan)
+        return plan
+
+    @classmethod
+    def load(cls, path: Path | str) -> "FaultPlan":
+        try:
+            doc = json.loads(Path(path).read_text(encoding="utf-8"))
+        except (OSError, ValueError) as e:
+            raise ClawkerError(f"chaos plan {path}: {e}") from e
+        if not isinstance(doc, dict):
+            raise ClawkerError(f"chaos plan {path}: expected a JSON object")
+        return cls.from_doc(doc)
+
+    def save(self, path: Path | str) -> Path:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(self.to_json(), encoding="utf-8")
+        return path
+
+
+# the sigkill seams worth crashing at, weighted toward the WAL-to-engine
+# gaps that historically hid bugs (ISSUE 8); resume.* seams only make
+# sense once a generation is already a resume, so the generator uses
+# them for the SECOND kill of a scenario
+_KILL_SEAMS_GEN1 = ("run.post_placement", "launch.pre_create",
+                    "launch.post_create", "launch.pre_start",
+                    "launch.post_start", "iteration.post_exit",
+                    "pool.post_fill")
+_KILL_SEAMS_GEN2 = ("resume.pre_reconcile", "resume.post_adopt",
+                    "launch.post_start", "iteration.post_exit")
+
+
+def generate_plan(seed: int, scenario: int = 0, *, n_workers: int = 4,
+                  n_loops: int = 6, iterations: int = 2,
+                  horizon_s: float = 0.9) -> FaultPlan:
+    """Deterministic plan for ``(seed, scenario)``.
+
+    Every scenario gets 1-4 fault events inside ``horizon_s``; kills and
+    wedges are always paired with a revive so the fleet can finish, and
+    roughly half the scenarios include a CLI SIGKILL at a named crash
+    seam (with a resume leg), a third of those with a torn journal
+    tail.  ``random.Random`` is seeded from the (seed, scenario) pair
+    alone -- no global state, no time, no machine dependence.
+    """
+    rng = random.Random((int(seed) & 0xFFFFFFFF) * 100_003 + int(scenario))
+    plan = FaultPlan(
+        seed=int(seed), scenario=int(scenario), n_workers=n_workers,
+        n_loops=n_loops, iterations=iterations,
+        failover=rng.choice(("migrate", "migrate", "wait")),
+        warm_pool_depth=rng.choice((0, 0, 1)),
+        max_inflight_per_worker=rng.choice((2, 2, 3)),
+    )
+    events: list[FaultEvent] = []
+    n_worker_faults = rng.randint(1, 2)
+    victims = rng.sample(range(n_workers), k=min(n_worker_faults, n_workers))
+    for victim in victims:
+        kind = rng.choice(("worker_kill", "worker_kill", "worker_wedge",
+                           "worker_flap", "worker_slow", "engine_burst",
+                           "probe_drop"))
+        at = rng.uniform(0.05, horizon_s * 0.6)
+        arg = None
+        if kind == "worker_slow":
+            arg = round(rng.uniform(0.05, 0.2), 3)
+        elif kind == "engine_burst":
+            arg = rng.randint(2, 6)
+        events.append(FaultEvent(at_s=at, kind=kind, worker=victim, arg=arg))
+        if kind in ("worker_kill", "worker_wedge", "worker_flap",
+                    "worker_slow", "probe_drop"):
+            # bounded outage: the scenario must be able to drain
+            events.append(FaultEvent(
+                at_s=at + rng.uniform(0.2, horizon_s * 0.5),
+                kind="worker_revive", worker=victim))
+    if rng.random() < 0.6:
+        # early arms catch the run while launches are still in flight;
+        # seams that never fire (the run drained first) are benign
+        seam = rng.choice(_KILL_SEAMS_GEN1)
+        torn = rng.choice((0, 0, rng.randint(1, 40)))
+        events.append(FaultEvent(
+            at_s=rng.uniform(0.02, horizon_s * 0.5), kind="cli_sigkill",
+            worker=-1, arg=seam, torn_tail=torn))
+        if rng.random() < 0.4:
+            seam2 = rng.choice(_KILL_SEAMS_GEN2)
+            events.append(FaultEvent(
+                at_s=rng.uniform(0.05, horizon_s * 0.6), kind="cli_sigkill",
+                worker=-1, arg=seam2))
+    plan.events = sorted(events, key=lambda e: e.at_s)
+    _validate(plan)
+    return plan
+
+
+def _validate(plan: FaultPlan) -> None:
+    from ..loop.scheduler import FAILOVER_POLICIES
+
+    if plan.failover not in FAILOVER_POLICIES:
+        raise ClawkerError(
+            f"chaos plan: unknown failover policy {plan.failover!r} "
+            f"(expected {'|'.join(FAILOVER_POLICIES)})")
+    for e in plan.events:
+        if e.kind == "cli_sigkill" and e.arg not in SEAM_NAMES:
+            raise ClawkerError(
+                f"chaos plan: cli_sigkill at unknown seam {e.arg!r}")
+        if e.kind != "cli_sigkill" and not (
+                -1 < e.worker < plan.n_workers):
+            raise ClawkerError(
+                f"chaos plan: event {e.kind} targets worker {e.worker} "
+                f"outside the {plan.n_workers}-worker fleet")

@@ -1,0 +1,537 @@
+"""Chaos soak runner: execute fault plans against a fake pod, audit,
+shrink failures to minimal repros.
+
+One :class:`ChaosRunner` executes one :class:`~.plan.FaultPlan` end to
+end: build the fake pod, start the scheduler, walk the injection
+schedule (worker faults through the driver's fault gates; CLI SIGKILLs
+through armed crash seams followed by ``--resume`` reconciliation,
+kill/resume cycles included), drive the run to completion, clean up,
+then run :func:`~.invariants.check_invariants`.  ``run_soak`` iterates
+N seeded scenarios and, on the first failure, calls
+:func:`shrink_plan` -- greedy delta-debugging over the event list -- so
+the report carries the SMALLEST schedule that still breaks an
+invariant, plus the exact ``--seed``/``--scenario`` repro.
+
+:class:`ChaosController` is the ``clawker loop --chaos-plan`` dev hook:
+it applies a plan's schedule to a LIVE scheduler the CLI already built
+(worker faults only where the driver supports injection; ``cli_sigkill``
+events deliver a real SIGKILL so ``--resume`` can be crash-tested
+against a genuine process death).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .. import logsetup, telemetry
+from ..errors import ClawkerError
+from .invariants import check_invariants
+from .plan import GATE_MODE, FaultEvent, FaultPlan, generate_plan
+from .seams import SeamAbort, SeamRegistry
+
+log = logsetup.get("chaos.runner")
+
+_INJECTIONS = telemetry.counter(
+    "chaos_injections_total", "Fault events injected by the chaos runner",
+    labels=("kind",))
+_SCENARIOS = telemetry.counter(
+    "chaos_scenarios_total", "Chaos scenarios executed",
+    labels=("result",))         # result: ok | violated | error
+_VIOLATIONS = telemetry.counter(
+    "chaos_invariant_violations_total",
+    "Invariant violations found by chaos scenarios",
+    labels=("invariant",))
+
+def apply_fault(driver, ev: FaultEvent) -> None:
+    """Apply one worker-fault event to an injectable driver -- the ONE
+    event-kind -> fault-gate mapping shared by the soak runner and the
+    live `loop --chaos-plan` controller."""
+    if ev.kind == "worker_revive":
+        driver.clear_fault(ev.worker)
+        return
+    kw = {}
+    if ev.kind == "worker_slow":
+        kw["delay_s"] = float(ev.arg or 0.1)
+    elif ev.kind == "engine_burst":
+        kw["count"] = int(ev.arg or 3)
+    driver.inject_fault(ev.worker, GATE_MODE[ev.kind], **kw)
+
+
+IMAGE = "clawker-chaos:default"
+# generous end-to-end ceiling per scenario: a scenario that cannot
+# drain within this is itself an invariant violation (stuck-run)
+SCENARIO_DEADLINE_S = 60.0
+MAX_GENERATIONS = 4             # sigkill/resume cycles per scenario bound
+
+
+@dataclass
+class ScenarioResult:
+    seed: int
+    scenario: int
+    ok: bool
+    violations: list[str] = field(default_factory=list)
+    wall_s: float = 0.0
+    kills: int = 0
+    generations: int = 1
+    injected: int = 0
+    run_id: str = ""
+    plan_doc: dict = field(default_factory=dict)
+
+    def to_doc(self) -> dict:
+        return {
+            "seed": self.seed, "scenario": self.scenario, "ok": self.ok,
+            "violations": list(self.violations),
+            "wall_s": round(self.wall_s, 3), "kills": self.kills,
+            "generations": self.generations, "injected": self.injected,
+            "run_id": self.run_id,
+        }
+
+
+class ChaosRunner:
+    """Execute one fault plan against a fresh fake pod."""
+
+    def __init__(self, cfg, plan: FaultPlan, *, on_event=None,
+                 behavior=None, poll_s: float = 0.05):
+        from ..engine.drivers import FakeDriver
+        from ..engine.fake import exit_behavior
+        from ..health import BreakerConfig, HealthConfig
+
+        self.cfg = cfg
+        self.plan = plan
+        self.on_event = on_event
+        self.poll_s = poll_s
+        self.driver = FakeDriver(n_workers=plan.n_workers)
+        for api in self.driver.apis:
+            api.add_image(IMAGE)
+            api.set_behavior(IMAGE,
+                             behavior or exit_behavior(b"", 0, delay=0.02))
+        # fast verdicts: the scenario horizon is under a second, so
+        # probes and breaker backoff must be an order faster than that
+        self.health_config = HealthConfig(
+            probe_interval_s=0.05, probe_deadline_s=0.5,
+            breaker=BreakerConfig(failure_threshold=2,
+                                  backoff_base_s=0.05, backoff_max_s=0.2))
+        self.kills = 0
+        self.generations = 0
+        self.injected = 0
+        self._sched = None
+        self._run_done = threading.Event()
+        self._run_exc: list[BaseException] = []
+        self._armed: list[tuple] = []   # (sched, seam, event) pending arms
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _spec(self):
+        from ..loop import LoopSpec
+
+        p = self.plan
+        return LoopSpec(
+            parallel=p.n_loops, iterations=p.iterations,
+            failover=p.failover, warm_pool_depth=p.warm_pool_depth,
+            max_inflight_per_worker=p.max_inflight_per_worker,
+            image=IMAGE, agent_prefix="chaos", orphan_grace_s=20.0)
+
+    def _start_generation(self, *, resume_of=None,
+                          arm_events: list | None = None):
+        """Build + start generation 1, or resume generation N+1 from the
+        dead generation's journal (kill/resume cycle).  ``arm_events``
+        re-arms surviving sigkill seams on the FRESH registry before the
+        generation starts driving -- resume.* seams fire during
+        reconcile, so arming after the thread started would race the
+        window."""
+        from ..loop import LoopScheduler
+        from ..loop.journal import RunJournal, journal_path, replay
+
+        self.generations += 1
+        seams = SeamRegistry()
+        if resume_of is None:
+            sched = LoopScheduler(self.cfg, self.driver, self._spec(),
+                                  on_event=self.on_event,
+                                  health_config=self.health_config,
+                                  seams=seams)
+        else:
+            image = replay(RunJournal.read(
+                journal_path(self.cfg.logs_dir, resume_of)))
+            if not image.run_id:
+                raise ClawkerError(
+                    "chaos: resume found no run header -- the kill beat "
+                    "the first journal record (seam fired too early?)")
+            sched = LoopScheduler.resume(
+                self.cfg, self.driver, image, on_event=self.on_event,
+                health_config=self.health_config, seams=seams)
+        self._sched = sched
+        # per-GENERATION completion state: the closure binds these
+        # locals, not self, so a stale gen-N thread that finally
+        # unblocks (e.g. out of a wedge after the 5s kill wait gave up
+        # on it) completes only its own dead generation -- it can
+        # neither mark the live one done nor pin its crash on it
+        done = self._run_done = threading.Event()
+        exc = self._run_exc = []
+        for ev in arm_events or []:
+            self._arm_sigkill(ev, sched)
+
+        def drive() -> None:
+            try:
+                if resume_of is None:
+                    sched.start()
+                else:
+                    sched.reconcile()
+                sched.run(poll_s=self.poll_s)
+            except SeamAbort:
+                pass            # the armed kill fired on this thread
+            except BaseException as e:  # noqa: BLE001 -- surfaced as error
+                exc.append(e)
+            finally:
+                done.set()
+
+        threading.Thread(target=drive, daemon=True,
+                         name=f"chaos-run-g{self.generations}").start()
+        return sched
+
+    # ------------------------------------------------------------ injection
+
+    def _apply_worker_fault(self, ev: FaultEvent) -> None:
+        apply_fault(self.driver, ev)
+        _INJECTIONS.labels(ev.kind).inc()
+        self.injected += 1
+
+    def _arm_sigkill(self, ev: FaultEvent, sched=None) -> None:
+        """Arm a crash seam on the current (or given) generation.
+        Several seams may be armed at once -- whichever fires first
+        kills the generation, and the survivors re-arm on the resumed
+        one (that is how resume.* seams become reachable).  Arming is
+        NOT counted as an injection -- a sigkill counts when its seam
+        fires (_service_kill), so re-arms on resumed generations and
+        seams the run never reaches don't inflate the report."""
+        sched = sched if sched is not None else self._sched
+        seam = str(ev.arg)
+        if any(s is sched and sm == seam for s, sm, _e in self._armed):
+            return              # same seam twice on one generation: one kill
+
+        def die() -> None:
+            sched.kill()
+            raise SeamAbort(f"chaos sigkill at {seam}")
+
+        sched.seams.arm(seam, die)
+        self._armed.append((sched, seam, ev))
+
+    def _service_kill(self) -> bool:
+        """If any armed seam fired, finish its kill/resume cycle (tear
+        the journal tail when the plan says so, resume as a fresh
+        generation, re-arm surviving seams on it).  Returns True when a
+        resume happened."""
+        fired_idx = next(
+            (i for i, (s, seam, _e) in enumerate(self._armed)
+             if seam in s.seams.fired), None)
+        if fired_idx is None:
+            return False
+        sched, _seam, ev = self._armed.pop(fired_idx)
+        survivors = [e for s, _sm, e in self._armed if s is sched]
+        self._armed = [entry for entry in self._armed
+                       if entry[0] is not sched]
+        self.kills += 1
+        _INJECTIONS.labels(ev.kind).inc()
+        self.injected += 1
+        sched.kill()            # idempotent; covers seams on the run thread
+        self._run_done.wait(5.0)
+        # give in-flight lane tasks a beat to hit their epoch guards
+        # (daemon threads survive a simulated SIGKILL; a real one's
+        # threads would be gone, so we only need them to stop mutating)
+        time.sleep(0.05)
+        if ev.torn_tail > 0:
+            self._tear_journal_tail(sched.loop_id, ev.torn_tail)
+        if self.generations < MAX_GENERATIONS:
+            self._start_generation(resume_of=sched.loop_id,
+                                   arm_events=survivors)
+        return True
+
+    def _tear_journal_tail(self, run_id: str, n_bytes: int) -> None:
+        from ..loop.journal import journal_path
+
+        path = journal_path(self.cfg.logs_dir, run_id)
+        try:
+            size = path.stat().st_size
+            with open(path, "rb+") as fh:
+                fh.truncate(max(0, size - int(n_bytes)))
+        except OSError:
+            pass                # no journal to tear is not a failure
+
+    # ------------------------------------------------------------- scenario
+
+    def run_scenario(self) -> ScenarioResult:
+        t0 = time.monotonic()
+        deadline = t0 + SCENARIO_DEADLINE_S
+        result = ScenarioResult(seed=self.plan.seed,
+                                scenario=self.plan.scenario, ok=False,
+                                plan_doc=self.plan.to_doc())
+        faulted: set[int] = set()
+        runner_error = False
+        try:
+            # inside the try: a scheduler that refuses the plan's spec
+            # must still close the driver (lane/wedge daemon threads)
+            # and report through the per-scenario violation path
+            sched = self._start_generation()
+            result.run_id = sched.loop_id
+            for ev in sorted(self.plan.events, key=lambda e: e.at_s):
+                # poll toward the event's time, servicing any fired
+                # crash seam (kill -> torn tail -> resume) along the way
+                while True:
+                    self._service_kill()
+                    now = time.monotonic()
+                    if now >= t0 + ev.at_s:
+                        break
+                    time.sleep(min(0.01, t0 + ev.at_s - now))
+                if ev.kind == "cli_sigkill":
+                    self._arm_sigkill(ev)
+                else:
+                    if ev.kind != "worker_revive":
+                        faulted.add(ev.worker)
+                    self._apply_worker_fault(ev)
+            # end of schedule: heal the fleet so the run can drain,
+            # servicing seams fired late (and the resumes they trigger)
+            for i in range(self.plan.n_workers):
+                self.driver.clear_fault(i)
+            while time.monotonic() < deadline:
+                self._service_kill()
+                if self._run_done.is_set():
+                    # armed seams the drained run never reached (e.g. a
+                    # pool seam with the pool disabled) are not
+                    # failures: disarm, then re-check for a fire that
+                    # raced the disarm
+                    for armed_sched, seam, _ev in self._armed:
+                        armed_sched.seams.disarm(seam)
+                    self._armed = [
+                        e for e in self._armed if e[1] in e[0].seams.fired]
+                    if not self._armed:
+                        break
+                time.sleep(0.01)
+            else:
+                self._sched.stop()
+                self._run_done.wait(10.0)
+                result.violations.append(
+                    "stuck-run: the scenario did not drain within "
+                    f"{SCENARIO_DEADLINE_S:.0f}s")
+            if self._run_exc:
+                result.violations.append(
+                    f"scheduler-crash: {self._run_exc[0]!r}")
+            final = self._sched
+            final.cleanup(remove_containers=True)
+            unfaulted = {w.id for i, w in enumerate(self.driver.workers())
+                         if i not in faulted}
+            result.violations.extend(check_invariants(
+                self.driver, self.cfg, final.loop_id,
+                loops=final.loops, cap=self.plan.max_inflight_per_worker,
+                unfaulted=unfaulted, health=final.health,
+                kills=self.kills))
+        except ClawkerError as e:
+            runner_error = True
+            result.violations.append(f"runner-error: {e}")
+        finally:
+            self.driver.close()
+        result.kills = self.kills
+        result.generations = self.generations
+        result.injected = self.injected
+        result.wall_s = time.monotonic() - t0
+        result.ok = not result.violations
+        for v in result.violations:
+            _VIOLATIONS.labels(v.split(":", 1)[0]).inc()
+        _SCENARIOS.labels(
+            "ok" if result.ok
+            else ("error" if runner_error else "violated")).inc()
+        return result
+
+
+# ------------------------------------------------------------------- soak
+
+
+def _fresh_cfg():
+    """An isolated project config per scenario: each scenario gets its
+    own logs/journal tree so invariant audits never cross-read."""
+    from .. import consts
+    from ..config import load_config
+    from ..testenv import TestEnv
+
+    env = TestEnv()
+    env.__enter__()
+    proj = env.base / "proj"
+    proj.mkdir()
+    (proj / consts.PROJECT_FLAT_FORM).write_text("project: chaosproj\n")
+    return env, load_config(proj)
+
+
+def run_plan(plan: FaultPlan, *, cfg=None, on_event=None) -> ScenarioResult:
+    """Execute ONE plan (replay entry point).  With no ``cfg`` a
+    throwaway isolated project is created and torn down."""
+    env = None
+    if cfg is None:
+        env, cfg = _fresh_cfg()
+    try:
+        return ChaosRunner(cfg, plan, on_event=on_event).run_scenario()
+    finally:
+        if env is not None:
+            env.__exit__(None, None, None)
+
+
+def shrink_plan(plan: FaultPlan, *, rounds: int = 2,
+                budget_s: float = 120.0) -> tuple[FaultPlan,
+                                                  ScenarioResult]:
+    """Greedy delta-debug a FAILING plan down to a minimal repro: try
+    dropping one event at a time; keep any reduction that still
+    violates an invariant.  Returns (smallest failing plan, its
+    result).  Bounded two ways: at most ``rounds`` full passes over the
+    event list (each event re-runs one scenario), and at most
+    ``budget_s`` of wall clock -- a stuck-run failure burns the full
+    scenario deadline PER TRIAL, and a shrink that outlives the caller's
+    timeout would discard the very report it exists to produce; on
+    budget exhaustion the smallest plan found so far is returned."""
+    import dataclasses
+
+    t0 = time.monotonic()
+    best = plan
+    best_result = run_plan(plan)
+    if best_result.ok:
+        return plan, best_result    # not failing (flaky?); nothing to shrink
+    for _ in range(rounds):
+        reduced_any = False
+        i = 0
+        while i < len(best.events):
+            if time.monotonic() - t0 > budget_s:
+                return best, best_result
+            trial = dataclasses.replace(
+                best, events=best.events[:i] + best.events[i + 1:])
+            res = run_plan(trial)
+            if not res.ok:
+                best, best_result = trial, res
+                reduced_any = True      # same index now names the next event
+            else:
+                i += 1
+        if not reduced_any:
+            break
+    return best, best_result
+
+
+def run_soak(scenarios: int, seed: int, *, n_workers: int = 4,
+             n_loops: int = 6, iterations: int = 2, on_event=None,
+             shrink: bool = True, keep_going: bool = False,
+             on_progress=None, cfg=None) -> dict:
+    """Run ``scenarios`` seeded scenarios; stop at (and shrink) the
+    first failure unless ``keep_going``.  Returns the soak report doc
+    ``{ok, scenarios, passed, failures: [...]}``.  With ``cfg`` the
+    scenarios journal under that project's logs dir (run ids keep them
+    apart); otherwise each gets a throwaway isolated environment."""
+    report: dict = {"seed": seed, "scenarios": scenarios, "passed": 0,
+                    "failures": [], "wall_s": 0.0, "kills": 0,
+                    "injected": 0}
+    t0 = time.monotonic()
+    for i in range(scenarios):
+        plan = generate_plan(seed, i, n_workers=n_workers, n_loops=n_loops,
+                             iterations=iterations)
+        env = None
+        scen_cfg = cfg
+        if scen_cfg is None:
+            env, scen_cfg = _fresh_cfg()
+        try:
+            result = ChaosRunner(scen_cfg, plan,
+                                 on_event=on_event).run_scenario()
+        finally:
+            if env is not None:
+                env.__exit__(None, None, None)
+        report["kills"] += result.kills
+        report["injected"] += result.injected
+        if on_progress is not None:
+            on_progress(result)
+        if result.ok:
+            report["passed"] += 1
+            continue
+        failure = result.to_doc()
+        # the repro must pin the FLEET SHAPE too: generate_plan draws
+        # victims from range(n_workers), so replaying a non-default
+        # soak's (seed, i) under default shape yields a different
+        # schedule entirely
+        failure["repro"] = (
+            f"clawker chaos replay --seed {seed} --scenario {i} "
+            f"--workers {n_workers} --parallel {n_loops} "
+            f"--iterations {iterations}")
+        if shrink:
+            minimal, min_result = shrink_plan(plan)
+            failure["minimal_plan"] = minimal.to_doc()
+            failure["minimal_violations"] = list(min_result.violations)
+        report["failures"].append(failure)
+        if not keep_going:
+            break
+    report["wall_s"] = round(time.monotonic() - t0, 2)
+    report["ok"] = (not report["failures"]
+                    and report["passed"] == scenarios)
+    return report
+
+
+# ---------------------------------------------------- live-run controller
+
+
+class ChaosController:
+    """Apply a fault plan to a LIVE scheduler (``loop --chaos-plan``).
+
+    Worker fault events need an injectable driver (the fake pod); on
+    real drivers they are skipped with a scheduler event.  A
+    ``cli_sigkill`` event arms its crash seam with a REAL
+    ``os.kill(getpid(), SIGKILL)`` -- the dev workflow for crash-testing
+    ``--resume`` against a genuine process death."""
+
+    def __init__(self, sched, driver, plan: FaultPlan):
+        self.sched = sched
+        self.driver = driver
+        self.plan = plan
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        if not isinstance(sched.seams, SeamRegistry):
+            sched.seams = SeamRegistry()
+
+    def start(self) -> "ChaosController":
+        self._thread = threading.Thread(target=self._drive, daemon=True,
+                                        name="chaos-controller")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _drive(self) -> None:
+        injectable = hasattr(self.driver, "inject_fault")
+        t0 = time.monotonic()
+        for ev in sorted(self.plan.events, key=lambda e: e.at_s):
+            if self._stop.wait(max(0.0, t0 + ev.at_s - time.monotonic())):
+                return
+            if ev.kind == "cli_sigkill":
+                seam = str(ev.arg)
+
+                def die(seam: str = seam) -> None:
+                    log.warning("chaos: SIGKILL at seam %s", seam)
+                    os.kill(os.getpid(), signal.SIGKILL)
+
+                self.sched.seams.arm(seam, die)
+                _INJECTIONS.labels(ev.kind).inc()
+                continue
+            if not injectable:
+                self.sched.on_event(
+                    "chaos", "skipped",
+                    f"{ev.kind} on worker {ev.worker}: driver "
+                    f"{getattr(self.driver, 'name', '?')} is not "
+                    "fault-injectable")
+                continue
+            if not 0 <= ev.worker < len(self.driver.workers()):
+                # a plan generated for a different fleet shape: skip
+                # visibly instead of letting an IndexError kill this
+                # thread and silently drop the rest of the schedule
+                self.sched.on_event(
+                    "chaos", "skipped",
+                    f"{ev.kind} worker={ev.worker}: outside the "
+                    f"{len(self.driver.workers())}-worker fleet")
+                continue
+            apply_fault(self.driver, ev)
+            _INJECTIONS.labels(ev.kind).inc()
+            self.sched.on_event("chaos", "injected",
+                                f"{ev.kind} worker={ev.worker}")

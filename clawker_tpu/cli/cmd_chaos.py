@@ -1,0 +1,207 @@
+"""``clawker chaos``: seeded chaos soak + deterministic replay.
+
+Net-new verb (docs/chaos.md).  ``chaos run`` executes N seeded fault
+scenarios against an in-process fake pod -- worker kills/wedges/flaps/
+slow-loris, engine 5xx bursts, probe drops, CLI SIGKILLs at named crash
+seams with kill/resume cycles -- and audits the fleet invariants after
+each one (zero duplicate creates, zero leaks, admission caps held,
+no spurious quarantine, exits accounted exactly once, span trees
+complete).  A failing scenario is shrunk to a minimal event schedule
+and reported with its one-command repro.
+"""
+
+from __future__ import annotations
+
+import json
+
+import click
+
+from .factory import Factory
+
+pass_factory = click.make_pass_decorator(Factory)
+
+
+@click.group("chaos")
+def chaos_group():
+    """Deterministic chaos injection against the loop scheduler's
+    robustness stack (breakers/failover, journal/--resume, admission,
+    warm pools)."""
+
+
+@chaos_group.command("run")
+@click.option("--scenarios", "-n", type=int, default=None,
+              help="Seeded scenarios to execute "
+                   "(default: settings chaos.scenarios).")
+@click.option("--seed", "-s", type=int, default=None,
+              help="Soak seed: scenario i replays as (seed, i) "
+                   "(default: settings chaos.seed).")
+@click.option("--parallel", "-p", type=int, default=None,
+              help="Agent loops per scenario (default: settings "
+                   "chaos.parallel).")
+@click.option("--workers", "-w", type=int, default=None,
+              help="Fake pod size per scenario (default: settings "
+                   "chaos.workers).")
+@click.option("--iterations", type=int, default=None,
+              help="Iteration budget per loop (default: settings "
+                   "chaos.iterations).")
+@click.option("--keep-going", is_flag=True,
+              help="Run every scenario even after a failure "
+                   "(default: stop and shrink the first).")
+@click.option("--no-shrink", is_flag=True,
+              help="Skip minimal-repro shrinking of failing schedules.")
+@click.option("--json", "as_json", is_flag=True, help="Report as JSON.")
+@pass_factory
+def chaos_run(f: Factory, scenarios, seed, parallel, workers, iterations,
+              keep_going, no_shrink, as_json):
+    """Run a seeded chaos soak and audit fleet invariants.
+
+    Every scenario builds a fresh fake pod, executes its generated
+    fault schedule (kill/resume cycles included), and cross-audits
+    engine state vs journal replay vs telemetry.  Exit is non-zero on
+    any invariant violation; the report names the exact
+    ``clawker chaos replay --seed S --scenario I`` repro and, unless
+    --no-shrink, the minimal failing schedule.
+    """
+    from ..chaos.runner import run_soak
+
+    cs = f.config.settings.chaos
+    scenarios = scenarios if scenarios is not None else cs.scenarios
+    seed = seed if seed is not None else cs.seed
+
+    def progress(result):
+        if not as_json:
+            mark = "ok" if result.ok else "FAIL"
+            click.echo(
+                f"scenario {result.scenario}: {mark} "
+                f"({result.wall_s:.2f}s, {result.injected} fault(s), "
+                f"{result.kills} kill(s), gen {result.generations})",
+                err=True)
+
+    report = run_soak(
+        scenarios, seed,
+        n_workers=workers if workers is not None else cs.workers,
+        n_loops=parallel if parallel is not None else cs.parallel,
+        iterations=(iterations if iterations is not None
+                    else cs.iterations),
+        shrink=not no_shrink, keep_going=keep_going,
+        on_progress=progress, cfg=f.config)
+    if as_json:
+        click.echo(json.dumps(report, indent=2))
+    else:
+        click.echo(
+            f"chaos: {report['passed']}/{report['scenarios']} scenario(s) "
+            f"passed (seed {report['seed']}, {report['injected']} "
+            f"injection(s), {report['kills']} kill/resume cycle(s), "
+            f"{report['wall_s']}s)")
+        for fail in report["failures"]:
+            click.echo(f"FAILED scenario {fail['scenario']}:", err=True)
+            for v in fail["violations"]:
+                click.echo(f"  - {v}", err=True)
+            click.echo(f"  repro: {fail['repro']}", err=True)
+            if "minimal_plan" in fail:
+                click.echo("  minimal schedule: "
+                           + json.dumps(fail["minimal_plan"]["events"]),
+                           err=True)
+    if not report["ok"]:
+        raise SystemExit(1)
+
+
+@chaos_group.command("replay")
+@click.option("--seed", "-s", type=int, default=None,
+              help="Seed of the soak that found the failure.")
+@click.option("--scenario", "-i", type=int, default=0,
+              help="Scenario index within the soak (default 0).")
+@click.option("--workers", "-w", type=int, default=None,
+              help="Fleet shape of the soak that found the failure "
+                   "(default: settings chaos.workers).")
+@click.option("--parallel", "-p", type=int, default=None,
+              help="Loops per scenario of that soak (default: settings "
+                   "chaos.parallel).")
+@click.option("--iterations", type=int, default=None,
+              help="Iteration budget of that soak (default: settings "
+                   "chaos.iterations).")
+@click.option("--plan", "plan_file", type=click.Path(exists=True),
+              default=None,
+              help="Replay a saved plan JSON instead of (seed, scenario).")
+@click.option("--json", "as_json", is_flag=True, help="Result as JSON.")
+@pass_factory
+def chaos_replay(f: Factory, seed, scenario, workers, parallel, iterations,
+                 plan_file, as_json):
+    """Deterministically re-execute one scenario.
+
+    Either --seed/--scenario (regenerates the exact schedule the soak
+    ran -- pass the soak's --workers/--parallel/--iterations too if it
+    used a non-default fleet shape, as the schedule depends on it) or
+    --plan FILE (a saved or hand-edited schedule).  Exit is non-zero
+    when an invariant is violated.
+    """
+    from ..chaos.plan import FaultPlan, generate_plan
+    from ..chaos.runner import run_plan
+
+    cs = f.config.settings.chaos
+    if plan_file is not None:
+        plan = FaultPlan.load(plan_file)
+    elif seed is not None:
+        plan = generate_plan(
+            seed, scenario,
+            n_workers=workers if workers is not None else cs.workers,
+            n_loops=parallel if parallel is not None else cs.parallel,
+            iterations=(iterations if iterations is not None
+                        else cs.iterations))
+    else:
+        raise click.UsageError("need --seed (with --scenario) or --plan")
+    result = run_plan(plan, cfg=f.config)
+    if as_json:
+        click.echo(json.dumps({**result.to_doc(),
+                               "plan": plan.to_doc()}, indent=2))
+    else:
+        click.echo(f"scenario ({plan.seed}, {plan.scenario}): "
+                   + ("ok" if result.ok else "FAILED"))
+        for v in result.violations:
+            click.echo(f"  - {v}", err=True)
+    if not result.ok:
+        raise SystemExit(1)
+
+
+@chaos_group.command("plan")
+@click.option("--seed", "-s", type=int, required=True,
+              help="Soak seed to generate from.")
+@click.option("--scenario", "-i", type=int, default=0,
+              help="Scenario index (default 0).")
+@click.option("--workers", "-w", type=int, default=None,
+              help="Fleet shape the soak used (default: settings "
+                   "chaos.workers; the schedule depends on it).")
+@click.option("--parallel", "-p", type=int, default=None,
+              help="Loops per scenario (default: settings chaos.parallel).")
+@click.option("--iterations", type=int, default=None,
+              help="Iteration budget (default: settings chaos.iterations).")
+@click.option("--out", "out_file", type=click.Path(), default=None,
+              help="Write the plan JSON here instead of stdout "
+                   "(editable; replay with --plan).")
+@pass_factory
+def chaos_plan(f: Factory, seed, scenario, workers, parallel, iterations,
+               out_file):
+    """Print (or save) the fault schedule for one (seed, scenario).
+
+    The schedule is exactly what ``chaos run``/``chaos replay`` would
+    execute under the same fleet shape -- save it, edit the events, and
+    replay the edited plan to bisect a failure by hand.
+    """
+    from ..chaos.plan import generate_plan
+
+    cs = f.config.settings.chaos
+    plan = generate_plan(
+        seed, scenario,
+        n_workers=workers if workers is not None else cs.workers,
+        n_loops=parallel if parallel is not None else cs.parallel,
+        iterations=(iterations if iterations is not None
+                    else cs.iterations))
+    if out_file:
+        path = plan.save(out_file)
+        click.echo(f"wrote {path}")
+    else:
+        click.echo(plan.to_json(), nl=False)
+
+
+def register(cli: click.Group) -> None:
+    cli.add_command(chaos_group)

@@ -109,6 +109,13 @@ class _TwoStageInterrupt:
               help="Serve Prometheus metrics on 127.0.0.1:<port>/metrics "
                    "for the run (default: settings telemetry.metrics_port; "
                    "0 = off).")
+@click.option("--chaos-plan", "chaos_plan", type=click.Path(exists=True),
+              default=None,
+              help="DEV: apply a chaos fault plan (clawker chaos plan "
+                   "--out) to this live run -- worker faults where the "
+                   "driver is injectable (fake), cli_sigkill events as a "
+                   "REAL SIGKILL at the named crash seam (crash-test "
+                   "--resume).  See docs/chaos.md.")
 @click.option("--json", "as_json", is_flag=True, help="Final status as JSON.")
 @click.option("--keep", is_flag=True, help="Keep containers after the run.")
 @pass_factory
@@ -116,7 +123,8 @@ class _TwoStageInterrupt:
 def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                placement, tenant, tenant_weight, max_inflight_per_worker,
                warm_pool, image, prompt, worktrees, env_kv, failover,
-               orphan_grace, resume_run, metrics_port, as_json, keep):
+               orphan_grace, resume_run, metrics_port, chaos_plan, as_json,
+               keep):
     """Fan autonomous agent loops across the runtime's workers."""
     if ctx.invoked_subcommand is not None:
         return
@@ -125,14 +133,14 @@ def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                resume_run=resume_run, tenant=tenant,
                tenant_weight=tenant_weight,
                max_inflight_per_worker=max_inflight_per_worker,
-               warm_pool=warm_pool)
+               warm_pool=warm_pool, chaos_plan=chaos_plan)
 
 
 def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
                worktrees, env_kv, failover, orphan_grace, metrics_port,
                as_json, keep, resume_run=None, tenant=None,
                tenant_weight=None, max_inflight_per_worker=None,
-               warm_pool=None):
+               warm_pool=None, chaos_plan=None):
     from .. import telemetry
 
     env = {}
@@ -202,6 +210,15 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             telemetry=tele.flight_recorder,
         )
         sched = LoopScheduler(f.config, f.driver, spec, on_event=on_event)
+    chaos = None
+    if chaos_plan:
+        from ..chaos.plan import FaultPlan
+        from ..chaos.runner import ChaosController
+
+        plan = FaultPlan.load(chaos_plan)
+        chaos = ChaosController(sched, f.driver, plan)
+        click.echo(f"chaos: applying {len(plan.events)} event(s) from "
+                   f"{chaos_plan} (seed {plan.seed})", err=True)
     feed = None
     watch = None
     metrics_server = None
@@ -255,6 +272,11 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
         + (" (resumed)" if resume_run else ""),
         err=True,
     )
+    # chaos starts BEFORE start()/reconcile(): run.post_placement fires
+    # inside start() and the resume.* seams inside reconcile(), so a
+    # controller started after them could never land those kills
+    if chaos is not None:
+        chaos.start()
     if resume_run:
         summary = sched.reconcile()
         click.echo(
@@ -272,6 +294,8 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
         else:
             loops = sched.run()
     finally:
+        if chaos is not None:
+            chaos.stop()
         if feed is not None:
             feed.stop()
         if watch is not None:

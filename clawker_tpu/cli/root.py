@@ -131,6 +131,7 @@ def register_commands() -> None:
     from . import (
         cmd_build,
         cmd_bundle,
+        cmd_chaos,
         cmd_container,
         cmd_controlplane,
         cmd_firewall,
@@ -149,6 +150,7 @@ def register_commands() -> None:
 
     cmd_build.register(cli)
     cmd_bundle.register(cli)
+    cmd_chaos.register(cli)
     cmd_container.register(cli)
     cmd_controlplane.register(cli)
     cmd_firewall.register(cli)

@@ -467,6 +467,22 @@ class TelemetrySettings:
 
 
 @dataclass
+class ChaosSettings:
+    """Defaults for ``clawker chaos run`` (docs/chaos.md).
+
+    ``seed`` pins the soak schedule: scenario ``i`` of a run is fully
+    determined by ``(seed, i)``, so a CI failure replays anywhere with
+    ``clawker chaos replay --seed S --scenario I``.  The fleet shape
+    mirrors the 4-worker fake pod the robustness suites use."""
+
+    scenarios: int = 25             # seeded scenarios per soak
+    seed: int = 20260803            # fixed default: CI soaks are repros
+    parallel: int = 6               # agent loops per scenario
+    workers: int = 4                # fake pod size
+    iterations: int = 2             # per-loop iteration budget
+
+
+@dataclass
 class CredentialSettings:
     """Host-credential staging policy (off by default).
 
@@ -492,6 +508,7 @@ class Settings:
     loop: LoopSettings = field(default_factory=LoopSettings)
     telemetry: TelemetrySettings = field(default_factory=TelemetrySettings)
     credentials: CredentialSettings = field(default_factory=CredentialSettings)
+    chaos: ChaosSettings = field(default_factory=ChaosSettings)
 
     @staticmethod
     def merge_strategies() -> dict[str, str]:

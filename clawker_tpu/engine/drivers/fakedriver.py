@@ -10,6 +10,7 @@ probes, breakers, and the scheduler's migration path are tested through.
 from __future__ import annotations
 
 import threading
+import time
 
 from ...errors import DriverError
 from ..api import Engine
@@ -20,7 +21,7 @@ from .base import RuntimeDriver, Worker
 # the worker (daemon threads would otherwise pile up across a session)
 WEDGE_ABANDON_S = 60.0
 
-FAULT_KINDS = ("refuse", "wedge", "flap")
+FAULT_KINDS = ("refuse", "wedge", "flap", "slow", "burst", "probe_drop")
 
 
 class _FaultGate:
@@ -33,6 +34,16 @@ class _FaultGate:
     - ``flap``: every other call refuses (a worker bouncing between
       alive and dead -- the breaker must quarantine it, not bounce
       loops on and off it).
+    - ``slow``: slow-loris -- every call pays ``delay_s`` before
+      executing (a congested daemon: latency-weighted placement should
+      shift load away without the breaker opening).
+    - ``burst``: the next ``count`` calls fail like a daemon 5xx /
+      mid-response ECONNRESET, then the gate self-heals (the transient
+      burst the engine pool's stale-retry and the scheduler's strand
+      ceiling must absorb without quarantining a healthy worker).
+    - ``probe_drop``: ``ping`` fails while data-path calls succeed (a
+      dropped SSH-mux probe channel: health must not condemn a worker
+      whose engine still serves traffic without corroboration).
 
     Lifecycle/telemetry passthroughs (``close``/``close_events``/
     ``pool_stats``) are never gated: draining a dead worker's engine on
@@ -55,10 +66,14 @@ class _FaultGate:
         self._calls = 0
         self._inflight = 0
         self._launch_inflight = 0
+        self._burst_left = 0        # remaining 'burst' failures
+        self._delay_s = 0.0         # per-call delay under 'slow'
+        self.injected = 0           # gated calls that were made to fail
         self.call_hwm = 0           # concurrent daemon calls, any kind
         self.launch_hwm = 0         # concurrent create/start calls
 
-    def set_fault(self, mode: str | None) -> None:
+    def set_fault(self, mode: str | None, *, count: int = 3,
+                  delay_s: float = 0.1) -> None:
         if mode is not None and mode not in FAULT_KINDS:
             raise ValueError(f"unknown fault kind {mode!r} "
                              f"(expected {'|'.join(FAULT_KINDS)})")
@@ -67,23 +82,43 @@ class _FaultGate:
             # clearing the event would let a concurrent call slip
             # through the wedge ungated
             self._mode = mode
+            self._burst_left = int(count) if mode == "burst" else 0
+            self._delay_s = float(delay_s) if mode == "slow" else 0.0
             if mode == "wedge":
                 self._cleared.clear()
             else:
                 self._cleared.set()
 
-    def _gate(self) -> None:
+    def _gate(self, name: str) -> None:
         with self._lock:
             mode = self._mode
+            delay = self._delay_s
             self._calls += 1
             n = self._calls
+            if mode == "burst":
+                if self._burst_left <= 0:
+                    self._mode, mode = None, None   # burst spent: heal
+                else:
+                    self._burst_left -= 1
+            if mode in ("refuse", "burst") or (mode == "flap" and n % 2) \
+                    or (mode == "probe_drop" and name == "ping"):
+                self.injected += 1
         if mode == "refuse":
             raise DriverError("injected fault: connection refused")
+        if mode == "burst":
+            raise DriverError(
+                "injected fault: daemon 5xx / connection reset by peer")
         if mode == "wedge":
             if not self._cleared.wait(WEDGE_ABANDON_S):
                 raise DriverError("injected fault: wedged (never revived)")
         if mode == "flap" and n % 2:
             raise DriverError("injected fault: flapping connection refused")
+        if mode == "slow" and delay > 0:
+            # interruptible: a revive (set_fault(None)) sets _cleared,
+            # but slow keeps it set -- plain sleep, delays are small
+            time.sleep(delay)
+        if mode == "probe_drop" and name == "ping":
+            raise DriverError("injected fault: probe channel dropped")
 
     def __getattr__(self, name: str):
         attr = getattr(self.inner, name)
@@ -92,7 +127,7 @@ class _FaultGate:
         is_launch = name in self._LAUNCH_CALLS
 
         def call(*args, **kwargs):
-            self._gate()
+            self._gate(name)
             with self._lock:
                 self._inflight += 1
                 self.call_hwm = max(self.call_hwm, self._inflight)
@@ -139,9 +174,10 @@ class FakeDriver(RuntimeDriver):
         """Default worker's fake API (single-worker tests)."""
         return self.apis[0]
 
-    def inject_fault(self, index: int, kind: str = "refuse") -> None:
-        """Kill/wedge/flap worker ``index``'s daemon (see _FaultGate)."""
-        self.gates[index].set_fault(kind)
+    def inject_fault(self, index: int, kind: str = "refuse", **kw) -> None:
+        """Fault worker ``index``'s daemon (see _FaultGate): refuse |
+        wedge | flap | slow(delay_s=) | burst(count=) | probe_drop."""
+        self.gates[index].set_fault(kind, **kw)
 
     def clear_fault(self, index: int) -> None:
         """Revive worker ``index`` (blocked 'wedge' calls proceed)."""

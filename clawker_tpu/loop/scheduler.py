@@ -92,6 +92,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 from .. import consts, logsetup, telemetry
+from ..chaos.seams import NULL_SEAMS
 from ..config import Config
 from ..engine.drivers import RuntimeDriver, Worker
 from ..errors import ClawkerError, DriverError, NotFoundError
@@ -328,7 +329,8 @@ class LoopScheduler:
     def __init__(self, cfg: Config, driver: RuntimeDriver, spec: LoopSpec,
                  *, on_event=None, health_config: HealthConfig | None = None,
                  run_id: str | None = None,
-                 admission: AdmissionController | None = None):
+                 admission: AdmissionController | None = None,
+                 seams=None):
         if spec.failover not in FAILOVER_POLICIES:
             raise ClawkerError(
                 f"loop: unknown failover policy {spec.failover!r} "
@@ -434,6 +436,12 @@ class LoopScheduler:
                 max_age_s=wps.max_age_s, journal=self._journal)
             self.admission.register_tenant(
                 self.warmpool.tenant, weight=wps.tenant_weight)
+        # --- chaos (docs/chaos.md): the named crash-seam registry.  The
+        # scheduler FIRES seams at journaled transition boundaries; the
+        # default registry is never armed and fire() is a no-op check.
+        # Chaos tests / `clawker chaos` arm hooks that kill() + abort
+        # mid-flight -- the enumerable replacement for ad-hoc stubbing.
+        self.seams = seams if seams is not None else NULL_SEAMS
         self._aborted = False       # kill(): crash seam, skip all shutdown
         self._image: RunImage | None = None   # journal image being resumed
         self._extra_workers: list[Worker] = []  # journaled workers missing
@@ -449,8 +457,10 @@ class LoopScheduler:
 
     def _journal(self, kind: str, *, durable: bool = False, **fields) -> None:
         """Append one journal record; a disabled/degraded journal no-ops
-        (journaling must never fail the run it protects)."""
-        if self.journal is not None:
+        (journaling must never fail the run it protects).  After kill()
+        nothing lands: a SIGKILLed process writes no records, and chaos
+        replays must see exactly the journal a real crash would leave."""
+        if self.journal is not None and not self._aborted:
             self.journal.append(kind, durable=durable, **fields)
 
     def attach_anomaly_watch(self, watch) -> None:
@@ -733,7 +743,7 @@ class LoopScheduler:
                if self.spec.prompt else {}),
             **self.spec.env,
         }
-        return rt.create(CreateOptions(
+        cid = rt.create(CreateOptions(
             agent=pool_agent,
             image=self.spec.image,
             env=env,
@@ -745,6 +755,8 @@ class LoopScheduler:
                           consts.LABEL_WARMPOOL: pool_agent},
             replace=True,
         ))
+        self.seams.fire("pool.post_fill")
+        return cid
 
     def _drain_pool_worker(self, worker: Worker) -> None:
         """Remove every pool member on ``worker`` (runs on its lane,
@@ -827,6 +839,7 @@ class LoopScheduler:
                           tenant=self.spec.tenant)
         if self.journal is not None:
             self.journal.sync()
+        self.seams.fire("run.post_placement")
         for loop in self.loops:
             note_decision(self.policy.name, loop.worker.id)
             self.on_event(loop.agent, PLACEMENT_DECISION, PlacementEvent(
@@ -871,8 +884,8 @@ class LoopScheduler:
                failover: str | None = None, iterations: int | None = None,
                orphan_grace_s: float | None = None,
                telemetry: bool = True,
-               admission: AdmissionController | None = None
-               ) -> "LoopScheduler":
+               admission: AdmissionController | None = None,
+               seams=None) -> "LoopScheduler":
         """Rebuild a scheduler from a replayed run journal.
 
         The journal is the authority for the run's SHAPE (slot count,
@@ -911,7 +924,7 @@ class LoopScheduler:
         )
         sched = cls(cfg, driver, spec, on_event=on_event,
                     health_config=health_config, run_id=image.run_id,
-                    admission=admission)
+                    admission=admission, seams=seams)
         sched._image = image
         sched._build_resumed_loops(image)
         sched._journal(REC_RESUME, durable=True,
@@ -1001,6 +1014,7 @@ class LoopScheduler:
         image = self._image
         if image is None:
             raise ClawkerError("loop resume: reconcile() before resume()")
+        self.seams.fire("resume.pre_reconcile")
         self._ensure_health()
         summary = {"adopted": 0, "continued": 0, "relaunched": 0,
                    "exits_accounted": 0, "ghosts": 0, "orphaned": 0,
@@ -1183,6 +1197,7 @@ class LoopScheduler:
             self.on_event(loop.agent, "adopted", f"{worker.id}:{cid[:12]}")
             with lock:
                 summary["adopted"] += 1
+            self.seams.fire("resume.post_adopt")
             return
         if state == "created":
             # created but never started (crash between the create and the
@@ -1348,6 +1363,7 @@ class LoopScheduler:
         # create below, transparently.
         cid = ""
         pool_hit = False
+        self.seams.fire("launch.pre_create")
         if self.warmpool is not None and worker.engine is not None:
             entry = self.warmpool.checkout(worker.id, by=loop.agent,
                                            epoch=epoch)
@@ -1373,6 +1389,7 @@ class LoopScheduler:
         self._journal(REC_CREATED, durable=True, agent=loop.agent,
                       worker=worker.id, epoch=epoch, cid=cid,
                       pool=pool_hit)
+        self.seams.fire("launch.post_create")
         with self._placement_lock:
             if loop.epoch != epoch:
                 # orphaned mid-create: the new placement owns the loop
@@ -1418,6 +1435,7 @@ class LoopScheduler:
             self._write_iteration(loop, engine, cid)
         except ClawkerError:
             pass  # state file is advisory; the loop itself is not
+        self.seams.fire("launch.pre_start")
         if fresh:
             # first start of THIS container (iteration 0, or the first
             # iteration after a migration re-created it elsewhere): the
@@ -1445,6 +1463,7 @@ class LoopScheduler:
         # reconcile pass adopts at this same iteration anyway
         self._journal(REC_STARTED, agent=loop.agent, worker=worker.id,
                       epoch=epoch, iteration=loop.iteration)
+        self.seams.fire("launch.post_start")
         now = self.tracer.now()
         self.tracer.child(loop.agent, loop.iteration, SPAN_START,
                           t_start, now, worker=worker.id)
@@ -1553,6 +1572,7 @@ class LoopScheduler:
         # replay only needs record ORDER, which is preserved
         self._journal(REC_EXITED, agent=loop.agent, iteration=finished,
                       code=code)
+        self.seams.fire("iteration.post_exit")
         if loop.consecutive_failures >= FAILURE_CEILING:
             loop.status = "failed"
             self.on_event(loop.agent, "failed",
@@ -2244,12 +2264,30 @@ class LoopScheduler:
                     for loop in self.loops]
             # containers abandoned on dead/recovered workers by failover
             # ride THEIR worker's lane (a dead worker's removal fails
-            # fast or eats the bounded wait, never the healthy lanes')
-            sweep_workers: dict[str, Worker] = {}
+            # fast or eats the bounded wait, never the healthy lanes').
+            # The label sweep covers every worker any GENERATION of this
+            # run touched -- final placements, failover-abandoned
+            # workers, and (for a resumed run) the journaled fleet:
+            # after a kill/resume cycle a worker may hold only an
+            # earlier generation's leftovers (exited copies,
+            # un-restored pool members) that no current loop points at
+            # (chaos-found leak: a worker whose loops all migrated away
+            # kept its exited containers).  Not the whole live fleet --
+            # cleanup cost scales with the run, and a worker no
+            # generation saw cannot hold this run's label.  Engine-less
+            # stand-ins are excluded everywhere: a sweep on one would
+            # die at require_engine before its guarded list call.
+            journaled = (set(self._image.workers)
+                         if self._image is not None else set())
+            sweep_workers: dict[str, Worker] = {
+                w.id: w for w in self.driver.workers()
+                if w.engine is not None and w.id in journaled}
             for loop in self.loops:
-                sweep_workers.setdefault(loop.worker.id, loop.worker)
+                if loop.worker.engine is not None:
+                    sweep_workers.setdefault(loop.worker.id, loop.worker)
                 for worker, cid in loop.abandoned:
-                    sweep_workers.setdefault(worker.id, worker)
+                    if worker.engine is not None:
+                        sweep_workers.setdefault(worker.id, worker)
                     futs.append(self._lane(worker).submit(
                         self._remove_cid, worker, cid))
             # label-scoped sweep: a create whose response was lost AFTER

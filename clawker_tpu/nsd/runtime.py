@@ -427,7 +427,14 @@ class NsRuntime:
 
     def put_archive(self, c: NsContainer, path: str, tar_bytes: bytes) -> None:
         self._mount_overlay(c)
-        base, dest = self._resolve_in_rootfs(c, path)
+        base, dest, ro = self._resolve_in_rootfs(c, path)
+        if ro:
+            # a `:ro` bind is a promise to the HOST: archive writes
+            # resolve to the bind source, so honoring the flag here is
+            # what keeps a read-only mount from being writable through
+            # the API (ADVICE r5; dockerd 403s the same way)
+            raise PermissionError(
+                f"bind mounted read-only: {path}")
         dest.mkdir(parents=True, exist_ok=True)
         with tarfile.open(fileobj=io.BytesIO(tar_bytes)) as tf:
             for m in tf.getmembers():
@@ -440,7 +447,7 @@ class NsRuntime:
             tf.extractall(dest, filter="data")
 
     def get_archive(self, c: NsContainer, path: str) -> bytes:
-        _, src = self._resolve_in_rootfs(c, path)
+        _, src, _ro = self._resolve_in_rootfs(c, path)
         if not src.exists():
             raise FileNotFoundError(path)
         buf = io.BytesIO()
@@ -448,31 +455,37 @@ class NsRuntime:
             tf.add(src, arcname=src.name)
         return buf.getvalue()
 
-    def _resolve_in_rootfs(self, c: NsContainer, path: str) -> tuple[str, Path]:
-        """-> (guard base, resolved host path).  Bind destinations shadow
-        the overlay inside the container, so archive ops under a bind go
-        to the bind SOURCE (dockerd resolves mounts the same way --
-        that is how volume seeding lands in the volume, not under the
-        future mount point)."""
+    def _resolve_in_rootfs(self, c: NsContainer, path: str
+                           ) -> tuple[str, Path, bool]:
+        """-> (guard base, resolved host path, read_only).  Bind
+        destinations shadow the overlay inside the container, so archive
+        ops under a bind go to the bind SOURCE (dockerd resolves mounts
+        the same way -- that is how volume seeding lands in the volume,
+        not under the future mount point).  ``read_only`` reports the
+        winning bind's ``:ro`` option so writers can refuse instead of
+        writing through to the host source."""
         norm = "/" + path.strip("/")
-        best: tuple[str, str] | None = None
+        best: tuple[str, str, bool] | None = None
         for b in c.binds():
             parts = b.split(":")
             if len(parts) < 2 or not parts[0].startswith("/"):
                 continue
             src, dst = parts[0], "/" + parts[1].strip("/")
+            opts = parts[2] if len(parts) > 2 else ""
             if norm == dst or norm.startswith(dst + "/"):
                 if best is None or len(dst) > len(best[1]):
-                    best = (src, dst)
+                    best = (src, dst, "ro" in opts.split(","))
         if best is not None:
             base = str(Path(best[0]).resolve())
             p = (Path(base) / norm[len(best[1]):].lstrip("/")).resolve()
+            ro = best[2]
         else:
             base = str(c.merged.resolve())
             p = (c.merged / norm.lstrip("/")).resolve()
+            ro = False
         if not _inside(p, base):
             raise RuntimeError(f"path escapes rootfs: {path}")
-        return base, p
+        return base, p, ro
 
     # ---------------------------------------------------------------- exec
 

@@ -307,8 +307,9 @@ class NsDaemon:
                  raw: bytes | None = None,
                  content_type: str = "application/json") -> None:
         reasons = {200: "OK", 201: "Created", 204: "No Content",
-                   304: "Not Modified", 404: "Not Found",
-                   409: "Conflict", 500: "Internal Server Error"}
+                   304: "Not Modified", 403: "Forbidden",
+                   404: "Not Found", 409: "Conflict",
+                   500: "Internal Server Error"}
         if raw is not None:
             payload = raw
         elif body is None:
@@ -521,7 +522,13 @@ class NsDaemon:
 
     def h_put_archive(self, req: Request, ref: str) -> None:
         c = self._find(ref)
-        self.runtime.put_archive(c, req.query.get("path", "/"), req.body)
+        try:
+            self.runtime.put_archive(c, req.query.get("path", "/"),
+                                     req.body)
+        except PermissionError as e:
+            # archive write into a `:ro` bind resolves to the HOST
+            # source: refuse like dockerd does (ADVICE r5)
+            raise HttpError(403, str(e)) from None
         self._respond(req.sock, 200)
 
     def h_get_archive(self, req: Request, ref: str) -> None:

@@ -47,6 +47,22 @@ def _umount2(target: str, flags: int) -> None:
         raise OSError(err, f"umount {target}: {os.strerror(err)}")
 
 
+def _remount_ro(target: str) -> None:
+    """Remount a just-bound target read-only, recursively where the
+    kernel can.  MS_REMOUNT|MS_BIND with MS_REC needs Linux >= 4.10 (and
+    some LTS kernels reject it with EINVAL regardless); on those the
+    non-recursive remount still protects the bind itself -- better than
+    aborting container start over an `:ro` option (ADVICE r5)."""
+    import errno
+
+    try:
+        _mount("none", target, "", MS_BIND | MS_REMOUNT | MS_RDONLY | MS_REC)
+    except OSError as e:
+        if e.errno != errno.EINVAL:
+            raise
+        _mount("none", target, "", MS_BIND | MS_REMOUNT | MS_RDONLY)
+
+
 def _pivot_root(new_root: str, put_old: str) -> None:
     SYS_pivot_root = 155  # x86_64
     if _libc.syscall(SYS_pivot_root, new_root.encode(), put_old.encode()) != 0:
@@ -91,8 +107,7 @@ def main(argv: list[str]) -> int:
                 open(target, "a").close()
         _mount(src, target, "", MS_BIND | MS_REC)
         if "ro" in opts.split(","):
-            _mount("none", target, "",
-                   MS_BIND | MS_REMOUNT | MS_RDONLY | MS_REC)
+            _remount_ro(target)
 
     # 4. become the rootfs
     old = os.path.join(merged, ".old_root")

@@ -39,6 +39,12 @@ Gates:
   passing -- the parallelized 52-surface suite must hold >= 2x over
   the 20.5s serial baseline (ISSUE 7; skipped with a visible marker
   when the cryptography stack is absent, as in some sandboxes)
+- chaos_soak: bench.CHAOS_SOAK_SCENARIOS fixed-seed compound-fault
+  scenarios with ZERO invariant violations, within
+  bench.CHAOS_SOAK_BUDGET_S; any failure prints its deterministic
+  `clawker chaos replay` repro + minimal shrunk schedule (ISSUE 8
+  acceptance bar).  `--only chaos` runs just this gate
+  (`make chaos-smoke`).
 
 Prints one JSON line; exit 1 on any gate failure.
 """
@@ -56,6 +62,40 @@ PROVISION_MIN_SPEEDUP = 2.0
 DIALS_MIN_REDUCTION = 2.0
 
 
+def _gate_chaos(chaos: dict, failures: list[str]) -> None:
+    from bench import CHAOS_SOAK_BUDGET_S
+
+    if not chaos["ok"]:
+        for f in chaos["failures"]:
+            failures.append(
+                f"chaos_soak: scenario {f['scenario']} violated "
+                f"invariant(s): {'; '.join(f['violations'][:3])} "
+                f"(repro: {f['repro']})")
+        if chaos["passed"] != chaos["scenarios"] and not chaos["failures"]:
+            failures.append(
+                f"chaos_soak: only {chaos['passed']}/{chaos['scenarios']} "
+                "scenarios passed")
+    elif chaos["wall_s"] > CHAOS_SOAK_BUDGET_S:
+        failures.append(
+            f"chaos_soak {chaos['wall_s']}s > {CHAOS_SOAK_BUDGET_S}s budget")
+
+
+def chaos_only() -> int:
+    """`make chaos-smoke`: just the fixed-seed soak gate."""
+    from bench import bench_chaos_soak
+
+    chaos = bench_chaos_soak()
+    failures: list[str] = []
+    _gate_chaos(chaos, failures)
+    print(json.dumps({"chaos_soak": chaos, "ok": not failures,
+                      "failures": failures}))
+    if failures:
+        print("CHAOS-SMOKE FAILED: " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> int:
     from bench import (
         FAILOVER_BUDGET_S,
@@ -68,6 +108,7 @@ def main() -> int:
         TELEMETRY_DISABLED_BUDGET_NS,
         WARM_POOL_BURST_BUDGET_S,
         WARM_POOL_HIT_BUDGET_MS,
+        bench_chaos_soak,
         bench_engine_dials,
         bench_failover,
         bench_fleet_provision,
@@ -102,6 +143,7 @@ def main() -> int:
         if retry["hit_p50_ms"] < pool_hit["hit_p50_ms"]:
             pool_hit = retry
     pool_burst = bench_warm_pool_refill_burst()
+    chaos = bench_chaos_soak()
     try:        # the parity worlds need the cryptography stack
         import cryptography  # noqa: F401
         parity_wall, parity_passed, parity_total = bench_parity()
@@ -216,6 +258,7 @@ def main() -> int:
         failures.append(
             f"warm_pool_refill_burst {pool_burst['wall_s']}s > "
             f"{WARM_POOL_BURST_BUDGET_S}s budget")
+    _gate_chaos(chaos, failures)
     if not parity["skipped"]:
         if parity["passed"] != parity["total"]:
             failures.append(
@@ -239,6 +282,7 @@ def main() -> int:
         "telemetry_overhead_ns": tele,
         "warm_pool_hit_p50": pool_hit,
         "warm_pool_refill_burst": pool_burst,
+        "chaos_soak": chaos,
         "parity_suite_wall": parity,
         "ok": not failures,
         "failures": failures,
@@ -249,5 +293,24 @@ def main() -> int:
     return 0
 
 
+def _only_target(argv: list[str]) -> str | None:
+    """Strict --only parsing: `--only chaos` / `--only=chaos`.  An
+    unknown target must ERROR, not silently fall through to the full
+    suite (which would blow the caller's single-gate timeout)."""
+    for i, arg in enumerate(argv):
+        if arg == "--only":
+            return argv[i + 1] if i + 1 < len(argv) else ""
+        if arg.startswith("--only="):
+            return arg.split("=", 1)[1]
+    return None
+
+
 if __name__ == "__main__":
+    target = _only_target(sys.argv[1:])
+    if target == "chaos":
+        raise SystemExit(chaos_only())
+    if target is not None:
+        print(f"bench_smoke: unknown --only target {target!r} "
+              "(known: chaos)", file=sys.stderr)
+        raise SystemExit(2)
     raise SystemExit(main())

@@ -19,6 +19,23 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Wedge diagnosability: the tier-1 runner kills an overrunning pytest
+# with `timeout -k 10 ...` (SIGTERM, then SIGKILL 10s later).  Dump
+# every thread's traceback on that SIGTERM, so a future chaos/scheduler
+# wedge leaves the exact blocked stacks in the log instead of a bare
+# rc=124.  faulthandler.register (not a Python signal handler): the
+# dump runs from the C handler even while the main thread is parked
+# inside a non-signal-checking C call -- a wedged XLA compile or native
+# extension is precisely the case worth diagnosing, and a Python-level
+# handler would wait forever for bytecode to resume.  chain=True falls
+# through to the previous (default: terminate) disposition after.
+import faulthandler  # noqa: E402
+import signal  # noqa: E402
+
+faulthandler.enable()
+if hasattr(faulthandler, "register") and hasattr(signal, "SIGTERM"):
+    faulthandler.register(signal.SIGTERM, chain=True)
+
 import pytest  # noqa: E402
 
 from clawker_tpu.testenv import TestEnv

@@ -1,0 +1,401 @@
+"""Chaos subsystem suite: fault plans, crash seams, fault gates, the
+invariant checker, kill/resume soak scenarios, and the
+adversarial-under-load composition (BASELINE config #5 shape).
+
+The soak tests run REAL scenarios end to end on the 4-worker fake pod:
+every layer under test (breakers/failover, journal/--resume, admission,
+warm pools) is the production code path -- only the daemons and the
+fault injection are fakes.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.chaos import (
+    SEAM_NAMES,
+    FaultEvent,
+    FaultPlan,
+    SeamAbort,
+    SeamRegistry,
+    generate_plan,
+)
+from clawker_tpu.chaos.invariants import check_invariants
+from clawker_tpu.chaos.runner import ChaosRunner, run_plan, run_soak, shrink_plan
+from clawker_tpu.config import load_config
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.errors import ClawkerError, DriverError
+from clawker_tpu.loop import LoopScheduler, LoopSpec
+from clawker_tpu.loop.journal import journal_path
+from clawker_tpu.testenv import TestEnv
+
+IMAGE = "clawker-chaosproj:default"
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: chaosproj\n")
+        cfg = load_config(proj)
+        yield tenv, proj, cfg
+
+
+# ------------------------------------------------------------------- plans
+
+
+def test_plan_generation_is_deterministic():
+    a = generate_plan(1234, 7)
+    b = generate_plan(1234, 7)
+    assert a.to_doc() == b.to_doc()
+    # a different scenario index under the same seed differs
+    assert generate_plan(1234, 8).to_doc() != a.to_doc()
+
+
+def test_plan_serialization_roundtrip(tmp_path):
+    plan = generate_plan(99, 3)
+    path = plan.save(tmp_path / "plan.json")
+    loaded = FaultPlan.load(path)
+    assert loaded.to_doc() == plan.to_doc()
+
+
+def test_plan_rejects_unknown_event_kind(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"seed": 1, "events": [
+        {"at_s": 0.1, "kind": "meteor_strike", "worker": 0}]}))
+    with pytest.raises(ClawkerError, match="meteor_strike"):
+        FaultPlan.load(p)
+
+
+def test_plan_rejects_sigkill_at_unknown_seam(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"seed": 1, "events": [
+        {"at_s": 0.1, "kind": "cli_sigkill", "arg": "no.such.seam"}]}))
+    with pytest.raises(ClawkerError, match="unknown seam"):
+        FaultPlan.load(p)
+
+
+# ------------------------------------------------------------------- seams
+
+
+def test_seam_registry_fires_once_and_logs():
+    reg = SeamRegistry()
+    hits = []
+    reg.arm("launch.pre_create", lambda: hits.append(1))
+    reg.fire("launch.pre_create")
+    reg.fire("launch.pre_create")       # consumed: second fire is a no-op
+    assert hits == [1]
+    assert reg.fired == ["launch.pre_create"]
+
+
+def test_seam_registry_rejects_unknown_names():
+    reg = SeamRegistry()
+    with pytest.raises(ValueError, match="unknown crash seam"):
+        reg.arm("not.a.seam", lambda: None)
+
+
+def test_scheduler_null_seams_cannot_be_armed(env):
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=1)
+    drv.api.add_image(IMAGE)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=1,
+                                             image=IMAGE))
+    with pytest.raises(RuntimeError, match="null seam registry"):
+        sched.seams.arm("launch.pre_create", lambda: None)
+
+
+def test_scheduler_fires_lifecycle_seams(env):
+    """A run's seam fire log covers the launch + exit boundaries, and
+    an armed hook that raises SeamAbort kills the path like SIGKILL."""
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, exit_behavior(b"", 0))
+    seams = SeamRegistry()
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=1,
+                                             image=IMAGE), seams=seams)
+    hits: list[str] = []
+    for seam in ("run.post_placement", "launch.pre_create",
+                 "launch.post_create", "launch.pre_start",
+                 "launch.post_start", "iteration.post_exit"):
+        seams.arm(seam, lambda seam=seam: hits.append(seam))
+    sched.start()
+    sched.run(poll_s=0.05)
+    sched.cleanup(remove_containers=True)
+    assert set(hits) == {"run.post_placement", "launch.pre_create",
+                         "launch.post_create", "launch.pre_start",
+                         "launch.post_start", "iteration.post_exit"}
+    assert seams.fired == hits
+    # benign hooks must not perturb the run
+    assert all(l.status == "done" for l in sched.loops)
+
+
+def test_armed_seam_kills_scheduler_mid_create(env):
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, exit_behavior(b"", 0))
+    seams = SeamRegistry()
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=1,
+                                             image=IMAGE), seams=seams)
+
+    def die():
+        sched.kill()
+        raise SeamAbort("test kill at pre_create")
+
+    seams.arm("launch.pre_create", die)
+    sched.start()
+    sched.run(poll_s=0.05)
+    assert "launch.pre_create" in seams.fired
+    # the journal records the placement the WAL wrote before the kill,
+    # but never a create for the killed slot's in-flight attempt
+    recs = [json.loads(l) for l in
+            journal_path(cfg.logs_dir, sched.loop_id)
+            .read_text().splitlines()]
+    assert any(r["kind"] == "placement" for r in recs)
+
+
+# -------------------------------------------------------------- fault gate
+
+
+def _gated_api(n=1):
+    drv = FakeDriver(n_workers=n)
+    drv.api.add_image(IMAGE)
+    return drv, drv.workers()[0].require_engine()
+
+
+def test_fault_gate_burst_self_heals():
+    drv, engine = _gated_api()
+    drv.inject_fault(0, "burst", count=2)
+    for _ in range(2):
+        with pytest.raises(DriverError, match="5xx"):
+            engine.ping()
+    assert engine.ping() is True        # burst spent: healed
+    assert drv.gates[0].injected == 2
+
+
+def test_fault_gate_probe_drop_fails_ping_only():
+    drv, engine = _gated_api()
+    drv.inject_fault(0, "probe_drop")
+    with pytest.raises(DriverError, match="probe channel"):
+        engine.ping()
+    assert engine.list_containers(all=True) == []   # data path healthy
+    drv.clear_fault(0)
+    assert engine.ping() is True
+
+
+def test_fault_gate_slow_delays_calls():
+    drv, engine = _gated_api()
+    drv.inject_fault(0, "slow", delay_s=0.05)
+    t0 = time.monotonic()
+    engine.ping()
+    assert time.monotonic() - t0 >= 0.05
+    drv.clear_fault(0)
+
+
+# -------------------------------------------------------------- invariants
+
+
+def _clean_run(cfg, n_loops=2, n_workers=2):
+    drv = FakeDriver(n_workers=n_workers)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, exit_behavior(b"", 0))
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=n_loops, iterations=1,
+                                             image=IMAGE))
+    sched.start()
+    sched.run(poll_s=0.05)
+    sched.cleanup(remove_containers=True)
+    return drv, sched
+
+
+def test_invariants_pass_on_clean_run(env):
+    tenv, proj, cfg = env
+    drv, sched = _clean_run(cfg)
+    assert check_invariants(drv, cfg, sched.loop_id, loops=sched.loops,
+                            cap=4) == []
+
+
+def test_invariants_flag_double_accounted_exit(env):
+    tenv, proj, cfg = env
+    drv, sched = _clean_run(cfg)
+    jpath = journal_path(cfg.logs_dir, sched.loop_id)
+    agent = sched.loops[0].agent
+    with open(jpath, "a") as fh:
+        fh.write(json.dumps({"kind": "exited", "seq": 9999, "ts": 0,
+                             "agent": agent, "iteration": 0, "code": 0})
+                 + "\n")
+    out = check_invariants(drv, cfg, sched.loop_id, loops=sched.loops)
+    assert any(v.startswith("exit-accounted-once") for v in out)
+
+
+def test_invariants_flag_unjournaled_create(env):
+    """A daemon-side create with no write-ahead placement record is a
+    duplicate-create violation (the adoption-should-have-happened bug)."""
+    tenv, proj, cfg = env
+    drv, sched = _clean_run(cfg)
+    from clawker_tpu.runtime.names import container_name
+
+    agent = sched.loops[0].agent
+    wid = sched.loops[0].worker.id
+    api = drv.apis[[w.id for w in drv.workers()].index(wid)]
+    # simulate a second create the journal never authorized
+    api.container_create(container_name(cfg.project_name(), agent) + "-x",
+                         {"Image": IMAGE})  # unrelated name: ignored
+    api._record("container_create",
+                container_name(cfg.project_name(), agent), {})
+    out = check_invariants(drv, cfg, sched.loop_id, loops=sched.loops)
+    assert any(v.startswith("duplicate-create") for v in out)
+
+
+def test_invariants_flag_leaked_container(env):
+    tenv, proj, cfg = env
+    drv, sched = _clean_run(cfg)
+    drv.apis[0].add_container("leftover", image=IMAGE,
+                              labels={consts.LABEL_LOOP: sched.loop_id})
+    out = check_invariants(drv, cfg, sched.loop_id, loops=sched.loops)
+    assert any(v.startswith("leaked-container") for v in out)
+
+
+def test_cleanup_sweeps_journaled_workers_no_final_loop_references(env):
+    """Regression (found by the first chaos soak): after kill/resume
+    cycles a worker can hold an earlier generation's leftovers while
+    every final-generation loop points elsewhere -- cleanup's label
+    sweep must cover every JOURNALED worker, not just the final
+    placements.  (It must also stay bounded by the run: a worker no
+    generation saw is not listed.)"""
+    from clawker_tpu.loop.journal import RunImage
+
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=2)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, exit_behavior(b"", 0))
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=1,
+                                             image=IMAGE, placement="pack"))
+    sched.start()
+    sched.run(poll_s=0.05)
+    # this generation is a resume: the journaled fleet includes the
+    # OTHER worker, which holds an orphaned copy from the crashed
+    # generation (full managed label set, like any real create would
+    # carry -- the engine's label jail filters unmanaged rows out)
+    sched._image = RunImage(run_id=sched.loop_id,
+                            workers=[w.id for w in drv.workers()])
+    other_api = drv.apis[1]
+    other_api.add_container(
+        "clawker.chaosproj.ghost", image=IMAGE, state="exited",
+        labels={consts.LABEL_LOOP: sched.loop_id,
+                consts.LABEL_MANAGED: consts.MANAGED_VALUE,
+                consts.LABEL_PROJECT: "chaosproj"})
+    sched.cleanup(remove_containers=True)
+    leaked = [c for c in other_api.containers.values()
+              if c.labels.get(consts.LABEL_LOOP) == sched.loop_id]
+    assert leaked == []
+
+
+# ------------------------------------------------------------------- soak
+
+
+def test_scenario_with_sigkill_and_torn_tail_holds_invariants(env):
+    tenv, proj, cfg = env
+    plan = FaultPlan(seed=1, scenario=0, n_workers=4, n_loops=4,
+                     iterations=2, events=[
+                         FaultEvent(at_s=0.05, kind="cli_sigkill",
+                                    worker=-1, arg="launch.post_start",
+                                    torn_tail=20),
+                         FaultEvent(at_s=0.2, kind="worker_kill", worker=1),
+                         FaultEvent(at_s=0.5, kind="worker_revive",
+                                    worker=1),
+                     ])
+    result = ChaosRunner(cfg, plan).run_scenario()
+    assert result.ok, result.violations
+    assert result.kills == 1 and result.generations == 2
+
+
+def test_soak_fixed_seed_passes_and_is_replayable(env):
+    tenv, proj, cfg = env
+    report = run_soak(4, 20260803, cfg=cfg, shrink=False)
+    assert report["ok"], report["failures"]
+    assert report["passed"] == 4
+    # any scenario replays deterministically from (seed, index)
+    r = run_plan(generate_plan(20260803, 2), cfg=cfg)
+    assert r.ok, r.violations
+
+
+def test_shrink_reduces_failing_plan():
+    """shrink_plan on a plan whose failure is event-independent
+    converges to an empty (or strictly smaller) schedule."""
+    calls = []
+
+    import clawker_tpu.chaos.runner as runner_mod
+
+    plan = generate_plan(5, 0)
+    assert plan.events
+
+    real_run_plan = runner_mod.run_plan
+
+    def fake_run_plan(p, **kw):
+        calls.append(len(p.events))
+        from clawker_tpu.chaos.runner import ScenarioResult
+
+        return ScenarioResult(seed=p.seed, scenario=p.scenario, ok=False,
+                              violations=["synthetic: always fails"])
+
+    runner_mod.run_plan, orig = fake_run_plan, real_run_plan
+    try:
+        minimal, res = shrink_plan(plan)
+    finally:
+        runner_mod.run_plan = orig
+    assert minimal.events == []
+    assert not res.ok
+
+
+# ------------------------------------- adversarial under load (config #5)
+
+
+def test_adversarial_suite_under_fleet_load(env):
+    """BASELINE config #5 shape: the adversarial payload corpus runs
+    CONCURRENTLY with an 8-loop fleet on the 4-worker fake pod.
+    Enforcement grading must not change under contention (identical
+    capture counts, zero escapes) and the fleet's invariants must hold.
+    """
+    from clawker_tpu.adversarial import run_corpus
+
+    tenv, proj, cfg = env
+    baseline = run_corpus()
+    assert baseline.escaped == 0
+
+    drv = FakeDriver(n_workers=4)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, exit_behavior(b"", 0, delay=0.01))
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=8, iterations=2,
+                                             image=IMAGE))
+    sched.start()
+    runner = threading.Thread(target=sched.run, kwargs={"poll_s": 0.05},
+                              daemon=True)
+    runner.start()
+    reports = []
+    while runner.is_alive():
+        reports.append(run_corpus())
+    runner.join(30.0)
+    if not reports:         # fleet drained before one corpus pass: rerun
+        reports.append(run_corpus())
+    sched.cleanup(remove_containers=True)
+    for rep in reports:
+        assert rep.escaped == 0
+        assert (rep.total, rep.captured, rep.contained) == (
+            baseline.total, baseline.captured, baseline.contained)
+    assert all(l.status == "done" and l.iteration == 2
+               for l in sched.loops)
+    assert check_invariants(drv, cfg, sched.loop_id, loops=sched.loops,
+                            cap=4) == []

@@ -1,0 +1,136 @@
+"""Read-only bind regression tests (ADVICE r5): pure-python paths, so
+unlike tests/test_nsd.py these run without root/unshare.
+
+- ``put_archive`` targeting a ``:ro`` bind must refuse (the resolver
+  maps archive writes to the bind SOURCE on the host -- honoring the
+  flag is what keeps a read-only mount from being writable through the
+  API); the nsd server maps the refusal to a 403.
+- The shim's read-only remount must tolerate kernels that reject
+  MS_REMOUNT|MS_BIND|MS_REC with EINVAL by retrying non-recursively
+  instead of aborting container start.
+"""
+
+from __future__ import annotations
+
+import errno
+import io
+import tarfile
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu.nsd import shim
+from clawker_tpu.nsd.runtime import NsContainer, NsRuntime
+
+
+def _tar(name: str, data: bytes) -> bytes:
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        ti = tarfile.TarInfo(name)
+        ti.size = len(data)
+        tf.addfile(ti, io.BytesIO(data))
+    return buf.getvalue()
+
+
+@pytest.fixture
+def rt(tmp_path, monkeypatch):
+    runtime = NsRuntime(tmp_path / "state")
+    # no overlayfs without root: archive resolution never needs the
+    # mount, only the merged dir
+    monkeypatch.setattr(NsRuntime, "_mount_overlay", lambda self, c: None)
+    return runtime
+
+
+def _container(tmp_path, binds: list[str]) -> NsContainer:
+    cdir = tmp_path / "ctr"
+    (cdir / "merged").mkdir(parents=True)
+    return NsContainer(
+        id="c" * 64, name="ro-test", cgroup_dir=None, dir=cdir,
+        config={"Image": "busybox", "HostConfig": {"Binds": binds}})
+
+
+def test_put_archive_refuses_ro_bind(rt, tmp_path):
+    host_src = tmp_path / "host-src"
+    host_src.mkdir()
+    c = _container(tmp_path, [f"{host_src}:/cfg:ro"])
+    with pytest.raises(PermissionError, match="read-only"):
+        rt.put_archive(c, "/cfg", _tar("evil.txt", b"write-through\n"))
+    # the refusal must come before any write reaches the host source
+    assert list(host_src.iterdir()) == []
+    # nested path under the ro bind is refused too
+    with pytest.raises(PermissionError):
+        rt.put_archive(c, "/cfg/sub/dir", _tar("evil.txt", b"x"))
+
+
+def test_put_archive_still_writes_rw_bind_and_overlay(rt, tmp_path):
+    host_src = tmp_path / "host-rw"
+    host_src.mkdir()
+    c = _container(tmp_path, [f"{host_src}:/work",
+                              f"{tmp_path / 'ro-src'}:/cfg:ro"])
+    (tmp_path / "ro-src").mkdir()
+    rt.put_archive(c, "/work", _tar("in.txt", b"bind-routed\n"))
+    assert (host_src / "in.txt").read_bytes() == b"bind-routed\n"
+    rt.put_archive(c, "/plain", _tar("f.txt", b"overlay\n"))
+    assert (c.merged / "plain" / "f.txt").read_bytes() == b"overlay\n"
+
+
+def test_get_archive_reads_through_ro_bind(rt, tmp_path):
+    host_src = tmp_path / "host-ro"
+    host_src.mkdir()
+    (host_src / "f.txt").write_bytes(b"readable\n")
+    c = _container(tmp_path, [f"{host_src}:/cfg:ro"])
+    out = rt.get_archive(c, "/cfg/f.txt")
+    with tarfile.open(fileobj=io.BytesIO(out)) as tf:
+        assert tf.extractfile("f.txt").read() == b"readable\n"
+
+
+def test_resolver_reports_ro_of_longest_matching_bind(rt, tmp_path):
+    ro_src, rw_src = tmp_path / "ro", tmp_path / "rw"
+    ro_src.mkdir(), rw_src.mkdir()
+    c = _container(tmp_path, [f"{ro_src}:/data:ro",
+                              f"{rw_src}:/data/rw"])
+    # the deeper rw bind shadows the ro parent under its own subtree
+    _, p, ro = rt._resolve_in_rootfs(c, "/data/rw/x")
+    assert not ro and str(p).startswith(str(rw_src.resolve()))
+    _, p, ro = rt._resolve_in_rootfs(c, "/data/other")
+    assert ro and str(p).startswith(str(ro_src.resolve()))
+
+
+# ----------------------------------------------------------------- shim
+
+
+def test_shim_ro_remount_retries_without_ms_rec_on_einval(monkeypatch):
+    calls: list[tuple[str, int]] = []
+
+    def fake_mount(src, dst, fstype, flags, data=""):
+        calls.append((dst, flags))
+        if flags & shim.MS_REMOUNT and flags & shim.MS_REC:
+            raise OSError(errno.EINVAL, "older kernel: no recursive "
+                                        "ro bind remount")
+
+    monkeypatch.setattr(shim, "_mount", fake_mount)
+    shim._remount_ro("/t")
+    assert calls == [
+        ("/t", shim.MS_BIND | shim.MS_REMOUNT | shim.MS_RDONLY
+         | shim.MS_REC),
+        ("/t", shim.MS_BIND | shim.MS_REMOUNT | shim.MS_RDONLY),
+    ]
+
+
+def test_shim_ro_remount_propagates_non_einval(monkeypatch):
+    def fake_mount(src, dst, fstype, flags, data=""):
+        raise OSError(errno.EPERM, "not allowed")
+
+    monkeypatch.setattr(shim, "_mount", fake_mount)
+    with pytest.raises(OSError) as ei:
+        shim._remount_ro("/t")
+    assert ei.value.errno == errno.EPERM
+
+
+def test_shim_ro_remount_single_call_when_supported(monkeypatch):
+    calls: list[int] = []
+    monkeypatch.setattr(shim, "_mount",
+                        lambda *a, **k: calls.append(a[3]))
+    shim._remount_ro("/t")
+    assert calls == [shim.MS_BIND | shim.MS_REMOUNT | shim.MS_RDONLY
+                     | shim.MS_REC]
