@@ -937,6 +937,178 @@ def bench_chaos_soak(scenarios: int = CHAOS_SOAK_SCENARIOS,
     }
 
 
+LOOPD_SUBMIT_BUDGET_MS = 5.0  # submit frame -> submitted ack over the
+#                               loopd unix socket: the per-run cost the
+#                               daemon split adds on top of scheduling
+#                               (ISSUE 9 acceptance; the point of a
+#                               resident daemon is that hundreds of
+#                               loops stop paying a CLI start-up)
+
+
+def bench_loopd_submit_roundtrip(iters: int = 14) -> dict:
+    """loopd_submit_roundtrip_p50: p50 milliseconds from a client's
+    ``submit_run`` frame hitting the daemon socket to the ``submitted``
+    ack (run registered, id assigned) -- ISSUE 9 gate <= 5ms.  Each
+    submitted run is also driven to completion and its first
+    ``created`` event timed, so the reported doc carries the full
+    submit -> first-container picture alongside the gated hop."""
+    from clawker_tpu import consts
+    from clawker_tpu.config import load_config
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.engine.fake import exit_behavior
+    from clawker_tpu.loopd.client import LoopdClient
+    from clawker_tpu.loopd.server import LoopdServer
+    from clawker_tpu.testenv import TestEnv
+
+    acks: list[float] = []
+    createds: list[float] = []
+    ok_runs = 0
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: benchloopd\n")
+        cfg = load_config(proj)
+        drv = FakeDriver(n_workers=1)
+        drv.api.add_image("clawker-benchloopd:default")
+        drv.api.set_behavior("clawker-benchloopd:default",
+                             exit_behavior(b"done\n", 0))
+        server = LoopdServer(cfg, drv).start()
+        try:
+            for i in range(iters + 2):      # two warmups eat lazy imports
+                client = LoopdClient(server.sock_path)
+                client.hello()
+                t0 = time.perf_counter()
+                client.submit_run({"parallel": 1, "iterations": 1})
+                ack_ms = (time.perf_counter() - t0) * 1000
+                first_created = None
+                done_ok = False
+                for frame in client.events():
+                    if (frame.get("type") == "event"
+                            and frame.get("event") == "created"
+                            and first_created is None):
+                        first_created = (time.perf_counter() - t0) * 1000
+                    if frame.get("type") == "run_done":
+                        done_ok = frame["ok"]
+                client.close()
+                if i >= 2:
+                    acks.append(ack_ms)
+                    if first_created is not None:
+                        createds.append(first_created)
+                    ok_runs += int(done_ok)
+        finally:
+            server.stop()
+    return {
+        "submit_p50_ms": round(statistics.median(acks), 3),
+        "submit_max_ms": round(max(acks), 3),
+        "first_created_p50_ms": round(statistics.median(createds), 3)
+        if createds else 0.0,
+        "iters": iters,
+        "runs_ok": ok_runs,
+    }
+
+
+def bench_cross_process_fairness(loops_per_client: int = 6,
+                                 cap: int = 2) -> dict:
+    """cross_process_fairness: TWO real client processes submit
+    concurrent runs to ONE loopd (pack onto one slow worker).  The
+    daemon-side launch high-water mark must hold the shared admission
+    cap -- the exact failure PR-6's per-process controllers allowed --
+    and the WFQ must interleave the tenants (both bursts overlap in
+    wall time) instead of first-burst-wins (ISSUE 9 acceptance)."""
+    import os
+    import subprocess
+    import sys
+
+    from clawker_tpu import consts
+    from clawker_tpu.config import load_config
+    from clawker_tpu.engine.fake import exit_behavior
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.loopd.server import LoopdServer
+    from clawker_tpu.testenv import TestEnv
+
+    child_src = (
+        "import json, sys, time\n"
+        "from clawker_tpu.loopd.client import LoopdClient\n"
+        "sock, tenant, n = sys.argv[1], sys.argv[2], int(sys.argv[3])\n"
+        "c = LoopdClient(sock)\n"
+        "c.hello()\n"
+        "c.submit_run({'parallel': n, 'iterations': 1,\n"
+        "              'placement': 'pack', 'tenant': tenant})\n"
+        "created, ok = [], False\n"
+        "for frame in c.events():\n"
+        "    if (frame.get('type') == 'event'\n"
+        "            and frame.get('event') == 'created'):\n"
+        "        created.append(time.time())\n"
+        "    if frame.get('type') == 'run_done':\n"
+        "        ok = frame['ok']\n"
+        "c.close()\n"
+        "print(json.dumps({'tenant': tenant, 'ok': ok,\n"
+        "                  'created': created}))\n"
+    )
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: benchloopd\n")
+        cfg = load_config(proj)
+        # the shared bucket's capacity is DAEMON state (settings), the
+        # whole point: no client can widen it from its own process
+        cfg.settings.loop.placement.max_inflight_per_worker = cap
+        drv = FakeDriver(n_workers=1)
+        api = drv.api
+        api.add_image("clawker-benchloopd:default")
+        api.set_behavior("clawker-benchloopd:default",
+                         exit_behavior(b"done\n", 0))
+        orig_create = api.container_create
+
+        def slow_create(name, config):
+            time.sleep(0.02)    # bursts must genuinely overlap
+            return orig_create(name, config)
+
+        api.container_create = slow_create
+        server = LoopdServer(cfg, drv).start()
+        env = dict(os.environ)
+        env["PYTHONPATH"] = str(Path(__file__).resolve().parent)
+        t0 = time.perf_counter()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", child_src, str(server.sock_path),
+                 tenant, str(loops_per_client)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env)
+            for tenant in ("tenant-a", "tenant-b")
+        ]
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            outs.append((p.returncode, out, err))
+        wall = time.perf_counter() - t0
+        stats = server.admission.stats()
+        launch_hwm = drv.gates[0].launch_hwm
+        server.stop()
+    results = []
+    for rc, out, err in outs:
+        if rc != 0:
+            return {"both_ok": False, "cap": cap, "cap_respected": False,
+                    "interleaved": False, "wall_s": round(wall, 3),
+                    "error": err.decode(errors="replace")[-400:]}
+        results.append(json.loads(out.decode()))
+    by_tenant = {r["tenant"]: r for r in results}
+    a, b = by_tenant["tenant-a"], by_tenant["tenant-b"]
+    overlap = (a["created"] and b["created"]
+               and max(a["created"][0], b["created"][0])
+               < min(a["created"][-1], b["created"][-1]))
+    admission_hwm = stats["workers"].get("fake-0", {}).get("inflight_hwm", 0)
+    return {
+        "both_ok": bool(a["ok"] and b["ok"]),
+        "cap": cap,
+        "daemon_launch_hwm": launch_hwm,
+        "admission_inflight_hwm": admission_hwm,
+        "cap_respected": launch_hwm <= cap and admission_hwm <= cap,
+        "interleaved": bool(overlap),
+        "loops_per_client": loops_per_client,
+        "wall_s": round(wall, 3),
+    }
+
+
 def bench_engine_dials(per_dial_delay: float = 0.01) -> dict:
     """Engine-API socket dials behind one `clawker run` orchestration.
 
@@ -1238,6 +1410,8 @@ def main() -> None:
     resume = bench_resume_reattach()
     pool_hit = bench_warm_pool_hit()
     pool_burst = bench_warm_pool_refill_burst()
+    loopd_rt = bench_loopd_submit_roundtrip()
+    fairness = bench_cross_process_fairness()
     dials = bench_engine_dials()
     tele = bench_telemetry_overhead()
     anom = bench_anomaly()
@@ -1321,6 +1495,22 @@ def main() -> None:
              if pool_burst["all_loops_done"] and pool_burst["pool_refilled"]
              and not pool_burst["leaked_containers"] else 0.0),
          "detail": pool_burst},
+        {"metric": "loopd_submit_roundtrip_p50",
+         "value": loopd_rt["submit_p50_ms"], "unit": "ms",
+         # headroom under the 5ms submit-hop budget; a leg whose runs
+         # failed must read FAILED, never merely fast
+         "vs_baseline": (round(
+             LOOPD_SUBMIT_BUDGET_MS / max(loopd_rt["submit_p50_ms"], 1e-9),
+             1) if loopd_rt["runs_ok"] == loopd_rt["iters"] else 0.0),
+         "detail": loopd_rt},
+        {"metric": "cross_process_fairness", "value": fairness["wall_s"],
+         "unit": "s",
+         # the gate IS the invariant set: two client processes, one
+         # daemon -- cap held at the daemon, tenants interleaved
+         "vs_baseline": (1.0 if fairness["both_ok"]
+                         and fairness["cap_respected"]
+                         and fairness["interleaved"] else 0.0),
+         "detail": fairness},
         {"metric": "engine_dials_per_run", "value": dials["dials_pooled"],
          "unit": "dials",
          # vs_baseline IS the dial reduction over the dial-per-request

@@ -37,6 +37,14 @@ SEAM_NAMES = (
     "resume.pre_reconcile",     # resume generation built, nothing adopted
     "resume.post_adopt",        # one container adopted in place
     "pool.post_fill",           # a warm-pool member created (REC_POOL_READY)
+    # loopd transition boundaries (docs/loopd.md): the daemon fires
+    # these around run registration so daemon crashes are soak-testable
+    # exactly like CLI crashes -- a kill here leaves a journaled run
+    # whose submitting client may or may not have seen the ack
+    "loopd.post_submit",        # run registered in the daemon's table,
+    #                             ack NOT yet sent to the client
+    "loopd.post_ack",           # ack sent; scheduler start + streaming
+    #                             not begun
 )
 
 

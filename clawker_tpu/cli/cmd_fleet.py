@@ -120,6 +120,36 @@ def fleet_provision(f: Factory, dry_run, no_firewall, no_cp, only, jobs):
         raise SystemExit(1)
 
 
+def _loopd_status(f: Factory, no_daemon: bool) -> dict | None:
+    """One status RPC to a discovered loopd, or None (degrade to the
+    CLI-side probe path).  The daemon already probes the fleet
+    continuously -- fleet views should read ITS breakers instead of
+    spinning up their own probe rounds (docs/loopd.md)."""
+    if no_daemon:
+        return None
+    from ..loopd.client import discover
+
+    # project-scoped like the loop submit path: the socket lives under
+    # the GLOBAL state dir, and rendering another project's daemon
+    # state here (or gating CI exit codes on its breakers) would lie
+    try:
+        project = f.config.project_name()
+    except LookupError:
+        project = None
+    client = discover(f.config, require_project=project)
+    if client is None:
+        return None
+    try:
+        doc = client.status()
+    except Exception as e:      # noqa: BLE001 -- view must degrade
+        click.echo(f"loopd status failed ({e}); probing directly",
+                   err=True)
+        return None
+    finally:
+        client.close()
+    return doc
+
+
 _HEALTH_COLUMNS = ("WORKER", "STATE", "BRK", "P50MS", "P95MS", "PROBES",
                    "FAILS", "ORPHANED", "MIG-OUT", "MIG-IN", "LAST-ERROR")
 
@@ -148,19 +178,42 @@ def _health_rows(stats: list[dict]) -> list[str]:
               help="Probe/refresh interval seconds (with --watch).")
 @click.option("--format", "fmt", type=click.Choice(["table", "json"]),
               default="table")
+@click.option("--no-daemon", is_flag=True,
+              help="Probe directly even when a loopd daemon is running.")
 @pass_factory
-def fleet_health(f: Factory, probes, watch, interval, fmt):
+def fleet_health(f: Factory, probes, watch, interval, fmt, no_daemon):
     """Per-worker breaker state, probe latency, and failover counters.
 
-    Probes every worker of the active runtime driver through the same
-    probe hook and circuit breakers `clawker loop --failover` uses
-    (docs/fleet-health.md).  One-shot by default: exits non-zero when
-    any worker's breaker is not closed.
+    With a loopd daemon running (docs/loopd.md) this renders the
+    daemon's LIVE breakers over its status RPC -- the breakers actual
+    placements use -- instead of a fresh CLI-side probe round.
+    Otherwise probes every worker of the active runtime driver through
+    the same probe hook and circuit breakers `clawker loop --failover`
+    uses (docs/fleet-health.md).  One-shot by default: exits non-zero
+    when any worker's breaker is not closed.
     """
     import json as _json
     import time as _time
 
     from ..health import BreakerConfig, HealthConfig, HealthMonitor
+
+    if not watch:
+        doc = _loopd_status(f, no_daemon)
+        if doc is not None:
+            stats = doc.get("health", [])
+            if fmt == "json":
+                click.echo(_json.dumps(
+                    {"source": f"loopd:{doc.get('pid')}", "health": stats},
+                    indent=2))
+            else:
+                click.echo(f"source: loopd (pid {doc.get('pid')}, "
+                           f"{len(doc.get('runs', []))} hosted run(s))",
+                           err=True)
+                for line in _health_rows(stats):
+                    click.echo(line)
+            if any(s["state"] != "closed" for s in stats):
+                raise SystemExit(1)
+            return
 
     # one-shot: the breaker must be able to open within the rounds the
     # user asked for, or `--probes 1` would report a dead fleet healthy
@@ -217,29 +270,103 @@ _PLACEMENT_COLUMNS = ("WORKER", "STATE", "COORD", "GROUP", "P50MS",
                    "depth, in-flight tokens, and rejection counts.")
 @click.option("--format", "fmt", type=click.Choice(["table", "json"]),
               default="table")
+@click.option("--no-daemon", is_flag=True,
+              help="Probe directly even when a loopd daemon is running.")
 @pass_factory
-def fleet_placement(f: Factory, policy, slots, probes, metrics_url, fmt):
+def fleet_placement(f: Factory, policy, slots, probes, metrics_url, fmt,
+                    no_daemon):
     """Placement & admission view: per-worker tokens, shares, queue depth.
 
-    Probes every worker of the active runtime driver (the same breakers
-    `clawker loop` places against), derives the pod topology, and shows
-    how the chosen policy would spread N loop slots -- plus the
-    admission token/queue configuration and per-tenant fairness shares
-    (docs/loop-placement.md).  With ``--metrics-url`` pointing at a live
-    run's metrics port, the static view is joined by the run's actual
-    queue depths and in-flight token counts.
+    With a loopd daemon running (docs/loopd.md) the breakers, probe
+    latencies, token counts, and tenant queues come straight off the
+    daemon's status RPC -- the LIVE admission state every concurrent
+    run bills against -- instead of a fresh CLI-side probe round.
+    Otherwise probes every worker of the active runtime driver (the
+    same breakers `clawker loop` places against), derives the pod
+    topology, and shows how the chosen policy would spread N loop
+    slots -- plus the admission token/queue configuration and
+    per-tenant fairness shares (docs/loop-placement.md).  With
+    ``--metrics-url`` pointing at a live run's metrics port, the
+    static view is joined by the run's actual queue depths and
+    in-flight token counts.
     """
     import json as _json
     from collections import Counter
 
+    from ..engine.drivers import Worker
     from ..fleet.inventory import pod_topology
-    from ..health import BreakerConfig, HealthConfig, HealthMonitor
+    from ..health import BREAKER_CLOSED, BreakerConfig, HealthConfig, HealthMonitor
     from ..placement import PlacementContext, get_policy
 
     settings = f.config.settings
     pdef = settings.loop.placement
     policy_name = policy or pdef.policy
     n_slots = slots or settings.loop.parallel
+    daemon_doc = _loopd_status(f, no_daemon) if not metrics_url else None
+    if daemon_doc is not None:
+        hstats = daemon_doc.get("health", [])
+        astats = daemon_doc.get("admission", {})
+        # plan preview over the DAEMON's breakers/latency: engine-less
+        # stand-in workers are fine, policies only read ids/indices
+        workers = [Worker(id=s["worker"], index=i, hostname=s["worker"])
+                   for i, s in enumerate(hstats)]
+        breaker = {s["worker"]: s["state"] for s in hstats}
+        lat = {s["worker"]: s.get("probe_p50_ms", 0.0) / 1000.0
+               for s in hstats}
+        topo = pod_topology(settings.runtime.tpu, len(workers))
+        ctx = PlacementContext(
+            workers=workers,
+            breaker_state=lambda wid: breaker.get(wid, BREAKER_CLOSED),
+            latency_s=lambda wid: lat.get(wid, 0.0), topology=topo)
+        eng = get_policy(policy_name)
+        try:
+            plan = Counter(w.id for w in eng.plan(ctx, n_slots))
+        except Exception as e:      # noqa: BLE001 -- preview must render
+            plan = Counter()
+            click.echo(f"plan: {e}", err=True)
+        aworkers = astats.get("workers", {})
+        cap = astats.get("max_inflight_per_worker",
+                         pdef.max_inflight_per_worker)
+        rows = []
+        for w in workers:
+            coord = topo.coords.get(w.index) if topo.known else None
+            aw = aworkers.get(w.id, {})
+            rows.append({
+                "worker": w.id,
+                "state": breaker.get(w.id, "closed"),
+                "coord": f"{coord[0]},{coord[1]}" if coord else "-",
+                "group": topo.group_of(w.index) if topo.known else "-",
+                "probe_p50_ms": round(lat.get(w.id, 0.0) * 1000, 2),
+                "weight": round(ctx.weight(w.id), 2),
+                "planned_slots": plan.get(w.id, 0),
+                "tokens": f"{aw.get('inflight', 0)}"
+                          f"/{aw.get('capacity', cap)}",
+                "rejections": aw.get("rejected", 0),
+            })
+        doc = {
+            "source": f"loopd:{daemon_doc.get('pid')}",
+            "policy": policy_name,
+            "slots": n_slots,
+            "topology": ({"rows": topo.rows, "cols": topo.cols}
+                         if topo.known else None),
+            "admission": {
+                "max_inflight_per_worker": cap,
+                "max_pending_per_worker": astats.get(
+                    "max_pending_per_worker", pdef.max_pending_per_worker),
+            },
+            "tenants": {
+                t: {"weight": s["weight"], "queue_depth": s["queued"],
+                    "inflight": s["inflight"],
+                    "dispatched": s["dispatched"]}
+                for t, s in astats.get("tenants", {}).items()},
+            "workers": rows,
+        }
+        if fmt == "table":
+            click.echo(f"source: loopd (pid {daemon_doc.get('pid')}, "
+                       f"{len(daemon_doc.get('runs', []))} hosted "
+                       "run(s))", err=True)
+        _render_placement(doc, topo, fmt)
+        return
     # same clamp as fleet health: the breaker must be able to open
     # within the probe rounds requested, or --probes 1 would preview a
     # dead fleet as healthy (and plan slots onto it)
@@ -293,16 +420,27 @@ def fleet_placement(f: Factory, policy, slots, probes, metrics_url, fmt):
                                    "max_inflight": pdef.tenant_max_inflight}}),
         "workers": rows,
     }
+    _render_placement(doc, topo, fmt)
+
+
+def _render_placement(doc: dict, topo, fmt: str) -> None:
+    """Shared render + exit contract for both placement sources (CLI
+    probe round and loopd status RPC): exits non-zero when any worker's
+    breaker is not closed, in both formats, so CI gates identically."""
+    import json as _json
+
+    rows = doc["workers"]
+    adm = doc["admission"]
     unhealthy = any(r["state"] != "closed" for r in rows)
     if fmt == "json":
         click.echo(_json.dumps(doc, indent=2))
-        if unhealthy:       # same exit contract as the table (and fleet
-            raise SystemExit(1)                         # health): both
-        return              # formats must gate CI identically
-    click.echo(f"policy={policy_name} slots={n_slots} "
+        if unhealthy:
+            raise SystemExit(1)
+        return
+    click.echo(f"policy={doc['policy']} slots={doc['slots']} "
                f"topology={'%dx%d' % (topo.rows, topo.cols) if topo.known else 'unknown (spread fallback)'} "
-               f"admission={pdef.max_inflight_per_worker} in-flight / "
-               f"{pdef.max_pending_per_worker} pending per worker")
+               f"admission={adm['max_inflight_per_worker']} in-flight / "
+               f"{adm['max_pending_per_worker']} pending per worker")
     lines = ["\t".join(_PLACEMENT_COLUMNS)]
     for r in rows:
         lines.append("\t".join(str(x) for x in (
@@ -363,14 +501,18 @@ def _scrape_placement_metrics(url: str) -> dict:
                    "path) and show its journaled pool membership.")
 @click.option("--format", "fmt", type=click.Choice(["table", "json"]),
               default="table")
+@click.option("--no-daemon", is_flag=True,
+              help="Skip loopd discovery; settings/metrics/journal only.")
 @pass_factory
-def fleet_warmpool(f: Factory, metrics_url, run_ref, fmt):
+def fleet_warmpool(f: Factory, metrics_url, run_ref, fmt, no_daemon):
     """Warm-pool view: settings, live depth/hit counters, membership.
 
     The warm pool keeps pre-created agent containers per worker that
     loop placements adopt instead of paying a full create
-    (docs/loop-warmpool.md).  With ``--metrics-url`` pointing at a live
-    run's metrics port this shows the run's actual per-worker depth and
+    (docs/loop-warmpool.md).  With a loopd daemon running
+    (docs/loopd.md) this shows every hosted run's live pool state over
+    the status RPC; with ``--metrics-url`` pointing at a live run's
+    metrics port it shows the run's actual per-worker depth and
     hit/miss/refill counters; with ``--run`` it replays that run's
     journal and lists every pool member's journaled state (what a
     ``--resume`` would restore or sweep).
@@ -386,6 +528,11 @@ def fleet_warmpool(f: Factory, metrics_url, run_ref, fmt):
             "tenant_weight": wps.tenant_weight,
         },
     }
+    if not metrics_url and not run_ref:
+        daemon_doc = _loopd_status(f, no_daemon)
+        if daemon_doc is not None:
+            doc["source"] = f"loopd:{daemon_doc.get('pid')}"
+            doc["daemon_pools"] = daemon_doc.get("warm_pools", {})
     if metrics_url:
         doc["live"] = _scrape_warmpool_metrics(metrics_url)
     if run_ref:
@@ -407,6 +554,18 @@ def fleet_warmpool(f: Factory, metrics_url, run_ref, fmt):
     click.echo(f"warm-pool: enable={s['enable']} depth={s['depth']} "
                f"max_age_s={s['max_age_s']} "
                f"tenant_weight={s['tenant_weight']}")
+    pools = doc.get("daemon_pools")
+    if pools is not None:
+        click.echo(f"source: {doc.get('source')}", err=True)
+        if not pools:
+            click.echo("no pooled runs hosted by loopd")
+        for run_id, st in sorted(pools.items()):
+            click.echo(f"run {run_id}: target_depth={st['target_depth']} "
+                       f"hits={st['hits']} misses={st['misses']} "
+                       f"refills={st['refills']} recycled={st['recycled']}")
+            for wid, w in sorted(st.get("workers", {}).items()):
+                click.echo(f"  {wid}\tready={w['ready']}\t"
+                           f"inflight={w['inflight']}")
     live = doc.get("live")
     if live is not None:
         click.echo("WORKER\tDEPTH\tHITS\tMISSES\tREFILLS\tRECYCLED")

@@ -52,6 +52,37 @@ class _TwoStageInterrupt:
             _hard_exit(130)
 
 
+class _ClientInterrupt:
+    """Daemon-owned runs invert the Ctrl-C contract: the run belongs to
+    loopd, this CLI is only a viewer -- so the first Ctrl-C DETACHES
+    (the run keeps executing; `clawker loop attach <run>` re-streams)
+    instead of journaling a shutdown.  A second Ctrl-C hard-exits the
+    viewer; the run is still untouched."""
+
+    def __init__(self, client, run_id: str):
+        self.client = client
+        self.run_id = run_id
+        self.hits = 0
+        self.detached = False
+
+    def __call__(self, signum=None, frame=None) -> None:
+        self.hits += 1
+        if self.hits == 1:
+            self.detached = True
+            click.echo(
+                f"\ninterrupt: detached -- the run keeps executing under "
+                f"loopd (re-attach with `clawker loop attach "
+                f"{self.run_id}`; stop it with `clawker loopd stop` or "
+                "`clawker loop --resume` after)", err=True)
+            # shuts the socket down too, so a reader blocked in
+            # events() wakes immediately
+            self.client.detach()
+        else:
+            click.echo("\nsecond interrupt: hard exit (run unaffected)",
+                       err=True)
+            _hard_exit(130)
+
+
 @click.group("loop", invoke_without_command=True)
 @click.option("--parallel", "-p", type=int, default=0,
               help="Number of agent loops (default: settings loop.parallel).")
@@ -118,13 +149,22 @@ class _TwoStageInterrupt:
                    "--resume).  See docs/chaos.md.")
 @click.option("--json", "as_json", is_flag=True, help="Final status as JSON.")
 @click.option("--keep", is_flag=True, help="Keep containers after the run.")
+@click.option("--daemon/--no-daemon", "use_daemon", default=None,
+              help="Submit the run to a discovered loopd daemon "
+                   "(docs/loopd.md) / force the in-process scheduler.  "
+                   "Default: use the daemon when one answers on this "
+                   "project's socket (settings loopd.enable).")
+@click.option("--detach", is_flag=True,
+              help="Daemon mode only: submit the run and exit "
+                   "immediately -- it keeps executing under loopd; "
+                   "re-attach with `clawker loop attach <run>`.")
 @pass_factory
 @click.pass_context
 def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                placement, tenant, tenant_weight, max_inflight_per_worker,
                warm_pool, image, prompt, worktrees, env_kv, failover,
                orphan_grace, resume_run, metrics_port, chaos_plan, as_json,
-               keep):
+               keep, use_daemon, detach):
     """Fan autonomous agent loops across the runtime's workers."""
     if ctx.invoked_subcommand is not None:
         return
@@ -133,16 +173,26 @@ def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                resume_run=resume_run, tenant=tenant,
                tenant_weight=tenant_weight,
                max_inflight_per_worker=max_inflight_per_worker,
-               warm_pool=warm_pool, chaos_plan=chaos_plan)
+               warm_pool=warm_pool, chaos_plan=chaos_plan,
+               use_daemon=use_daemon, detach=detach)
 
 
 def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
                worktrees, env_kv, failover, orphan_grace, metrics_port,
                as_json, keep, resume_run=None, tenant=None,
                tenant_weight=None, max_inflight_per_worker=None,
-               warm_pool=None, chaos_plan=None):
+               warm_pool=None, chaos_plan=None, use_daemon=None,
+               detach=False):
     from .. import telemetry
 
+    if use_daemon and (resume_run or chaos_plan):
+        # an explicit --daemon must never silently degrade to a
+        # CLI-owned run -- the exact ownership the user opted out of
+        raise click.ClickException(
+            "--daemon cannot combine with "
+            + ("--resume" if resume_run else "--chaos-plan")
+            + ": these stay in-process by design (docs/loopd.md "
+            "degrade matrix)")
     env = {}
     for kv in env_kv:
         if "=" not in kv:
@@ -209,6 +259,41 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             orphan_grace_s=orphan_grace,
             telemetry=tele.flight_recorder,
         )
+        # --- daemon mode (docs/loopd.md): when a loopd answers on this
+        # project's socket the CLI becomes a thin control client -- the
+        # run executes inside the daemon (shared admission caps +
+        # fairness across every concurrent CLI) and survives this
+        # process exiting.  No daemon = the in-process path below,
+        # unchanged.  --resume and --chaos-plan stay in-process: resume
+        # reconciles against a DEAD scheduler's journal, and the chaos
+        # controller needs the scheduler in-process to kill it.
+        if use_daemon is not False and chaos_plan is None:
+            from .cmd_loopd import ensure_daemon
+
+            client = ensure_daemon(f)
+            if client is not None:
+                if max_inflight_per_worker:
+                    click.echo(
+                        "note: the admission bucket is daemon-scoped -- "
+                        "--max-inflight-per-worker is ignored under "
+                        "loopd (tune settings loop.placement.* and "
+                        "restart the daemon)", err=True)
+                if metrics_port:
+                    click.echo(
+                        "note: metrics are daemon-scoped under loopd -- "
+                        "--metrics-port is ignored; scrape settings "
+                        "loopd.metrics_port instead", err=True)
+                _run_loops_client(f, client, spec, detach=detach,
+                                  as_json=as_json, keep=keep)
+                return
+            if use_daemon:
+                raise click.ClickException(
+                    "--daemon: no loopd answering on this project's "
+                    "socket (start one with `clawker loopd start`)")
+        if detach:
+            raise click.ClickException(
+                "--detach needs a loopd daemon to own the run "
+                "(start one with `clawker loopd start`)")
         sched = LoopScheduler(f.config, f.driver, spec, on_event=on_event)
     chaos = None
     if chaos_plan:
@@ -324,6 +409,129 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
     # failover outcome before stop): that is not a success either
     if any(l.status in ("failed", "orphaned") for l in loops):
         raise SystemExit(1)
+
+
+# ------------------------------------------------------------ daemon mode
+
+
+def _client_spec_doc(spec: LoopSpec) -> dict:
+    """LoopSpec -> the submit_run spec doc (the journal's run-header
+    vocabulary; loopd.server.spec_from_doc is the inverse)."""
+    return {
+        "parallel": spec.parallel, "iterations": spec.iterations,
+        "placement": spec.placement, "image": spec.image,
+        "prompt": spec.prompt, "worktrees": spec.worktrees,
+        "workspace_mode": spec.workspace_mode,
+        "agent_prefix": spec.agent_prefix, "env": dict(spec.env),
+        "failover": spec.failover, "tenant": spec.tenant,
+        "tenant_weight": spec.tenant_weight,
+        "tenant_max_inflight": spec.tenant_max_inflight,
+        "max_inflight_per_worker": spec.max_inflight_per_worker,
+        "warm_pool_depth": spec.warm_pool_depth,
+        "orphan_grace_s": spec.orphan_grace_s,
+        "telemetry": spec.telemetry,
+    }
+
+
+def _run_loops_client(f: Factory, client, spec: LoopSpec, *, detach: bool,
+                      as_json: bool, keep: bool) -> None:
+    """Submit the run to loopd and (unless ``--detach``) stream it."""
+    from ..errors import ClawkerError
+
+    try:
+        ack = client.submit_run(_client_spec_doc(spec), keep=keep,
+                                stream=not detach)
+    except ClawkerError as e:
+        client.close()
+        raise click.ClickException(f"loopd submit failed: {e}")
+    run_id = str(ack.get("run", ""))
+    click.echo(
+        f"loop {run_id}: {spec.parallel} agent(s), "
+        f"{spec.iterations or 'unbounded'} iteration(s), {spec.placement} "
+        f"placement -- daemon-owned (loopd tenant {ack.get('tenant')})",
+        err=True)
+    if detach:
+        client.close()
+        click.echo(f"detached: the run executes under loopd; re-attach "
+                   f"with `clawker loop attach {run_id}`", err=True)
+        if as_json:
+            click.echo(json.dumps({"loop_id": run_id, "detached": True}))
+        return
+    _stream_daemon_run(client, run_id, as_json)
+
+
+def _stream_daemon_run(client, run_id: str, as_json: bool) -> None:
+    """Render a daemon-owned run's event stream; exit semantics match
+    the in-process path (non-zero on failed/orphaned loops).  Ctrl-C
+    DETACHES -- killing the viewer must never kill the run."""
+    from ..agentd.protocol import ProtocolError
+    from ..errors import ClawkerError
+
+    handler = _ClientInterrupt(client, run_id)
+    signal.signal(signal.SIGINT, handler)
+    final = None
+    try:
+        for frame in client.events():
+            kind = frame.get("type")
+            if kind == "event":
+                detail = frame.get("detail", "")
+                click.echo(f"[{frame.get('agent')}] {frame.get('event')}"
+                           + (f" {detail}" if detail else ""), err=True)
+            elif kind == "run_done":
+                final = frame
+                break
+    except (ProtocolError, ClawkerError, OSError):
+        pass        # daemon gone, or our own detach shut the socket
+    finally:
+        client.close()
+    if final is None:
+        if handler.detached:
+            return      # clean viewer exit; the run lives on
+        raise click.ClickException(
+            f"loopd stream ended unexpectedly (daemon died?) -- the "
+            f"journal survives: `clawker loop --resume {run_id}`")
+    agents = final.get("agents", [])
+    if as_json:
+        click.echo(json.dumps({"loop_id": run_id, "agents": agents},
+                              indent=2))
+    else:
+        for a in agents:
+            codes = ",".join(map(str, a.get("exit_codes", []))) or "-"
+            click.echo(f"{a.get('agent')}\t{a.get('worker')}\t"
+                       f"{a.get('status')}\titers={a.get('iteration')}\t"
+                       f"exits={codes}")
+    if not final.get("ok", False):
+        raise SystemExit(1)
+
+
+@loop_group.command("attach")
+@click.argument("run")
+@click.option("--json", "as_json", is_flag=True, help="Final status as JSON.")
+@pass_factory
+def loop_attach(f: Factory, run, as_json):
+    """Re-attach to a daemon-owned run and stream it.
+
+    RUN is the loop id printed at submit time (or an unambiguous
+    prefix).  The stream replays the run's recent events, then follows
+    it live; Ctrl-C detaches again without touching the run
+    (docs/loopd.md).
+    """
+    from ..errors import ClawkerError
+    from ..loopd.client import discover
+
+    client = discover(f.config)
+    if client is None:
+        raise click.ClickException(
+            "no loopd daemon answering (check `clawker loopd status`; "
+            "a dead daemon's runs resume with `clawker loop --resume`)")
+    try:
+        ack = client.attach(run)
+    except ClawkerError as e:
+        client.close()
+        raise click.ClickException(str(e))
+    run_id = str(ack.get("run", run))
+    click.echo(f"attached to run {run_id} ({ack.get('state')})", err=True)
+    _stream_daemon_run(client, run_id, as_json)
 
 
 def _resolve_journal(f: Factory, run: str) -> Path:

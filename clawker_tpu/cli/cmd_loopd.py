@@ -1,0 +1,191 @@
+"""``clawker loopd``: the host-resident loop-supervisor daemon.
+
+``start`` brings one daemon up per host (detached, project-scoped);
+``status`` renders its hosted runs + pod-scale admission/health state
+over the status RPC; ``stop`` drains every hosted run (durable
+``shutdown`` journal records -- resumable) and exits it.  See
+docs/loopd.md for the lifecycle, wire protocol, and degrade matrix.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+
+import click
+
+from ..loopd import (
+    LoopdError,
+    logfile_path,
+    pidfile_path,
+    socket_path,
+    spawn_daemon,
+)
+from ..loopd.client import LoopdClient, discover
+from .factory import Factory
+
+pass_factory = click.make_pass_decorator(Factory)
+
+
+@click.group("loopd")
+def loopd_group():
+    """Host-resident loop supervisor: runs outlive the CLI."""
+
+
+@loopd_group.command("start")
+@click.option("--foreground", is_flag=True,
+              help="Run the daemon in THIS process (debugging/ops; "
+                   "Ctrl-C drains and exits).")
+@pass_factory
+def loopd_start(f: Factory, foreground):
+    """Start the daemon (no-op when one already answers).
+
+    The daemon is project-scoped: start it from the project it will
+    serve.  Once up, every ``clawker loop`` in this project submits its
+    runs to the daemon instead of scheduling in-process -- admission
+    caps and tenant fairness then hold across CLI processes, and runs
+    keep executing after the submitting terminal closes.
+    """
+    client = discover(f.config)
+    if client is not None:
+        pong = client.ping()
+        client.close()
+        click.echo(f"loopd already running (pid {pong.get('pid')}, "
+                   f"{pong.get('runs', 0)} live run(s)) on "
+                   f"{socket_path(f.config)}")
+        return
+    if foreground:
+        from ..loopd.server import LoopdServer
+
+        server = LoopdServer(f.config, f.driver)
+        signal.signal(signal.SIGINT, lambda *_: server.stop())
+        signal.signal(signal.SIGTERM, lambda *_: server.stop())
+        server.start()
+        click.echo(f"loopd listening on {server.sock_path} "
+                   f"(pid {os.getpid()}; Ctrl-C drains)", err=True)
+        server.serve_forever()
+        return
+    pid = spawn_daemon(f.config, cwd=f.cwd)
+    click.echo(f"loopd started (pid {pid}) on {socket_path(f.config)}; "
+               f"log: {logfile_path(f.config)}")
+
+
+@loopd_group.command("stop")
+@click.option("--force", is_flag=True,
+              help="SIGTERM the pidfile's process when the socket does "
+                   "not answer (wedged daemon).")
+@pass_factory
+def loopd_stop(f: Factory, force):
+    """Drain every hosted run and stop the daemon.
+
+    Drained runs journal a durable ``shutdown`` record first: resume
+    any of them later with ``clawker loop --resume <run>``.
+    """
+    client = discover(f.config)
+    if client is not None:
+        client.shutdown()
+        client.close()
+        # the drain is asynchronous; wait for the socket to go away so
+        # `loopd stop && loopd start` cannot race the old daemon
+        sock = socket_path(f.config)
+        deadline = time.monotonic() + f.config.settings.loopd.drain_grace_s + 5
+        while time.monotonic() < deadline and sock.exists():
+            time.sleep(0.1)
+        if sock.exists():
+            # a wedged drain must not report success: the very next
+            # `loopd start` would hit "already running"
+            raise click.ClickException(
+                "loopd did not drain within the grace period (socket "
+                f"still present at {sock}); retry with --force to "
+                "SIGTERM it")
+        click.echo("loopd stopped")
+        return
+    pidfile = pidfile_path(f.config)
+    if force and pidfile.exists():
+        try:
+            pid = int(pidfile.read_text().strip())
+            os.kill(pid, signal.SIGTERM)
+            click.echo(f"loopd: SIGTERM sent to pid {pid}")
+            return
+        except (OSError, ValueError) as e:
+            raise click.ClickException(f"loopd: force-stop failed: {e}")
+    click.echo("loopd: not running", err=True)
+
+
+_RUN_COLUMNS = ("RUN", "STATE", "TENANT", "CLIENT", "LOOPS", "PLACEMENT",
+                "SUBS")
+
+
+@loopd_group.command("status")
+@click.option("--format", "fmt", type=click.Choice(["table", "json"]),
+              default="table")
+@pass_factory
+def loopd_status(f: Factory, fmt):
+    """Daemon status: hosted runs, admission tokens, worker breakers.
+
+    Exits non-zero when no daemon answers -- scriptable as a liveness
+    probe.
+    """
+    client = discover(f.config)
+    if client is None:
+        click.echo("loopd: not running (start one with `clawker loopd "
+                   "start`)", err=True)
+        raise SystemExit(1)
+    try:
+        doc = client.status()
+    finally:
+        client.close()
+    doc.pop("type", None)
+    if fmt == "json":
+        click.echo(json.dumps(doc, indent=2))
+        return
+    click.echo(f"loopd pid {doc['pid']} project={doc.get('project') or '-'} "
+               f"uptime={doc.get('uptime_s', 0)}s "
+               f"socket={doc.get('socket')}")
+    runs = doc.get("runs", [])
+    if runs:
+        click.echo("\t".join(_RUN_COLUMNS))
+        for r in runs:
+            click.echo("\t".join(str(x) for x in (
+                r["run"], r["state"], r["tenant"], r["client"],
+                r["parallel"], r["placement"], r["subscribers"])))
+    else:
+        click.echo("no hosted runs")
+    adm = doc.get("admission", {})
+    for wid, w in sorted(adm.get("workers", {}).items()):
+        click.echo(f"worker {wid}: tokens {w['inflight']}/{w['capacity']} "
+                   f"hwm={w['inflight_hwm']} pending={w['pending']} "
+                   f"dispatched={w['dispatched']} rejected={w['rejected']}")
+    for tenant, t in sorted(adm.get("tenants", {}).items()):
+        click.echo(f"tenant {tenant}: weight={t['weight']} "
+                   f"inflight={t['inflight']} queued={t['queued']} "
+                   f"dispatched={t['dispatched']}")
+
+
+def ensure_daemon(f: Factory) -> "LoopdClient | None":
+    """Autostart path for ``clawker loop``: a connected client when a
+    daemon answers (spawning one first if settings ``loopd.autostart``
+    asks for it), else None -- the caller degrades in-process."""
+    project = None
+    try:
+        project = f.config.project_name()
+    except LookupError:
+        pass
+    client = discover(f.config, require_project=project)
+    if client is not None:
+        return client
+    if not f.config.settings.loopd.autostart:
+        return None
+    try:
+        spawn_daemon(f.config, cwd=f.cwd)
+    except LoopdError as e:
+        click.echo(f"loopd autostart failed ({e}); running in-process",
+                   err=True)
+        return None
+    return discover(f.config, require_project=project)
+
+
+def register(cli: click.Group) -> None:
+    cli.add_command(loopd_group)

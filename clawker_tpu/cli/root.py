@@ -140,6 +140,7 @@ def register_commands() -> None:
         cmd_image,
         cmd_init,
         cmd_loop,
+        cmd_loopd,
         cmd_monitor,
         cmd_network,
         cmd_plugin,
@@ -159,6 +160,7 @@ def register_commands() -> None:
     cmd_image.register(cli)
     cmd_init.register(cli)
     cmd_loop.register(cli)
+    cmd_loopd.register(cli)
     cmd_monitor.register(cli)
     cmd_network.register(cli)
     cmd_project.register(cli)

@@ -467,6 +467,33 @@ class TelemetrySettings:
 
 
 @dataclass
+class LoopdSettings:
+    """The host-resident loop-supervisor daemon (docs/loopd.md).
+
+    ``clawker loopd start`` brings up one daemon per host; it owns the
+    pod-scale state -- ONE admission controller, the per-worker serial
+    lanes, its own health breakers -- so two concurrent ``clawker
+    loop`` invocations share the per-worker inflight caps and tenant
+    fairness ACROSS processes, and runs keep executing after the
+    submitting CLI exits (``clawker loop attach <run>`` re-streams).
+
+    With ``enable`` the CLI auto-discovers a running daemon (unix
+    socket in a 0700 runtime dir under the state dir) and becomes a
+    thin control client; no daemon = today's in-process scheduler,
+    unchanged.  ``autostart`` spawns the daemon on first ``clawker
+    loop`` when none answers."""
+
+    enable: bool = True             # CLI may discover & use a running daemon
+    socket: str = ""                # unix socket path override
+    #                                 ("" = <state>/loopd/loopd.sock)
+    autostart: bool = False         # `clawker loop` starts loopd if absent
+    metrics_port: int = 0           # daemon-owned Prometheus scrape port
+    #                                 (127.0.0.1; 0 = off)
+    drain_grace_s: float = 10.0     # graceful-stop budget per live run
+    start_deadline_s: float = 15.0  # loopd start: socket-answering deadline
+
+
+@dataclass
 class ChaosSettings:
     """Defaults for ``clawker chaos run`` (docs/chaos.md).
 
@@ -506,6 +533,7 @@ class Settings:
     control_plane: ControlPlaneSettings = field(default_factory=ControlPlaneSettings)
     runtime: RuntimeSettings = field(default_factory=RuntimeSettings)
     loop: LoopSettings = field(default_factory=LoopSettings)
+    loopd: LoopdSettings = field(default_factory=LoopdSettings)
     telemetry: TelemetrySettings = field(default_factory=TelemetrySettings)
     credentials: CredentialSettings = field(default_factory=CredentialSettings)
     chaos: ChaosSettings = field(default_factory=ChaosSettings)

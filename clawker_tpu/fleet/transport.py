@@ -237,6 +237,24 @@ class SSHTransport:
 
     # ----------------------------------------------------------- forwards
 
+    def remote_loopd_sock(self) -> str:
+        """The worker's canonical loopd control-socket path
+        (docs/loopd.md): ``<XDG state>/loopd/loopd.sock`` under the ssh
+        user's home.  Absolute on purpose -- sshd does not tilde-expand
+        direct-streamlocal forward targets."""
+        user = self.tpu.ssh_user or consts.TPU_SSH_USER_DEFAULT
+        home = "/root" if user == "root" else f"/home/{user}"
+        return (f"{home}/.local/state/{consts.PRODUCT}/"
+                "loopd/loopd.sock")
+
+    def forward_loopd(self, remote_sock: str = "") -> Path:
+        """Tunnel the worker-resident loopd control socket over the SSH
+        mux; returns the local socket path to point ``loopd.socket`` at
+        (the JSON-frame protocol is transport agnostic, so a LoopdClient
+        on the forwarded path behaves identically to a local one)."""
+        return self.forward_unix(remote_sock or self.remote_loopd_sock(),
+                                 tag="loopd")
+
     def forward_unix(self, remote_sock: str, tag: str = "docker") -> Path:
         """Forward a remote unix socket to a local one; returns the local
         path once it accepts connections."""

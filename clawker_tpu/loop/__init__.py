@@ -7,9 +7,9 @@ iteration, aggregate status.
 """
 
 from .journal import RunImage, RunJournal, journal_path, replay
-from .scheduler import AgentLoop, LoopScheduler, LoopSpec
+from .scheduler import AgentLoop, LaneRegistry, LoopScheduler, LoopSpec
 from .warmpool import POOL_TENANT, PoolEntry, WarmPool
 
-__all__ = ["AgentLoop", "LoopScheduler", "LoopSpec",
+__all__ = ["AgentLoop", "LaneRegistry", "LoopScheduler", "LoopSpec",
            "POOL_TENANT", "PoolEntry", "WarmPool",
            "RunImage", "RunJournal", "journal_path", "replay"]

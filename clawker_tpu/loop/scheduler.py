@@ -325,11 +325,56 @@ class _WorkerLane:
                     time.monotonic() - t_run)
 
 
+class LaneRegistry:
+    """Get-or-create registry of per-worker serial lanes.
+
+    Each scheduler used to own its lanes privately; the loopd daemon
+    (docs/loopd.md) passes ONE registry to every run it hosts, so two
+    co-tenant runs' engine mutations against a worker serialize on the
+    same lane instead of racing from two lane threads.  ``retire``
+    keeps the quarantine semantics: the wedged thread is abandoned for
+    EVERY user of the lane (a wedged daemon is wedged for all runs),
+    and the next ``lane()`` call builds a fresh thread.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.lanes: dict[str, _WorkerLane] = {}
+
+    def lane(self, worker_id: str) -> _WorkerLane:
+        # get-or-create must not race two lanes into existence for one
+        # worker (admission dispatch runs on whichever thread released
+        # a token)
+        with self._lock:
+            lane = self.lanes.get(worker_id)
+            if lane is None:
+                lane = _WorkerLane(worker_id)
+                self.lanes[worker_id] = lane
+            return lane
+
+    def retire(self, worker_id: str) -> None:
+        """Abandon the worker's (possibly wedged) lane thread; the next
+        ``lane()`` call starts a fresh one.  Queued tasks on the old
+        lane are epoch-guarded by their submitters and no-op when (if)
+        the thread unblocks."""
+        with self._lock:
+            lane = self.lanes.pop(worker_id, None)
+        if lane is not None:
+            lane.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            lanes, self.lanes = list(self.lanes.values()), {}
+        for lane in lanes:
+            lane.close()
+
+
 class LoopScheduler:
     def __init__(self, cfg: Config, driver: RuntimeDriver, spec: LoopSpec,
                  *, on_event=None, health_config: HealthConfig | None = None,
                  run_id: str | None = None,
                  admission: AdmissionController | None = None,
+                 lanes: LaneRegistry | None = None,
                  seams=None):
         if spec.failover not in FAILOVER_POLICIES:
             raise ClawkerError(
@@ -374,8 +419,11 @@ class LoopScheduler:
         # on it rides this lock, or an orphan landing mid-create could
         # leak a container into neither container_id nor abandoned
         self._placement_lock = threading.Lock()
-        self._lanes: dict[str, _WorkerLane] = {}
-        self._lanes_lock = threading.Lock()
+        # a SHARED registry (the `lanes` param) is how loopd serializes
+        # several runs' engine calls per worker on one lane; a private
+        # registry (the default) is owned -- and closed -- by this run
+        self.lanes = lanes if lanes is not None else LaneRegistry()
+        self._owns_lanes = lanes is None
         self._inflight: dict[str, Future] = {}   # agent -> launch HANDLE: the
         #                                          admission-to-completion
         #                                          future busy-tracking reads
@@ -533,16 +581,13 @@ class LoopScheduler:
             load=self._load_by_worker(),
             topology=self._topology)
 
+    @property
+    def _lanes(self) -> dict[str, _WorkerLane]:
+        """The live lane table (tests / introspection)."""
+        return self.lanes.lanes
+
     def _lane(self, worker: Worker) -> _WorkerLane:
-        # admission dispatch runs on whichever thread released a token
-        # (run thread, lane done-callbacks): get-or-create must not race
-        # two lanes into existence for one worker
-        with self._lanes_lock:
-            lane = self._lanes.get(worker.id)
-            if lane is None:
-                lane = _WorkerLane(worker.id)
-                self._lanes[worker.id] = lane
-            return lane
+        return self.lanes.lane(worker.id)
 
     def _submit_launch(self, loop: AgentLoop, worker: Worker, epoch: int,
                        fn) -> None:
@@ -1968,10 +2013,7 @@ class LoopScheduler:
                 # never queue behind the stuck call (ROADMAP: PR-3 known
                 # limitation).  Queued tasks on the old lane are
                 # epoch-guarded and no-op when (if) the thread unblocks.
-                with self._lanes_lock:
-                    stale_lane = self._lanes.pop(wid, None)
-                if stale_lane is not None:
-                    stale_lane.close()
+                self.lanes.retire(wid)
                 self._unreach.pop(wid, None)   # a fresh episode starts clean
                 # the halt attempted at orphan time ran against a dead
                 # daemon and likely failed: a recovered worker may still
@@ -1990,10 +2032,7 @@ class LoopScheduler:
         # after recovery must get a FRESH lane thread, not queue behind
         # the wedged one.  Tasks already queued on the old lane are
         # epoch-guarded, so they no-op when (if) the thread unblocks.
-        with self._lanes_lock:
-            stale_lane = self._lanes.pop(wid, None)
-        if stale_lane is not None:
-            stale_lane.close()
+        self.lanes.retire(wid)
         self._unreach.pop(wid, None)   # the episode ends with the orphaning
         for loop in self.loops:
             halt_cid = ""
@@ -2299,10 +2338,11 @@ class LoopScheduler:
                         for w in sweep_workers.values())
             if futs:
                 futures_wait(futs, timeout=HALT_DEADLINE_S)
-        with self._lanes_lock:
-            lanes, self._lanes = list(self._lanes.values()), {}
-        for lane in lanes:
-            lane.close()
+        if self._owns_lanes:
+            # a SHARED registry (loopd) outlives this run: the daemon
+            # closes it at its own shutdown, and other runs' queued
+            # work must not die with ours
+            self.lanes.close_all()
         self.tracer.close_open("stopped")
         if self.flight is not None:
             self.flight.close()

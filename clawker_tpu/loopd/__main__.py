@@ -1,0 +1,41 @@
+"""``python -m clawker_tpu.loopd``: run the loop-supervisor daemon.
+
+Spawned detached by ``clawker loopd start`` (or ``loop`` autostart);
+loads config from its working directory -- the daemon is
+project-scoped -- builds the runtime driver from settings, serves the
+control socket until SIGTERM/SIGINT, then drains every hosted run with
+a durable ``shutdown`` journal record so ``clawker loop --resume``
+picks them up.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+
+from .. import logsetup
+from ..config import load_config
+from ..engine.drivers import get_driver
+from .server import LoopdServer
+
+
+def main() -> int:
+    logsetup.setup(os.environ.get("CLAWKER_TPU_LOOPD_LOG", "info"))
+    cfg = load_config()
+    driver = get_driver(cfg.settings,
+                        override=os.environ.get("CLAWKER_TPU_DRIVER", ""))
+    server = LoopdServer(cfg, driver)
+
+    def _term(signum, frame):
+        server.stop()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    server.start()
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

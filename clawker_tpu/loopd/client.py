@@ -1,0 +1,164 @@
+"""Thin control client for the loopd socket (docs/loopd.md).
+
+The CLI side of the daemon split: connect, hello, submit/attach/stream
+over the agentd JSON-frame protocol.  ``discover`` is the degrade
+seam -- it returns a connected client only when settings allow it AND
+a daemon actually answers; every caller falls back to the in-process
+scheduler on ``None``, so a missing/dead daemon costs one failed
+``connect`` and nothing else.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from pathlib import Path
+
+from ..agentd import protocol
+from ..errors import ClawkerError
+from . import LoopdError, socket_path
+
+DISCOVER_TIMEOUT_S = 2.0
+
+
+class LoopdClient:
+    """One connection to a loopd daemon.  Unary verbs are
+    request/response; ``submit_run(stream=True)`` / ``attach`` turn the
+    connection into an event stream consumed via :meth:`events`."""
+
+    def __init__(self, path: Path | str, *, timeout: float = 10.0):
+        self.path = Path(path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout)
+        try:
+            self._sock.connect(str(self.path))
+        except OSError as e:
+            self._sock.close()
+            raise LoopdError(f"loopd socket {self.path}: {e}") from e
+        self._hello: dict = {}
+        self._detach_sent = False
+
+    # --------------------------------------------------------- unary verbs
+
+    def _call(self, msg: dict) -> dict:
+        protocol.write_msg(self._sock, msg)
+        reply = protocol.read_msg(self._sock)
+        if reply.get("type") == "error":
+            raise LoopdError(str(reply.get("error", "loopd error")))
+        return reply
+
+    def hello(self) -> dict:
+        """Introduce this client; the daemon keys tenant accounting on
+        the returned identity when a run names no tenant."""
+        if not self._hello:
+            self._hello = self._call({
+                "type": "hello", "pid": os.getpid(), "uid": os.getuid(),
+                "user": os.environ.get("USER", "")})
+        return self._hello
+
+    def ping(self) -> dict:
+        return self._call({"type": "ping"})
+
+    def status(self) -> dict:
+        return self._call({"type": "status"})
+
+    def daemon_project(self) -> str:
+        """The project the daemon serves ('' when it has none)."""
+        return str(self.hello().get("project", ""))
+
+    def submit_run(self, spec_doc: dict, *, keep: bool = False,
+                   stream: bool = True) -> dict:
+        """Submit a loop run; returns the ack (``run`` id, tenant,
+        agent names).  With ``stream`` the connection then carries the
+        run's event frames -- consume them via :meth:`events`."""
+        return self._call({"type": "submit_run", "spec": spec_doc,
+                           "keep": keep, "stream": stream})
+
+    def attach(self, run_ref: str) -> dict:
+        """Attach to a hosted run (id or unambiguous prefix); returns
+        the snapshot ack and switches this connection to streaming."""
+        return self._call({"type": "attach", "run": run_ref})
+
+    def stop_run(self, run_ref: str) -> dict:
+        return self._call({"type": "stop_run", "run": run_ref})
+
+    def shutdown(self) -> dict:
+        """Ask the daemon to drain every hosted run and exit."""
+        return self._call({"type": "shutdown"})
+
+    # ----------------------------------------------------------- streaming
+
+    def events(self):
+        """Yield event frames after ``submit_run(stream=True)`` /
+        ``attach``, ending after the ``run_done`` frame.  Raises
+        :class:`~clawker_tpu.agentd.protocol.ConnectionClosed` when the
+        daemon (or a concurrent :meth:`detach`) drops the stream."""
+        self._sock.settimeout(None)
+        while True:
+            frame = protocol.read_msg(self._sock)
+            yield frame
+            if frame.get("type") == "run_done":
+                return
+
+    def detach(self) -> None:
+        """Leave the stream WITHOUT stopping the run: best-effort
+        detach frame, then shut the socket down so a reader blocked in
+        :meth:`events` wakes immediately (the Ctrl-C path runs this
+        from the signal handler)."""
+        if self._detach_sent:
+            return
+        self._detach_sent = True
+        try:
+            protocol.write_msg(self._sock, {"type": "detach"})
+        except (OSError, ClawkerError):
+            pass
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
+    # ----------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "LoopdClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def discover(cfg, *, sock_path: Path | None = None,
+             require_project: str | None = None) -> LoopdClient | None:
+    """A connected client when a daemon is discoverable, else None.
+
+    ``None`` on: settings ``loopd.enable`` off, no socket file, nothing
+    answering (stale socket from a SIGKILLed daemon), a handshake
+    error, or -- when ``require_project`` is given -- a daemon serving
+    a DIFFERENT project (container names and labels key on the project,
+    so submitting across projects would run the wrong workload).
+    """
+    if not cfg.settings.loopd.enable:
+        return None
+    path = sock_path if sock_path is not None else socket_path(cfg)
+    if not path.exists():
+        return None
+    try:
+        client = LoopdClient(path, timeout=DISCOVER_TIMEOUT_S)
+    except ClawkerError:
+        return None
+    try:
+        client.hello()
+    except (ClawkerError, OSError):
+        client.close()
+        return None
+    if require_project is not None:
+        served = client.daemon_project()
+        if served and served != require_project:
+            client.close()
+            return None
+    return client

@@ -1,0 +1,729 @@
+"""The loopd server: pod-scale loop supervision behind a unix socket.
+
+One :class:`LoopdServer` per host owns the state PR-6 left in-process:
+
+- **one** :class:`~clawker_tpu.placement.AdmissionController` -- every
+  hosted run's launches bill the same per-worker token buckets, so two
+  concurrent ``clawker loop`` clients can never jointly exceed
+  ``max_inflight_per_worker`` the way two in-process controllers could;
+- **one** :class:`~clawker_tpu.loop.LaneRegistry` -- engine mutations
+  against a worker serialize on one lane across runs;
+- **daemon-owned health breakers** -- a
+  :class:`~clawker_tpu.health.HealthMonitor` probing the fleet for the
+  daemon's whole lifetime, feeding ``clawker fleet health`` without a
+  CLI-side probe round;
+- the hosted runs themselves: each ``submit_run`` builds a
+  :class:`~clawker_tpu.loop.LoopScheduler` (shared admission + lanes)
+  and drives it on a daemon thread, so the run OUTLIVES the submitting
+  CLI -- detach closes the stream, ``clawker loop attach`` re-streams.
+
+Wire protocol: length-prefixed JSON frames (``agentd/protocol.py``
+framing) over a unix socket in a 0700 runtime dir with a 0600 socket --
+filesystem permissions are the authentication, the bksession/nsd
+pattern (docs/loopd.md#security).
+
+Durability: hosted schedulers journal write-ahead exactly as the
+in-process path does (same :class:`~clawker_tpu.loop.RunJournal`
+records under the same ``logs/runs`` dir), so a SIGKILLed daemon
+resumes via ``clawker loop --resume`` with the same adoption
+semantics.  The daemon fires the chaos seams ``loopd.post_submit`` /
+``loopd.post_ack`` at its own transition boundaries, and
+:meth:`LoopdServer.kill` freezes every hosted scheduler the way
+process death would (the soak/crash-test seam).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .. import __version__, logsetup, telemetry
+from ..agentd import protocol
+from ..chaos.seams import NULL_SEAMS
+from ..config import Config
+from ..engine.drivers import RuntimeDriver
+from ..errors import ClawkerError
+from ..health import HealthMonitor
+from ..loop import LaneRegistry, LoopScheduler, LoopSpec
+from ..monitor.events import TRACE_SPAN
+from ..placement import AdmissionController
+from . import LoopdError, pidfile_path, runtime_dir, socket_path
+
+log = logsetup.get("loopd.server")
+
+_CONNECTIONS = telemetry.counter(
+    "loopd_connections_total", "Client connections accepted by loopd")
+_RUNS = telemetry.counter(
+    "loopd_runs_total", "Loop runs submitted to loopd", labels=("tenant",))
+_ACTIVE_RUNS = telemetry.gauge(
+    "loopd_active_runs", "Hosted runs currently executing")
+_EVENTS_DROPPED = telemetry.counter(
+    "loopd_events_dropped_total",
+    "Stream events dropped on slow subscriber queues")
+
+EVENT_RING = 512                # recent events kept per run for attach
+SUB_QUEUE_MAX = 4096            # per-subscriber buffered frames
+DRIVE_POLL_S = 0.05             # scheduler tick cadence inside the daemon
+DONE_RUNS_KEPT = 64             # finished runs retained for attach/status;
+#                                 beyond this the oldest done runs are
+#                                 evicted (a resident daemon must not
+#                                 accumulate every run it ever hosted)
+
+
+def spec_from_doc(doc: dict) -> LoopSpec:
+    """Submitted spec doc -> LoopSpec (the same key set the journal's
+    run header uses, so client and WAL stay one vocabulary)."""
+    return LoopSpec(
+        parallel=max(1, int(doc.get("parallel") or 1)),
+        iterations=int(doc.get("iterations") or 0),
+        placement=str(doc.get("placement") or "spread"),
+        tenant=str(doc.get("tenant") or "default"),
+        tenant_weight=float(doc.get("tenant_weight") or 1.0),
+        tenant_max_inflight=int(doc.get("tenant_max_inflight") or 0),
+        max_inflight_per_worker=int(doc.get("max_inflight_per_worker") or 0),
+        image=str(doc.get("image") or "@"),
+        prompt=str(doc.get("prompt") or ""),
+        worktrees=bool(doc.get("worktrees") or False),
+        workspace_mode=str(doc.get("workspace_mode") or ""),
+        agent_prefix=str(doc.get("agent_prefix") or "loop"),
+        env={str(k): str(v) for k, v in (doc.get("env") or {}).items()},
+        failover=str(doc.get("failover") or "migrate"),
+        orphan_grace_s=(float(doc["orphan_grace_s"])
+                        if doc.get("orphan_grace_s") is not None else None),
+        warm_pool_depth=int(doc.get("warm_pool_depth") or 0),
+        telemetry=bool(doc.get("telemetry", True)),
+    )
+
+
+@dataclass
+class _DaemonRun:
+    """One hosted run: its scheduler, drive thread, and subscribers.
+
+    ``sched`` is built on the DRIVE thread (submit acks in one socket
+    hop plus registration; journal/flight-recorder opens and the
+    placement fan-out happen just after) -- readers must tolerate a
+    brief ``None``."""
+
+    run_id: str
+    spec: LoopSpec
+    tenant: str
+    client: str                         # submitting client identity
+    keep: bool = False
+    sched: LoopScheduler | None = None
+    thread: threading.Thread | None = None
+    stop_requested: threading.Event = field(default_factory=threading.Event)
+    done: threading.Event = field(default_factory=threading.Event)
+    result: dict = field(default_factory=dict)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    ring: collections.deque = field(
+        default_factory=lambda: collections.deque(maxlen=EVENT_RING))
+    subs: dict[int, queue.Queue] = field(default_factory=dict)
+    _next_sub: int = 0
+
+    def subscribe(self) -> tuple[int, queue.Queue, list[dict], bool]:
+        """(sub id, frame queue, ring snapshot, finished).  Snapshot and
+        registration happen under one lock so no event can land between
+        them unseen."""
+        with self.lock:
+            snapshot = list(self.ring)
+            if self.done.is_set():
+                return -1, queue.Queue(), snapshot, True
+            self._next_sub += 1
+            q: queue.Queue = queue.Queue(maxsize=SUB_QUEUE_MAX)
+            self.subs[self._next_sub] = q
+            return self._next_sub, q, snapshot, False
+
+    def unsubscribe(self, sub_id: int) -> None:
+        with self.lock:
+            self.subs.pop(sub_id, None)
+
+    def publish(self, frame: dict | None) -> None:
+        """Push a frame to every subscriber (None = stream sentinel).
+        A slow subscriber drops its OLDEST buffered frames rather than
+        back-pressuring the scheduler's event bus -- the journal/flight
+        record stay the durable history; the stream is a live view.
+        Drop-oldest (not drop-newest) so the terminal ``run_done``
+        frame and the None sentinel always land: dropping those would
+        wedge the writer in ``q.get()`` and the client in ``events()``
+        forever."""
+        with self.lock:
+            if frame is not None:
+                self.ring.append(frame)
+            for q in self.subs.values():
+                while True:
+                    try:
+                        q.put_nowait(frame)
+                        break
+                    except queue.Full:
+                        try:
+                            q.get_nowait()
+                        except queue.Empty:
+                            continue
+                        _EVENTS_DROPPED.inc()
+
+    def status_doc(self) -> dict:
+        sched = self.sched
+        return {
+            "run": self.run_id,
+            "state": "done" if self.done.is_set() else "running",
+            "tenant": self.tenant,
+            "client": self.client,
+            "parallel": self.spec.parallel,
+            "iterations": self.spec.iterations,
+            "placement": self.spec.placement,
+            "agents": sched.status() if sched is not None else [],
+            "subscribers": len(self.subs),
+            **({"ok": self.result.get("ok")} if self.done.is_set() else {}),
+        }
+
+
+class LoopdServer:
+    """Accept loop, per-connection handlers, hosted-run supervision."""
+
+    def __init__(self, cfg: Config, driver: RuntimeDriver, *,
+                 sock_path=None, seams=None, metrics_port: int | None = None):
+        self.cfg = cfg
+        self.driver = driver
+        self.sock_path = sock_path if sock_path is not None else (
+            socket_path(cfg))
+        self.seams = seams if seams is not None else NULL_SEAMS
+        ps = cfg.settings.loop.placement
+        # THE pod-scale state (one per host, not per run):
+        self.admission = AdmissionController(
+            max_inflight_per_worker=ps.max_inflight_per_worker,
+            max_pending_per_worker=ps.max_pending_per_worker)
+        self.lanes = LaneRegistry()
+        self.health: HealthMonitor | None = None
+        self.runs: dict[str, _DaemonRun] = {}
+        self._runs_lock = threading.Lock()
+        self._listener: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._stopped = threading.Event()   # stop()/kill() COMPLETED
+        self._aborted = False           # kill(): the chaos crash seam
+        self._started_at = 0.0
+        self._metrics_port = (metrics_port if metrics_port is not None
+                              else cfg.settings.loopd.metrics_port)
+        self._metrics_server = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "LoopdServer":
+        """Bind the control socket (0700 dir / 0600 socket -- the
+        bksession/nsd hardening pattern), start the accept loop, the
+        daemon health monitor, and the metrics port."""
+        rt = self.sock_path.parent
+        rt.mkdir(parents=True, exist_ok=True)
+        os.chmod(rt, 0o700)
+        if self.sock_path.exists():
+            # a live daemon answering on the socket must not be usurped;
+            # a stale socket from a SIGKILLed daemon is swept
+            if self._socket_answers():
+                raise LoopdError(
+                    f"loopd already running on {self.sock_path}")
+            self.sock_path.unlink()
+        listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        old_umask = os.umask(0o177)     # cover the bind itself
+        try:
+            listener.bind(str(self.sock_path))
+        finally:
+            os.umask(old_umask)
+        os.chmod(self.sock_path, 0o600)     # umask-proof pin
+        listener.listen(64)
+        self._listener = listener
+        self._started_at = time.monotonic()
+        try:
+            pidfile_path(self.cfg).parent.mkdir(parents=True, exist_ok=True)
+            pidfile_path(self.cfg).write_text(str(os.getpid()))
+        except OSError:
+            pass
+        self.health = HealthMonitor(self.driver)
+        self.health.start()
+        if self._metrics_port:
+            self._metrics_server = telemetry.MetricsServer(
+                self._metrics_port).start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="loopd-accept")
+        self._accept_thread.start()
+        log.info("loopd listening on %s (pid %d)", self.sock_path,
+                 os.getpid())
+        return self
+
+    def _socket_answers(self) -> bool:
+        try:
+            with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+                s.settimeout(1.0)
+                s.connect(str(self.sock_path))
+                protocol.write_msg(s, {"type": "ping"})
+                return protocol.read_msg(s).get("type") == "pong"
+        except (OSError, ClawkerError):
+            return False
+
+    def serve_forever(self) -> None:
+        """Block until a stop/kill has COMPLETED (the ``__main__``
+        entrypoint).  Waiting on the stop *flag* instead would let the
+        daemon process exit while the `shutdown` RPC's stop thread is
+        still mid-drain -- killing it before the runs journal their
+        shutdown records and before the socket is unlinked."""
+        self._stopped.wait()
+
+    def stop(self, *, drain: bool = True) -> None:
+        """Graceful shutdown: stop accepting, journal a durable
+        ``shutdown`` for every live run and drain it (bounded by
+        settings ``loopd.drain_grace_s``), close subscribers, unlink
+        the socket.  Runs drained here resume later with
+        ``clawker loop --resume`` exactly like a Ctrl-C'd CLI run."""
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self._close_listener(unlink=True)
+        grace = self.cfg.settings.loopd.drain_grace_s
+        with self._runs_lock:
+            live = [r for r in self.runs.values() if not r.done.is_set()]
+        for run in live:
+            run.stop_requested.set()
+            sched = run.sched
+            if sched is None:
+                continue        # drive thread honors stop_requested
+            if drain:
+                sched.request_shutdown("loopd stop")
+            else:
+                sched.stop()
+        for run in live:
+            if run.thread is not None:
+                run.thread.join(grace)
+        if self.health is not None:
+            self.health.stop()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+        self.lanes.close_all()
+        self._drop_conns()
+        pidfile_path(self.cfg).unlink(missing_ok=True)
+        log.info("loopd stopped")
+        self._stopped.set()
+
+    def kill(self) -> None:
+        """Simulate daemon SIGKILL (chaos/crash tests): freeze every
+        hosted scheduler's bookkeeping the way process death would --
+        no shutdown records, no cleanup, no pool drains -- and drop
+        every connection mid-frame.  The socket FILE stays behind,
+        exactly as a real SIGKILL leaves it; discovery treats a
+        connection-refused socket as "no daemon"."""
+        self._aborted = True
+        self._stop.set()
+        with self._runs_lock:
+            runs = list(self.runs.values())
+        for run in runs:
+            if run.sched is not None:
+                run.sched.kill()
+        self._close_listener(unlink=False)
+        self._drop_conns()
+        if self.health is not None:
+            self.health.stop()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+        self._stopped.set()
+
+    def _drop_conns(self) -> None:
+        """Hard-drop every client connection.  ``shutdown`` before
+        ``close``: a plain close cannot interrupt a thread blocked in
+        recv on the same socket (the blocked call pins the fd open), so
+        without it neither the peer's EOF nor our own stream reader
+        threads would ever wake."""
+        with self._conns_lock:
+            conns, self._conns = set(self._conns), set()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _close_listener(self, *, unlink: bool) -> None:
+        listener, self._listener = self._listener, None
+        if listener is not None:
+            # a blocked accept() pins the listener fd, so close alone
+            # cannot stop the accept loop: wake it with a throwaway
+            # connection first (the loop sees _stop/_listener and exits)
+            try:
+                with socket.socket(socket.AF_UNIX,
+                                   socket.SOCK_STREAM) as s:
+                    s.settimeout(0.5)
+                    s.connect(str(self.sock_path))
+            except OSError:
+                pass
+            try:
+                listener.close()
+            except OSError:
+                pass
+        if unlink:
+            try:
+                self.sock_path.unlink(missing_ok=True)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- serving
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            listener = self._listener
+            if listener is None:
+                return
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                return          # listener closed by stop()/kill()
+            if self._stop.is_set() or self._listener is None:
+                try:
+                    conn.close()    # the wake-up connection itself
+                except OSError:
+                    pass
+                return
+            _CONNECTIONS.inc()
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._handle_conn, args=(conn,),
+                             daemon=True, name="loopd-conn").start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        ident = "anonymous"
+        try:
+            conn.settimeout(30.0)
+            while not self._stop.is_set():
+                try:
+                    msg = protocol.read_msg(conn)
+                except (protocol.ConnectionClosed, OSError):
+                    return
+                kind = msg.get("type", "")
+                if kind == "hello":
+                    ident = (f"uid{msg.get('uid', '?')}:"
+                             f"pid{msg.get('pid', '?')}")
+                    protocol.write_msg(conn, {
+                        "type": "hello_ack", "pid": os.getpid(),
+                        "version": __version__,
+                        "project": self._project_name(),
+                    })
+                elif kind == "ping":
+                    with self._runs_lock:
+                        n = sum(1 for r in self.runs.values()
+                                if not r.done.is_set())
+                    protocol.write_msg(conn, {
+                        "type": "pong", "pid": os.getpid(), "runs": n})
+                elif kind == "status":
+                    protocol.write_msg(conn, self._status_doc())
+                elif kind == "submit_run":
+                    self._handle_submit(conn, msg, ident)
+                    return      # streaming connections are single-purpose
+                elif kind == "attach":
+                    self._handle_attach(conn, msg)
+                    return
+                elif kind == "stop_run":
+                    self._handle_stop_run(conn, msg)
+                elif kind == "shutdown":
+                    protocol.write_msg(conn, {"type": "ok"})
+                    threading.Thread(target=self.stop, daemon=True,
+                                     name="loopd-shutdown").start()
+                    return
+                else:
+                    protocol.write_msg(conn, {
+                        "type": "error",
+                        "error": f"unknown request {kind!r}"})
+        except (protocol.ProtocolError, OSError) as e:
+            log.info("loopd connection dropped: %s", e)
+        except ClawkerError as e:
+            try:
+                protocol.write_msg(conn, {"type": "error", "error": str(e)})
+            except (OSError, ClawkerError):
+                pass
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _project_name(self) -> str:
+        try:
+            return self.cfg.project_name()
+        except LookupError:
+            return ""
+
+    # ----------------------------------------------------------- run verbs
+
+    def _handle_submit(self, conn, msg: dict, ident: str) -> None:
+        doc = msg.get("spec") or {}
+        spec = spec_from_doc(doc)
+        # per-tenant accounting keyed by CLIENT IDENTITY: a run that
+        # never named a tenant bills under its submitter, so two
+        # anonymous CLIs on one pod still split tokens fairly instead
+        # of pooling into one "default" share
+        if spec.tenant in ("", "default"):
+            spec.tenant = ident
+        # _create_run validates the spec (unknown policy/failover raise
+        # here = an error ack) and registers the run; the scheduler's
+        # own start() -- WAL + launch submission -- runs on the drive
+        # thread AFTER the ack, so submit latency is the socket hop
+        # plus registration, not a journal fsync + fan-out
+        run = self._create_run(spec, ident, keep=bool(msg.get("keep")))
+        self.seams.fire("loopd.post_submit")
+        client_gone = False
+        try:
+            protocol.write_msg(conn, {
+                "type": "submitted", "run": run.run_id,
+                "tenant": run.tenant,
+                # deterministic per (run, slot) -- the same names the
+                # scheduler will place (and the journal will record)
+                "agents": [f"{spec.agent_prefix}-{run.run_id[:6]}-{i}"
+                           for i in range(spec.parallel)]})
+        except (OSError, ClawkerError):
+            client_gone = True      # ownership already transferred: the
+            #                         run executes regardless
+        self.seams.fire("loopd.post_ack")
+        self._start_run(run)
+        if not client_gone and msg.get("stream", True):
+            self._stream(conn, run)
+
+    def _create_run(self, spec: LoopSpec, ident: str, *,
+                    keep: bool) -> _DaemonRun:
+        """Validate the spec and REGISTER the run (the ack gate).  The
+        expensive part -- journal/flight-recorder opens, placement,
+        launch submission -- happens on the drive thread, so submit
+        latency is one socket hop plus this registration."""
+        from ..loop.scheduler import FAILOVER_POLICIES
+        from ..placement import get_policy
+        from ..util import ids
+
+        get_policy(spec.placement)          # raises on unknown policy
+        if spec.failover not in FAILOVER_POLICIES:
+            raise ClawkerError(
+                f"loopd: unknown failover policy {spec.failover!r} "
+                f"({'|'.join(FAILOVER_POLICIES)})")
+        run = _DaemonRun(run_id=ids.short_id(), spec=spec,
+                         tenant=spec.tenant, client=ident, keep=keep)
+        with self._runs_lock:
+            self.runs[run.run_id] = run
+            # retention: evict the oldest DONE runs past the keep window
+            # (dict order is insertion order = submit order); live runs
+            # are never evicted.  The journal/flight record remain on
+            # disk -- eviction only drops the in-memory view.
+            done_ids = [rid for rid, r in self.runs.items()
+                        if r.done.is_set()]
+            for rid in done_ids[:max(0, len(done_ids) - DONE_RUNS_KEPT)]:
+                del self.runs[rid]
+            active = sum(1 for r in self.runs.values()
+                         if not r.done.is_set())
+        _RUNS.labels(spec.tenant).inc()
+        _ACTIVE_RUNS.set(active)
+        log.info("run %s submitted by %s (tenant %s, %d loop(s))",
+                 run.run_id, ident, run.tenant, spec.parallel)
+        return run
+
+    def _start_run(self, run: _DaemonRun) -> None:
+        """Spawn the drive thread (idempotent)."""
+        if run.thread is not None:
+            return
+        run.thread = threading.Thread(target=self._drive, args=(run,),
+                                      daemon=True,
+                                      name=f"loopd-run-{run.run_id[:6]}")
+        run.thread.start()
+
+    def _drive(self, run: _DaemonRun) -> None:
+        """Build and drive one hosted run to completion on a daemon
+        thread.  The scheduler is constructed with the SHARED admission
+        controller and lane registry; placements are journaled
+        write-ahead and launches submitted exactly as in-process."""
+        if self._aborted:
+            return
+
+        def on_event(agent, event, detail=""):
+            if event == TRACE_SPAN:
+                return      # spans live in the flight recorder; the
+                #             stream carries the lifecycle events
+            run.publish({"type": "event", "run": run.run_id,
+                         "agent": agent, "event": event, "detail": detail})
+
+        try:
+            sched = LoopScheduler(self.cfg, self.driver, run.spec,
+                                  on_event=on_event,
+                                  run_id=run.run_id,
+                                  admission=self.admission,
+                                  lanes=self.lanes,
+                                  seams=self.seams)
+            run.sched = sched
+            if self._aborted:
+                sched.kill()        # kill() raced the construction
+                return
+            if run.stop_requested.is_set():
+                sched.request_shutdown("loopd stop_run")
+            sched.start()
+            loops = sched.run(poll_s=DRIVE_POLL_S)
+            if not (self._aborted or sched._aborted):
+                sched.cleanup(remove_containers=not run.keep)
+            agents = [l.summary() for l in loops]
+            ok = not any(l.status in ("failed", "orphaned") for l in loops)
+        except Exception as e:      # noqa: BLE001 -- a run must never
+            #                         take the daemon down with it
+            log.exception("hosted run %s crashed", run.run_id)
+            agents = run.sched.status() if run.sched is not None else []
+            ok = False
+            run.result["error"] = repr(e)
+        if self._aborted:
+            return      # killed daemons publish nothing
+        run.result.update({"agents": agents, "ok": ok})
+        run.done.set()
+        with self._runs_lock:
+            _ACTIVE_RUNS.set(sum(1 for r in self.runs.values()
+                                 if not r.done.is_set()))
+        run.publish({"type": "run_done", "run": run.run_id,
+                     "agents": agents, "ok": ok})
+        run.publish(None)
+
+    def _resolve_run(self, ref: str) -> _DaemonRun:
+        with self._runs_lock:
+            run = self.runs.get(ref)
+            if run is not None:
+                return run
+            matches = [r for rid, r in self.runs.items()
+                       if rid.startswith(ref)]
+        if len(matches) == 1:
+            return matches[0]
+        if matches:
+            names = ", ".join(r.run_id for r in matches)
+            raise LoopdError(f"run {ref!r} is ambiguous: {names}")
+        raise LoopdError(f"loopd hosts no run {ref!r}")
+
+    def _handle_attach(self, conn, msg: dict) -> None:
+        run = self._resolve_run(str(msg.get("run", "")))
+        protocol.write_msg(conn, {
+            "type": "attached", "run": run.run_id,
+            "state": "done" if run.done.is_set() else "running",
+            "agents": (run.sched.status()
+                       if run.sched is not None else [])})
+        self._stream(conn, run)
+
+    def _handle_stop_run(self, conn, msg: dict) -> None:
+        run = self._resolve_run(str(msg.get("run", "")))
+        run.stop_requested.set()
+        if run.sched is not None:
+            run.sched.request_shutdown("loopd stop_run")
+        protocol.write_msg(conn, {"type": "ok", "run": run.run_id})
+
+    # ------------------------------------------------------------ streaming
+
+    def _stream(self, conn, run: _DaemonRun) -> None:
+        """Push the run's event frames until it completes or the client
+        detaches.  Detaching (an explicit ``detach`` frame, or just
+        closing the socket) unsubscribes and returns -- it must NEVER
+        stop the run; that is the whole point of a daemon-owned run."""
+        sub_id, q, snapshot, finished = run.subscribe()
+        conn.settimeout(None)
+        detached = threading.Event()
+
+        def reader():
+            # the client side of a stream only ever says "detach" (or
+            # vanishes); either way the writer must wake promptly
+            try:
+                while True:
+                    m = protocol.read_msg(conn)
+                    if m.get("type") == "detach":
+                        break
+            except (protocol.ProtocolError, OSError):
+                pass
+            detached.set()
+            try:
+                q.put_nowait(None)
+            except queue.Full:
+                pass
+
+        threading.Thread(target=reader, daemon=True,
+                         name="loopd-stream-reader").start()
+        try:
+            for frame in snapshot:
+                protocol.write_msg(conn, frame)
+            if finished:
+                protocol.write_msg(conn, {
+                    "type": "run_done", "run": run.run_id,
+                    "agents": run.result.get("agents", []),
+                    "ok": run.result.get("ok", False)})
+                return
+            while not detached.is_set():
+                frame = q.get()
+                if frame is None:
+                    if detached.is_set():
+                        break
+                    return      # run_done already pushed; stream over
+                protocol.write_msg(conn, frame)
+        except (protocol.ProtocolError, OSError):
+            pass                # client vanished mid-write: same as detach
+        finally:
+            run.unsubscribe(sub_id)
+
+    # -------------------------------------------------------------- status
+
+    def _health_stats(self) -> list[dict]:
+        """Per-worker health rows: the daemon's own monitor merged with
+        every LIVE hosted run's monitor, keeping the most pessimistic
+        breaker row per worker.  Placements consult the RUN monitors
+        (each scheduler builds its own), so a fleet view fed only by
+        the daemon's idle monitor could read all-closed while a hosted
+        run is actively failing over -- the merge renders the breakers
+        placements actually use."""
+        monitors = [self.health] if self.health is not None else []
+        with self._runs_lock:
+            for r in self.runs.values():
+                sched = r.sched
+                if (not r.done.is_set() and sched is not None
+                        and sched.health is not None):
+                    monitors.append(sched.health)
+        best: dict[str, dict] = {}
+        for mon in monitors:
+            try:
+                rows = mon.stats()
+            except Exception:       # noqa: BLE001 -- a dying run's
+                continue            # monitor must not break status
+            for row in rows:
+                cur = best.get(row["worker"])
+                if (cur is None or row["breaker_state_gauge"]
+                        > cur["breaker_state_gauge"]):
+                    best[row["worker"]] = row
+        return [best[w] for w in sorted(best)]
+
+    def _status_doc(self) -> dict:
+        with self._runs_lock:
+            runs = [r.status_doc() for r in self.runs.values()]
+        pools = {}
+        with self._runs_lock:
+            for r in self.runs.values():
+                wp = (r.sched.warmpool if r.sched is not None else None)
+                if wp is not None:
+                    pools[r.run_id] = wp.stats()
+        return {
+            "type": "status",
+            "pid": os.getpid(),
+            "version": __version__,
+            "project": self._project_name(),
+            "socket": str(self.sock_path),
+            "uptime_s": round(time.monotonic() - self._started_at, 1),
+            "runs": runs,
+            "admission": self.admission.stats(),
+            "health": self._health_stats(),
+            "warm_pools": pools,
+            "settings": {
+                "max_inflight_per_worker":
+                    self.cfg.settings.loop.placement.max_inflight_per_worker,
+                "max_pending_per_worker":
+                    self.cfg.settings.loop.placement.max_pending_per_worker,
+                "metrics_port": self._metrics_port,
+            },
+        }

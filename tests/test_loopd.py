@@ -1,0 +1,708 @@
+"""loopd suite: the worker-resident loop-supervisor daemon (ISSUE 9).
+
+The acceptance shape: two CLI clients on ONE daemon hold the per-worker
+admission cap (daemon-side launch high-water mark <= cap) and the WFQ
+interleaves their tenants; a detached run survives its submitting
+client exiting and is re-attachable; a SIGKILLed daemon resumes via
+journal adoption with zero duplicate creates and the invariant checker
+green.  Plus the socket security model (0700 dir / 0600 socket), the
+client-mode two-stage SIGINT (first Ctrl-C DETACHES -- killing the
+viewer must not kill the run), CLI wiring (`clawker loopd`, `loop
+--detach`, `loop attach`), fleet views over the status RPC, and the
+no-daemon degrade path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import stat
+import threading
+import time
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.config import load_config
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.loop import LoopScheduler
+from clawker_tpu.loop.journal import RunJournal, journal_path, replay
+from clawker_tpu.loopd import LoopdError
+from clawker_tpu.loopd.client import LoopdClient, discover
+from clawker_tpu.loopd.server import LoopdServer
+from clawker_tpu.testenv import TestEnv
+
+IMAGE = "clawker-loopdproj:default"
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: loopdproj\n")
+        cfg = load_config(proj)
+        yield tenv, proj, cfg
+
+
+def driver_with(n_workers: int, behavior=None):
+    drv = FakeDriver(n_workers=n_workers)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, behavior or exit_behavior(b"done\n", 0))
+    return drv
+
+
+def hold_behavior(hold: threading.Event):
+    def run(io) -> int:
+        if not hold.is_set():
+            hold.wait(20.0)
+        return 0
+
+    return run
+
+
+def wait_for(pred, timeout=10.0, interval=0.01) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def total_creates(drv) -> int:
+    return sum(len(api.calls_named("container_create")) for api in drv.apis)
+
+
+@pytest.fixture
+def server(env):
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    srv = LoopdServer(cfg, drv).start()
+    yield cfg, drv, srv
+    srv.stop()
+
+
+def _run_to_done(client, spec_doc, **kw):
+    ack = client.submit_run(spec_doc, **kw)
+    final = None
+    for frame in client.events():
+        if frame.get("type") == "run_done":
+            final = frame
+    return ack, final
+
+
+# ---------------------------------------------------------------- security
+
+
+def test_socket_modes_private(server):
+    """0700 runtime dir, 0600 socket: filesystem permissions are the
+    authentication (the bksession/nsd pattern -- ADVICE r5)."""
+    cfg, drv, srv = server
+    sock = srv.sock_path
+    assert stat.S_IMODE(os.stat(sock).st_mode) == 0o600
+    assert stat.S_IMODE(os.stat(sock.parent).st_mode) == 0o700
+
+
+def test_second_daemon_refuses_to_usurp(server):
+    cfg, drv, srv = server
+    with pytest.raises(LoopdError, match="already running"):
+        LoopdServer(cfg, drv).start()
+
+
+# ------------------------------------------------------------ basic verbs
+
+
+def test_submit_streams_and_completes(server):
+    cfg, drv, srv = server
+    client = discover(cfg)
+    assert client is not None
+    ack, final = _run_to_done(client, {"parallel": 2, "iterations": 1})
+    client.close()
+    assert len(ack["agents"]) == 2
+    assert final is not None and final["ok"]
+    assert all(a["status"] == "done" and a["iteration"] == 1
+               for a in final["agents"])
+    # the run journaled under the ordinary path: --resume vocabulary
+    assert journal_path(cfg.logs_dir, ack["run"]).exists()
+
+
+def test_status_reports_runs_admission_health(server):
+    cfg, drv, srv = server
+    client = discover(cfg)
+    ack, final = _run_to_done(client, {"parallel": 1, "iterations": 1})
+    client.close()
+    c2 = LoopdClient(srv.sock_path)
+    doc = c2.status()
+    c2.close()
+    runs = {r["run"]: r for r in doc["runs"]}
+    assert runs[ack["run"]]["state"] == "done"
+    assert runs[ack["run"]]["ok"] is True
+    # client-identity tenant accounting: the run billed its submitter
+    assert runs[ack["run"]]["tenant"].startswith("uid")
+    assert doc["admission"]["workers"]     # shared controller saw it
+    assert {h["worker"] for h in doc["health"]} == {"fake-0", "fake-1"}
+    assert doc["project"] == "loopdproj"
+
+
+def test_stop_drains_to_resumable_journal(env):
+    """`loopd stop` journals a durable shutdown per live run -- the
+    drained run resumes later exactly like a Ctrl-C'd CLI run."""
+    tenv, proj, cfg = env
+    hold = threading.Event()
+    drv = driver_with(1, behavior=hold_behavior(hold))
+    srv = LoopdServer(cfg, drv).start()
+    client = discover(cfg)
+    ack = client.submit_run({"parallel": 1, "iterations": 1}, stream=False)
+    run = srv.runs[ack["run"]]
+    assert wait_for(lambda: run.sched is not None
+                    and any(l.status == "running"
+                            for l in run.sched.loops))
+    client.close()
+    hold.set()      # srv.stop() drains: let the iteration finish
+    srv.stop()
+    records = RunJournal.read(journal_path(cfg.logs_dir, ack["run"]))
+    kinds = [r["kind"] for r in records]
+    assert "run" in kinds and "placement" in kinds
+
+
+# ------------------------------------------- cross-process cap + fairness
+
+
+def test_two_clients_hold_admission_cap_and_interleave(env):
+    """THE acceptance bar: two CLI clients on one daemon never jointly
+    exceed max_inflight_per_worker (daemon-side launch high-water mark)
+    and the WFQ interleaves their tenants instead of first-burst-wins.
+    """
+    tenv, proj, cfg = env
+    cap = 2
+    # the admission bucket is DAEMON-scoped state: its capacity comes
+    # from the daemon's settings, never a per-run flag (a shared bucket
+    # cannot be resized per submitter -- docs/loopd.md degrade matrix)
+    cfg.settings.loop.placement.max_inflight_per_worker = cap
+    drv = driver_with(1)
+
+    # make creates slow enough that the two runs' bursts genuinely
+    # overlap at the daemon
+    api = drv.apis[0]
+    orig_create = api.container_create
+
+    def slow_create(name, config):
+        time.sleep(0.02)
+        return orig_create(name, config)
+
+    api.container_create = slow_create
+    srv = LoopdServer(cfg, drv).start()
+    created_order: list[str] = []
+    done = {}
+
+    def one_client(tenant: str):
+        c = LoopdClient(srv.sock_path)
+        c.hello()
+        ack = c.submit_run({
+            "parallel": 6, "iterations": 1, "placement": "pack",
+            "tenant": tenant})
+        for frame in c.events():
+            if (frame.get("type") == "event"
+                    and frame.get("event") == "created"):
+                created_order.append(tenant)
+            if frame.get("type") == "run_done":
+                done[tenant] = frame
+        c.close()
+
+    t_a = threading.Thread(target=one_client, args=("tenant-a",))
+    t_b = threading.Thread(target=one_client, args=("tenant-b",))
+    t_a.start()
+    t_b.start()
+    t_a.join(60.0)
+    t_b.join(60.0)
+    srv.stop()
+    assert done["tenant-a"]["ok"] and done["tenant-b"]["ok"]
+    # daemon-side evidence: the fake daemon never saw more concurrent
+    # create/start calls than ONE shared bucket allows
+    assert drv.gates[0].launch_hwm <= cap, drv.gates[0].launch_hwm
+    stats = srv.admission.stats()
+    assert stats["workers"]["fake-0"]["inflight_hwm"] <= cap
+    # fairness: neither tenant's whole burst drained before the other
+    # started (WFQ interleaves; serial would give aaaaaabbbbbb)
+    first_a = created_order.index("tenant-a")
+    first_b = created_order.index("tenant-b")
+    last_a = len(created_order) - 1 - created_order[::-1].index("tenant-a")
+    last_b = len(created_order) - 1 - created_order[::-1].index("tenant-b")
+    assert first_a < last_b and first_b < last_a, created_order
+
+
+# ------------------------------------------------- detach / attach / kill
+
+
+def test_detached_run_survives_client_and_reattaches(env):
+    """A daemon-owned run keeps executing after its submitting client
+    connection dies; `attach` replays recent events and streams the
+    finish."""
+    tenv, proj, cfg = env
+    hold = threading.Event()
+    drv = driver_with(2, behavior=hold_behavior(hold))
+    srv = LoopdServer(cfg, drv).start()
+    c1 = LoopdClient(srv.sock_path)
+    c1.hello()
+    ack = c1.submit_run({"parallel": 2, "iterations": 1})
+    started = 0
+    for frame in c1.events():
+        if (frame.get("type") == "event"
+                and frame.get("event") == "iteration_start"):
+            started += 1
+            if started == 2:
+                break
+    c1.close()      # the viewer dies mid-run
+    run = srv.runs[ack["run"]]
+    assert not run.done.is_set()
+    hold.set()
+    c2 = LoopdClient(srv.sock_path)
+    c2.hello()
+    snap = c2.attach(ack["run"][:6])
+    assert snap["run"] == ack["run"]
+    final = None
+    for frame in c2.events():
+        if frame.get("type") == "run_done":
+            final = frame
+    c2.close()
+    assert final is not None and final["ok"]
+    assert all(a["status"] == "done" for a in final["agents"])
+    srv.stop()
+
+
+def test_explicit_detach_frame_keeps_run_alive(env):
+    tenv, proj, cfg = env
+    hold = threading.Event()
+    drv = driver_with(1, behavior=hold_behavior(hold))
+    srv = LoopdServer(cfg, drv).start()
+    c = LoopdClient(srv.sock_path)
+    c.hello()
+    ack = c.submit_run({"parallel": 1, "iterations": 1})
+    for frame in c.events():
+        if (frame.get("type") == "event"
+                and frame.get("event") == "iteration_start"):
+            break
+    c.detach()
+    c.close()
+    run = srv.runs[ack["run"]]
+    assert wait_for(lambda: not run.subs)       # daemon unsubscribed us
+    assert not run.done.is_set()                # ...without stopping it
+    hold.set()
+    assert run.done.wait(10.0)
+    assert run.result["ok"]
+    srv.stop()
+
+
+def test_daemon_sigkill_mid_run_resume_adopts_zero_duplicates(env):
+    """The chaos satellite: SIGKILL the daemon mid-run (both containers
+    executing), then `--resume` adopts them in place -- zero duplicate
+    creates -- and the chaos invariant checker is green."""
+    from clawker_tpu.chaos.invariants import check_invariants
+
+    tenv, proj, cfg = env
+    hold = threading.Event()
+    drv = driver_with(2, behavior=hold_behavior(hold))
+    srv = LoopdServer(cfg, drv).start()
+    client = LoopdClient(srv.sock_path)
+    client.hello()
+    ack = client.submit_run({"parallel": 2, "iterations": 1})
+    started = 0
+    for frame in client.events():
+        if (frame.get("type") == "event"
+                and frame.get("event") == "iteration_start"):
+            started += 1
+            if started == 2:
+                break
+    creates_before = total_creates(drv)
+    srv.kill()      # daemon SIGKILL: all bookkeeping freezes mid-frame
+    # the viewer sees its stream die, NOT a clean run_done
+    with pytest.raises(Exception):
+        for frame in client.events():
+            assert frame.get("type") != "run_done"
+    client.close()
+    # the socket file survives a SIGKILL; discovery must read it as
+    # "no daemon" and the CLI degrades to the in-process path
+    assert srv.sock_path.exists()
+    assert discover(cfg) is None
+    # resume from the journal the daemon left behind
+    image = replay(RunJournal.read(journal_path(cfg.logs_dir, ack["run"])))
+    sched2 = LoopScheduler.resume(cfg, drv, image)
+    summary = sched2.reconcile()
+    assert summary["adopted"] == 2
+    hold.set()
+    t = threading.Thread(target=sched2.run, kwargs={"poll_s": 0.05},
+                         daemon=True)
+    t.start()
+    t.join(15.0)
+    assert all(l.status == "done" for l in sched2.loops)
+    assert total_creates(drv) == creates_before     # zero duplicates
+    violations = check_invariants(
+        drv, cfg, ack["run"], loops=sched2.loops, cap=0, kills=1)
+    # adopted containers linger until cleanup; sweep then re-audit
+    sched2.cleanup(remove_containers=True)
+    violations = check_invariants(
+        drv, cfg, ack["run"], loops=sched2.loops, cap=0, kills=1)
+    assert violations == [], violations
+
+
+def test_daemon_killed_at_post_submit_seam_leaves_no_orphan_state(env):
+    """Crash consistency at the loopd.post_submit seam: the client
+    never gets an ack, and no engine call was made for the registered
+    run -- nothing to resume, nothing leaked."""
+    from clawker_tpu.agentd.protocol import ConnectionClosed
+    from clawker_tpu.chaos.seams import SeamRegistry
+
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    seams = SeamRegistry()
+    srv = LoopdServer(cfg, drv, seams=seams).start()
+    seams.arm("loopd.post_submit", srv.kill)
+    client = LoopdClient(srv.sock_path)
+    client.hello()
+    with pytest.raises((ConnectionClosed, LoopdError, OSError)):
+        client.submit_run({"parallel": 1, "iterations": 1})
+    client.close()
+    assert total_creates(drv) == 0
+    assert seams.fired == ["loopd.post_submit"]
+
+
+# --------------------------------------------------------------- lanes
+
+
+def test_shared_lane_registry_serializes_across_runs(server):
+    """Two hosted runs' engine calls for one worker ride the SAME lane
+    (daemon-owned per-worker serial lanes)."""
+    cfg, drv, srv = server
+    client = LoopdClient(srv.sock_path)
+    client.hello()
+    _, final1 = _run_to_done(client, {"parallel": 1, "iterations": 1,
+                                      "placement": "pack"})
+    client.close()
+    c2 = LoopdClient(srv.sock_path)
+    c2.hello()
+    _, final2 = _run_to_done(c2, {"parallel": 1, "iterations": 1,
+                                  "placement": "pack"})
+    c2.close()
+    assert final1["ok"] and final2["ok"]
+    assert "fake-0" in srv.lanes.lanes      # one registry, reused
+    r1 = srv.runs[final1["run"]].sched
+    r2 = srv.runs[final2["run"]].sched
+    assert r1.lanes is srv.lanes and r2.lanes is srv.lanes
+
+
+# ------------------------------------------------------------- CLI wiring
+
+
+def test_cli_loop_submits_to_daemon_and_attach_restreams(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    srv = LoopdServer(cfg, drv).start()
+    res = CliRunner().invoke(
+        cli, ["loop", "-p", "2", "-n", "1", "--json"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert "daemon-owned" in res.output + res.stderr
+    out = json.loads(res.output[res.output.index("{"):])
+    assert all(a["status"] == "done" for a in out["agents"])
+    # the run executed inside the DAEMON's scheduler, not the CLI's
+    assert out["loop_id"] in srv.runs
+    srv.stop()
+
+
+def test_cli_loop_detach_and_attach(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    srv = LoopdServer(cfg, drv).start()
+    res = CliRunner().invoke(
+        cli, ["loop", "-p", "1", "-n", "1", "--detach"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert "clawker loop attach" in res.output
+    run_id = next(iter(srv.runs))
+    srv.runs[run_id].done.wait(10.0)
+    res2 = CliRunner().invoke(
+        cli, ["loop", "attach", run_id[:6], "--json"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res2.exit_code == 0, res2.output
+    out = json.loads(res2.output[res2.output.index("{"):])
+    assert out["loop_id"] == run_id
+    srv.stop()
+
+
+def test_cli_loop_no_daemon_flag_and_detach_without_daemon(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    srv = LoopdServer(cfg, drv).start()
+    # --no-daemon forces the in-process scheduler despite a live daemon
+    res = CliRunner().invoke(
+        cli, ["loop", "-p", "1", "-n", "1", "--no-daemon", "--json"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert not srv.runs                     # daemon hosted nothing
+    srv.stop()
+    # --detach without a daemon is an explicit error, not a silent
+    # in-process run that dies with the CLI
+    res2 = CliRunner().invoke(
+        cli, ["loop", "-p", "1", "-n", "1", "--detach"],
+        obj=Factory(cwd=proj, driver=drv))
+    assert res2.exit_code != 0
+    assert "loopd" in res2.output
+
+
+def test_cli_no_daemon_degrades_in_process(env):
+    """No socket -> discover None -> today's in-process path (tier-1
+    behavior unchanged)."""
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    assert discover(cfg) is None
+    res = CliRunner().invoke(
+        cli, ["loop", "-p", "1", "-n", "1", "--json"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+
+
+def test_client_interrupt_first_detaches_then_hard_exits(monkeypatch):
+    """Client-mode two-stage SIGINT: the first Ctrl-C DETACHES (the
+    daemon-owned run keeps executing, the attach hint prints); the
+    second hard-exits the viewer.  Killing the viewer never kills the
+    run."""
+    from clawker_tpu.cli import cmd_loop
+
+    exits = []
+    monkeypatch.setattr(cmd_loop, "_hard_exit", exits.append)
+
+    class ClientStub:
+        def __init__(self):
+            self.detaches = 0
+
+        def detach(self):
+            self.detaches += 1
+
+    stub = ClientStub()
+    handler = cmd_loop._ClientInterrupt(stub, "abc123def")
+    handler()
+    assert stub.detaches == 1 and handler.detached and not exits
+    handler()
+    assert exits == [130]
+    assert stub.detaches == 1       # detach fired exactly once
+
+
+def test_cli_loopd_group_status_stop(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    # status with no daemon: non-zero (liveness probe contract)
+    res = CliRunner().invoke(cli, ["loopd", "status"],
+                             obj=Factory(cwd=proj, driver=drv))
+    assert res.exit_code == 1
+    srv = LoopdServer(cfg, drv).start()
+    res = CliRunner().invoke(cli, ["loopd", "status", "--format", "json"],
+                             obj=Factory(cwd=proj, driver=drv),
+                             catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    doc = json.loads(res.output)
+    assert doc["pid"] == os.getpid() and doc["runs"] == []
+    # `loopd start` against a live daemon is a friendly no-op
+    res = CliRunner().invoke(cli, ["loopd", "start"],
+                             obj=Factory(cwd=proj, driver=drv),
+                             catch_exceptions=False)
+    assert "already running" in res.output
+    res = CliRunner().invoke(cli, ["loopd", "stop"],
+                             obj=Factory(cwd=proj, driver=drv),
+                             catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert not srv.sock_path.exists()
+
+
+def test_publish_drop_oldest_always_delivers_terminal_frames():
+    """A slow subscriber sheds its OLDEST frames; the run_done frame
+    and the None sentinel must always land -- dropping them would
+    wedge the stream writer and the client forever."""
+    import queue as _queue
+
+    from clawker_tpu.loop import LoopSpec
+    from clawker_tpu.loopd.server import SUB_QUEUE_MAX, _DaemonRun
+
+    run = _DaemonRun(run_id="r", spec=LoopSpec(), tenant="t", client="c")
+    _, q, _, _ = run.subscribe()
+    for i in range(SUB_QUEUE_MAX + 50):     # way past the queue bound
+        run.publish({"type": "event", "i": i})
+    run.publish({"type": "run_done", "run": "r", "agents": [], "ok": True})
+    run.publish(None)
+    frames = []
+    while True:
+        try:
+            frames.append(q.get_nowait())
+        except _queue.Empty:
+            break
+    assert frames[-1] is None
+    assert frames[-2]["type"] == "run_done"
+
+
+def test_cli_explicit_daemon_rejects_resume_and_chaos(env, tmp_path):
+    """--daemon must error, not silently degrade, when combined with
+    the in-process-only modes."""
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    res = CliRunner().invoke(
+        cli, ["loop", "--daemon", "--resume", "whatever"],
+        obj=Factory(cwd=proj, driver=drv))
+    assert res.exit_code != 0 and "--resume" in res.output
+    plan = tmp_path / "plan.json"
+    plan.write_text('{"seed": 1, "events": []}')
+    res = CliRunner().invoke(
+        cli, ["loop", "--daemon", "--chaos-plan", str(plan)],
+        obj=Factory(cwd=proj, driver=drv))
+    assert res.exit_code != 0 and "--chaos-plan" in res.output
+
+
+def test_done_runs_evicted_past_retention(server, monkeypatch):
+    """A resident daemon keeps a bounded window of finished runs."""
+    from clawker_tpu.loopd import server as srv_mod
+
+    cfg, drv, srv = server
+    monkeypatch.setattr(srv_mod, "DONE_RUNS_KEPT", 2)
+    ids = []
+    for _ in range(4):
+        c = LoopdClient(srv.sock_path)
+        c.hello()
+        ack, final = _run_to_done(c, {"parallel": 1, "iterations": 1})
+        c.close()
+        assert final["ok"]
+        ids.append(ack["run"])
+    assert ids[0] not in srv.runs           # oldest done runs evicted
+    assert ids[-1] in srv.runs
+
+
+# --------------------------------------------------------- tpu_vm tunnel
+
+
+def test_transport_forwards_loopd_socket_over_mux(tmp_path):
+    """tpu_vm case: the daemon socket rides the existing SSH mux --
+    forward_loopd targets the worker's canonical loopd socket (absolute
+    path; sshd does not tilde-expand streamlocal targets) under the
+    'loopd' tag."""
+    from clawker_tpu.config.schema import TPUSettings
+    from clawker_tpu.fleet.transport import FakeRunner, SSHTransport
+
+    tpu = TPUSettings(ssh_user="clawker")
+    t = SSHTransport(tpu, "worker0", 0, mux_dir=tmp_path,
+                     runner=FakeRunner())
+    assert (t.remote_loopd_sock()
+            == "/home/clawker/.local/state/clawker-tpu/loopd/loopd.sock")
+    seen = {}
+
+    def fake_forward(remote_sock, tag="docker"):
+        seen["remote"], seen["tag"] = remote_sock, tag
+        return tmp_path / f"{tag}-0.sock"
+
+    t.forward_unix = fake_forward
+    local = t.forward_loopd()
+    assert seen == {"remote": t.remote_loopd_sock(), "tag": "loopd"}
+    assert local.name == "loopd-0.sock"
+
+
+# ------------------------------------------------------------ fleet views
+
+
+def test_fleet_health_renders_daemon_breakers(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    srv = LoopdServer(cfg, drv).start()
+    res = CliRunner().invoke(
+        cli, ["fleet", "health"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert "source: loopd" in res.output + res.stderr
+    assert "fake-0" in res.output and "fake-1" in res.output
+    srv.stop()
+    # daemon gone: the CLI probe path takes over
+    res2 = CliRunner().invoke(
+        cli, ["fleet", "health"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res2.exit_code == 0, res2.output
+    assert "source: loopd" not in res2.output + res2.stderr
+
+
+def test_fleet_placement_renders_daemon_admission(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    srv = LoopdServer(cfg, drv).start()
+    client = discover(cfg)
+    _, final = _run_to_done(client, {"parallel": 2, "iterations": 1,
+                                     "tenant": "viewtenant"})
+    client.close()
+    res = CliRunner().invoke(
+        cli, ["fleet", "placement", "--format", "json"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    doc = json.loads(res.output[res.output.index("{"):])
+    assert doc["source"].startswith("loopd:")
+    assert "viewtenant" in doc["tenants"]
+    assert {w["worker"] for w in doc["workers"]} == {"fake-0", "fake-1"}
+    srv.stop()
+
+
+def test_fleet_warmpool_renders_daemon_pools(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    srv = LoopdServer(cfg, drv).start()
+    client = discover(cfg)
+    ack, final = _run_to_done(client, {"parallel": 1, "iterations": 1,
+                                       "warm_pool_depth": 1})
+    client.close()
+    assert final["ok"]
+    res = CliRunner().invoke(
+        cli, ["fleet", "warmpool"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert "source: loopd" in res.output + res.stderr
+    assert ack["run"] in res.output
+    srv.stop()
