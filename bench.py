@@ -915,8 +915,10 @@ def bench_chaos_soak(scenarios: int = CHAOS_SOAK_SCENARIOS,
     drops, CLI SIGKILLs at crash seams with kill/resume cycles), each
     audited by the fleet invariant checker (docs/chaos.md).  The gate is
     ZERO invariant violations: this is the composition test for
-    breakers/failover + journal/--resume + admission + warm pools --
-    any failure is a one-command deterministic repro."""
+    breakers/failover + journal/--resume + admission + warm pools +
+    the sentinel riding along (stream silence/floods, collector kills)
+    -- any failure is a one-command deterministic repro.  The soak ends
+    with the sentinel observe-only twin check (docs/analytics-online.md)."""
     from clawker_tpu.chaos.runner import run_soak
 
     report = run_soak(scenarios, seed, shrink=True, keep_going=False)
@@ -927,6 +929,7 @@ def bench_chaos_soak(scenarios: int = CHAOS_SOAK_SCENARIOS,
         "kills": report["kills"],
         "injected": report["injected"],
         "wall_s": report["wall_s"],
+        "observe_only": report.get("observe_only"),
         "ok": report["ok"],
         "failures": [
             {"scenario": f["scenario"], "violations": f["violations"],
@@ -1339,6 +1342,152 @@ def bench_anomaly(device_budget_s: float = 240.0) -> dict:
             "error": "; ".join(failures)}
 
 
+_SENTINEL_FLAG_CHILD = """
+import json, sys, time, tempfile
+from pathlib import Path
+import jax
+jax.config.update("jax_platforms", "cpu")
+from bench import synth_egress_records
+from clawker_tpu.monitor.events import ANOMALY_FLAG, EventBus
+from clawker_tpu.sentinel import FleetSentinel, StreamCollector
+
+BASE = 1_700_000_000
+REPS = 5
+lat = []
+total_flags = 0
+for rep in range(REPS):
+    # one seeded incident per fresh sentinel: append -> flag latency at
+    # steady state (the jit cache is warm after rep 0's prewarm tick,
+    # like tick N>1 of a long-running sentinel)
+    tmp = Path(tempfile.mkdtemp())
+    recs = synth_egress_records(agents=8, windows=6, per_window=16)
+    with open(tmp / "w0.jsonl", "w") as f0, open(tmp / "w1.jsonl", "w") as f1:
+        for i, r in enumerate(recs):
+            r["worker"] = f"fake-{i % 2}"
+            (f0 if i % 2 == 0 else f1).write(json.dumps(r) + chr(10))
+    col = StreamCollector()
+    col.add_local("fake-0", tmp / "w0.jsonl")
+    col.add_local("fake-1", tmp / "w1.jsonl")
+
+    class Cfg:
+        logs_dir = tmp
+
+    flags = {}
+    bus = EventBus(lambda agent, ev, detail:
+                   flags.setdefault(agent, time.perf_counter())
+                   if ev == ANOMALY_FLAG else None)
+    s = FleetSentinel(Cfg(), interval_s=0.05, train_steps=40, window_s=60,
+                      collector=col)
+    s.bind_run(events=bus)
+    s.refresh_once(); s.refresh_once()      # compile (rep 0) + baselines
+    s.start()
+    agent = "clawker.hot"
+    t0 = time.perf_counter()
+    with open(tmp / "w1.jsonl", "a") as f:
+        for i in range(60):
+            ts = BASE + 2 * 60 + i % 59
+            f.write(json.dumps({
+                "@timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime(ts)),
+                "container": agent, "worker": "fake-1",
+                "dst_ip": f"203.0.113.{i}", "dst_port": 4444 + i,
+                "proto": 6, "verdict": "DENY", "reason": "NO_DNS_ENTRY",
+                "zone": "",
+            }) + chr(10))
+    deadline = t0 + 10.0
+    while agent not in flags and time.perf_counter() < deadline:
+        time.sleep(0.005)
+    s.stop()
+    bus.close()
+    lat.append(flags.get(agent, deadline) - t0)
+    total_flags += len(flags)
+lat.sort()
+print("BENCHJSON " + json.dumps({
+    "flag_latency_p50_s": round(lat[len(lat) // 2], 3),
+    "flag_latency_max_s": round(lat[-1], 3),
+    "flags": total_flags, "reps": REPS,
+    "workers_fused": 2,
+}))
+"""
+
+
+_SENTINEL_TICK_CHILD = """
+import json, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from bench import synth_egress_records
+from clawker_tpu.sentinel import ScoringEngine, featurize_fused
+
+recs = synth_egress_records(agents=64, windows=4, per_window=16)
+for i, r in enumerate(recs):
+    r["worker"] = f"fake-{i % 4}"
+keys, X, worker_of = featurize_fused(recs, None)
+eng = ScoringEngine(train_steps=40)
+rep = eng.score_tick(keys, X, worker_of)    # warm: compile
+ticks = []
+for _ in range(3):
+    t0 = time.perf_counter()
+    rep = eng.score_tick(keys, X, worker_of)
+    ticks.append(time.perf_counter() - t0)
+ticks.sort()
+agents = len({k.agent for k in rep.keys})
+print("BENCHJSON " + json.dumps({
+    "windows": rep.windows, "agents": agents,
+    "tick_p50_s": round(ticks[len(ticks) // 2], 3),
+    "train_ms": round(rep.train_ms, 1),
+    "score_ms": round(rep.score_ms, 1),
+    "device": rep.device,
+}))
+"""
+
+
+def _run_bench_child(code: str, budget_s: float) -> dict:
+    """Run a jax-using bench body in a bounded CPU-pinned subprocess
+    (the bench_anomaly pattern): a wedged accelerator runtime must cost
+    the budget, never the whole suite."""
+    import os
+    import subprocess
+    import sys
+
+    here = str(Path(__file__).resolve().parent)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch the TPU tunnel
+    try:
+        res = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=budget_s, cwd=here, env=env)
+    except subprocess.TimeoutExpired:
+        return {"error": f"exceeded {budget_s:.0f}s budget"}
+    for line in res.stdout.splitlines():
+        if line.startswith("BENCHJSON "):
+            try:
+                return json.loads(line[len("BENCHJSON "):])
+            except ValueError:
+                pass
+    return {"error": f"rc={res.returncode} "
+                     f"{(res.stderr or res.stdout).strip()[-300:]}"}
+
+
+def bench_anomaly_flag_latency() -> dict:
+    """anomaly_flag_latency_p50: egress record appended to a worker
+    stream -> typed ``anomaly.flag`` observable on the event bus, with
+    the sentinel ticking live over TWO fused worker streams on the fake
+    pod (docs/analytics-online.md).  A seeded deny-storm/exotic-port
+    agent per rep; gate p50 <= ANOMALY_FLAG_LATENCY_BUDGET_S -- the
+    security signal must land while the behavior is still happening."""
+    return _run_bench_child(_SENTINEL_FLAG_CHILD, 180.0)
+
+
+def bench_anomaly_fleet_score_tick() -> dict:
+    """anomaly_fleet_score_tick: 64 agents' open windows (the fused
+    40-dim extended ABI) scored in ONE sharded fit/score program --
+    the sentinel's steady-state tick, compile excluded (the persistent
+    cache + stable padded shapes make tick 1 the only compile)."""
+    return _run_bench_child(_SENTINEL_TICK_CHILD, 180.0)
+
+
 def previous_round_p50() -> float:
     """The newest committed BENCH_r*.json's headline value (ms), or 0."""
     import re
@@ -1394,6 +1543,11 @@ TELEMETRY_BUDGET_NS = 20_000  # per-record registry cost, enabled (a
 #                               1% of the 8.95ms cold-start headline)
 TELEMETRY_DISABLED_BUDGET_NS = 4_000   # disabled = one attr check; it
 #                               must stay near-free or opting out is a lie
+ANOMALY_FLAG_LATENCY_BUDGET_S = 2.0   # egress append -> anomaly.flag on
+#                               the bus, sentinel live on the fake pod
+#                               (ISSUE 10 acceptance)
+ANOMALY_TICK_BUDGET_S = 10.0  # 64 agents x open windows, one sharded
+#                               fit/score tick, compile excluded
 
 
 def main() -> None:
@@ -1415,6 +1569,8 @@ def main() -> None:
     dials = bench_engine_dials()
     tele = bench_telemetry_overhead()
     anom = bench_anomaly()
+    flag_lat = bench_anomaly_flag_latency()
+    score_tick = bench_anomaly_fleet_score_tick()
 
     budget_s = 10.0
     extra = [
@@ -1532,6 +1688,28 @@ def main() -> None:
          "vs_baseline": (round(5000.0 / anom["score_step_us"], 1)
                          if anom["score_step_us"] > 0 else 0.0),
          "detail": anom},
+        {"metric": "anomaly_flag_latency_p50",
+         "value": flag_lat.get("flag_latency_p50_s", 0.0), "unit": "s",
+         # the gate is the full sentinel acceptance: every seeded rep
+         # flagged, within budget -- a rep that never flagged reads 0
+         "vs_baseline": (round(
+             ANOMALY_FLAG_LATENCY_BUDGET_S
+             / max(flag_lat.get("flag_latency_p50_s", 0.0), 1e-9), 1)
+             if not flag_lat.get("error")
+             and flag_lat.get("flags") == flag_lat.get("reps")
+             and flag_lat.get("flag_latency_p50_s", 99.0)
+             <= ANOMALY_FLAG_LATENCY_BUDGET_S else 0.0),
+         "detail": flag_lat},
+        {"metric": "anomaly_fleet_score_tick",
+         "value": score_tick.get("tick_p50_s", 0.0), "unit": "s",
+         "vs_baseline": (round(
+             ANOMALY_TICK_BUDGET_S
+             / max(score_tick.get("tick_p50_s", 0.0), 1e-9), 1)
+             if not score_tick.get("error")
+             and score_tick.get("agents") == 64
+             and score_tick.get("tick_p50_s", 99.0)
+             <= ANOMALY_TICK_BUDGET_S else 0.0),
+         "detail": score_tick},
     ]
     prev_ms = previous_round_p50()
     cur_ms = round(p50_s * 1000, 2)

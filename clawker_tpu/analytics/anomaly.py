@@ -147,3 +147,10 @@ def shard_params(params: AnomalyParams, mesh: Mesh) -> AnomalyParams:
 
 def shard_batch(x: jax.Array, mesh: Mesh) -> jax.Array:
     return jax.device_put(x, NamedSharding(mesh, P("data", None)))
+
+
+def shard_noise(noises: jax.Array, mesh: Mesh) -> jax.Array:
+    """The fit scan's [steps, n, feat] noise tensor, sharded like the
+    batch it perturbs (rows over ``data``); the steps axis is the scan
+    axis and stays unsharded."""
+    return jax.device_put(noises, NamedSharding(mesh, P(None, "data", None)))

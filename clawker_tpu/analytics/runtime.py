@@ -65,6 +65,39 @@ def _robust_z(raw: np.ndarray) -> np.ndarray:
 _PAD_BUCKET = 128    # rows padded up to a multiple of this: stable jit shapes
 _jit_cache: dict = {}
 
+# Persistent XLA compilation cache for the anomaly lane (MULTICHIP r05
+# root fix): the device leg's budget was eaten by COMPILING the fit scan
+# on a tunneled backend, so the suite degraded to CPU every round.  With
+# the cache on, the first round pays the compile and every later tick /
+# bench round / CLI run loads the executable from disk instead.
+# "" disables; unwritable dirs and jax builds without the knob degrade
+# silently -- the cache is an accelerator, never a dependency.
+_CACHE_DIR_ENV = "CLAWKER_JAX_CACHE_DIR"
+
+
+def _ensure_compilation_cache() -> None:
+    if _jit_cache.get("cache_wired"):
+        return
+    _jit_cache["cache_wired"] = True
+    import os
+
+    cache_dir = os.environ.get(
+        _CACHE_DIR_ENV,
+        os.path.join(os.path.expanduser("~"), ".cache", "clawker-tpu",
+                     "jax-cache"))
+    if not cache_dir:
+        return
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache even fast compiles: the fit scan is re-jitted per input
+        # shape, and a pod of watchers shares one home dir
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # noqa: BLE001 -- optional fast path only
+        pass
+
 
 def _standardize(X: np.ndarray) -> np.ndarray:
     """Zero-mean/unit-var per feature over the window set, so the
@@ -77,11 +110,20 @@ def _standardize(X: np.ndarray) -> np.ndarray:
 
 def _jitted():
     """Module-level jitted fit/score: one compilation per input shape,
-    shared by every AnomalyWatch poll and CLI run in the process."""
+    shared by every AnomalyWatch poll, sentinel tick, and CLI run in
+    the process (the sentinel's steady state is exactly this cache --
+    every tick after the first reuses the same compiled fit).  The fit
+    scan's carry (the params pytree) is DONATED on accelerator
+    backends: the caller never reads the pre-fit params again, and the
+    donation lets XLA update the carry in place instead of holding both
+    generations live across the scan (part of the MULTICHIP r05 fix).
+    CPU ignores donation, so it is only requested where it works."""
     if "fit" not in _jit_cache:
         import jax
 
         from . import anomaly
+
+        _ensure_compilation_cache()
 
         def fit(params, x, noises, lr):
             # noises: [steps, n, feat], generated host-side -- keeps
@@ -93,34 +135,63 @@ def _jitted():
 
             return jax.lax.scan(body, params, noises)
 
-        _jit_cache["fit"] = jax.jit(fit)
+        donate = ()
+        try:
+            if jax.default_backend() != "cpu":
+                donate = (0,)       # params: the scan carry
+        except Exception:  # noqa: BLE001 -- backend probe must not fail us
+            donate = ()
+        _jit_cache["fit"] = jax.jit(fit, donate_argnums=donate)
         _jit_cache["score"] = jax.jit(anomaly.score)
     return _jit_cache["fit"], _jit_cache["score"]
 
 
-def _fit_and_score(X: np.ndarray, *, train_steps: int, lr: float, seed: int):
+def _fit_and_score(X: np.ndarray, *, train_steps: int, lr: float, seed: int,
+                   mesh=None, feat: int | None = None):
     """-> (raw_scores[n], params, x_padded, timings).  Rows are padded by
     edge-replication up to _PAD_BUCKET multiples so a growing stream
-    reuses compilations; padded scores are sliced off."""
+    reuses compilations; padded scores are sliced off.
+
+    With ``mesh`` (an :func:`anomaly.fleet_mesh`), params/batch/noise
+    are placed with their named shardings before the call, so the ONE
+    cached jitted fit runs as a single SPMD program over the whole
+    device mesh -- the sentinel's per-tick fleet scoring path.  The
+    row pad rounds up to a multiple of the mesh's data-axis size on
+    top of the bucket (a 6-device mesh has data=3, which does not
+    divide 128 -- sharding would reject the batch), so sharded shapes
+    stay stable per mesh too.
+    """
     import jax
     import jax.numpy as jnp
 
     n = len(X)
+    width = feat or (X.shape[1] if X.ndim == 2 and X.shape[1] else 32)
     padded = max(_PAD_BUCKET, -(-n // _PAD_BUCKET) * _PAD_BUCKET)
+    if mesh is not None:
+        data_axis = int(mesh.devices.shape[0])
+        padded = -(-padded // data_axis) * data_axis
     Xn = _standardize(X)
     if padded != n:
         pad = Xn[np.arange(padded - n) % max(n, 1)] if n else np.zeros(
-            (padded, X.shape[1]), np.float32)
-        Xn = np.concatenate([Xn, pad], axis=0)
+            (padded, width), np.float32)
+        Xn = np.concatenate([Xn, pad], axis=0) if n else pad
 
     fit, score_fn = _jitted()
-    params = anomaly_init(seed)
+    params = anomaly_init(seed, feat=width)
     x = jnp.asarray(Xn)
     # the whole noise tensor as ONE un-jitted device op: threefry stays
     # out of the compiled scan (pathological compile on tunneled
     # backends) without shipping tens of MB host->device per fit
     noises = jax.random.normal(jax.random.key(seed + 1),
                                (train_steps,) + Xn.shape, jnp.float32)
+    mesh_desc = ""
+    if mesh is not None:
+        from . import anomaly
+
+        params = anomaly.shard_params(params, mesh)
+        x = anomaly.shard_batch(x, mesh)
+        noises = anomaly.shard_noise(noises, mesh)
+        mesh_desc = "x".join(str(s) for s in mesh.devices.shape)
 
     t0 = time.perf_counter()
     params, losses = fit(params, x, noises, lr)
@@ -131,16 +202,20 @@ def _fit_and_score(X: np.ndarray, *, train_steps: int, lr: float, seed: int):
     raw = np.asarray(score_fn(params, x))[:n]
     score_ms = (time.perf_counter() - t0) * 1000.0
     dev = next(iter(x.devices()), None) if hasattr(x, "devices") else None
+    device = str(dev) if dev else "unknown"
+    if mesh_desc:
+        device += f" mesh={mesh_desc}"
     return raw, params, x, {"train_ms": train_ms, "score_ms": score_ms,
-                            "device": str(dev) if dev else "unknown"}
+                            "device": device}
 
 
-def anomaly_init(seed: int):
+def anomaly_init(seed: int, feat: int | None = None):
     import jax
 
     from . import anomaly
 
-    return anomaly.init_params(jax.random.key(seed))
+    return anomaly.init_params(jax.random.key(seed),
+                               feat=feat or anomaly.FEATURES)
 
 
 def score_windows(X: np.ndarray, keys: list[F.WindowKey], *,
@@ -160,14 +235,21 @@ def bench_lane(records: list[dict], *, train_steps: int = 100,
                reps: int = 20) -> dict:
     """Featurize + fit + steady-state score timing for bench.py: the
     SAME pipeline `monitor anomalies` and AnomalyWatch run (denoising
-    fit), so the bench cannot drift from the product path."""
+    fit), so the bench cannot drift from the product path.  On a
+    multi-device backend the fit/score run sharded over the full
+    fleet mesh -- the pod earns its hardware here, not on one chip."""
     import jax
 
     t0 = time.perf_counter()
     keys, X = F.featurize(records)
     featurize_ms = (time.perf_counter() - t0) * 1000.0
+    mesh = None
+    if len(jax.devices()) > 1:
+        from . import anomaly
+
+        mesh = anomaly.fleet_mesh()
     raw, params, x, t = _fit_and_score(X, train_steps=train_steps,
-                                       lr=1e-2, seed=0)
+                                       lr=1e-2, seed=0, mesh=mesh)
     _, score_fn = _jitted()
     jax.block_until_ready(score_fn(params, x))   # warm
     steps = []
@@ -219,8 +301,9 @@ class AnomalyWatch:
         self.on_error = on_error or (lambda msg: None)
         self._records: collections.deque = collections.deque(
             maxlen=self.MAX_RECORDS)
-        self._offset = 0
-        self._carry = b""
+        from ..monitor.ledger import TailState
+
+        self._tail = TailState()
         self._scores: dict[str, F.AgentScore] = {}
         self._flagged: set[str] = set()
         self._lock = threading.Lock()
@@ -252,37 +335,25 @@ class AnomalyWatch:
 
     # ------------------------------------------------------------ lifecycle
 
-    def _tail_new_records(self) -> None:
-        """Read bytes past the remembered offset; reset on truncation."""
-        try:
-            size = self.egress_path.stat().st_size
-        except OSError:
-            return
-        if size < self._offset:      # rotated/truncated: start over
-            self._offset = 0
-            self._carry = b""
-            self._records.clear()
-        if size == self._offset:
-            return
-        try:
-            with open(self.egress_path, "rb") as f:
-                f.seek(self._offset)
-                chunk = f.read(size - self._offset)
-        except OSError:
-            return
-        self._offset += len(chunk)
-        data = self._carry + chunk
-        lines = data.split(b"\n")
-        self._carry = lines.pop()    # possibly-partial last line
-        import json as _json
+    @property
+    def _offset(self) -> int:
+        """Consumed-bytes cursor (tests/introspection)."""
+        return self._tail.offset
 
-        for line in lines:
-            try:
-                rec = _json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(rec, dict):
-                self._records.append(rec)
+    def _tail_new_records(self) -> None:
+        """Incremental tail via the shared crash-evidence reader
+        (monitor/ledger.tail_jsonl): a netlogger that died mid-line
+        leaves a torn tail that is SKIPPED, not fatal, exactly like the
+        flight recorder's and journal's readers.  On truncation/rotation
+        the cursor resets and the bounded record window is dropped with
+        it (the file's records are the window's source of truth)."""
+        from ..monitor.ledger import tail_jsonl
+
+        resets = self._tail.resets
+        recs = tail_jsonl(self.egress_path, self._tail)
+        if self._tail.resets != resets:
+            self._records.clear()
+        self._records.extend(recs)
 
     def refresh_once(self) -> int:
         """Synchronous tail + re-score; returns number of scored windows."""

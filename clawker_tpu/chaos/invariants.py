@@ -31,6 +31,14 @@ with; docs/chaos.md#invariants):
 - ``span-tree``: the flight record parses, and (for scenarios without
   CLI kills) every span tree is rooted at a terminally-statused
   iteration root.
+- ``sentinel-observe-only``: the fleet sentinel changes NO scheduling
+  outcome.  Two halves: scenarios that ran with a sentinel attached
+  audit its mutation counters (zero engine/breaker/placement calls --
+  checked here via the ``sentinel`` param), and the dedicated twin
+  check (:func:`observe_only_violations`, driven by
+  ``runner.run_observe_only_check``) compares a fixed-seed run's
+  journaled placements and daemon-side create counts with and without
+  ``--sentinel``: they must be identical.
 """
 
 from __future__ import annotations
@@ -45,7 +53,8 @@ TERMINAL_STATUSES = ("done", "failed", "stopped")
 
 def check_invariants(driver, cfg, run_id: str, *, loops=None,
                      cap: int = 0, unfaulted: set[str] | None = None,
-                     health=None, kills: int = 0) -> list[str]:
+                     health=None, kills: int = 0,
+                     sentinel=None) -> list[str]:
     """Audit one finished scenario; returns human-readable violations
     (empty list = all invariants hold).
 
@@ -68,7 +77,12 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
     )
     from ..monitor.ledger import flight_path
     from ..runtime.names import container_name
-    from ..telemetry.spans import SPAN_ITERATION, build_trees, load_spans
+    from ..telemetry.spans import (
+        SPAN_ITERATION,
+        STANDALONE_SPANS,
+        build_trees,
+        load_spans,
+    )
 
     violations: list[str] = []
     records = RunJournal.read(journal_path(cfg.logs_dir, run_id))
@@ -163,6 +177,17 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
                     f"spurious-quarantine: {wid} was never faulted but "
                     f"its breaker reads {state!r}")
 
+    # --- sentinel-observe-only (counter half): a scenario that ran with
+    # the sentinel attached must show ZERO mutations in its audit --
+    # the sentinel has no code path that could increment these, and the
+    # invariant keeps it that way
+    if sentinel is not None:
+        for name, count in sorted(sentinel.audit().items()):
+            if count:
+                violations.append(
+                    f"sentinel-observe-only: sentinel performed "
+                    f"{count} {name}")
+
     # --- span-tree: flight record parses; kill-free runs close every root
     fpath = Path(flight_path(cfg.logs_dir, run_id))
     if fpath.exists():
@@ -175,6 +200,9 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
         if spans and kills == 0:
             for tree in build_trees(spans):
                 rec = tree.record
+                if rec.name in STANDALONE_SPANS:
+                    continue    # run-level spans (sentinel ticks) are
+                    #             legitimate non-iteration roots
                 if rec.name != SPAN_ITERATION:
                     violations.append(
                         f"span-tree: {rec.agent} span {rec.name!r} has no "
@@ -185,3 +213,55 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
                         f"span-tree: {rec.agent} iteration root ended "
                         f"with status {rec.status!r}")
     return violations
+
+
+# ------------------------------------------------------- observe-only twin
+
+
+def scheduling_outcome(driver, cfg, run_id: str, loops=None) -> dict:
+    """The scheduling-outcome fingerprint the observe-only invariant
+    compares: journaled placements per agent, daemon-side create counts
+    per worker, and terminal statuses.  Everything the sentinel could
+    conceivably have perturbed if it were not observe-only."""
+    from ..loop.journal import REC_PLACEMENT, RunJournal, journal_path
+
+    # agent and container names embed the run id (deterministic per
+    # (run, slot)); the twin runs under two ids, so names normalize to
+    # their slot before comparison
+    def norm(name: str) -> str:
+        return name.replace(run_id[:6], "RUN") if run_id else name
+
+    records = RunJournal.read(journal_path(cfg.logs_dir, run_id))
+    placements: dict[str, list[str]] = {}
+    for rec in records:
+        if rec.get("kind") == REC_PLACEMENT:
+            placements.setdefault(norm(str(rec.get("agent", ""))),
+                                  []).append(str(rec.get("worker", "")))
+    creates: dict[str, dict[str, int]] = {}
+    for worker, api in zip(driver.workers(), driver.apis):
+        counts: dict[str, int] = {}
+        for (args, _kw) in api.calls_named("container_create"):
+            cname = norm(str(args[0])) if args else ""
+            counts[cname] = counts.get(cname, 0) + 1
+        creates[worker.id] = counts
+    statuses = {norm(l.agent): l.status for l in (loops or [])}
+    return {"placements": placements, "creates": creates,
+            "statuses": statuses}
+
+
+def observe_only_violations(baseline: dict, with_sentinel: dict) -> list[str]:
+    """Compare two fixed-seed runs' scheduling outcomes -- one without
+    and one with the sentinel attached.  Any difference is a violation:
+    an observe-only subsystem may add events, metrics, and spans, but
+    never a placement, a create, or a status."""
+    out: list[str] = []
+    for field_name in ("placements", "creates", "statuses"):
+        a, b = baseline.get(field_name), with_sentinel.get(field_name)
+        if a != b:
+            keys = sorted(set(a or {}) | set(b or {}))
+            diff = [k for k in keys if (a or {}).get(k) != (b or {}).get(k)]
+            out.append(
+                f"sentinel-observe-only: {field_name} differ with the "
+                f"sentinel attached (changed: {', '.join(diff[:6])}"
+                + ("..." if len(diff) > 6 else "") + ")")
+    return out

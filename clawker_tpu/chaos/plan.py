@@ -27,7 +27,21 @@ kind                    injection point
                         scheduler dies there mid-flight, optionally with
                         ``torn_tail`` bytes truncated off the journal, and
                         the runner resumes the run (`--resume` semantics)
+``egress_silent``       sentinel scenarios: the worker's egress stream
+                        stops mid-run (netlogger death / firewall gap)
+``egress_flood``        sentinel scenarios: the worker's stream bursts
+                        ``arg`` records at once (log storm)
+``sentinel_kill``       SIGKILL the sentinel's collector mid-run: scoring
+                        degrades to the stale buffer; the fleet must not
+                        notice (observe-only invariant)
 ======================  ====================================================
+
+Plans with ``sentinel: true`` run with the fleet sentinel attached to
+the scheduler (and per-worker synthetic egress feeders for the
+``egress_*`` events); the standard invariant audit then proves the
+robustness stack holds WITH the sentinel riding along, and the
+dedicated observe-only check (runner.run_observe_only_check) proves
+sentinel presence changes no scheduling outcome.
 """
 
 from __future__ import annotations
@@ -43,7 +57,11 @@ from .seams import SEAM_NAMES
 EVENT_KINDS = (
     "worker_kill", "worker_wedge", "worker_flap", "worker_slow",
     "engine_burst", "probe_drop", "worker_revive", "cli_sigkill",
+    "egress_silent", "egress_flood", "sentinel_kill",
 )
+
+# event kinds that target no worker (worker index is ignored)
+_WORKERLESS_KINDS = ("cli_sigkill", "sentinel_kill")
 
 # fault gate modes the worker_* / engine_* / probe_* kinds map onto
 GATE_MODE = {
@@ -105,6 +123,7 @@ class FaultPlan:
     failover: str = "migrate"
     warm_pool_depth: int = 0
     max_inflight_per_worker: int = 2
+    sentinel: bool = False          # run with the fleet sentinel attached
     events: list[FaultEvent] = field(default_factory=list)
 
     @property
@@ -118,6 +137,7 @@ class FaultPlan:
             "iterations": self.iterations, "failover": self.failover,
             "warm_pool_depth": self.warm_pool_depth,
             "max_inflight_per_worker": self.max_inflight_per_worker,
+            "sentinel": self.sentinel,
             "events": [e.to_doc() for e in sorted(self.events,
                                                   key=lambda e: e.at_s)],
         }
@@ -137,6 +157,7 @@ class FaultPlan:
             warm_pool_depth=int(doc.get("warm_pool_depth", 0)),
             max_inflight_per_worker=int(
                 doc.get("max_inflight_per_worker", 2)),
+            sentinel=bool(doc.get("sentinel", False)),
             events=[FaultEvent.from_doc(e) for e in doc.get("events") or []],
         )
         _validate(plan)
@@ -224,6 +245,23 @@ def generate_plan(seed: int, scenario: int = 0, *, n_workers: int = 4,
             events.append(FaultEvent(
                 at_s=rng.uniform(0.05, horizon_s * 0.6), kind="cli_sigkill",
                 worker=-1, arg=seam2))
+    # sentinel rider (drawn strictly AFTER every pre-existing draw, so
+    # the worker-fault/sigkill schedule of a (seed, scenario) pair is
+    # byte-identical to what it was before the sentinel existed): about
+    # a third of scenarios run with the fleet sentinel attached, plus
+    # stream chaos against it -- silence, floods, a collector SIGKILL
+    if rng.random() < 0.35:
+        plan.sentinel = True
+        victim = rng.randrange(n_workers)
+        kind = rng.choice(("egress_silent", "egress_flood", "egress_flood"))
+        events.append(FaultEvent(
+            at_s=rng.uniform(0.05, horizon_s * 0.6), kind=kind,
+            worker=victim,
+            arg=rng.randint(50, 200) if kind == "egress_flood" else None))
+        if rng.random() < 0.4:
+            events.append(FaultEvent(
+                at_s=rng.uniform(0.1, horizon_s * 0.7),
+                kind="sentinel_kill", worker=-1))
     plan.events = sorted(events, key=lambda e: e.at_s)
     _validate(plan)
     return plan
@@ -240,7 +278,7 @@ def _validate(plan: FaultPlan) -> None:
         if e.kind == "cli_sigkill" and e.arg not in SEAM_NAMES:
             raise ClawkerError(
                 f"chaos plan: cli_sigkill at unknown seam {e.arg!r}")
-        if e.kind != "cli_sigkill" and not (
+        if e.kind not in _WORKERLESS_KINDS and not (
                 -1 < e.worker < plan.n_workers):
             raise ClawkerError(
                 f"chaos plan: event {e.kind} targets worker {e.worker} "

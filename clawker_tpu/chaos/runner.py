@@ -21,6 +21,7 @@ against a genuine process death).
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import threading
@@ -29,7 +30,11 @@ from dataclasses import dataclass, field
 
 from .. import logsetup, telemetry
 from ..errors import ClawkerError
-from .invariants import check_invariants
+from .invariants import (
+    check_invariants,
+    observe_only_violations,
+    scheduling_outcome,
+)
 from .plan import GATE_MODE, FaultEvent, FaultPlan, generate_plan
 from .seams import SeamAbort, SeamRegistry
 
@@ -66,6 +71,74 @@ IMAGE = "clawker-chaos:default"
 # drain within this is itself an invariant violation (stuck-run)
 SCENARIO_DEADLINE_S = 60.0
 MAX_GENERATIONS = 4             # sigkill/resume cycles per scenario bound
+SENTINEL_TRAIN_STEPS = 20       # one shape for every chaos sentinel fit:
+#                                 the soak and the observe-only twin share
+#                                 a single jit compilation per process
+
+
+class _EgressFeeder:
+    """Synthetic per-worker egress streams for sentinel scenarios.
+
+    Writes benign netlogger-shaped records into each worker's
+    ``ebpf-egress-<worker>.jsonl`` under the scenario's logs dir (the
+    sentinel collector's fake-pod convention) on a feeder thread.
+    ``silence(i)`` stops worker i's stream mid-run; ``flood(i, n)``
+    bursts n records at once -- the two stream-level faults the
+    ``sentinel`` chaos scenario injects."""
+
+    def __init__(self, cfg, worker_ids: list[str], *, hz: float = 20.0):
+        self.cfg = cfg
+        self.worker_ids = list(worker_ids)
+        self.hz = hz
+        self._silent: set[str] = set()
+        self._stop = threading.Event()
+        self._n = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="chaos-egress-feeder")
+
+    def path(self, wid: str):
+        return self.cfg.logs_dir / f"ebpf-egress-{wid}.jsonl"
+
+    def _record(self, wid: str) -> dict:
+        self._n += 1
+        return {
+            "@timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "service": "ebpf-egress",
+            "container": f"clawker.chaosproj.{wid}-agent{self._n % 3}",
+            "worker": wid, "dst_ip": "198.51.100.9",
+            "dst_port": 443, "proto": 6, "verdict": "ALLOW",
+            "reason": "ROUTE", "zone": "example.com",
+        }
+
+    def _append(self, wid: str, n: int) -> None:
+        try:
+            with open(self.path(wid), "a", encoding="utf-8") as f:
+                for _ in range(n):
+                    f.write(json.dumps(self._record(wid)) + "\n")
+        except OSError:
+            pass
+
+    def _run(self) -> None:
+        while not self._stop.wait(1.0 / self.hz):
+            for wid in self.worker_ids:
+                if wid not in self._silent:
+                    self._append(wid, 1)
+
+    def start(self) -> "_EgressFeeder":
+        self._thread.start()
+        return self
+
+    def silence(self, index: int) -> None:
+        if 0 <= index < len(self.worker_ids):
+            self._silent.add(self.worker_ids[index])
+
+    def flood(self, index: int, n: int) -> None:
+        if 0 <= index < len(self.worker_ids):
+            self._append(self.worker_ids[index], max(1, n))
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(1.0)
 
 
 @dataclass
@@ -122,6 +195,30 @@ class ChaosRunner:
         self._run_done = threading.Event()
         self._run_exc: list[BaseException] = []
         self._armed: list[tuple] = []   # (sched, seam, event) pending arms
+        # sentinel scenarios (plan.sentinel): the fleet sentinel rides
+        # the run, fed by synthetic per-worker egress streams; the
+        # standard invariants must hold WITH it attached, its audit
+        # counters must stay zero, and egress_*/sentinel_kill events
+        # fault the streams/collector instead of the workers
+        self.sentinel = None
+        self.feeder = None
+        if plan.sentinel and self._sentinel_available():
+            self.feeder = _EgressFeeder(
+                cfg, [w.id for w in self.driver.workers()]).start()
+            from ..sentinel import FleetSentinel
+
+            self.sentinel = FleetSentinel(
+                cfg, self.driver, interval_s=0.15,
+                train_steps=SENTINEL_TRAIN_STEPS, threshold=3.5).start()
+
+    @staticmethod
+    def _sentinel_available() -> bool:
+        try:
+            from ..analytics import runtime as art
+
+            return art.jax_available()
+        except ImportError:
+            return False
 
     # ------------------------------------------------------------ lifecycle
 
@@ -164,6 +261,12 @@ class ChaosRunner:
                 self.cfg, self.driver, image, on_event=self.on_event,
                 health_config=self.health_config, seams=seams)
         self._sched = sched
+        if self.sentinel is not None:
+            # re-attached per generation: each generation owns a fresh
+            # bus/flight recorder, while the sentinel's baselines and
+            # flagged set persist across the kill/resume cycle via its
+            # run-keyed state file (the --resume persistence contract)
+            sched.attach_sentinel(self.sentinel)
         # per-GENERATION completion state: the closure binds these
         # locals, not self, so a stale gen-N thread that finally
         # unblocks (e.g. out of a wedge after the 5s kill wait gave up
@@ -196,6 +299,20 @@ class ChaosRunner:
 
     def _apply_worker_fault(self, ev: FaultEvent) -> None:
         apply_fault(self.driver, ev)
+        _INJECTIONS.labels(ev.kind).inc()
+        self.injected += 1
+
+    def _apply_stream_fault(self, ev: FaultEvent) -> None:
+        """Sentinel-scenario faults: silence/flood a worker's egress
+        stream, or SIGKILL the sentinel's collector.  No-ops (but still
+        counted) when the sentinel could not start -- the schedule must
+        not depend on jax availability."""
+        if ev.kind == "egress_silent" and self.feeder is not None:
+            self.feeder.silence(ev.worker)
+        elif ev.kind == "egress_flood" and self.feeder is not None:
+            self.feeder.flood(ev.worker, int(ev.arg or 100))
+        elif ev.kind == "sentinel_kill" and self.sentinel is not None:
+            self.sentinel.kill_collector()
         _INJECTIONS.labels(ev.kind).inc()
         self.injected += 1
 
@@ -287,6 +404,13 @@ class ChaosRunner:
                     time.sleep(min(0.01, t0 + ev.at_s - now))
                 if ev.kind == "cli_sigkill":
                     self._arm_sigkill(ev)
+                elif ev.kind in ("egress_silent", "egress_flood",
+                                 "sentinel_kill"):
+                    # stream/collector faults: they hit the SENTINEL's
+                    # inputs, never the workers -- the workers stay in
+                    # the unfaulted set, so spurious-quarantine also
+                    # proves stream chaos cannot open a breaker
+                    self._apply_stream_fault(ev)
                 else:
                     if ev.kind != "worker_revive":
                         faulted.add(ev.worker)
@@ -319,6 +443,10 @@ class ChaosRunner:
                 result.violations.append(
                     f"scheduler-crash: {self._run_exc[0]!r}")
             final = self._sched
+            if self.feeder is not None:
+                self.feeder.stop()
+            if self.sentinel is not None:
+                self.sentinel.stop()
             final.cleanup(remove_containers=True)
             unfaulted = {w.id for i, w in enumerate(self.driver.workers())
                          if i not in faulted}
@@ -326,11 +454,15 @@ class ChaosRunner:
                 self.driver, self.cfg, final.loop_id,
                 loops=final.loops, cap=self.plan.max_inflight_per_worker,
                 unfaulted=unfaulted, health=final.health,
-                kills=self.kills))
+                kills=self.kills, sentinel=self.sentinel))
         except ClawkerError as e:
             runner_error = True
             result.violations.append(f"runner-error: {e}")
         finally:
+            if self.feeder is not None:
+                self.feeder.stop()
+            if self.sentinel is not None:
+                self.sentinel.stop()
             self.driver.close()
         result.kills = self.kills
         result.generations = self.generations
@@ -414,6 +546,77 @@ def shrink_plan(plan: FaultPlan, *, rounds: int = 2,
     return best, best_result
 
 
+def run_observe_only_check(seed: int = 20260803, *, n_workers: int = 4,
+                           n_loops: int = 6, iterations: int = 1,
+                           ) -> list[str] | None:
+    """The observe-only TWIN check: run the same fixed-seed benign fleet
+    twice -- once bare, once with the sentinel attached AND its streams
+    chaosed (silence + flood mid-run) -- and require byte-identical
+    scheduling outcomes (journaled placements, daemon-side create
+    counts, terminal statuses; invariants.scheduling_outcome).  No
+    worker faults: with a healthy fleet the scheduler is deterministic,
+    so ANY divergence is the sentinel leaking into scheduling.
+    Returns violations ([] = the observe-only contract holds), or
+    ``None`` when the sentinel cannot attach on this host (no jax) --
+    a contract that was never exercised must report SKIPPED, never
+    verified.  Runs in the fixed-seed soak (run_soak) and
+    tests/test_sentinel.py.
+    """
+    if not ChaosRunner._sentinel_available():
+        return None
+    from ..engine.fake import exit_behavior
+    from ..loop import LoopScheduler, LoopSpec
+
+    def one(with_sentinel: bool) -> dict:
+        from ..engine.drivers import FakeDriver
+
+        env, cfg = _fresh_cfg()
+        driver = FakeDriver(n_workers=n_workers)
+        sentinel = feeder = None
+        try:
+            for api in driver.apis:
+                api.add_image(IMAGE)
+                api.set_behavior(IMAGE, exit_behavior(b"", 0, delay=0.02))
+            spec = LoopSpec(parallel=n_loops, iterations=iterations,
+                            image=IMAGE, agent_prefix="twin",
+                            orphan_grace_s=20.0)
+            sched = LoopScheduler(cfg, driver, spec)
+            if with_sentinel and ChaosRunner._sentinel_available():
+                from ..sentinel import FleetSentinel
+
+                feeder = _EgressFeeder(
+                    cfg, [w.id for w in driver.workers()]).start()
+                sentinel = FleetSentinel(
+                    cfg, driver, interval_s=0.1,
+                    train_steps=SENTINEL_TRAIN_STEPS).start()
+                sched.attach_sentinel(sentinel)
+            sched.start()
+            if feeder is not None:
+                # stream chaos mid-run: silence one worker, flood another
+                feeder.silence(0)
+                feeder.flood(min(1, n_workers - 1), 120)
+            loops = sched.run(poll_s=0.05)
+            if sentinel is not None:
+                sentinel.refresh_once()     # at least one scored tick
+                sentinel.stop()
+            if feeder is not None:
+                feeder.stop()
+            sched.cleanup(remove_containers=True)
+            return scheduling_outcome(driver, cfg, sched.loop_id, loops)
+        finally:
+            if sentinel is not None:
+                sentinel.stop()
+            if feeder is not None:
+                feeder.stop()
+            driver.close()
+            env.__exit__(None, None, None)
+
+    del seed  # the twin fleet is deterministic; kept for repro symmetry
+    baseline = one(False)
+    with_sentinel = one(True)
+    return observe_only_violations(baseline, with_sentinel)
+
+
 def run_soak(scenarios: int, seed: int, *, n_workers: int = 4,
              n_loops: int = 6, iterations: int = 2, on_event=None,
              shrink: bool = True, keep_going: bool = False,
@@ -463,6 +666,28 @@ def run_soak(scenarios: int, seed: int, *, n_workers: int = 4,
         report["failures"].append(failure)
         if not keep_going:
             break
+    # the observe-only twin rides every soak (fixed-seed sentinel
+    # scenarios prove invariants hold WITH the sentinel; the twin proves
+    # the sentinel changed nothing) -- skipped only when a failure
+    # already stopped the soak early
+    if not report["failures"] or keep_going:
+        violations = run_observe_only_check(seed, n_workers=n_workers)
+        if violations is None:
+            report["observe_only"] = {"ok": None,
+                                      "skipped": "jax unavailable -- "
+                                                 "sentinel never attached"}
+            violations = []
+        else:
+            report["observe_only"] = {"ok": not violations,
+                                      "violations": violations}
+        if violations:
+            report["failures"].append({
+                "scenario": "observe-only-twin",
+                "violations": violations,
+                "repro": "python -c 'from clawker_tpu.chaos.runner import "
+                         "run_observe_only_check; "
+                         "print(run_observe_only_check())'",
+            })
     report["wall_s"] = round(time.monotonic() - t0, 2)
     report["ok"] = (not report["failures"]
                     and report["passed"] == scenarios)

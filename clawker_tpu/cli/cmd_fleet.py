@@ -154,19 +154,41 @@ _HEALTH_COLUMNS = ("WORKER", "STATE", "BRK", "P50MS", "P95MS", "PROBES",
                    "FAILS", "ORPHANED", "MIG-OUT", "MIG-IN", "LAST-ERROR")
 
 
-def _health_rows(stats: list[dict]) -> list[str]:
+def _health_rows(stats: list[dict], anom: dict | None = None) -> list[str]:
     # BRK is the registry's health_breaker_state gauge (0=closed
     # 1=half_open 2=open) -- the same value a Prometheus scrape of
-    # `clawker loop --metrics-port` serves (docs/telemetry.md)
-    lines = ["\t".join(_HEALTH_COLUMNS)]
+    # `clawker loop --metrics-port` serves (docs/telemetry.md).
+    # ``anom`` (worker -> hottest sentinel z, from a loopd-hosted
+    # sentinel) appends the live ANOM-Z column (docs/analytics-online.md)
+    cols = _HEALTH_COLUMNS + (("ANOM-Z",) if anom is not None else ())
+    lines = ["\t".join(cols)]
     for s in stats:
-        lines.append("\t".join(str(x) for x in (
+        row = [str(x) for x in (
             s["worker"], s["state"], s["breaker_state_gauge"],
             s["probe_p50_ms"], s["probe_p95_ms"],
             s["probes"], s["probe_failures"], s["orphaned"],
             s["migrations_out"], s["migrations_in"],
-            (s["last_error"] or "-")[:60])))
+            (s["last_error"] or "-")[:60])]
+        if anom is not None:
+            z = anom.get(s["worker"])
+            row.append("-" if z is None else f"{z:.2f}")
+        lines.append("\t".join(row))
     return lines
+
+
+def _sentinel_anom_by_worker(doc: dict | None) -> dict | None:
+    """worker -> hottest latest z from a loopd status doc's sentinel
+    rows; None when the daemon hosts no sentinel."""
+    rows = ((doc or {}).get("sentinel") or {}).get("rows")
+    if not rows:
+        return None
+    out: dict = {}
+    for r in rows:
+        wid = r.get("worker") or ""
+        z = float(r.get("latest_z", 0.0))
+        if wid and (wid not in out or z > out[wid]):
+            out[wid] = z
+    return out
 
 
 @fleet_group.command("health")
@@ -201,15 +223,17 @@ def fleet_health(f: Factory, probes, watch, interval, fmt, no_daemon):
         doc = _loopd_status(f, no_daemon)
         if doc is not None:
             stats = doc.get("health", [])
+            anom = _sentinel_anom_by_worker(doc)
             if fmt == "json":
-                click.echo(_json.dumps(
-                    {"source": f"loopd:{doc.get('pid')}", "health": stats},
-                    indent=2))
+                out = {"source": f"loopd:{doc.get('pid')}", "health": stats}
+                if doc.get("sentinel"):
+                    out["sentinel"] = doc["sentinel"]
+                click.echo(_json.dumps(out, indent=2))
             else:
                 click.echo(f"source: loopd (pid {doc.get('pid')}, "
                            f"{len(doc.get('runs', []))} hosted run(s))",
                            err=True)
-                for line in _health_rows(stats):
+                for line in _health_rows(stats, anom):
                     click.echo(line)
             if any(s["state"] != "closed" for s in stats):
                 raise SystemExit(1)
@@ -620,6 +644,146 @@ def _scrape_warmpool_metrics(url: str) -> dict:
         # recycled carries a reason label too: sum per worker
         out[key][worker] = out[key].get(worker, 0) + val
     return out
+
+
+_ANOMALY_COLUMNS = ("AGENT", "WORKER", "WINDOWS", "LATEST-Z", "PEAK-Z",
+                    "RECORDS", "FLAG")
+
+
+@fleet_group.command("anomaly")
+@click.option("--watch", is_flag=True,
+              help="Keep scoring and re-print the table every interval.")
+@click.option("--interval", type=float, default=None,
+              help="Scoring tick seconds with --watch (default: settings "
+                   "sentinel.interval_s).")
+@click.option("--ticks", type=int, default=0,
+              help="With --watch: stop after N ticks (0 = until Ctrl-C).")
+@click.option("--window", type=int, default=None,
+              help="Window seconds (default: settings sentinel.window_s).")
+@click.option("--train-steps", type=int, default=None,
+              help="Denoising fit steps per tick (default: settings "
+                   "sentinel.train_steps).")
+@click.option("--threshold", type=float, default=None,
+              help="Worker-relative robust z past which an agent flags "
+                   "(default: settings sentinel.threshold).")
+@click.option("--stream", "streams", multiple=True, metavar="WORKER=PATH",
+              help="Extra local stream source(s): tail PATH as WORKER's "
+                   "egress jsonl (besides the fleet's own streams).")
+@click.option("--format", "fmt", type=click.Choice(["table", "json"]),
+              default="table")
+@click.option("--no-daemon", is_flag=True,
+              help="Score locally even when a loopd daemon hosts a "
+                   "sentinel.")
+@pass_factory
+def fleet_anomaly(f: Factory, watch, interval, ticks, window, train_steps,
+                  threshold, streams, fmt, no_daemon):
+    """Live fleet-wide anomaly scores: every agent's fused egress +
+    behavior windows scored as one sharded program per tick.
+
+    One-shot by default: collect every worker's stream (local reads on
+    local/fake, ``tail -F`` over the SSH mux for tpu_vm), score once,
+    and exit non-zero (2) when any agent's window flags past the
+    threshold.  ``--watch`` keeps ticking and re-prints live scores.
+    With a loopd daemon hosting a sentinel (settings sentinel.enable,
+    docs/loopd.md) the one-shot renders the daemon's LIVE rows instead
+    of building a second scorer (docs/analytics-online.md).
+    """
+    import json as _json
+    import time as _time
+
+    ss = f.config.settings.sentinel
+    if not watch:
+        doc = _loopd_status(f, no_daemon)
+        sent = (doc or {}).get("sentinel") if doc else None
+        if sent and sent.get("enabled"):
+            if fmt == "json":
+                click.echo(_json.dumps(
+                    {"source": f"loopd:{doc.get('pid')}", **sent}, indent=2))
+            else:
+                click.echo(f"source: loopd (pid {doc.get('pid')}, run "
+                           f"{sent.get('run') or '-'}, "
+                           f"{sent.get('ticks', 0)} tick(s))", err=True)
+                _render_anomaly_rows(sent.get("rows", []))
+            if any(r.get("flagged") for r in sent.get("rows", [])):
+                raise SystemExit(2)
+            return
+
+    try:
+        from ..analytics import runtime as art
+    except ImportError:
+        raise click.ClickException(
+            "fleet anomaly: analytics runtime unavailable on this host "
+            "(numpy missing)")
+    if not art.jax_available():
+        raise click.ClickException(
+            "fleet anomaly: jax unavailable on this host -- the scoring "
+            "lane needs an accelerator runtime (cpu works)")
+    from ..sentinel import FleetSentinel
+
+    sentinel = FleetSentinel(
+        f.config, f.driver,
+        interval_s=(interval if interval is not None else ss.interval_s),
+        window_s=window or ss.window_s,
+        train_steps=train_steps or ss.train_steps,
+        threshold=(threshold if threshold is not None else ss.threshold),
+        baseline_window=ss.baseline_window)
+    for kv in streams:
+        wid, _, path = kv.partition("=")
+        if not wid or not path:
+            raise click.BadParameter(f"--stream {kv!r}: expected WORKER=PATH")
+        sentinel.collector.add_local(wid, Path(path))
+
+    def render() -> list[dict]:
+        rows = sentinel.rows()
+        if fmt == "json":
+            click.echo(_json.dumps(sentinel.status_doc(), indent=2))
+        else:
+            _render_anomaly_rows(rows)
+        return rows
+
+    try:
+        if watch:
+            n = 0
+            try:
+                while True:
+                    sentinel.refresh_once()
+                    n += 1
+                    rep = sentinel.last_tick
+                    if fmt == "table":
+                        click.echo(f"-- tick {n}: "
+                                   f"{rep.windows if rep else 0} window(s)"
+                                   + (f" on {rep.device}" if rep else ""),
+                                   err=True)
+                    rows = render()
+                    if ticks and n >= ticks:
+                        break
+                    _time.sleep(max(0.05, sentinel.interval_s))
+            except KeyboardInterrupt:
+                rows = sentinel.rows()
+        else:
+            # remote (tpu_vm) tails replay worker history asynchronously
+            # over the SSH mux: let the feed settle before the one
+            # verdict tick, or a busy fleet reads as empty
+            sentinel.collector.wait_quiescent(2.0)
+            n = sentinel.refresh_once()
+            if n == 0 and not sentinel.rows():
+                click.echo("fleet anomaly: no scorable windows in any "
+                           "worker stream", err=True)
+                raise SystemExit(1)
+            rows = render()
+    finally:
+        sentinel.stop()
+    if any(r.get("flagged") for r in rows):
+        raise SystemExit(2)
+
+
+def _render_anomaly_rows(rows: list[dict]) -> None:
+    click.echo("\t".join(_ANOMALY_COLUMNS))
+    for r in rows:
+        click.echo("\t".join(str(x) for x in (
+            r["agent"], r["worker"] or "-", r["windows"],
+            r["latest_z"], r["peak_z"], r.get("stream_records", 0),
+            "ANOMALOUS" if r.get("flagged") else "-")))
 
 
 @fleet_group.command("status")

@@ -140,6 +140,12 @@ class _ClientInterrupt:
               help="Serve Prometheus metrics on 127.0.0.1:<port>/metrics "
                    "for the run (default: settings telemetry.metrics_port; "
                    "0 = off).")
+@click.option("--sentinel/--no-sentinel", "sentinel_flag", default=None,
+              help="Attach the online fleet sentinel: fused egress + "
+                   "behavior windows scored live each tick, flags as "
+                   "typed anomaly.flag events/metrics/spans -- strictly "
+                   "observe-only (default: settings sentinel.enable; "
+                   "docs/analytics-online.md).")
 @click.option("--chaos-plan", "chaos_plan", type=click.Path(exists=True),
               default=None,
               help="DEV: apply a chaos fault plan (clawker chaos plan "
@@ -163,8 +169,8 @@ class _ClientInterrupt:
 def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                placement, tenant, tenant_weight, max_inflight_per_worker,
                warm_pool, image, prompt, worktrees, env_kv, failover,
-               orphan_grace, resume_run, metrics_port, chaos_plan, as_json,
-               keep, use_daemon, detach):
+               orphan_grace, resume_run, metrics_port, sentinel_flag,
+               chaos_plan, as_json, keep, use_daemon, detach):
     """Fan autonomous agent loops across the runtime's workers."""
     if ctx.invoked_subcommand is not None:
         return
@@ -173,7 +179,8 @@ def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                resume_run=resume_run, tenant=tenant,
                tenant_weight=tenant_weight,
                max_inflight_per_worker=max_inflight_per_worker,
-               warm_pool=warm_pool, chaos_plan=chaos_plan,
+               warm_pool=warm_pool, sentinel_flag=sentinel_flag,
+               chaos_plan=chaos_plan,
                use_daemon=use_daemon, detach=detach)
 
 
@@ -181,8 +188,8 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
                worktrees, env_kv, failover, orphan_grace, metrics_port,
                as_json, keep, resume_run=None, tenant=None,
                tenant_weight=None, max_inflight_per_worker=None,
-               warm_pool=None, chaos_plan=None, use_daemon=None,
-               detach=False):
+               warm_pool=None, sentinel_flag=None, chaos_plan=None,
+               use_daemon=None, detach=False):
     from .. import telemetry
 
     if use_daemon and (resume_run or chaos_plan):
@@ -283,6 +290,12 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
                         "note: metrics are daemon-scoped under loopd -- "
                         "--metrics-port is ignored; scrape settings "
                         "loopd.metrics_port instead", err=True)
+                if sentinel_flag:
+                    click.echo(
+                        "note: the sentinel is daemon-scoped under loopd "
+                        "-- --sentinel is ignored; set settings "
+                        "sentinel.enable and restart the daemon "
+                        "(docs/analytics-online.md)", err=True)
                 _run_loops_client(f, client, spec, detach=detach,
                                   as_json=as_json, keep=keep)
                 return
@@ -319,15 +332,38 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             shipper = telemetry.MetricsOtlpShipper(lane).start()
     # fleet anomaly scoring rides along whenever the accelerator runtime
     # is importable: scores land in the dashboard's ANOM-Z column, the
-    # status JSON, and as scheduler events past the threshold
+    # status JSON, and as scheduler events past the threshold.  With
+    # --sentinel (or settings sentinel.enable) the single-file
+    # AnomalyWatch is replaced by the online fleet sentinel: every
+    # worker's stream fused with the run's typed events, scored as one
+    # sharded program per tick, flags as typed anomaly.flag bus events
+    # + metrics + flight spans (docs/analytics-online.md).  Strictly
+    # observe-only either way.
+    ss = f.config.settings.sentinel
+    want_sentinel = (sentinel_flag if sentinel_flag is not None
+                     else ss.enable)
     try:
         from ..analytics import runtime as art
     except ImportError:      # numpy-less host: the loop still runs
         art = None
     if art is not None and art.jax_available():
-        watch = art.AnomalyWatch(f.config.logs_dir / "ebpf-egress.jsonl")
-        sched.attach_anomaly_watch(watch)
+        if want_sentinel:
+            from ..sentinel import FleetSentinel
+
+            watch = FleetSentinel(
+                f.config, f.driver, run_id=sched.loop_id,
+                interval_s=ss.interval_s, window_s=ss.window_s,
+                train_steps=ss.train_steps, threshold=ss.threshold,
+                baseline_window=ss.baseline_window)
+            sched.attach_sentinel(watch)
+        else:
+            watch = art.AnomalyWatch(f.config.logs_dir / "ebpf-egress.jsonl")
+            sched.attach_anomaly_watch(watch)
         watch.start()
+    elif want_sentinel:
+        click.echo("note: --sentinel needs the accelerator runtime "
+                   "(jax unavailable); running without live scoring",
+                   err=True)
     if live:
         # BASELINE config 4: the shared monitor TUI over the fan-out, with
         # EVERY worker's egress stream merged into the ticker (remote
@@ -598,14 +634,18 @@ def _render_node(node, depth: int, out: list[str]) -> None:
     rec = node.record
     pad = "  " * depth
     if depth == 0:
+        from ..telemetry.spans import STANDALONE_SPANS
+
         attrs = rec.attrs
         extra = "".join(
             f" {k}={attrs[k]}" for k in ("queue_ms", "resumed", "adopted")
             if k in attrs)
         # a non-iteration root is a phase span whose iteration root never
-        # flushed (crashed run): show it, flagged, rather than hide it
+        # flushed (crashed run): show it, flagged, rather than hide it.
+        # Standalone run-level spans (sentinel ticks) are their own kind.
         name = (f"iteration {attrs.get('iteration', '?')}"
                 if rec.name == "iteration"
+                else rec.name if rec.name in STANDALONE_SPANS
                 else f"{rec.name} (no iteration root)")
         out.append(f"{rec.agent}  {name} "
                    f"[{rec.status}] {_fmt_ms(rec.wall_s)} "
@@ -647,12 +687,16 @@ def loop_trace(f: Factory, run, as_json):
             "iterations": [tree_to_dict(t) for t in trees],
         }, indent=2))
         return
+    from ..telemetry.spans import STANDALONE_SPANS
+
     agents = sorted({s.agent for s in spans})
     migrations = [s for s in spans if s.name == "migrate"]
     # a phase span promoted to a root means its iteration root never
-    # flushed -- the writer died before end_iteration/close_open ran
-    promoted = [t for t in trees if t.record.name != "iteration"]
-    n_iters = len(trees) - len(promoted)
+    # flushed -- the writer died before end_iteration/close_open ran.
+    # Run-level standalone roots (sentinel ticks) are by-design roots.
+    promoted = [t for t in trees if t.record.name != "iteration"
+                and t.record.name not in STANDALONE_SPANS]
+    n_iters = sum(1 for t in trees if t.record.name == "iteration")
     click.echo(f"run {run_id}: {n_iters} iteration span(s) across "
                f"{len(agents)} agent(s)  ({path})")
     out: list[str] = []

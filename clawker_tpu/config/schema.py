@@ -494,6 +494,29 @@ class LoopdSettings:
 
 
 @dataclass
+class SentinelSettings:
+    """The online fleet sentinel (docs/analytics-online.md).
+
+    With ``enable`` (or ``clawker loop --sentinel``), every loop run
+    fuses the fleet's egress streams with the scheduler's typed events
+    and scores the whole fleet's open windows each ``interval_s`` as
+    ONE sharded program -- flags surface as typed ``anomaly.flag`` bus
+    events, ``anomaly_score``/``anomaly_flags_total`` metrics, and
+    flight-recorder spans.  Strictly observe-only: flags never feed
+    breakers or placement.  ``threshold`` is the worker-relative robust
+    z past which an agent's window flags; ``baseline_window`` bounds
+    the per-worker rolling normal profile (persisted per run, so
+    ``--resume`` keeps it)."""
+
+    enable: bool = False
+    interval_s: float = 5.0
+    window_s: int = 60
+    train_steps: int = 40           # denoising fit steps per tick
+    threshold: float = 3.5
+    baseline_window: int = 256
+
+
+@dataclass
 class ChaosSettings:
     """Defaults for ``clawker chaos run`` (docs/chaos.md).
 
@@ -537,6 +560,7 @@ class Settings:
     telemetry: TelemetrySettings = field(default_factory=TelemetrySettings)
     credentials: CredentialSettings = field(default_factory=CredentialSettings)
     chaos: ChaosSettings = field(default_factory=ChaosSettings)
+    sentinel: SentinelSettings = field(default_factory=SentinelSettings)
 
     @staticmethod
     def merge_strategies() -> dict[str, str]:

@@ -531,6 +531,19 @@ class LoopScheduler:
         watch.on_error = lambda msg: self.on_event(
             "scheduler", "anomaly_watch_error", msg)
 
+    def attach_sentinel(self, sentinel) -> None:
+        """Attach the online fleet sentinel (clawker_tpu/sentinel,
+        docs/analytics-online.md): status rows and the dashboard reuse
+        the AnomalyWatch surface; the bus tap feeds its behavioral
+        features; typed ``anomaly.flag`` events ride this run's bus and
+        its ticks land in this run's flight recorder.  Strictly
+        observe-only -- the sentinel holds no engine/placement/
+        admission reference, and nothing in the scheduler reads its
+        verdicts back into a decision."""
+        self.attach_anomaly_watch(sentinel)
+        sentinel.bind_run(run_id=self.loop_id, events=self.events,
+                          flight=self.flight)
+
     # -------------------------------------------------------------- set up
 
     def _ensure_health(self) -> HealthMonitor:

@@ -212,6 +212,8 @@ class LoopdServer:
         self._metrics_port = (metrics_port if metrics_port is not None
                               else cfg.settings.loopd.metrics_port)
         self._metrics_server = None
+        self.sentinel = None        # daemon-lifetime FleetSentinel when
+        #                             settings sentinel.enable + jax
 
     # ----------------------------------------------------------- lifecycle
 
@@ -246,6 +248,7 @@ class LoopdServer:
             pass
         self.health = HealthMonitor(self.driver)
         self.health.start()
+        self._start_sentinel()
         if self._metrics_port:
             self._metrics_server = telemetry.MetricsServer(
                 self._metrics_port).start()
@@ -255,6 +258,33 @@ class LoopdServer:
         log.info("loopd listening on %s (pid %d)", self.sock_path,
                  os.getpid())
         return self
+
+    def _start_sentinel(self) -> None:
+        """Bring up the daemon-lifetime fleet sentinel when settings
+        sentinel.enable is set and the accelerator runtime imports
+        (docs/analytics-online.md).  Hosted runs' event buses tap into
+        its behavioral features at construction (_drive); fleet views
+        render its rows off the status RPC.  Failure to start degrades
+        to no sentinel -- the daemon's job is supervision, not scoring."""
+        ss = self.cfg.settings.sentinel
+        if not ss.enable:
+            return
+        try:
+            from ..analytics import runtime as art
+
+            if not art.jax_available():
+                return
+            from ..sentinel import FleetSentinel
+
+            self.sentinel = FleetSentinel(
+                self.cfg, self.driver, interval_s=ss.interval_s,
+                window_s=ss.window_s, train_steps=ss.train_steps,
+                threshold=ss.threshold,
+                baseline_window=ss.baseline_window).start()
+            log.info("loopd sentinel up (interval %.1fs)", ss.interval_s)
+        except Exception:           # noqa: BLE001 -- observe-only rider
+            log.exception("loopd sentinel failed to start; continuing")
+            self.sentinel = None
 
     def _socket_answers(self) -> bool:
         try:
@@ -301,6 +331,8 @@ class LoopdServer:
                 run.thread.join(grace)
         if self.health is not None:
             self.health.stop()
+        if self.sentinel is not None:
+            self.sentinel.stop()
         if self._metrics_server is not None:
             self._metrics_server.stop()
         self.lanes.close_all()
@@ -327,6 +359,8 @@ class LoopdServer:
         self._drop_conns()
         if self.health is not None:
             self.health.stop()
+        if self.sentinel is not None:
+            self.sentinel.kill_collector()
         if self._metrics_server is not None:
             self._metrics_server.stop()
         self._stopped.set()
@@ -561,6 +595,11 @@ class LoopdServer:
                                   lanes=self.lanes,
                                   seams=self.seams)
             run.sched = sched
+            if self.sentinel is not None:
+                # the hosted run's typed events feed the daemon
+                # sentinel's behavioral features (observe-only: the tap
+                # reads records, the sentinel holds no scheduler ref)
+                sched.events.add_tap(self.sentinel.behavior)
             if self._aborted:
                 sched.kill()        # kill() raced the construction
                 return
@@ -719,6 +758,9 @@ class LoopdServer:
             "admission": self.admission.stats(),
             "health": self._health_stats(),
             "warm_pools": pools,
+            "sentinel": (self.sentinel.status_doc()
+                         if self.sentinel is not None
+                         else {"enabled": False}),
             "settings": {
                 "max_inflight_per_worker":
                     self.cfg.settings.loop.placement.max_inflight_per_worker,

@@ -49,6 +49,41 @@ TRACE_SPAN = "trace.span"
 # typed so the fleet placement view and tests can round-trip it.
 PLACEMENT_DECISION = "placement.decision"
 
+# Event name sentinel verdicts ride the bus under (clawker_tpu/sentinel
+# + docs/analytics-online.md): a live per-agent anomaly flag.  Strictly
+# observational -- nothing on the bus consumes it to change scheduling.
+ANOMALY_FLAG = "anomaly.flag"
+
+
+@dataclass(frozen=True)
+class AnomalyFlagEvent:
+    """Typed payload of an ``anomaly.flag`` event.
+
+    ``kind`` names the dominant feature family of the reconstruction
+    error: ``egress`` (network behavior) or ``behavior`` (exit codes /
+    orphans / migrations).  Rides as the detail string like the other
+    typed events so every existing sink renders it unchanged;
+    structured consumers round-trip with :meth:`parse`.
+    """
+
+    agent: str
+    worker: str
+    z: float
+    kind: str = "egress"
+
+    def detail(self) -> str:
+        return f"{self.kind} z={self.z:.2f} worker={self.worker}"
+
+    @classmethod
+    def parse(cls, agent: str, detail: str) -> "AnomalyFlagEvent":
+        kind, _, rest = detail.partition(" z=")
+        zs, _, worker = rest.partition(" worker=")
+        try:
+            z = float(zs)
+        except ValueError:
+            z = 0.0
+        return cls(agent, worker, z, kind)
+
 
 @dataclass(frozen=True)
 class PlacementEvent:
@@ -134,10 +169,27 @@ class EventBus:
         # a dashboard polling one agent contended with every hot-path
         # emit.  Kept in lockstep with history's bounded eviction.
         self._by_agent: dict[str, deque[EventRecord]] = {}
+        # taps see every stamped record synchronously on the EMITTER
+        # thread (no ordering loss, no drainer dependency): the seam the
+        # fleet sentinel's behavioral featurizer rides.  A tap must be
+        # O(dict update) cheap and never raise into the hot path.
+        self._taps: list[Callable[[EventRecord], None]] = []
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         if sink is not None:
             threading.Thread(target=self._drain, daemon=True,
                              name="event-bus").start()
+
+    def add_tap(self, tap: Callable[[EventRecord], None]) -> None:
+        """Attach a synchronous observer of every stamped record.  Runs
+        on the emitting thread AFTER the stamp lock is released -- a
+        slow tap delays only its own emitter, never the stamp order."""
+        self._taps.append(tap)
+
+    def remove_tap(self, tap: Callable[[EventRecord], None]) -> None:
+        try:
+            self._taps.remove(tap)
+        except ValueError:
+            pass
 
     def emit(self, agent: str, event: str, detail: str = "") -> EventRecord:
         with self._lock:
@@ -169,6 +221,11 @@ class EventBus:
                 self._q.put(rec)
             else:
                 self._delivered = max(self._delivered, self._seq)
+        for tap in self._taps:
+            try:
+                tap(rec)
+            except Exception:       # noqa: BLE001 -- observers never wedge emits
+                log.exception("event tap failed for %s/%s", agent, event)
         return rec
 
     def close(self) -> None:

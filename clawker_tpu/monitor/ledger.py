@@ -70,6 +70,54 @@ def read_jsonl(path: Path) -> list[dict]:
     return parse_jsonl(text.splitlines())
 
 
+@dataclass
+class TailState:
+    """Cursor for :func:`tail_jsonl`: byte offset of everything consumed,
+    the carried possibly-partial last line, and how many times the file
+    was observed truncated/rotated (callers that cache derived state --
+    the anomaly watch's record window, the sentinel collector's feed --
+    compare ``resets`` to know when to drop it)."""
+
+    offset: int = 0
+    carry: bytes = b""
+    resets: int = 0
+
+
+def tail_jsonl(path: Path, state: TailState) -> list[dict]:
+    """Incremental crash-tolerant JSONL tail: every parseable record
+    appended past ``state.offset``, riding :func:`parse_jsonl` so a
+    torn write (a netlogger or journal writer dying mid-line) is
+    SKIPPED, never fatal, and degrades identically to the whole-file
+    readers.  A partial trailing line is carried in ``state`` and
+    completed by a later append; truncation/rotation resets the cursor
+    (and bumps ``state.resets``) so the stream replays from the top.
+    Cost is O(new bytes); a missing/unreadable file reads as no news.
+    """
+    path = Path(path)
+    try:
+        size = path.stat().st_size
+    except OSError:
+        return []
+    if size < state.offset:         # rotated/truncated: start over
+        state.offset = 0
+        state.carry = b""
+        state.resets += 1
+    if size == state.offset:
+        return []
+    try:
+        with open(path, "rb") as f:
+            f.seek(state.offset)
+            chunk = f.read(size - state.offset)
+    except OSError:
+        return []
+    state.offset += len(chunk)
+    data = state.carry + chunk
+    lines = data.split(b"\n")
+    state.carry = lines.pop()       # possibly-partial last line
+    return parse_jsonl(
+        line.decode("utf-8", "replace") for line in lines)
+
+
 def flight_path(logs_dir: Path, run_id: str) -> Path:
     """Canonical flight-recorder path for one loop run."""
     return Path(logs_dir) / FLIGHT_DIR / f"loop-{run_id}.jsonl"

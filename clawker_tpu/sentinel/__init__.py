@@ -1,0 +1,37 @@
+"""Online fleet sentinel: pod-sharded live anomaly scoring.
+
+The production half of the analytics subsystem (docs/analytics-online.md):
+where ``analytics/`` scores a recorded egress file offline, the sentinel
+fuses EVERY fleet worker's live egress stream with the scheduler's typed
+event stream and scores the whole fleet's open windows as one sharded
+program per tick -- publishing typed ``anomaly.flag`` bus events,
+registry metrics, and flight-recorder spans.  Strictly observe-only:
+flags never feed breakers or placement.
+
+Surfaces: ``clawker fleet anomaly`` (one-shot / --watch / --json),
+``clawker loop --sentinel``, loopd status, and the loop dashboard's
+ANOM-Z column (the sentinel implements the AnomalyWatch surface).
+
+jax is imported lazily inside the scoring tick; importing this package
+costs nothing on accelerator-less hosts.
+"""
+
+from .collector import StreamCollector, wire_fleet
+from .engine import DEFAULT_THRESHOLD, ScoringEngine, TickReport
+from .features import BEHAVIOR_FEATURES, EXT_FEATURES, BehaviorTracker, featurize_fused
+from .sentinel import STATE_DIR, FleetSentinel, state_path
+
+__all__ = [
+    "BEHAVIOR_FEATURES",
+    "BehaviorTracker",
+    "DEFAULT_THRESHOLD",
+    "EXT_FEATURES",
+    "FleetSentinel",
+    "STATE_DIR",
+    "ScoringEngine",
+    "StreamCollector",
+    "TickReport",
+    "featurize_fused",
+    "state_path",
+    "wire_fleet",
+]

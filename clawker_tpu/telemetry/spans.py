@@ -44,6 +44,14 @@ SPAN_ORPHAN = "orphan"
 SPAN_MIGRATE = "migrate"
 SPAN_RESUME = "resume"      # zero-width hop: --resume adopted/continued
 #                             this iteration across a scheduler death
+SPAN_SENTINEL_TICK = "sentinel.tick"    # one fleet-wide scoring tick
+#                             (clawker_tpu/sentinel); a run-level span
+
+# Root spans that are NOT iteration roots by design (run-level
+# subsystems recording into the same flight file).  `loop trace` and
+# the chaos span-tree invariant treat any OTHER non-iteration root as
+# evidence of a writer that died mid-flush.
+STANDALONE_SPANS = frozenset({SPAN_SENTINEL_TICK})
 
 
 @dataclass(frozen=True)

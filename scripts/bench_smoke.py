@@ -52,8 +52,16 @@ Gates:
   scenarios with ZERO invariant violations, within
   bench.CHAOS_SOAK_BUDGET_S; any failure prints its deterministic
   `clawker chaos replay` repro + minimal shrunk schedule (ISSUE 8
-  acceptance bar).  `--only chaos` runs just this gate
-  (`make chaos-smoke`).
+  acceptance bar).  Includes the sentinel observe-only twin check.
+  `--only chaos` runs just this gate (`make chaos-smoke`).
+- anomaly_flag_latency_p50 <= bench.ANOMALY_FLAG_LATENCY_BUDGET_S from
+  an egress record appended to a worker stream to the typed
+  anomaly.flag observable on the event bus, sentinel live over two
+  fused streams on the fake pod, EVERY seeded anomaly flagged
+  (ISSUE 10 acceptance bar)
+- anomaly_fleet_score_tick <= bench.ANOMALY_TICK_BUDGET_S for 64
+  agents' open fused windows scored as ONE sharded fit/score program
+  (the sentinel's steady-state tick, compile excluded) (ISSUE 10)
 
 Prints one JSON line; exit 1 on any gate failure.
 """
@@ -118,6 +126,10 @@ def main() -> int:
         LOOPD_SUBMIT_BUDGET_MS,
         WARM_POOL_BURST_BUDGET_S,
         WARM_POOL_HIT_BUDGET_MS,
+        ANOMALY_FLAG_LATENCY_BUDGET_S,
+        ANOMALY_TICK_BUDGET_S,
+        bench_anomaly_flag_latency,
+        bench_anomaly_fleet_score_tick,
         bench_chaos_soak,
         bench_cross_process_fairness,
         bench_engine_dials,
@@ -166,6 +178,8 @@ def main() -> int:
         if retry["submit_p50_ms"] < loopd_rt["submit_p50_ms"]:
             loopd_rt = retry
     fairness = bench_cross_process_fairness()
+    flag_lat = bench_anomaly_flag_latency()
+    score_tick = bench_anomaly_fleet_score_tick()
     chaos = bench_chaos_soak()
     try:        # the parity worlds need the cryptography stack
         import cryptography  # noqa: F401
@@ -302,6 +316,28 @@ def main() -> int:
     elif not fairness["interleaved"]:
         failures.append("cross_process_fairness: tenants did not "
                         "interleave (first-burst-wins starvation)")
+    if flag_lat.get("error"):
+        failures.append(
+            f"anomaly_flag_latency_p50: {flag_lat['error']}")
+    elif flag_lat["flags"] != flag_lat["reps"]:
+        failures.append(
+            f"anomaly_flag_latency_p50: only {flag_lat['flags']}/"
+            f"{flag_lat['reps']} seeded anomalies flagged")
+    elif flag_lat["flag_latency_p50_s"] > ANOMALY_FLAG_LATENCY_BUDGET_S:
+        failures.append(
+            f"anomaly_flag_latency_p50 {flag_lat['flag_latency_p50_s']}s "
+            f"> {ANOMALY_FLAG_LATENCY_BUDGET_S}s budget")
+    if score_tick.get("error"):
+        failures.append(
+            f"anomaly_fleet_score_tick: {score_tick['error']}")
+    elif score_tick["agents"] != 64:
+        failures.append(
+            f"anomaly_fleet_score_tick: scored {score_tick['agents']} "
+            "agents, expected 64")
+    elif score_tick["tick_p50_s"] > ANOMALY_TICK_BUDGET_S:
+        failures.append(
+            f"anomaly_fleet_score_tick {score_tick['tick_p50_s']}s > "
+            f"{ANOMALY_TICK_BUDGET_S}s budget (one sharded tick)")
     _gate_chaos(chaos, failures)
     if not parity["skipped"]:
         if parity["passed"] != parity["total"]:
@@ -328,6 +364,8 @@ def main() -> int:
         "warm_pool_refill_burst": pool_burst,
         "loopd_submit_roundtrip_p50": loopd_rt,
         "cross_process_fairness": fairness,
+        "anomaly_flag_latency_p50": flag_lat,
+        "anomaly_fleet_score_tick": score_tick,
         "chaos_soak": chaos,
         "parity_suite_wall": parity,
         "ok": not failures,
