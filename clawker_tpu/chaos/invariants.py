@@ -39,6 +39,15 @@ with; docs/chaos.md#invariants):
   ``runner.run_observe_only_check``) compares a fixed-seed run's
   journaled placements and daemon-side create counts with and without
   ``--sentinel``: they must be identical.
+- ``workerd-reconcile``: journaled intent reconciles on link heal.
+  A channel that ends the scenario LIVE (any partition healed) must
+  leave zero undelivered events on its daemon -- no lost exits -- and
+  the standard ``duplicate-create`` audit above already proves no
+  workerd-executed create exceeded its write-ahead placements (the
+  worker-resident daemon mutates the same fake engine the recorder
+  watches).  Intent dedup hits are legitimate (a re-sent intent across
+  a partition); an intent executed with no placement to authorize it
+  is not, and surfaces as duplicate-create.
 """
 
 from __future__ import annotations
@@ -54,7 +63,7 @@ TERMINAL_STATUSES = ("done", "failed", "stopped")
 def check_invariants(driver, cfg, run_id: str, *, loops=None,
                      cap: int = 0, unfaulted: set[str] | None = None,
                      health=None, kills: int = 0,
-                     sentinel=None) -> list[str]:
+                     sentinel=None, workerd=None) -> list[str]:
     """Audit one finished scenario; returns human-readable violations
     (empty list = all invariants hold).
 
@@ -187,6 +196,19 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
                 violations.append(
                     f"sentinel-observe-only: sentinel performed "
                     f"{count} {name}")
+
+    # --- workerd-reconcile: a healed link leaves nothing undelivered.
+    # ``workerd`` rows come from the runner's audit (worker, alive,
+    # channel_live, undelivered).  A dead daemon / never-healed channel
+    # is the DEGRADE case, covered by the drain + accounting checks
+    # above; only a live channel owes an empty buffer.
+    for row in workerd or []:
+        if row.get("alive") and row.get("channel_live") \
+                and int(row.get("undelivered", 0)) > 0:
+            violations.append(
+                f"workerd-reconcile: {row.get('worker')} channel healed "
+                f"but {row['undelivered']} event(s) were never delivered "
+                "(lost exits)")
 
     # --- span-tree: flight record parses; kill-free runs close every root
     fpath = Path(flight_path(cfg.logs_dir, run_id))

@@ -34,6 +34,14 @@ kind                    injection point
 ``sentinel_kill``       SIGKILL the sentinel's collector mid-run: scoring
                         degrades to the stale buffer; the fleet must not
                         notice (observe-only invariant)
+``workerd_partition``   drop the worker's workerd intent channel mid-run
+                        (the daemon lives): pending intents survive, the
+                        executor redials + resyncs, buffered events
+                        replay -- no duplicate creates, no lost exits
+``workerd_kill``        SIGKILL the worker's workerd: pending intents hit
+                        the deadline and strand their loops WITHOUT a
+                        breaker penalty; the fleet degrades that worker
+                        to the direct WAN path and still drains
 ======================  ====================================================
 
 Plans with ``sentinel: true`` run with the fleet sentinel attached to
@@ -58,6 +66,7 @@ EVENT_KINDS = (
     "worker_kill", "worker_wedge", "worker_flap", "worker_slow",
     "engine_burst", "probe_drop", "worker_revive", "cli_sigkill",
     "egress_silent", "egress_flood", "sentinel_kill",
+    "workerd_partition", "workerd_kill",
 )
 
 # event kinds that target no worker (worker index is ignored)
@@ -124,6 +133,7 @@ class FaultPlan:
     warm_pool_depth: int = 0
     max_inflight_per_worker: int = 2
     sentinel: bool = False          # run with the fleet sentinel attached
+    workerd: bool = False           # run with per-worker workerd executors
     events: list[FaultEvent] = field(default_factory=list)
 
     @property
@@ -138,6 +148,7 @@ class FaultPlan:
             "warm_pool_depth": self.warm_pool_depth,
             "max_inflight_per_worker": self.max_inflight_per_worker,
             "sentinel": self.sentinel,
+            "workerd": self.workerd,
             "events": [e.to_doc() for e in sorted(self.events,
                                                   key=lambda e: e.at_s)],
         }
@@ -158,6 +169,7 @@ class FaultPlan:
             max_inflight_per_worker=int(
                 doc.get("max_inflight_per_worker", 2)),
             sentinel=bool(doc.get("sentinel", False)),
+            workerd=bool(doc.get("workerd", False)),
             events=[FaultEvent.from_doc(e) for e in doc.get("events") or []],
         )
         _validate(plan)
@@ -262,6 +274,30 @@ def generate_plan(seed: int, scenario: int = 0, *, n_workers: int = 4,
             events.append(FaultEvent(
                 at_s=rng.uniform(0.1, horizon_s * 0.7),
                 kind="sentinel_kill", worker=-1))
+    # workerd rider (again drawn strictly AFTER every pre-existing draw,
+    # sentinel's included -- the worker-fault/sigkill/sentinel schedule
+    # of a (seed, scenario) pair is byte-identical to the pre-workerd
+    # generator): about a third of scenarios run with per-worker
+    # workerd executors attached, most of those with data-plane chaos
+    # against one channel -- a partition (heals via redial + resync) or
+    # a daemon SIGKILL (degrades that worker to the direct WAN path).
+    # The generated cli_sigkill seams above stay drawn from the
+    # pre-workerd pools for the same reason; the workerd.* seams are
+    # reachable via hand-written plans and the optional draw below.
+    if rng.random() < 0.35:
+        plan.workerd = True
+        if rng.random() < 0.75:
+            victim = rng.randrange(n_workers)
+            kind = rng.choice(("workerd_partition", "workerd_partition",
+                               "workerd_kill"))
+            events.append(FaultEvent(
+                at_s=rng.uniform(0.05, horizon_s * 0.6), kind=kind,
+                worker=victim))
+        if rng.random() < 0.25:
+            events.append(FaultEvent(
+                at_s=rng.uniform(0.02, horizon_s * 0.4),
+                kind="cli_sigkill", worker=-1,
+                arg="workerd.pre_dispatch"))
     plan.events = sorted(events, key=lambda e: e.at_s)
     _validate(plan)
     return plan

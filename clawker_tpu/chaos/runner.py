@@ -210,6 +210,28 @@ class ChaosRunner:
             self.sentinel = FleetSentinel(
                 cfg, self.driver, interval_s=0.15,
                 train_steps=SENTINEL_TRAIN_STEPS, threshold=3.5).start()
+        # workerd scenarios (plan.workerd): per-worker launch daemons on
+        # the fake pod's LOCAL engine views + an executor per channel;
+        # the scheduler's data plane rides them, and
+        # workerd_partition/workerd_kill events fault the channels
+        # while every standard invariant must keep holding
+        self.workerd_servers: list = []
+        self.executors = None
+        if plan.workerd:
+            from ..workerd.executor import ExecutorSet, WorkerdExecutor
+            from ..workerd.server import WorkerdServer
+
+            exs = {}
+            for i, w in enumerate(self.driver.workers()):
+                sock = cfg.state_dir / "chaos-wd" / f"wd-{i}.sock"
+                srv = WorkerdServer(cfg, self.driver.local_engine(i),
+                                    worker_id=w.id, sock_path=sock).start()
+                self.workerd_servers.append(srv)
+                # a killed daemon must strand its pending intents well
+                # inside the scenario deadline
+                exs[w.id] = WorkerdExecutor(w.id, sock,
+                                            intent_deadline_s=2.0)
+            self.executors = ExecutorSet(exs)
 
     @staticmethod
     def _sentinel_available() -> bool:
@@ -249,7 +271,7 @@ class ChaosRunner:
             sched = LoopScheduler(self.cfg, self.driver, self._spec(),
                                   on_event=self.on_event,
                                   health_config=self.health_config,
-                                  seams=seams)
+                                  seams=seams, executors=self.executors)
         else:
             image = replay(RunJournal.read(
                 journal_path(self.cfg.logs_dir, resume_of)))
@@ -259,7 +281,8 @@ class ChaosRunner:
                     "the first journal record (seam fired too early?)")
             sched = LoopScheduler.resume(
                 self.cfg, self.driver, image, on_event=self.on_event,
-                health_config=self.health_config, seams=seams)
+                health_config=self.health_config, seams=seams,
+                executors=self.executors)
         self._sched = sched
         if self.sentinel is not None:
             # re-attached per generation: each generation owns a fresh
@@ -313,6 +336,42 @@ class ChaosRunner:
             self.feeder.flood(ev.worker, int(ev.arg or 100))
         elif ev.kind == "sentinel_kill" and self.sentinel is not None:
             self.sentinel.kill_collector()
+        _INJECTIONS.labels(ev.kind).inc()
+        self.injected += 1
+
+    def _workerd_audit(self) -> list[dict] | None:
+        """Per-worker workerd evidence for the invariant checker: the
+        channel's end-of-scenario liveness plus the server's
+        undelivered-event and intent-dedup counters.  None when the
+        scenario ran without workerd."""
+        if self.executors is None:
+            return None
+        out = []
+        for srv in self.workerd_servers:
+            ex = self.executors.any_for(srv.worker_id)
+            out.append({
+                "worker": srv.worker_id,
+                "alive": not srv._stop.is_set(),
+                "channel_live": bool(ex is not None and ex.live()),
+                "undelivered": srv.undelivered(),
+                "intents": srv.stats["intents"],
+                "dedup_hits": srv.stats["dedup_hits"],
+            })
+        return out
+
+    def _apply_workerd_fault(self, ev: FaultEvent) -> None:
+        """Data-plane faults: partition a channel (the daemon lives;
+        the executor redials + resyncs) or SIGKILL the daemon itself
+        (pending intents strand, the worker degrades to the direct WAN
+        path).  Neither touches the worker's ENGINE -- the worker stays
+        in the unfaulted set, so spurious-quarantine also proves
+        workerd chaos can never open a breaker."""
+        if 0 <= ev.worker < len(self.workerd_servers):
+            srv = self.workerd_servers[ev.worker]
+            if ev.kind == "workerd_partition":
+                srv.drop_conns()
+            else:
+                srv.kill()
         _INJECTIONS.labels(ev.kind).inc()
         self.injected += 1
 
@@ -404,6 +463,10 @@ class ChaosRunner:
                     time.sleep(min(0.01, t0 + ev.at_s - now))
                 if ev.kind == "cli_sigkill":
                     self._arm_sigkill(ev)
+                elif ev.kind in ("workerd_partition", "workerd_kill"):
+                    # data-plane faults hit the workerd channel/daemon,
+                    # never the engine: the worker stays unfaulted
+                    self._apply_workerd_fault(ev)
                 elif ev.kind in ("egress_silent", "egress_flood",
                                  "sentinel_kill"):
                     # stream/collector faults: they hit the SENTINEL's
@@ -454,7 +517,8 @@ class ChaosRunner:
                 self.driver, self.cfg, final.loop_id,
                 loops=final.loops, cap=self.plan.max_inflight_per_worker,
                 unfaulted=unfaulted, health=final.health,
-                kills=self.kills, sentinel=self.sentinel))
+                kills=self.kills, sentinel=self.sentinel,
+                workerd=self._workerd_audit()))
         except ClawkerError as e:
             runner_error = True
             result.violations.append(f"runner-error: {e}")
@@ -463,6 +527,10 @@ class ChaosRunner:
                 self.feeder.stop()
             if self.sentinel is not None:
                 self.sentinel.stop()
+            if self.executors is not None:
+                self.executors.close_all()
+            for srv in self.workerd_servers:
+                srv.stop()
             self.driver.close()
         result.kills = self.kills
         result.generations = self.generations

@@ -45,6 +45,14 @@ SEAM_NAMES = (
     #                             ack NOT yet sent to the client
     "loopd.post_ack",           # ack sent; scheduler start + streaming
     #                             not begun
+    # workerd data-plane boundaries (docs/workerd.md): an intent is
+    # about to leave the scheduler for the worker-resident daemon, and
+    # a partitioned channel has just re-synced -- the two places a
+    # crash interleaves with remote execution
+    "workerd.pre_dispatch",     # placement WAL durable; intent about to
+    #                             enter the channel send queue
+    "workerd.post_reconnect",   # channel healed + resync done; buffered
+    #                             events about to replay
 )
 
 

@@ -150,14 +150,20 @@ def _loopd_status(f: Factory, no_daemon: bool) -> dict | None:
     return doc
 
 
-_HEALTH_COLUMNS = ("WORKER", "STATE", "BRK", "P50MS", "P95MS", "PROBES",
-                   "FAILS", "ORPHANED", "MIG-OUT", "MIG-IN", "LAST-ERROR")
+_HEALTH_COLUMNS = ("WORKER", "STATE", "BRK", "WORKERD", "P50MS", "P95MS",
+                   "PROBES", "FAILS", "ORPHANED", "MIG-OUT", "MIG-IN",
+                   "LAST-ERROR")
 
 
-def _health_rows(stats: list[dict], anom: dict | None = None) -> list[str]:
+def _health_rows(stats: list[dict], anom: dict | None = None,
+                 workerd: dict | None = None) -> list[str]:
     # BRK is the registry's health_breaker_state gauge (0=closed
     # 1=half_open 2=open) -- the same value a Prometheus scrape of
     # `clawker loop --metrics-port` serves (docs/telemetry.md).
+    # WORKERD is the worker-resident launch daemon's liveness
+    # (docs/workerd.md): `degraded` means the socket exists but nothing
+    # answers -- that worker's data plane silently fell back to the WAN
+    # path, visibly slower while every breaker still reads healthy.
     # ``anom`` (worker -> hottest sentinel z, from a loopd-hosted
     # sentinel) appends the live ANOM-Z column (docs/analytics-online.md)
     cols = _HEALTH_COLUMNS + (("ANOM-Z",) if anom is not None else ())
@@ -165,6 +171,7 @@ def _health_rows(stats: list[dict], anom: dict | None = None) -> list[str]:
     for s in stats:
         row = [str(x) for x in (
             s["worker"], s["state"], s["breaker_state_gauge"],
+            (workerd or {}).get(s["worker"], "absent"),
             s["probe_p50_ms"], s["probe_p95_ms"],
             s["probes"], s["probe_failures"], s["orphaned"],
             s["migrations_out"], s["migrations_in"],
@@ -219,13 +226,17 @@ def fleet_health(f: Factory, probes, watch, interval, fmt, no_daemon):
 
     from ..health import BreakerConfig, HealthConfig, HealthMonitor
 
+    from ..workerd import liveness as workerd_liveness
+
     if not watch:
         doc = _loopd_status(f, no_daemon)
         if doc is not None:
             stats = doc.get("health", [])
             anom = _sentinel_anom_by_worker(doc)
+            wd = doc.get("workerd") or {}
             if fmt == "json":
-                out = {"source": f"loopd:{doc.get('pid')}", "health": stats}
+                out = {"source": f"loopd:{doc.get('pid')}", "health": stats,
+                       "workerd": wd}
                 if doc.get("sentinel"):
                     out["sentinel"] = doc["sentinel"]
                 click.echo(_json.dumps(out, indent=2))
@@ -233,7 +244,7 @@ def fleet_health(f: Factory, probes, watch, interval, fmt, no_daemon):
                 click.echo(f"source: loopd (pid {doc.get('pid')}, "
                            f"{len(doc.get('runs', []))} hosted run(s))",
                            err=True)
-                for line in _health_rows(stats, anom):
+                for line in _health_rows(stats, anom, wd):
                     click.echo(line)
             if any(s["state"] != "closed" for s in stats):
                 raise SystemExit(1)
@@ -250,11 +261,17 @@ def fleet_health(f: Factory, probes, watch, interval, fmt, no_daemon):
     mon = HealthMonitor(f.driver, config=cfg)
 
     def emit() -> list[dict]:
+        # liveness probed per emit, not once: under --watch a workerd
+        # dying mid-session must flip the column to `degraded`, which
+        # is the whole reason the column exists
+        wd = workerd_liveness(f.config, f.driver)
         stats = mon.stats()
         if fmt == "json":
+            for s in stats:
+                s["workerd"] = wd.get(s["worker"], "absent")
             click.echo(_json.dumps(stats, indent=2))
         else:
-            for line in _health_rows(stats):
+            for line in _health_rows(stats, None, wd):
                 click.echo(line)
         return stats
 

@@ -160,6 +160,13 @@ class _ClientInterrupt:
                    "(docs/loopd.md) / force the in-process scheduler.  "
                    "Default: use the daemon when one answers on this "
                    "project's socket (settings loopd.enable).")
+@click.option("--workerd/--no-workerd", "use_workerd", default=None,
+              help="Route the launch data plane through worker-resident "
+                   "workerd daemons (docs/workerd.md): batched intents + "
+                   "events over one channel per worker instead of a WAN "
+                   "round trip per engine call.  Default: use any workerd "
+                   "that answers (settings workerd.enable); workers "
+                   "without one keep the direct path.")
 @click.option("--detach", is_flag=True,
               help="Daemon mode only: submit the run and exit "
                    "immediately -- it keeps executing under loopd; "
@@ -170,7 +177,7 @@ def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                placement, tenant, tenant_weight, max_inflight_per_worker,
                warm_pool, image, prompt, worktrees, env_kv, failover,
                orphan_grace, resume_run, metrics_port, sentinel_flag,
-               chaos_plan, as_json, keep, use_daemon, detach):
+               chaos_plan, as_json, keep, use_daemon, use_workerd, detach):
     """Fan autonomous agent loops across the runtime's workers."""
     if ctx.invoked_subcommand is not None:
         return
@@ -181,7 +188,8 @@ def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                max_inflight_per_worker=max_inflight_per_worker,
                warm_pool=warm_pool, sentinel_flag=sentinel_flag,
                chaos_plan=chaos_plan,
-               use_daemon=use_daemon, detach=detach)
+               use_daemon=use_daemon, use_workerd=use_workerd,
+               detach=detach)
 
 
 def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
@@ -189,7 +197,7 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
                as_json, keep, resume_run=None, tenant=None,
                tenant_weight=None, max_inflight_per_worker=None,
                warm_pool=None, sentinel_flag=None, chaos_plan=None,
-               use_daemon=None, detach=False):
+               use_daemon=None, use_workerd=None, detach=False):
     from .. import telemetry
 
     if use_daemon and (resume_run or chaos_plan):
@@ -223,6 +231,26 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
         line = f"[{agent}] {event}" + (f" {detail}" if detail else "")
         click.echo(line, err=True)
 
+    def discover_workerd(worktree_run: bool):
+        """ExecutorSet for the in-process scheduler, or None (direct).
+        Worktree runs stay direct: the worktree mount is host-local."""
+        if use_workerd is False or worktree_run:
+            return None
+        from ..workerd.executor import discover_executors
+
+        execset = discover_executors(f.config, f.driver)
+        if not execset:
+            if use_workerd:
+                raise click.ClickException(
+                    "--workerd: no workerd answering on any worker "
+                    "(start one per worker with `clawker workerd start`; "
+                    "docs/workerd.md)")
+            return None
+        click.echo(f"workerd: launch data plane on {len(execset)} "
+                   "worker(s) (batched intents over one channel each)",
+                   err=True)
+        return execset
+
     if resume_run:
         if (parallel or placement or prompt or env_kv or image != "@"
                 or tenant or tenant_weight is not None
@@ -237,12 +265,15 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             raise click.ClickException(
                 f"{jpath}: no usable run header -- the journal is too "
                 "damaged to resume; start a fresh run")
+        executors = discover_workerd(
+            bool(run_image.spec.get("worktrees")))
         sched = LoopScheduler.resume(
             f.config, f.driver, run_image, on_event=on_event,
             failover=failover,
             iterations=iterations if iterations >= 0 else None,
             orphan_grace_s=orphan_grace,
-            telemetry=tele.flight_recorder)
+            telemetry=tele.flight_recorder,
+            executors=executors)
         spec = sched.spec
     else:
         pdef = defaults.placement
@@ -279,6 +310,12 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
 
             client = ensure_daemon(f)
             if client is not None:
+                if use_workerd:
+                    click.echo(
+                        "note: loopd-hosted runs keep the in-process "
+                        "launch path -- --workerd is ignored under the "
+                        "daemon (docs/workerd.md degrade matrix)",
+                        err=True)
                 if max_inflight_per_worker:
                     click.echo(
                         "note: the admission bucket is daemon-scoped -- "
@@ -307,7 +344,9 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             raise click.ClickException(
                 "--detach needs a loopd daemon to own the run "
                 "(start one with `clawker loopd start`)")
-        sched = LoopScheduler(f.config, f.driver, spec, on_event=on_event)
+        executors = discover_workerd(worktrees)
+        sched = LoopScheduler(f.config, f.driver, spec, on_event=on_event,
+                              executors=executors)
     chaos = None
     if chaos_plan:
         from ..chaos.plan import FaultPlan
@@ -425,6 +464,8 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             shipper.stop()
         if metrics_server is not None:
             metrics_server.stop()
+        if executors is not None:
+            executors.close_all()
     if not keep:
         sched.cleanup(remove_containers=True)
     if as_json:

@@ -1,0 +1,138 @@
+"""``clawker workerd``: manage the worker-resident launch daemon.
+
+Run ON a worker host (docs/workerd.md): ``start`` forks the daemon
+detached, serving the host's local engine socket; ``status`` probes the
+control socket; ``stop`` asks a running daemon to shut down.  The
+scheduler (or loopd) on the client host discovers the socket --
+tunneled over the existing SSH mux for ``tpu_vm`` -- and moves the
+launch data plane onto it, so engine mutations stop paying a
+host<->worker WAN round trip each.
+"""
+
+from __future__ import annotations
+
+import json as _json
+import os
+import signal
+import time
+
+import click
+
+from .factory import Factory
+
+pass_factory = click.make_pass_decorator(Factory)
+
+
+@click.group("workerd")
+def workerd_group() -> None:
+    """Worker-resident launch daemon (docs/workerd.md)."""
+
+
+@workerd_group.command("start")
+@click.option("--driver", "driver_override", default="",
+              help="Runtime driver the daemon serves (default: settings "
+                   "runtime.driver; pass `local` on a provisioned worker "
+                   "whose settings still name tpu_vm).")
+@pass_factory
+def workerd_start(f: Factory, driver_override) -> None:
+    """Start workerd detached on THIS host.
+
+    The daemon binds a 0600 unix socket in a 0700 runtime dir under the
+    state dir and executes launch intents against this host's engine;
+    it outlives this CLI.  Idempotent: a daemon already answering is
+    left alone.
+    """
+    from ..workerd import WorkerdError, socket_path, spawn_daemon
+    from ..workerd.executor import ping_socket
+
+    sock = socket_path(f.config)
+    if ping_socket(sock):
+        click.echo(f"workerd already running on {sock}")
+        return
+    try:
+        pid = spawn_daemon(f.config, cwd=f.cwd,
+                           driver_override=driver_override)
+    except WorkerdError as e:
+        raise click.ClickException(str(e))
+    click.echo(f"workerd started (pid {pid}) on {sock}")
+
+
+@workerd_group.command("status")
+@click.option("--json", "as_json", is_flag=True, help="Status as JSON.")
+@pass_factory
+def workerd_status(f: Factory, as_json) -> None:
+    """Probe the local workerd (exit 1 when nothing answers).
+
+    Also renders per-worker liveness for the active runtime driver:
+    ``live`` (socket answers), ``degraded`` (socket exists, daemon
+    dead -- that worker's data plane silently fell back to the WAN
+    path), ``absent`` (never provisioned).
+    """
+    from ..agentd import protocol
+    from ..workerd import liveness, socket_path
+
+    sock = socket_path(f.config)
+    doc = None
+    try:
+        import socket as _s
+
+        with _s.socket(_s.AF_UNIX, _s.SOCK_STREAM) as s:
+            s.settimeout(2.0)
+            s.connect(str(sock))
+            protocol.write_msg(s, {"type": "status"})
+            doc = protocol.read_msg(s)
+    except OSError:
+        doc = None
+    fleet = liveness(f.config, f.driver)
+    if as_json:
+        click.echo(_json.dumps({"local": doc, "workers": fleet}, indent=2))
+    else:
+        if doc is not None:
+            click.echo(f"workerd pid {doc.get('pid')} on {sock}: "
+                       f"{doc.get('intents', 0)} intent(s), "
+                       f"{doc.get('events', 0)} event(s) in "
+                       f"{doc.get('batches', 0)} batch(es), "
+                       f"uptime {doc.get('uptime_s', 0)}s")
+        else:
+            click.echo(f"no workerd answering on {sock}", err=True)
+        for wid in sorted(fleet):
+            click.echo(f"{wid}\t{fleet[wid]}")
+    if doc is None:
+        raise SystemExit(1)
+
+
+@workerd_group.command("stop")
+@pass_factory
+def workerd_stop(f: Factory) -> None:
+    """Stop a running workerd (graceful; in-flight intents finish on
+    the local lane, clients degrade to the direct path)."""
+    import socket as _s
+
+    from ..agentd import protocol
+    from ..workerd import pidfile_path, socket_path
+
+    sock = socket_path(f.config)
+    try:
+        with _s.socket(_s.AF_UNIX, _s.SOCK_STREAM) as s:
+            s.settimeout(2.0)
+            s.connect(str(sock))
+            protocol.write_msg(s, {"type": "shutdown"})
+            protocol.read_msg(s)
+    except OSError:
+        # nothing answering: sweep a stale pidfile/socket best-effort
+        pid_path = pidfile_path(f.config)
+        try:
+            pid = int(pid_path.read_text().strip())
+            os.kill(pid, signal.SIGTERM)
+        except (OSError, ValueError):
+            raise click.ClickException(
+                f"no workerd answering on {sock} (and no live pidfile)")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and sock.exists():
+        time.sleep(0.1)
+    click.echo("workerd stopped" if not sock.exists()
+               else "workerd stop requested (socket still present)")
+
+
+def register(cli: click.Group) -> None:
+    cli.add_command(workerd_group)

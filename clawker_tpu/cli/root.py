@@ -147,6 +147,7 @@ def register_commands() -> None:
         cmd_project,
         cmd_settings,
         cmd_volume,
+        cmd_workerd,
     )
 
     cmd_build.register(cli)
@@ -167,6 +168,7 @@ def register_commands() -> None:
     cmd_plugin.register(cli)
     cmd_settings.register(cli)
     cmd_volume.register(cli)
+    cmd_workerd.register(cli)
 
 
 register_commands()

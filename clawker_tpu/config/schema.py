@@ -494,6 +494,28 @@ class LoopdSettings:
 
 
 @dataclass
+class WorkerdSettings:
+    """The worker-resident launch daemon (docs/workerd.md).
+
+    ``clawker workerd start`` brings up one daemon per WORKER host; the
+    scheduler (or loopd) discovers it -- the transport-forwarded socket
+    for ``tpu_vm`` workers, the canonical state-dir socket for the
+    local engine -- and moves the launch data plane there: batched
+    intents out, batched typed events back, one persistent channel per
+    worker, so creates/starts/waits stop paying a host<->worker WAN
+    round trip per engine call.  No daemon answering = the in-process
+    direct executor, unchanged (`clawker loop --no-workerd` forces it;
+    `fleet health` renders per-worker liveness)."""
+
+    enable: bool = True             # scheduler may discover & use workerd
+    socket: str = ""                # unix socket path override
+    #                                 ("" = <state>/workerd/workerd.sock)
+    intent_deadline_s: float = 60.0  # pending intent age before the loop
+    #                                  fails over to the direct path
+    start_deadline_s: float = 15.0  # workerd start: socket-answer deadline
+
+
+@dataclass
 class SentinelSettings:
     """The online fleet sentinel (docs/analytics-online.md).
 
@@ -557,6 +579,7 @@ class Settings:
     runtime: RuntimeSettings = field(default_factory=RuntimeSettings)
     loop: LoopSettings = field(default_factory=LoopSettings)
     loopd: LoopdSettings = field(default_factory=LoopdSettings)
+    workerd: WorkerdSettings = field(default_factory=WorkerdSettings)
     telemetry: TelemetrySettings = field(default_factory=TelemetrySettings)
     credentials: CredentialSettings = field(default_factory=CredentialSettings)
     chaos: ChaosSettings = field(default_factory=ChaosSettings)

@@ -48,6 +48,15 @@ class _FaultGate:
     Lifecycle/telemetry passthroughs (``close``/``close_events``/
     ``pool_stats``) are never gated: draining a dead worker's engine on
     shutdown must not raise.
+
+    Fake-WAN RTT (``rtt_s``, docs/workerd.md#fake-wan): every call
+    arriving through the REMOTE view (the ``Worker.engine`` the
+    scheduler dials, i.e. the host side of the host<->worker link) pays
+    an injected per-call round trip before executing -- the
+    deterministic stand-in for an SSH-mux-forwarded daemon on a real
+    pod.  The LOCAL view (:meth:`local_view`, what a worker-resident
+    workerd dials) pays every injected FAULT (a dead daemon is dead
+    from any side) but never the WAN rtt.
     """
 
     _UNGATED = {"close", "close_events", "pool_stats"}
@@ -68,6 +77,8 @@ class _FaultGate:
         self._launch_inflight = 0
         self._burst_left = 0        # remaining 'burst' failures
         self._delay_s = 0.0         # per-call delay under 'slow'
+        self.rtt_s = 0.0            # injected host<->worker WAN round trip
+        #                             per REMOTE call (local_view skips it)
         self.injected = 0           # gated calls that were made to fail
         self.call_hwm = 0           # concurrent daemon calls, any kind
         self.launch_hwm = 0         # concurrent create/start calls
@@ -89,7 +100,20 @@ class _FaultGate:
             else:
                 self._cleared.set()
 
-    def _gate(self, name: str) -> None:
+    def set_rtt(self, rtt_s: float) -> None:
+        """Inject a per-call WAN round trip on the remote view."""
+        self.rtt_s = max(0.0, float(rtt_s))
+
+    def local_view(self) -> "_LocalGateView":
+        """The worker-resident side of this daemon: same faults, no
+        injected WAN rtt (what a WorkerdServer should be built on)."""
+        return _LocalGateView(self)
+
+    def _gate(self, name: str, *, local: bool = False) -> None:
+        if not local and self.rtt_s > 0:
+            # the remote caller's request/response round trip; paid
+            # BEFORE mode handling so even refused dials cost the wire
+            time.sleep(self.rtt_s)
         with self._lock:
             mode = self._mode
             delay = self._delay_s
@@ -120,14 +144,14 @@ class _FaultGate:
         if mode == "probe_drop" and name == "ping":
             raise DriverError("injected fault: probe channel dropped")
 
-    def __getattr__(self, name: str):
+    def _wrap(self, name: str, *, local: bool):
         attr = getattr(self.inner, name)
         if not callable(attr) or name in self._UNGATED:
             return attr
         is_launch = name in self._LAUNCH_CALLS
 
         def call(*args, **kwargs):
-            self._gate(name)
+            self._gate(name, local=local)
             with self._lock:
                 self._inflight += 1
                 self.call_hwm = max(self.call_hwm, self._inflight)
@@ -144,6 +168,22 @@ class _FaultGate:
                         self._launch_inflight -= 1
 
         return call
+
+    def __getattr__(self, name: str):
+        return self._wrap(name, local=False)
+
+
+class _LocalGateView:
+    """Worker-resident view of a gated fake daemon: shares the gate's
+    faults, counters, and high-water marks (the daemon is ONE daemon),
+    but never pays the injected WAN ``rtt_s`` -- calls from this side
+    never cross the fake WAN.  Built by ``FakeDriver.local_engine``."""
+
+    def __init__(self, gate: _FaultGate):
+        self._gate_obj = gate
+
+    def __getattr__(self, name: str):
+        return self._gate_obj._wrap(name, local=True)
 
 
 class FakeDriver(RuntimeDriver):
@@ -178,6 +218,23 @@ class FakeDriver(RuntimeDriver):
         """Fault worker ``index``'s daemon (see _FaultGate): refuse |
         wedge | flap | slow(delay_s=) | burst(count=) | probe_drop."""
         self.gates[index].set_fault(kind, **kw)
+
+    def set_rtt(self, index: int, rtt_s: float) -> None:
+        """Inject a deterministic host<->worker WAN round trip paid by
+        every REMOTE engine call against worker ``index`` (the fake-WAN
+        harness; docs/workerd.md#fake-wan).  ``local_engine`` calls --
+        a worker-resident workerd's -- never pay it."""
+        self.gates[index].set_rtt(rtt_s)
+
+    def set_rtt_all(self, rtt_s: float) -> None:
+        for gate in self.gates:
+            gate.set_rtt(rtt_s)
+
+    def local_engine(self, index: int) -> Engine:
+        """An Engine over the worker-resident view of worker ``index``'s
+        daemon: pays injected faults, never the injected WAN rtt.  What
+        an in-process WorkerdServer for that worker should be built on."""
+        return Engine(self.gates[index].local_view())
 
     def clear_fault(self, index: int) -> None:
         """Revive worker ``index`` (blocked 'wedge' calls proceed)."""

@@ -148,6 +148,11 @@ class SSHTransport:
         self.index = index
         self.mux_dir = Path(mux_dir)
         self.runner = runner or Runner()
+        # injectable per-call RTT (the fake-WAN harness for REAL
+        # transports; docs/workerd.md#fake-wan): every mux command pays
+        # this before dispatch, so a bench/test can make a local ssh
+        # target behave like a cross-continent worker deterministically
+        self.rtt_s = 0.0
         self._forwards: list[subprocess.Popen] = []
         self._rev_tags: set[str] = set()
         self._lock = threading.Lock()
@@ -175,6 +180,8 @@ class SSHTransport:
 
     def run(self, remote_cmd: str, *, input_bytes: bytes | None = None,
             timeout: float = 120.0) -> RunResult:
+        if self.rtt_s > 0:
+            time.sleep(self.rtt_s)      # injected fake-WAN round trip
         return self.runner.run(self.ssh_base() + [remote_cmd],
                                input_bytes=input_bytes, timeout=timeout)
 
@@ -254,6 +261,24 @@ class SSHTransport:
         on the forwarded path behaves identically to a local one)."""
         return self.forward_unix(remote_sock or self.remote_loopd_sock(),
                                  tag="loopd")
+
+    def remote_workerd_sock(self) -> str:
+        """The worker's canonical workerd data-plane socket
+        (docs/workerd.md).  Absolute on purpose -- sshd does not
+        tilde-expand direct-streamlocal forward targets."""
+        user = self.tpu.ssh_user or consts.TPU_SSH_USER_DEFAULT
+        home = "/root" if user == "root" else f"/home/{user}"
+        return (f"{home}/.local/state/{consts.PRODUCT}/"
+                "workerd/workerd.sock")
+
+    def forward_workerd(self, remote_sock: str = "") -> Path:
+        """Tunnel the worker-resident workerd intent channel over the
+        existing SSH mux; returns the local socket the scheduler's
+        WorkerdExecutor dials.  One persistent channel rides this
+        forward -- the whole point is that per-engine-call WAN round
+        trips collapse onto it (docs/workerd.md)."""
+        return self.forward_unix(remote_sock or self.remote_workerd_sock(),
+                                 tag="workerd")
 
     def forward_unix(self, remote_sock: str, tag: str = "docker") -> Path:
         """Forward a remote unix socket to a local one; returns the local

@@ -375,7 +375,7 @@ class LoopScheduler:
                  run_id: str | None = None,
                  admission: AdmissionController | None = None,
                  lanes: LaneRegistry | None = None,
-                 seams=None):
+                 seams=None, executors=None):
         if spec.failover not in FAILOVER_POLICIES:
             raise ClawkerError(
                 f"loop: unknown failover policy {spec.failover!r} "
@@ -490,6 +490,27 @@ class LoopScheduler:
         # Chaos tests / `clawker chaos` arm hooks that kill() + abort
         # mid-flight -- the enumerable replacement for ad-hoc stubbing.
         self.seams = seams if seams is not None else NULL_SEAMS
+        # --- workerd (docs/workerd.md): the WorkerExecutor seam.  An
+        # ExecutorSet maps workers to live channels into their
+        # worker-resident launch daemons: dispatch sends batched intents
+        # there instead of running engine calls on the local lane, and
+        # the event stream drives the SAME journal records, spans, and
+        # status transitions.  None (the default) or a worker with no
+        # live channel = today's direct in-process path, unchanged.
+        # Worktree runs stay direct: a worktree is a host-local mount
+        # workerd cannot stage.  The set is caller-owned (CLI, bench,
+        # chaos runner) -- the scheduler never closes it.
+        self.executors = executors
+        if executors is not None:
+            executors.bind(self)
+        self._remote_exits: queue.SimpleQueue = queue.SimpleQueue()
+        self._placed_workers: set[str] = set()  # every worker a launch or
+        #                           refill was EVER submitted to: the
+        #                           cleanup sweep set.  Final placements +
+        #                           abandoned lists miss a worker whose
+        #                           remote create's `created` event died
+        #                           with a workerd kill after every loop
+        #                           migrated off it (chaos-found leak)
         self._aborted = False       # kill(): crash seam, skip all shutdown
         self._image: RunImage | None = None   # journal image being resumed
         self._extra_workers: list[Worker] = []  # journaled workers missing
@@ -621,6 +642,7 @@ class LoopScheduler:
         through the policy at tick cadence.
         """
         agent = loop.agent
+        self._placed_workers.add(worker.id)
         handle: Future = Future()
         handle.add_done_callback(lambda _f: self._wake.set())
         self._inflight[agent] = handle
@@ -639,19 +661,38 @@ class LoopScheduler:
                 handle.set_result(None)
 
         def dispatch(release) -> None:
-            def task():
-                # stamp the full pre-create wait (admission queue + lane
-                # queue) where the iteration span can pick it up: the
-                # root opens inside fn, on this same lane thread
-                self._queue_wait[agent] = time.monotonic() - t_submit
-                return fn(loop, epoch, worker)
+            # the WorkerExecutor seam (docs/workerd.md): with a live
+            # channel to this worker's workerd, the launch becomes a
+            # batched intent executed against the worker's LOCAL engine
+            # socket -- zero blocking WAN round trips on this side.  A
+            # mid-dispatch degrade (channel just died, restart with no
+            # container yet) falls through to the direct lane.
+            fut: Future | None = None
+            if fn in (self._launch, self._guarded_start):
+                ex = self._workerd_for(worker)
+                if ex is not None:
+                    self._queue_wait[agent] = time.monotonic() - t_submit
+                    # NOTE: == not `is` -- bound-method attribute access
+                    # builds a fresh object per read, so identity never
+                    # matches; equality compares (__self__, __func__)
+                    fut = self._workerd_dispatch(
+                        ex, loop, epoch, worker,
+                        restart=fn == self._guarded_start)
+            if fut is None:
+                def task():
+                    # stamp the full pre-create wait (admission queue +
+                    # lane queue) where the iteration span can pick it
+                    # up: the root opens inside fn, on this lane thread
+                    self._queue_wait[agent] = time.monotonic() - t_submit
+                    return fn(loop, epoch, worker)
 
-            fut = self._lane(worker).submit(task)
-            self._lane_task[agent] = fut
+                fut = self._lane(worker).submit(task)
+                self._lane_task[agent] = fut
+            lane_fut = self._lane_task.get(agent)
 
             def done(f: Future) -> None:
                 release()
-                if self._lane_task.get(agent) is fut:
+                if self._lane_task.get(agent) is lane_fut is f:
                     self._lane_task.pop(agent, None)
                 if handle.done():
                     return
@@ -685,6 +726,237 @@ class LoopScheduler:
         # ceiling (penalize=False is flow control, not sickness), so
         # --orphan-grace is the only bound on a queue that never drains
         self._orphan_since.pop(agent, None)
+
+    # ------------------------------------------------------------- workerd
+
+    def _workerd_live(self, worker_id: str) -> bool:
+        """True while the worker has a LIVE channel to its workerd --
+        exits stream, so run() skips WAN polls and waiters for it."""
+        return (self.executors is not None
+                and self.executors.for_worker(worker_id) is not None)
+
+    def _workerd_for(self, worker: Worker):
+        """The worker's live executor, or None (= direct path).
+        Worktree runs are always direct: the worktree mount is a
+        host-local path the worker-resident daemon cannot stage."""
+        if self.executors is None or self.spec.worktrees:
+            return None
+        return self.executors.for_worker(worker.id)
+
+    def _launch_env(self, loop: AgentLoop) -> dict[str, str]:
+        return {
+            "CLAWKER_LOOP_ID": self.loop_id,
+            "CLAWKER_LOOP_AGENT": loop.agent,
+            **({"CLAWKER_LOOP_PROMPT": self.spec.prompt}
+               if self.spec.prompt else {}),
+            **self.spec.env,
+        }
+
+    def _launch_opts_doc(self, loop: AgentLoop, worker: Worker,
+                         epoch: int) -> dict:
+        """The CreateOptions a launch intent carries -- the same fields
+        _create builds in-process (workerd constructs the CreateOptions
+        from this doc and runs the full create path locally)."""
+        return {
+            "agent": loop.agent, "image": self.spec.image,
+            "env": self._launch_env(loop), "tty": False,
+            "workspace_mode": self.spec.workspace_mode or "snapshot",
+            "worker": worker.id, "loop_id": self.loop_id,
+            "extra_labels": {consts.LABEL_LOOP_EPOCH: str(epoch)},
+            "replace": True,
+        }
+
+    def _state_doc(self, loop: AgentLoop) -> dict:
+        """The per-iteration context file, shipped in the intent so
+        workerd writes it locally (the direct path's
+        _write_iteration)."""
+        from ..agentd.protocol import b64
+
+        return {"dir": LOOP_STATE_DIR,
+                "tar": b64(self._iteration_state_tar(loop))}
+
+    def _workerd_dispatch(self, ex, loop: AgentLoop, epoch: int,
+                          worker: Worker, *, restart: bool) -> Future | None:
+        """Send one launch/restart intent over the worker's channel.
+        Returns the handle future the admission release rides, or None
+        to fall back to the direct lane (restart with no container --
+        the epoch moved under us)."""
+        self.seams.fire("workerd.pre_dispatch")
+        if restart:
+            with self._placement_lock:
+                if loop.epoch != epoch or self._stop.is_set():
+                    done: Future = Future()
+                    done.set_result(None)
+                    return done
+                cid = loop.container_id
+                fresh = loop.fresh_container
+            if not cid:
+                return None         # nothing to restart: direct path owns it
+            return ex.submit_start(loop, epoch, worker, cid=cid,
+                                   fresh=fresh, state=self._state_doc(loop))
+        # launch: create + first start.  Warm-pool checkout stays
+        # scheduler-side (bookkeeping); the engine-side adoption runs
+        # worker-resident, falling back to a cold create there.
+        self.seams.fire("launch.pre_create")
+        pool_cid = ""
+        pool_entry = None
+        if self.warmpool is not None and worker.engine is not None:
+            pool_entry = self.warmpool.checkout(worker.id, by=loop.agent,
+                                                epoch=epoch)
+            if pool_entry is not None:
+                pool_cid = pool_entry.cid
+        opts = self._launch_opts_doc(loop, worker, epoch)
+        if pool_entry is not None:
+            opts["extra_labels"][consts.LABEL_WARMPOOL] = pool_entry.agent
+        return ex.submit_launch(loop, epoch, worker, opts_doc=opts,
+                                state=self._state_doc(loop),
+                                pool_cid=pool_cid, pool_entry=pool_entry)
+
+    # --- event-stream accounting: these run on the executor's reader
+    # thread and write the SAME journal records, spans, and transitions
+    # the lane-thread path writes, on the same locks, in the same order.
+
+    def _workerd_created(self, loop: AgentLoop, epoch: int, worker: Worker,
+                         cid: str, pool_hit: bool, pool_error: str,
+                         pool_entry, ms: float) -> None:
+        if pool_entry is not None and not pool_hit:
+            # remote adoption failed and workerd cold-created instead:
+            # account the recycled member and discard its container
+            if self.warmpool is not None:
+                self.warmpool.adoption_failed(
+                    pool_entry, pool_error or "remote adoption failed")
+            threading.Thread(
+                target=self._remove_cid, args=(worker, pool_entry.cid),
+                daemon=True, name=f"workerd-recycle-{pool_entry.cid[:12]}",
+            ).start()
+        # durable before anything acts on the cid -- same contract as
+        # _create: a crash here re-finds the container by (deterministic
+        # name, journaled cid)
+        self._journal(REC_CREATED, durable=True, agent=loop.agent,
+                      worker=worker.id, epoch=epoch, cid=cid,
+                      pool=pool_hit)
+        self.seams.fire("launch.post_create")
+        with self._placement_lock:
+            if loop.epoch != epoch or self._stop.is_set():
+                # orphaned while the create was remote: leftover for
+                # the cleanup/ghost machinery, exactly like _create
+                loop.abandoned.append((worker, cid))
+                return
+            loop.container_id = cid
+            loop.fresh_container = True
+            self._begin_iter_span(loop, worker, epoch)
+        now = self.tracer.now()
+        self.tracer.child(loop.agent, loop.iteration, SPAN_CREATE,
+                          now - ms / 1000.0, now, worker=worker.id,
+                          pool=pool_hit, workerd=True)
+        self.on_event(loop.agent, "created", worker.id)
+
+    def _workerd_started(self, loop: AgentLoop, epoch: int, worker: Worker,
+                         ms: float) -> None:
+        with self._placement_lock:
+            if loop.epoch != epoch or self._stop.is_set():
+                return
+            if loop.status not in ("pending", "running"):
+                # a late started for a loop that already reached a
+                # terminal state must never resurrect it to "running"
+                return
+            self._begin_iter_span(loop, worker, epoch)   # idempotent
+            loop.fresh_container = False
+            loop.status = "running"
+            loop.strands = 0        # the placement genuinely works
+        self._journal(REC_STARTED, agent=loop.agent, worker=worker.id,
+                      epoch=epoch, iteration=loop.iteration)
+        self.seams.fire("launch.post_start")
+        now = self.tracer.now()
+        self.tracer.child(loop.agent, loop.iteration, SPAN_START,
+                          now - ms / 1000.0, now, worker=worker.id,
+                          workerd=True)
+        self._iter_started[(loop.agent, loop.iteration)] = now
+        self.on_event(loop.agent, "iteration_start", str(loop.iteration))
+
+    def _workerd_failed(self, loop: AgentLoop, epoch: int, worker: Worker,
+                        phase: str, error: str, *, driverish: bool,
+                        penalize: bool = True, pool_entry=None) -> None:
+        if pool_entry is not None:
+            # the checked-out pool member never got adopted (intent
+            # failed or expired): account the recycle and discard its
+            # container, exactly like the direct path's adoption-failed
+            # branch -- silent drops would drift pool depth accounting
+            if self.warmpool is not None:
+                self.warmpool.adoption_failed(
+                    pool_entry, f"workerd {phase}: {error}")
+            threading.Thread(
+                target=self._remove_cid, args=(worker, pool_entry.cid),
+                daemon=True,
+                name=f"workerd-recycle-{pool_entry.cid[:12]}").start()
+        if self._stop.is_set() or loop.epoch != epoch:
+            return
+        if driverish:
+            # the worker-side engine refused (daemon down there), or the
+            # channel itself died (penalize=False: workerd death is not
+            # engine sickness) -- either way the rescue pass re-places
+            self._strand(loop, epoch, f"workerd {phase}: {error}",
+                         penalize=penalize)
+            return
+        loop.status = "failed"
+        self._journal(REC_LOOP_END, agent=loop.agent, status="failed",
+                      reason=f"{phase}: {error}")
+        self.tracer.end_iteration(loop.agent, loop.iteration,
+                                  status="failed",
+                                  reason=f"{phase}: {error}")
+        self.on_event(loop.agent, f"{phase}_failed", error)
+        log.error("loop %s: workerd %s failed: %s", loop.agent, phase, error)
+
+    def _workerd_exited(self, agent: str, epoch: int, iteration: int,
+                        code, detail: str) -> None:
+        """Unsolicited exit from the worker-resident waiter: queued for
+        the run thread, which accounts it through the same
+        _finish_iteration path as a poll result."""
+        self._remote_exits.put((agent, epoch, iteration, code, detail))
+        self._wake.set()
+
+    def _workerd_running_view(self, worker_id: str) -> list[dict]:
+        """The iterations the scheduler has actually OBSERVED start on
+        ``worker_id`` -- what a post-partition resync asks workerd to
+        re-watch.  Gated on the open wait span (_iter_started), not
+        just status: a loop between iterations still reads "running"
+        while its restart is queued, and a view entry for it would make
+        workerd inspect the PREVIOUS iteration's exited container and
+        report a phantom exit for an iteration that never ran."""
+        view = []
+        for loop in list(self.loops):
+            if (loop.status == "running" and loop.container_id
+                    and loop.worker.id == worker_id
+                    and (loop.agent, loop.iteration) in self._iter_started):
+                view.append({"agent": loop.agent, "epoch": loop.epoch,
+                             "iteration": loop.iteration,
+                             "cid": loop.container_id})
+        return view
+
+    def _drain_remote_exits(self) -> list[tuple[AgentLoop, int | None, str]]:
+        """Remote exit events -> (loop, code, detail) rows, dropping
+        stale ones (superseded epoch, already-accounted iteration, or
+        an iteration the scheduler never observed START -- the dedup
+        that makes a post-partition resync's replayed exits idempotent
+        and phantom-proof)."""
+        out: list[tuple[AgentLoop, int | None, str]] = []
+        by_agent = {l.agent: l for l in self.loops}
+        while True:
+            try:
+                agent, epoch, iteration, code, detail = \
+                    self._remote_exits.get_nowait()
+            except queue.Empty:
+                return out
+            loop = by_agent.get(agent)
+            if (loop is None or loop.epoch != epoch
+                    or loop.status != "running"
+                    or loop.iteration != iteration
+                    or (agent, iteration) not in self._iter_started):
+                continue
+            if code is None and not detail:
+                detail = "exit unreadable"
+            out.append((loop, int(code) if code is not None else None,
+                        detail))
 
     # ------------------------------------------------------------ warm pool
 
@@ -755,8 +1027,18 @@ class LoopScheduler:
             wp.fill_done(worker, pool_agent, None, "cancelled")
 
         def dispatch(release) -> None:
-            fut = self._lane(worker).submit(
-                self._pool_fill, worker, pool_agent)
+            # workerd seam: refill creates execute worker-resident too
+            # (the `create` intent), so a pool fill costs one batched
+            # WAN crossing instead of the whole create call chain
+            remote_fill = (self._workerd_for(worker)
+                           if not (self._stop.is_set() or wp.draining)
+                           else None)
+            if remote_fill is not None:
+                fut = remote_fill.submit_pool_fill(
+                    pool_agent, self._pool_opts_doc(worker, pool_agent))
+            else:
+                fut = self._lane(worker).submit(
+                    self._pool_fill, worker, pool_agent)
 
             def done(f: Future) -> None:
                 release()
@@ -766,6 +1048,8 @@ class LoopScheduler:
                     log.info("pool refill on %s failed: %s", worker.id, exc)
                     return
                 cid = f.result()
+                if remote_fill is not None and cid:
+                    self.seams.fire("pool.post_fill")
                 if cid is None:
                     wp.fill_done(worker, pool_agent, None, "skipped")
                 elif not wp.fill_done(worker, pool_agent, cid):
@@ -777,12 +1061,32 @@ class LoopScheduler:
 
             fut.add_done_callback(done)
 
+        self._placed_workers.add(worker.id)
         st = self.admission.submit(worker.id, wp.tenant, dispatch,
                                    cancelled=cancelled, on_cancel=on_cancel)
         if st == ADMISSION_REJECTED:
             wp.fill_done(worker, pool_agent, None, "admission rejected")
             return False
         return True
+
+    def _pool_opts_doc(self, worker: Worker, pool_agent: str) -> dict:
+        """The create doc a remote pool-fill intent carries (mirrors
+        _pool_fill's CreateOptions)."""
+        env = {
+            "CLAWKER_LOOP_ID": self.loop_id,
+            **({"CLAWKER_LOOP_PROMPT": self.spec.prompt}
+               if self.spec.prompt else {}),
+            **self.spec.env,
+        }
+        return {
+            "agent": pool_agent, "image": self.spec.image, "env": env,
+            "tty": False,
+            "workspace_mode": self.spec.workspace_mode or "snapshot",
+            "worker": worker.id, "loop_id": self.loop_id,
+            "extra_labels": {consts.LABEL_LOOP_EPOCH: consts.POOL_EPOCH,
+                             consts.LABEL_WARMPOOL: pool_agent},
+            "replace": True,
+        }
 
     def _pool_fill(self, worker: Worker, pool_agent: str) -> str | None:
         """Create one pool member (the expensive create-time stages) on
@@ -943,7 +1247,7 @@ class LoopScheduler:
                orphan_grace_s: float | None = None,
                telemetry: bool = True,
                admission: AdmissionController | None = None,
-               seams=None) -> "LoopScheduler":
+               seams=None, executors=None) -> "LoopScheduler":
         """Rebuild a scheduler from a replayed run journal.
 
         The journal is the authority for the run's SHAPE (slot count,
@@ -982,7 +1286,7 @@ class LoopScheduler:
         )
         sched = cls(cfg, driver, spec, on_event=on_event,
                     health_config=health_config, run_id=image.run_id,
-                    admission=admission, seams=seams)
+                    admission=admission, seams=seams, executors=executors)
         sched._image = image
         sched._build_resumed_loops(image)
         sched._journal(REC_RESUME, durable=True,
@@ -1252,6 +1556,12 @@ class LoopScheduler:
             done: Future = Future()
             done.set_result(None)
             self._inflight[loop.agent] = done
+            ex = self._workerd_for(worker)
+            if ex is not None:
+                # the adopted iteration's exit streams from a
+                # worker-resident waiter; run() will skip WAN polls for
+                # this worker while the channel is live
+                ex.submit_adopt(loop, epoch)
             self.on_event(loop.agent, "adopted", f"{worker.id}:{cid[:12]}")
             with lock:
                 summary["adopted"] += 1
@@ -1463,8 +1773,7 @@ class LoopScheduler:
 
     # ----------------------------------------------------------- iteration
 
-    def _write_iteration(self, loop: AgentLoop, engine, cid: str) -> None:
-        """Per-iteration context file (env can't change after create)."""
+    def _iteration_state_tar(self, loop: AgentLoop) -> bytes:
         body = (f"loop_id={self.loop_id}\nagent={loop.agent}\n"
                 f"iteration={loop.iteration}\n").encode()
         buf = io.BytesIO()
@@ -1472,7 +1781,12 @@ class LoopScheduler:
             ti = tarfile.TarInfo("loop-state")
             ti.size = len(body)
             tf.addfile(ti, io.BytesIO(body))
-        engine.put_archive(cid, LOOP_STATE_DIR, buf.getvalue())
+        return buf.getvalue()
+
+    def _write_iteration(self, loop: AgentLoop, engine, cid: str) -> None:
+        """Per-iteration context file (env can't change after create)."""
+        engine.put_archive(cid, LOOP_STATE_DIR,
+                           self._iteration_state_tar(loop))
 
     def _start_iteration(self, loop: AgentLoop, worker: Worker,
                          epoch: int) -> None:
@@ -1483,6 +1797,12 @@ class LoopScheduler:
         # write) the new placement's container_id / fresh_container
         with self._placement_lock:
             if loop.epoch != epoch:
+                return
+            if loop.status not in ("pending", "running"):
+                # stale restart racing a terminal transition (e.g. an
+                # exit accounted through another path while this task
+                # was queued): a done/failed loop must never start
+                # another iteration
                 return
             cid = loop.container_id
             fresh = loop.fresh_container
@@ -1528,6 +1848,15 @@ class LoopScheduler:
         # the wait span opens here and closes when the poll accounts the
         # exit -- the container-executing phase of the iteration
         self._iter_started[(loop.agent, loop.iteration)] = now
+        # mixed-path window (docs/workerd.md): this start ran DIRECT
+        # (channel was down at submit) but the channel may be live
+        # again -- and a live channel suppresses WAN polls/waiters for
+        # this worker.  Hand workerd the exit watch so the iteration's
+        # end is observed whichever path the launch took; the adopt
+        # intent is idempotent server-side.
+        ex = self._workerd_for(worker)
+        if ex is not None:
+            ex.submit_adopt(loop, epoch)
         self.on_event(loop.agent, "iteration_start", str(loop.iteration))
 
     def _guarded_start(self, loop: AgentLoop, epoch: int,
@@ -1807,6 +2136,11 @@ class LoopScheduler:
                             and self._inflight[l.agent].done()]
                 by_worker: dict[str, list[AgentLoop]] = {}
                 for l in pollable:
+                    if self._workerd_live(l.worker.id):
+                        # exits stream over the workerd channel: no WAN
+                        # waiter, no WAN poll.  A degraded channel drops
+                        # the worker back into this table next tick.
+                        continue
                     self._spawn_waiter(l)
                     by_worker.setdefault(l.worker.id, []).append(l)
                 now = time.monotonic()
@@ -1879,7 +2213,11 @@ class LoopScheduler:
                     polls[wid] = fut
                     poll_epochs[wid] = {l.agent: l.epoch for l in group}
                     next_poll_at[wid] = now + poll_s
-                finished: list[tuple[AgentLoop, int | None, str]] = []
+                # workerd-streamed exits first: already deduped against
+                # stale epochs/iterations, accounted through the same
+                # block as poll results below
+                finished: list[tuple[AgentLoop, int | None, str]] = \
+                    self._drain_remote_exits()
                 for wid in list(polls):
                     fut = polls[wid]
                     if not fut.done():
@@ -2331,6 +2669,12 @@ class LoopScheduler:
             # die at require_engine before its guarded list call.
             journaled = (set(self._image.workers)
                          if self._image is not None else set())
+            # every worker a launch/refill was ever SUBMITTED to joins
+            # the journaled set: a remote create whose `created` event
+            # died with its workerd (after the loop migrated away)
+            # leaves a labeled container no final placement or
+            # abandoned entry points at
+            journaled |= self._placed_workers
             sweep_workers: dict[str, Worker] = {
                 w.id: w for w in self.driver.workers()
                 if w.engine is not None and w.id in journaled}

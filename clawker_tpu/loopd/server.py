@@ -738,6 +738,17 @@ class LoopdServer:
                     best[row["worker"]] = row
         return [best[w] for w in sorted(best)]
 
+    def _workerd_rows(self) -> dict:
+        """Per-worker workerd liveness for the status RPC: `fleet
+        health` renders it so a worker silently degraded to the WAN
+        launch path is visible instead of just slow (docs/workerd.md)."""
+        from ..workerd import liveness
+
+        try:
+            return liveness(self.cfg, self.driver)
+        except Exception:       # noqa: BLE001 -- a probe failure must
+            return {}           # never break the status RPC
+
     def _status_doc(self) -> dict:
         with self._runs_lock:
             runs = [r.status_doc() for r in self.runs.values()]
@@ -757,6 +768,7 @@ class LoopdServer:
             "runs": runs,
             "admission": self.admission.stats(),
             "health": self._health_stats(),
+            "workerd": self._workerd_rows(),
             "warm_pools": pools,
             "sentinel": (self.sentinel.status_doc()
                          if self.sentinel is not None

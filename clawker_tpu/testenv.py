@@ -2,6 +2,25 @@
 
 Parity reference: internal/testenv -- isolated XDG dirs wired through env
 overrides so tests never touch the real user config (SURVEY.md 4).
+
+Fake-WAN harness (docs/workerd.md#fake-wan): any bench or test can
+simulate host<->worker WAN latency deterministically by injecting a
+per-call RTT at the transport seams --
+
+- ``FakeDriver.set_rtt(index, rtt_s)`` / ``set_rtt_all(rtt_s)``: every
+  REMOTE engine call against that fake worker sleeps ``rtt_s`` before
+  executing (the fault gate's ``rtt_s`` knob).  The worker-resident
+  view (``FakeDriver.local_engine(i)``, what an in-process
+  :class:`~clawker_tpu.workerd.server.WorkerdServer` serves) pays
+  injected faults but never the rtt -- locality is the whole point.
+- ``SSHTransport.rtt_s``: the same knob for real transports -- every
+  mux command pays it, so a localhost ssh target behaves like a
+  cross-continent worker.
+- ``WorkerdExecutor.rtt_s``: one-way propagation per intent/event
+  FRAME on the workerd channel (rtt/2 each direction), modelling the
+  single persistent connection the data plane rides.
+
+Use :func:`inject_wan_rtt` to set all of a driver's workers at once.
 """
 
 from __future__ import annotations
@@ -80,6 +99,22 @@ class TestEnv(contextlib.AbstractContextManager):
             if local is not None:
                 (root / ".clawker.local.yaml").write_text(local)
         return main
+
+
+def inject_wan_rtt(driver, rtt_s: float) -> None:
+    """Inject a deterministic per-call host<->worker WAN round trip on
+    every worker of ``driver`` (see the module docstring).  Works on
+    any driver exposing ``set_rtt_all`` (FakeDriver) or per-worker
+    engine transports (tpu_vm); silently no-ops elsewhere -- tests can
+    call it unconditionally."""
+    set_all = getattr(driver, "set_rtt_all", None)
+    if callable(set_all):
+        set_all(rtt_s)
+        return
+    for w in driver.workers():
+        transport = getattr(getattr(w, "engine", None), "transport", None)
+        if transport is not None:
+            transport.rtt_s = max(0.0, float(rtt_s))
 
 
 class StubDockerDaemon:

@@ -1,0 +1,55 @@
+"""``python -m clawker_tpu.workerd``: run the workerd daemon.
+
+Run ON the worker host whose engine it should serve (``clawker workerd
+start`` forks this detached).  The config loads from the cwd -- workerd
+is project-scoped like loopd: container names and labels key on the
+project.  The engine comes from the settings runtime driver's default
+worker (override with ``CLAWKER_TPU_WORKERD_DRIVER``, e.g. ``local``
+when the provisioned worker settings still name ``tpu_vm``)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+from pathlib import Path
+
+from .. import logsetup
+from ..config import load_config
+from ..engine.drivers import get_driver
+from .server import WorkerdServer
+
+
+def main() -> int:
+    cfg = load_config(Path.cwd())
+    logsetup.setup(os.environ.get("CLAWKER_TPU_WORKERD_LOG", "info"))
+    override = os.environ.get("CLAWKER_TPU_WORKERD_DRIVER", "")
+    driver = get_driver(cfg.settings, override=override)
+    workers = driver.connect()
+    worker = workers[0] if workers else None
+    if worker is None or worker.engine is None:
+        print("workerd: no local engine to serve", file=sys.stderr)
+        return 1
+    server = WorkerdServer(cfg, worker.engine, worker_id=worker.id,
+                           driver=driver)
+    server.start()
+    stop = threading.Event()
+
+    def on_term(signum, frame):
+        server.stop()
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    try:
+        while not stop.is_set() and not server._stop.is_set():
+            stop.wait(0.5)
+    finally:
+        server.stop()
+        driver.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,6 +41,13 @@ import pytest  # noqa: E402
 from clawker_tpu.testenv import TestEnv
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running acceptance shapes excluded from the tier-1 "
+        "`-m 'not slow'` run (the bench suite covers them)")
+
+
 @pytest.fixture()
 def tenv():
     with TestEnv() as env:
