@@ -4,7 +4,7 @@
 PY ?= python
 
 .PHONY: all test test-fast test-e2e parity bench bench-smoke chaos-smoke \
-        native ebpf-check docs docs-check adversarial graft clean
+        analyze native ebpf-check docs docs-check adversarial graft clean
 
 all: native test
 
@@ -38,6 +38,12 @@ bench-smoke:
 # regression check for scheduler/journal/admission/warm-pool changes.
 chaos-smoke:
 	timeout -k 10 420 $(PY) scripts/bench_smoke.py --only chaos
+
+# Static architectural-invariant checks (docs/static-analysis.md):
+# pure-stdlib, <5s, exit 2 on any finding not in the committed
+# grandfather baseline.  Also rides bench-smoke and a tier-1 test.
+analyze:
+	$(PY) -m clawker_tpu.analysis
 
 native:
 	$(MAKE) -C native

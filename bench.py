@@ -1114,8 +1114,17 @@ def bench_chaos_soak(scenarios: int = CHAOS_SOAK_SCENARIOS,
     -- any failure is a one-command deterministic repro.  The soak ends
     with the sentinel observe-only twin check (docs/analytics-online.md)."""
     from clawker_tpu.chaos.runner import run_soak
+    from clawker_tpu.testenv import lock_tracing
 
-    report = run_soak(scenarios, seed, shrink=True, keep_going=False)
+    # the lock-order tracer rides the soak (docs/static-analysis.md#
+    # lock-order-tracer): 25 compound-fault scenarios exercise every
+    # scheduler/journal/admission/pool lock from many threads, so a
+    # cycle-free acquisition graph here is the deadlock-freedom gate
+    with lock_tracing() as graph:
+        report = run_soak(scenarios, seed, shrink=True, keep_going=False)
+    cycles = graph.cycles()
+    if cycles:
+        print(graph.render_cycles())
     return {
         "scenarios": report["scenarios"],
         "passed": report["passed"],
@@ -1124,7 +1133,10 @@ def bench_chaos_soak(scenarios: int = CHAOS_SOAK_SCENARIOS,
         "injected": report["injected"],
         "wall_s": report["wall_s"],
         "observe_only": report.get("observe_only"),
-        "ok": report["ok"],
+        "lockgraph": {"acquires": graph.acquires,
+                      "edges": graph.report()["edges"],
+                      "cycles": len(cycles)},
+        "ok": report["ok"] and not cycles,
         "failures": [
             {"scenario": f["scenario"], "violations": f["violations"],
              "repro": f["repro"],

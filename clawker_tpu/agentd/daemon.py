@@ -385,6 +385,13 @@ class Agentd:
                 else:
                     child_env = dict(os.environ)
                     child_env.update(env)
+                    # grandfathered no-blocking-under-lock finding
+                    # (analysis-baseline.json): the spawn-exactly-once CAS
+                    # must be atomic with _cmd_running, and this in-container
+                    # daemon serves ONE session connection -- nothing
+                    # contends _cmd_lock while the fork runs.  Splitting the
+                    # CAS to move Popen out would trade a real double-spawn
+                    # hazard for a theoretical stall.
                     self._direct_child = subprocess.Popen(
                         argv,
                         env=child_env,

@@ -1,0 +1,125 @@
+"""Checker: seam and metric name registries must stay in sync.
+
+Two registries keep string-keyed surfaces honest:
+
+- chaos/seams.py ``SEAM_NAMES``: every ``seams.fire("...")`` site must
+  name a registered seam (``arm`` validates at runtime, ``fire`` does
+  NOT -- a typo'd fire site silently never fires), and every registered
+  seam must have at least one fire site (a seam nothing fires is dead
+  coverage the chaos plan generator still draws).
+
+- docs/telemetry.md's registry table: every metric registered via
+  ``telemetry.counter/gauge/histogram("name", ...)`` must have a
+  ``| `name` |`` row, and every documented name must still be
+  registered somewhere (documented-but-never-emitted names rot the
+  operator docs the monitor stack dashboards are built from).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Checker, Finding, RepoContext, SourceFile, register_checker
+from ._util import call_tail, first_str_arg, receiver
+
+SEAMS_FILE = "clawker_tpu/chaos/seams.py"
+TELEMETRY_DOC = "docs/telemetry.md"
+
+_DOC_ROW_RE = re.compile(r"^\|\s*`([a-z][a-z0-9_]+)`\s*\|", re.MULTILINE)
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+_METRIC_RECEIVERS = {"telemetry", "REGISTRY"}
+
+
+def _seam_names(ctx: RepoContext) -> tuple[set[str], int] | None:
+    """SEAM_NAMES parsed from the registry module's AST, with the
+    tuple's line; None when the fixture repo has no seam registry."""
+    src = ctx.source(SEAMS_FILE)
+    if src is None or src.tree is None:
+        return None
+    for n in ast.walk(src.tree):
+        if isinstance(n, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "SEAM_NAMES"
+                for t in n.targets):
+            if isinstance(n.value, (ast.Tuple, ast.List)):
+                names = {e.value for e in n.value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str)}
+                return names, n.lineno
+    return None
+
+
+@register_checker
+class RegistryParityChecker(Checker):
+    id = "registry-parity"
+    doc = ("every fired seam name must be registered in chaos/seams.py "
+           "(and every seam fired somewhere); every registered metric "
+           "must have a docs/telemetry.md row (and vice versa)")
+
+    def __init__(self):
+        self._fired: dict[str, tuple[str, int]] = {}
+        self._metrics: dict[str, tuple[str, int]] = {}
+
+    def interested(self, rel: str) -> bool:
+        return True
+
+    def check(self, src: SourceFile, ctx: RepoContext) -> list[Finding]:
+        assert src.tree is not None
+        if src.rel == SEAMS_FILE:
+            return []
+        for c in ast.walk(src.tree):
+            if not isinstance(c, ast.Call):
+                continue
+            tail = call_tail(c)
+            if tail == "fire" and receiver(c) in {"seams", "self"} \
+                    or tail == "_fire_seam":
+                name = first_str_arg(c)
+                if name and "." in name:
+                    self._fired.setdefault(name, (src.rel, c.lineno))
+            elif tail in _METRIC_FACTORIES \
+                    and receiver(c) in _METRIC_RECEIVERS:
+                name = first_str_arg(c)
+                if name:
+                    self._metrics.setdefault(name, (src.rel, c.lineno))
+        return []
+
+    def finish(self, ctx: RepoContext) -> list[Finding]:
+        findings: list[Finding] = []
+        fired, self._fired = self._fired, {}
+        metrics, self._metrics = self._metrics, {}
+
+        seams = _seam_names(ctx)
+        if seams is not None:
+            registered, reg_line = seams
+            for name, (rel, line) in sorted(fired.items()):
+                if name not in registered:
+                    findings.append(Finding(
+                        checker=self.id, path=rel, line=line,
+                        message=(f"seam `{name}` is fired but not "
+                                 f"registered in chaos/seams.py SEAM_NAMES "
+                                 f"-- fire() does not validate, this site "
+                                 f"is silently dead")))
+            for name in sorted(registered - set(fired)):
+                findings.append(Finding(
+                    checker=self.id, path=SEAMS_FILE, line=reg_line,
+                    message=(f"seam `{name}` is registered in SEAM_NAMES "
+                             f"but nothing fires it -- the chaos plan "
+                             f"generator still draws it as dead coverage")))
+
+        doc = ctx.read_text(TELEMETRY_DOC)
+        if doc is not None and metrics:
+            documented = set(_DOC_ROW_RE.findall(doc))
+            for name, (rel, line) in sorted(metrics.items()):
+                if name not in documented:
+                    findings.append(Finding(
+                        checker=self.id, path=rel, line=line,
+                        message=(f"metric `{name}` is registered but has "
+                                 f"no row in docs/telemetry.md's registry "
+                                 f"table")))
+            for name in sorted(documented - set(metrics)):
+                findings.append(Finding(
+                    checker=self.id, path=TELEMETRY_DOC, line=1,
+                    message=(f"metric `{name}` is documented in "
+                             f"docs/telemetry.md but never registered -- "
+                             f"documented-but-never-emitted")))
+        return findings

@@ -129,6 +129,7 @@ def main(argv: list[str] | None = None) -> int:
 def register_commands() -> None:
     """Attach all command groups (import-cycle-free late binding)."""
     from . import (
+        cmd_analyze,
         cmd_build,
         cmd_bundle,
         cmd_chaos,
@@ -150,6 +151,7 @@ def register_commands() -> None:
         cmd_workerd,
     )
 
+    cmd_analyze.register(cli)
     cmd_build.register(cli)
     cmd_bundle.register(cli)
     cmd_chaos.register(cli)

@@ -346,15 +346,17 @@ class SSHTransport:
             time.sleep(0.2)
         with self._lock:
             self._rev_tags.discard(key)
-            # reap the dead/stale tunnel so a retry doesn't lose the bind
-            # race against a leaked first attempt
-            try:
-                proc.terminate()
-                proc.wait(timeout=3)
-            except Exception:
-                pass
             if proc in self._forwards:
                 self._forwards.remove(proc)
+        # reap the dead/stale tunnel so a retry doesn't lose the bind
+        # race against a leaked first attempt -- outside the lock: the
+        # wait can take seconds and every other transport caller
+        # contends this lock
+        try:
+            proc.terminate()
+            proc.wait(timeout=3)
+        except Exception:
+            pass
         raise TransportError(
             f"worker {self.index}: reverse forward {remote_bind}:{remote_port}"
             f" -> {local_host}:{local_port} did not come up"
@@ -383,15 +385,18 @@ class SSHTransport:
             return False
 
     def close(self) -> None:
+        # snapshot under the lock, reap outside it: each wait can take
+        # up to 3s per tunnel, and holding the lock through that wedges
+        # every concurrent run()/forward caller
         with self._lock:
-            for p in self._forwards:
-                try:
-                    p.terminate()
-                    p.wait(timeout=3)
-                except Exception:
-                    pass
-            self._forwards.clear()
+            procs, self._forwards = list(self._forwards), []
             self._rev_tags.clear()
+        for p in procs:
+            try:
+                p.terminate()
+                p.wait(timeout=3)
+            except Exception:
+                pass
 
 
 def connect_worker_engine(tpu: TPUSettings, host: str, index: int,

@@ -1105,6 +1105,9 @@ class LoopScheduler:
                if self.spec.prompt else {}),
             **self.spec.env,
         }
+        # analyze: allow(wal-before-mutation): REC_POOL_ADD is journaled
+        # durable in warmpool.begin_refill BEFORE this fill is submitted
+        # to the lane -- the WAL lives one hop up the flow
         cid = rt.create(CreateOptions(
             agent=pool_agent,
             image=self.spec.image,
@@ -1785,6 +1788,9 @@ class LoopScheduler:
 
     def _write_iteration(self, loop: AgentLoop, engine, cid: str) -> None:
         """Per-iteration context file (env can't change after create)."""
+        # analyze: allow(wal-before-mutation): advisory state file into an
+        # already-journaled cid (REC_CREATED durable at create); callers
+        # tolerate its loss, so there is nothing for a resume to replay
         engine.put_archive(cid, LOOP_STATE_DIR,
                            self._iteration_state_tar(loop))
 

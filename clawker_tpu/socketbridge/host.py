@@ -189,6 +189,7 @@ class SocketBridgeManager:
                              else default_host_sockets())
         self._bridges: dict[str, Bridge] = {}
         self._lock = threading.Lock()
+        self._closed = False
 
     def ensure_bridge(self, container_ref: str) -> Bridge | None:
         if not self.host_sockets:
@@ -198,18 +199,37 @@ class SocketBridgeManager:
             existing = self._bridges.get(container_ref)
             if existing is not None and not existing.closed.is_set():
                 return existing
-            _eid, stream = self.engine.exec(
-                container_ref, CONTAINER_CMD, stdin=True, tty=False,
-            )
-            if stream is None:
-                raise ClawkerError(
-                    f"socketbridge: exec into {container_ref} gave no stream")
-            bridge = Bridge(_RawStream(stream), self.host_sockets)
-            bridge.start()
-            self._bridges[container_ref] = bridge
-            log.info("socket bridge up for %s (%s)", container_ref,
-                     ",".join(str(w) for w in self.host_sockets))
-            return bridge
+        # the exec is an engine round-trip (and on tpu_vm a WAN hop):
+        # doing it under the lock coupled every other caller -- and
+        # close() -- to the daemon's latency.  Dial outside, then
+        # settle the install race under the lock; the loser's bridge
+        # (and its exec stream) is closed, the winner is shared.
+        _eid, stream = self.engine.exec(
+            container_ref, CONTAINER_CMD, stdin=True, tty=False,
+        )
+        if stream is None:
+            raise ClawkerError(
+                f"socketbridge: exec into {container_ref} gave no stream")
+        bridge = Bridge(_RawStream(stream), self.host_sockets)
+        bridge.start()
+        with self._lock:
+            if self._closed:
+                # manager torn down while our exec was in flight: a
+                # bridge installed now would outlive every close()
+                winner, loser = None, bridge
+            else:
+                existing = self._bridges.get(container_ref)
+                if existing is not None and not existing.closed.is_set():
+                    winner, loser = existing, bridge
+                else:
+                    self._bridges[container_ref] = bridge
+                    winner, loser = bridge, None
+        if loser is not None:
+            loser.close()
+            return winner
+        log.info("socket bridge up for %s (%s)", container_ref,
+                 ",".join(str(w) for w in self.host_sockets))
+        return winner
 
     def drop_bridge(self, container_ref: str) -> None:
         with self._lock:
@@ -219,6 +239,7 @@ class SocketBridgeManager:
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             bridges, self._bridges = list(self._bridges.values()), {}
         for b in bridges:
             b.close()

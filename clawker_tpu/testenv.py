@@ -117,6 +117,30 @@ def inject_wan_rtt(driver, rtt_s: float) -> None:
             transport.rtt_s = max(0.0, float(rtt_s))
 
 
+@contextlib.contextmanager
+def lock_tracing():
+    """Opt-in lock-order tracing for a test or bench block
+    (docs/static-analysis.md#lock-order-tracer): every
+    ``threading.Lock``/``RLock`` created inside the block feeds a
+    :class:`~clawker_tpu.analysis.lockgraph.LockGraph`; yields the
+    graph so the caller can assert ``graph.cycles() == []`` (the
+    deadlock-freedom check the chaos soak gates on).
+
+        with testenv.lock_tracing() as graph:
+            ... run the workload ...
+        assert not graph.cycles(), graph.render_cycles()
+
+    The suite-wide hook is ``CLAWKER_TPU_LOCKGRAPH=1`` (tests/conftest
+    installs at session start and fails the session on cycles)."""
+    from .analysis.lockgraph import install_lock_tracing, uninstall_lock_tracing
+
+    graph = install_lock_tracing()
+    try:
+        yield graph
+    finally:
+        uninstall_lock_tracing()
+
+
 class StubDockerDaemon:
     """Minimal keep-alive HTTP daemon over a unix socket (test/bench
     support for the engine client's connection pool).

@@ -508,12 +508,19 @@ class WorkerdServer:
         try:
             if pool_cid:
                 try:
+                    # analyze: allow(wal-before-mutation): workerd executes
+                    # intents the scheduler journaled write-ahead
+                    # (REC_PLACEMENT durable before dispatch, the
+                    # workerd.pre_dispatch seam) -- the WAL lives on the
+                    # control-plane side of the channel
                     rt.adopt_pooled(pool_cid, opts)
                     cid = pool_cid
                     pool_hit = True
                 except ClawkerError as e:
                     pool_error = str(e)     # cold-create fallback below
             if not cid:
+                # analyze: allow(wal-before-mutation): intent WAL'd by the
+                # dispatching scheduler (see above)
                 cid = rt.create(opts)
         except ClawkerError as e:
             self._emit({"ev": "failed", "seq": seq, "phase": "create",
@@ -543,14 +550,21 @@ class WorkerdServer:
                 # the per-iteration context file (scheduler's
                 # _write_iteration): advisory, never fatal
                 try:
+                    # analyze: allow(wal-before-mutation): advisory write
+                    # into a cid whose REC_CREATED the scheduler already
+                    # journaled
                     self.engine.put_archive(
                         cid, str(state_doc.get("dir", "/run/clawker")),
                         protocol.unb64(str(state_doc.get("tar", ""))))
                 except ClawkerError:
                     pass
             if fresh:
+                # analyze: allow(wal-before-mutation): start intents are
+                # WAL'd scheduler-side before dispatch (docs/workerd.md)
                 rt.start(cid)
             else:
+                # analyze: allow(wal-before-mutation): same contract as
+                # the fresh branch above
                 self.engine.start_container(cid)
                 if rt.post_start:
                     rt.post_start(cid)
@@ -569,6 +583,9 @@ class WorkerdServer:
         rt = self._runtime()
         t0 = time.monotonic()
         try:
+            # analyze: allow(wal-before-mutation): pool-fill intents carry
+            # a durable REC_POOL_ADD journaled by warmpool.begin_refill
+            # before dispatch (docs/loop-warmpool.md)
             cid = rt.create(opts)
         except ClawkerError as e:
             self._emit({"ev": "failed", "seq": seq, "phase": "create",

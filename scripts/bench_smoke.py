@@ -92,6 +92,12 @@ DIALS_MIN_REDUCTION = 2.0
 def _gate_chaos(chaos: dict, failures: list[str]) -> None:
     from bench import CHAOS_SOAK_BUDGET_S
 
+    if chaos.get("lockgraph", {}).get("cycles"):
+        failures.append(
+            f"chaos_soak: lock-order tracer found "
+            f"{chaos['lockgraph']['cycles']} acquisition-graph cycle(s) "
+            f"(potential deadlock; stacks above, "
+            f"docs/static-analysis.md#lock-order-tracer)")
     if not chaos["ok"]:
         for f in chaos["failures"]:
             failures.append(
@@ -105,6 +111,24 @@ def _gate_chaos(chaos: dict, failures: list[str]) -> None:
     elif chaos["wall_s"] > CHAOS_SOAK_BUDGET_S:
         failures.append(
             f"chaos_soak {chaos['wall_s']}s > {CHAOS_SOAK_BUDGET_S}s budget")
+
+
+def _gate_analyze(failures: list[str]) -> dict:
+    """`clawker analyze` as a bench-smoke gate: a NEW un-baselined
+    static-analysis finding fails the suite exactly like a perf
+    regression (docs/static-analysis.md#ci)."""
+    from clawker_tpu.analysis import Baseline, run_analysis
+
+    root = Path(__file__).resolve().parents[1]
+    report = run_analysis(root, baseline=Baseline.load(
+        root / "analysis-baseline.json"))
+    for f in report.new:
+        failures.append(f"analyze: NEW finding {f.render()}")
+    return {"ok": not report.new, "files": report.files_scanned,
+            "new": len(report.new),
+            "grandfathered": len(report.grandfathered),
+            "suppressed": len(report.suppressed),
+            "wall_s": round(report.wall_s, 2)}
 
 
 def chaos_only() -> int:
@@ -397,6 +421,7 @@ def main() -> int:
             f"anomaly_fleet_score_tick {score_tick['tick_p50_s']}s > "
             f"{ANOMALY_TICK_BUDGET_S}s budget (one sharded tick)")
     _gate_chaos(chaos, failures)
+    analyze = _gate_analyze(failures)
     if not parity["skipped"]:
         if parity["passed"] != parity["total"]:
             failures.append(
@@ -427,6 +452,7 @@ def main() -> int:
         "anomaly_flag_latency_p50": flag_lat,
         "anomaly_fleet_score_tick": score_tick,
         "chaos_soak": chaos,
+        "analyze": analyze,
         "parity_suite_wall": parity,
         "ok": not failures,
         "failures": failures,

@@ -46,6 +46,31 @@ def pytest_configure(config):
         "markers",
         "slow: long-running acceptance shapes excluded from the tier-1 "
         "`-m 'not slow'` run (the bench suite covers them)")
+    # Opt-in lock-order tracing (docs/static-analysis.md#lock-order-
+    # tracer): CLAWKER_TPU_LOCKGRAPH=1 wraps every Lock/RLock the suite
+    # creates and fails the session on an acquisition-graph cycle
+    # (potential deadlock), with both acquisition stacks in the report.
+    if os.environ.get("CLAWKER_TPU_LOCKGRAPH"):
+        from clawker_tpu.analysis.lockgraph import install_lock_tracing
+
+        config._lockgraph = install_lock_tracing()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    graph = getattr(session.config, "_lockgraph", None)
+    if graph is None:
+        return
+    from clawker_tpu.analysis.lockgraph import uninstall_lock_tracing
+
+    uninstall_lock_tracing()
+    cycles = graph.cycles()
+    if cycles:
+        print("\nlockgraph: POTENTIAL DEADLOCK(S) over the test suite:")
+        print(graph.render_cycles())
+        session.exitstatus = 3
+    else:
+        print(f"\nlockgraph: cycle-free ({graph.acquires} acquires, "
+              f"{graph.report()['edges']} cross-site edges)")
 
 
 @pytest.fixture()

@@ -1,0 +1,799 @@
+"""Static analyzer + lock-order tracer tests (docs/static-analysis.md).
+
+Every checker is proven LIVE twice: it fires on a seeded bad fixture
+and stays silent on the repaired twin -- a checker that cannot fire is
+dead CI weight, and one that fires on good code is a gate nobody
+trusts.  Fixture repos mirror the real relative paths because checker
+scoping is path-based.
+
+Plus: baseline add/expire round-trip, allow-comment suppression, the
+lockgraph AB/BA deadlock repro, CLI exit codes, the pure-stdlib import
+contract, and the repo-clean gate (the analyzer run that makes a new
+un-baselined finding fail tier-1).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+import threading
+from pathlib import Path
+
+import pytest
+
+from clawker_tpu.analysis import Baseline, run_analysis
+from clawker_tpu.analysis.lockgraph import (
+    LockGraph,
+    install_lock_tracing,
+    uninstall_lock_tracing,
+)
+from clawker_tpu.analysis.runner import main as analyze_main
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def make_repo(tmp_path: Path, files: dict[str, str]) -> Path:
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return tmp_path
+
+
+def findings_of(root: Path, checker: str):
+    return run_analysis(root, only={checker}).findings
+
+
+# ------------------------------------------------------- wal checker
+
+WAL_BAD = {
+    "clawker_tpu/loop/scheduler.py": """
+    class S:
+        def _create(self, engine, opts):
+            cid = engine.create_container(opts)
+            return cid
+    """,
+}
+
+WAL_GOOD = {
+    "clawker_tpu/loop/scheduler.py": """
+    class S:
+        def _create(self, engine, opts):
+            self._journal("placement", durable=True)
+            cid = engine.create_container(opts)
+            return cid
+
+        def _start(self, engine, cid):
+            self.seams.fire("launch.pre_start")
+            engine.start_container(cid)
+    """,
+}
+
+
+def test_wal_checker_fires_on_unjournaled_mutation(tmp_path):
+    found = findings_of(make_repo(tmp_path, WAL_BAD), "wal-before-mutation")
+    assert len(found) == 1
+    assert "create_container" in found[0].message
+    assert found[0].path == "clawker_tpu/loop/scheduler.py"
+
+
+def test_wal_checker_silent_on_journaled_twin(tmp_path):
+    assert findings_of(make_repo(tmp_path, WAL_GOOD),
+                       "wal-before-mutation") == []
+
+
+def test_wal_checker_accepts_journaling_helper_call(tmp_path):
+    # calling a same-module helper that itself journals counts as WAL
+    # evidence for mutations after the call
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/loop/warmpool.py": """
+        class P:
+            def _note(self):
+                self._journal("pool_add", durable=True)
+
+            def fill(self, engine, opts):
+                self._note()
+                return engine.create_container(opts)
+        """,
+    })
+    assert findings_of(repo, "wal-before-mutation") == []
+
+
+def test_wal_checker_not_disarmed_by_thread_start(tmp_path):
+    """A journaling method named `start` (LoopScheduler.start) must not
+    turn every `.start()` call -- thread starts, the rt.start mutation
+    itself -- into WAL evidence."""
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/loop/scheduler.py": """
+        import threading
+
+        class S:
+            def start(self):
+                self._journal("run", durable=True)
+
+            def _create(self, rt, opts):
+                threading.Thread(target=self._pump).start()
+                cid = rt.create(opts)
+                rt.start(cid)
+                return cid
+        """,
+    })
+    found = findings_of(repo, "wal-before-mutation")
+    assert len(found) == 2      # rt.create AND rt.start both uncovered
+    assert {"create", "start"} == {
+        f.message.split("`")[1] for f in found}
+
+
+# ------------------------------------------------- layering checker
+
+def test_layering_fires_on_sentinel_engine_import(tmp_path):
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/sentinel/bad.py": """
+        from ..engine.api import Engine
+        """,
+    })
+    found = findings_of(repo, "import-layering")
+    assert len(found) == 1
+    assert "sentinel" in found[0].message and "observe-only" in found[0].message
+
+
+def test_layering_fires_on_rank_inversion(tmp_path):
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/engine/bad.py": """
+        from ..loop import scheduler
+        """,
+    })
+    found = findings_of(repo, "import-layering")
+    assert len(found) == 1
+    assert "rank" in found[0].message
+
+
+def test_layering_silent_on_clean_edges(tmp_path):
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/sentinel/ok.py": """
+        from ..monitor.ledger import parse_jsonl
+        from ..fleet.egress_tail import REMOTE_EGRESS_LOG
+        from .. import telemetry
+        """,
+        "clawker_tpu/loop/ok.py": """
+        from ..engine.api import Engine
+        from ..placement.policy import PlacementPolicy
+        """,
+    })
+    assert findings_of(repo, "import-layering") == []
+
+
+# ---------------------------------------------------- locks checker
+
+def test_locks_checker_fires_on_sleep_under_lock(tmp_path):
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/monitor/bad.py": """
+        import threading
+        import time
+
+        class C:
+            def poke(self):
+                with self._lock:
+                    time.sleep(1)
+        """,
+    })
+    found = findings_of(repo, "no-blocking-under-lock")
+    assert len(found) == 1 and "sleep" in found[0].message
+
+
+def test_locks_checker_silent_on_repaired_twin(tmp_path):
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/monitor/ok.py": """
+        import threading
+        import time
+
+        class C:
+            def poke(self):
+                with self._lock:
+                    self._n += 1
+                time.sleep(1)
+
+            def park(self):
+                with self._cond:
+                    self._cond.wait(1.0)   # waiting the HELD cond is fine
+
+            def spawn_later(self):
+                with self._lock:
+                    # defining a closure under the lock is fine
+                    def work():
+                        time.sleep(1)
+                    self._pending = work
+                    label = ",".join(self._names)   # str.join, not thread
+        """,
+    })
+    assert findings_of(repo, "no-blocking-under-lock") == []
+
+
+def test_locks_checker_fires_on_foreign_wait_under_lock(tmp_path):
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/engine/bad.py": """
+        class C:
+            def reap(self, proc):
+                with self._lock:
+                    proc.wait(timeout=3)
+        """,
+    })
+    found = findings_of(repo, "no-blocking-under-lock")
+    assert len(found) == 1 and "wait" in found[0].message
+
+
+# -------------------------------------------------- sockets checker
+
+SOCK_BAD = {
+    "clawker_tpu/nsd/bad.py": """
+    import os
+    import socket
+
+    def serve(path):
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(path)
+        srv.listen(8)
+        return srv
+    """,
+}
+
+SOCK_GOOD = {
+    "clawker_tpu/nsd/ok.py": """
+    import os
+    import socket
+
+    def serve(path, rundir):
+        os.makedirs(rundir, mode=0o700, exist_ok=True)
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        old = os.umask(0o177)
+        try:
+            srv.bind(path)
+        finally:
+            os.umask(old)
+        os.chmod(path, 0o600)
+        srv.listen(8)
+        return srv
+
+    def dial(path):
+        c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        c.bind("")          # client-side autobind: no listen -> exempt
+        c.connect(path)
+        return c
+    """,
+}
+
+
+def test_socket_checker_fires_on_unhardened_bind(tmp_path):
+    found = findings_of(make_repo(tmp_path, SOCK_BAD), "socket-hardening")
+    assert len(found) == 1
+    assert "umask" in found[0].message and "0o600" in found[0].message
+
+
+def test_socket_checker_silent_on_hardened_twin(tmp_path):
+    assert findings_of(make_repo(tmp_path, SOCK_GOOD),
+                       "socket-hardening") == []
+
+
+# --------------------------------------------------- parity checker
+
+def _seams_module(names: tuple[str, ...]) -> str:
+    return "SEAM_NAMES = (\n" + "".join(f"    {n!r},\n" for n in names) + ")\n"
+
+
+def test_parity_fires_on_unregistered_seam_fire(tmp_path):
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/chaos/seams.py": _seams_module(("launch.pre_create",)),
+        "clawker_tpu/loop/x.py": """
+        class S:
+            def go(self):
+                self.seams.fire("launch.pre_create")
+                self.seams.fire("launch.pre_creat")    # typo: dead site
+        """,
+    })
+    found = findings_of(repo, "registry-parity")
+    assert len(found) == 1 and "launch.pre_creat" in found[0].message
+
+
+def test_parity_fires_on_never_fired_seam(tmp_path):
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/chaos/seams.py": _seams_module(
+            ("launch.pre_create", "launch.ghost_seam")),
+        "clawker_tpu/loop/x.py": """
+        class S:
+            def go(self):
+                self.seams.fire("launch.pre_create")
+        """,
+    })
+    found = findings_of(repo, "registry-parity")
+    assert len(found) == 1 and "launch.ghost_seam" in found[0].message
+
+
+def test_parity_metrics_both_directions(tmp_path):
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/chaos/seams.py": _seams_module(()),
+        "clawker_tpu/loop/m.py": """
+        from .. import telemetry
+
+        _A = telemetry.counter("documented_total", "ok")
+        _B = telemetry.counter("undocumented_total", "drifted")
+        """,
+        "docs/telemetry.md": """
+        | name | type |
+        |---|---|
+        | `documented_total` | counter |
+        | `ghost_metric_total` | counter |
+        """,
+    })
+    found = findings_of(repo, "registry-parity")
+    msgs = " / ".join(f.message for f in found)
+    assert len(found) == 2
+    assert "undocumented_total" in msgs and "ghost_metric_total" in msgs
+
+
+def test_parity_silent_when_in_sync(tmp_path):
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/chaos/seams.py": _seams_module(("launch.pre_create",)),
+        "clawker_tpu/loop/m.py": """
+        from .. import telemetry
+
+        _A = telemetry.counter("documented_total", "ok")
+
+        class S:
+            def go(self):
+                self.seams.fire("launch.pre_create")
+        """,
+        "docs/telemetry.md": "| `documented_total` | counter |\n",
+    })
+    assert findings_of(repo, "registry-parity") == []
+
+
+# ---------------------------------------------- determinism checker
+
+def test_determinism_fires_on_clock_and_global_random(tmp_path):
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/chaos/plan.py": """
+        import random
+        import time
+
+        def generate_plan(seed, scenario):
+            jitter = random.random()
+            stamp = time.time()
+            return [jitter, stamp]
+        """,
+    })
+    found = findings_of(repo, "chaos-determinism")
+    msgs = " / ".join(f.message for f in found)
+    assert len(found) == 2
+    assert "time.time" in msgs and "random.random" in msgs
+
+
+def test_determinism_silent_on_seeded_rng(tmp_path):
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/chaos/plan.py": """
+        import random
+
+        def generate_plan(seed, scenario):
+            rng = random.Random((seed & 0xFFFFFFFF) * 100_003 + scenario)
+            return [rng.random() for _ in range(4)]
+        """,
+    })
+    assert findings_of(repo, "chaos-determinism") == []
+
+
+# ------------------------------------------- suppression + baseline
+
+def test_allow_comment_suppresses_with_justification(tmp_path):
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/monitor/bad.py": """
+        import time
+
+        class C:
+            def poke(self):
+                with self._lock:
+                    # analyze: allow(no-blocking-under-lock): test waiver
+                    time.sleep(1)
+        """,
+    })
+    report = run_analysis(repo, only={"no-blocking-under-lock"})
+    assert report.findings == []
+    assert len(report.suppressed) == 1
+    assert report.suppressed[0].justification == "test waiver"
+
+
+def test_baseline_add_and_expire_round_trip(tmp_path):
+    repo = make_repo(tmp_path, WAL_BAD)
+    report = run_analysis(repo, only={"wal-before-mutation"})
+    assert len(report.new) == 1
+
+    # grandfather: the same finding stops being NEW
+    base = Baseline().updated_from(report)
+    path = base.save(tmp_path / "analysis-baseline.json")
+    base2 = Baseline.load(path)
+    report2 = run_analysis(repo, baseline=base2,
+                           only={"wal-before-mutation"})
+    assert report2.new == [] and len(report2.grandfathered) == 1
+    assert report2.exit_code == 0
+
+    # fix the code: the entry goes stale and --baseline-update expires it
+    make_repo(tmp_path, WAL_GOOD)
+    report3 = run_analysis(repo, baseline=base2,
+                           only={"wal-before-mutation"})
+    assert report3.findings == []
+    assert report3.stale_baseline == base2.fingerprints()
+    assert len(base2.updated_from(report3)) == 0
+
+
+def test_scoped_baseline_update_preserves_other_checkers(tmp_path):
+    """--checker X --baseline-update must not expire checker Y's
+    grandfathered entries (they were never re-checked)."""
+    repo = make_repo(tmp_path, {
+        **WAL_BAD,
+        "clawker_tpu/chaos/plan.py": """
+        import time
+
+        def generate_plan(seed, scenario):
+            return [time.time()]
+        """,
+    })
+    assert analyze_main(["--root", str(repo), "--baseline-update"]) == 0
+    base = Baseline.load(repo / "analysis-baseline.json")
+    assert {e["checker"] for e in base.entries()} == {
+        "wal-before-mutation", "chaos-determinism"}
+    # scoped update touching only chaos-determinism: the wal entry
+    # survives and the full run stays clean
+    assert analyze_main(["--root", str(repo),
+                         "--checker", "chaos-determinism",
+                         "--baseline-update"]) == 0
+    base2 = Baseline.load(repo / "analysis-baseline.json")
+    assert {e["checker"] for e in base2.entries()} == {
+        "wal-before-mutation", "chaos-determinism"}
+    assert analyze_main(["--root", str(repo)]) == 0
+
+
+def test_second_identical_finding_is_not_grandfathered(tmp_path):
+    """Identical (checker, path, message) findings get distinct
+    occurrence-indexed fingerprints: baselining the first must not
+    grandfather a NEW second instance of the same defect."""
+    one = {
+        "clawker_tpu/loop/scheduler.py": """
+        class S:
+            def _create(self, engine, opts):
+                return engine.create_container(opts)
+        """,
+    }
+    two = {
+        "clawker_tpu/loop/scheduler.py": """
+        class S:
+            def _create(self, engine, opts):
+                engine.create_container(opts)
+                return engine.create_container(opts)
+        """,
+    }
+    repo = make_repo(tmp_path, one)
+    report = run_analysis(repo, only={"wal-before-mutation"})
+    base = Baseline().updated_from(report)
+    make_repo(tmp_path, two)
+    report2 = run_analysis(repo, baseline=base,
+                           only={"wal-before-mutation"})
+    assert len(report2.findings) == 2
+    assert len(report2.grandfathered) == 1
+    assert len(report2.new) == 1        # the added duplicate FAILS the gate
+    fps = {f.fingerprint for f in report2.findings}
+    assert len(fps) == 2
+
+
+def test_fingerprint_survives_line_drift(tmp_path):
+    repo = make_repo(tmp_path, WAL_BAD)
+    fp1 = run_analysis(repo, only={"wal-before-mutation"}).new[0].fingerprint
+    shifted = "\n\n\n# a comment pushing everything down\n" + (
+        tmp_path / "clawker_tpu/loop/scheduler.py").read_text()
+    (tmp_path / "clawker_tpu/loop/scheduler.py").write_text(shifted)
+    fp2 = run_analysis(repo, only={"wal-before-mutation"}).new[0].fingerprint
+    assert fp1 == fp2
+
+
+# ------------------------------------------------------- lockgraph
+
+def _skip_if_session_traced():
+    from clawker_tpu.analysis import lockgraph as lg
+
+    if lg.installed_graph() is not None:
+        pytest.skip("session-wide lock tracing active "
+                    "(CLAWKER_TPU_LOCKGRAPH=1); this test's exact-count "
+                    "asserts need a quiet global factory")
+
+
+def test_lockgraph_detects_ab_ba_cycle():
+    _skip_if_session_traced()
+    graph = install_lock_tracing()
+    try:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        gate = threading.Barrier(2, timeout=5)
+
+        def ab():
+            with lock_a:
+                gate.wait()
+                if lock_b.acquire(timeout=0.3):
+                    lock_b.release()
+
+        def ba():
+            with lock_b:
+                gate.wait()
+                if lock_a.acquire(timeout=0.3):
+                    lock_a.release()
+
+        t1, t2 = threading.Thread(target=ab), threading.Thread(target=ba)
+        t1.start(); t2.start(); t1.join(5); t2.join(5)
+    finally:
+        g = uninstall_lock_tracing()
+    assert g is graph
+    cycles = graph.cycles()
+    assert len(cycles) == 1
+    edges = cycles[0]["edges"]
+    assert len(edges) == 2
+    for e in edges:
+        # both acquisition stacks present, pointing into this test
+        assert any("ab" in fr or "ba" in fr for fr in e["held_stack"])
+        assert any("ab" in fr or "ba" in fr for fr in e["acquire_stack"])
+
+
+def test_lockgraph_hierarchical_order_is_cycle_free():
+    # direct TracedLock construction: the graph mechanics need no
+    # global factory patch (and so coexist with CLAWKER_TPU_LOCKGRAPH)
+    from clawker_tpu.analysis.lockgraph import TracedLock
+
+    graph = LockGraph()
+    outer = TracedLock(graph, "x.py:1")
+    inner = TracedLock(graph, "x.py:2")
+
+    def nested():
+        with outer:
+            with inner:
+                pass
+
+    threads = [threading.Thread(target=nested) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5)
+    assert graph.cycles() == []
+    assert graph.report()["edges"] == 1
+
+
+def test_lockgraph_same_site_nesting_is_not_a_cycle():
+    from clawker_tpu.analysis.lockgraph import TracedLock
+
+    graph = LockGraph()
+    lanes = [TracedLock(graph, "lanes.py:7") for _ in range(2)]
+    with lanes[0]:
+        with lanes[1]:
+            pass
+    with lanes[1]:
+        with lanes[0]:
+            pass
+    assert graph.cycles() == []
+    assert sum(graph.same_site.values()) == 2
+
+
+def test_lockgraph_condition_wait_does_not_leak_held_state():
+    from clawker_tpu.analysis.lockgraph import TracedLock, TracedRLock
+
+    graph = LockGraph()
+    cond = threading.Condition(TracedRLock(graph, "cond.py:1"))
+    other = TracedLock(graph, "other.py:1")
+    done = threading.Event()
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=2)
+        # the waited lock was RELEASED during wait: taking another
+        # lock afterwards must not read as nested under it
+        with other:
+            pass
+        done.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time as _t
+    _t.sleep(0.1)
+    with cond:
+        cond.notify_all()
+    t.join(5)
+    assert done.is_set()
+    assert graph.cycles() == []
+    assert not any("other.py" in b for _a, b in graph.edges), graph.edges
+
+
+def test_lockgraph_records_nonreentrant_self_deadlock():
+    """An UNBOUNDED re-acquire of a HELD plain Lock is a guaranteed
+    single-thread deadlock: the graph records the evidence (a
+    self-cycle with both stacks) BEFORE the thread parks forever.
+    Trylocks/timed attempts (Condition._is_owned's acquire(False)
+    probe) must not false-positive."""
+    import time
+
+    from clawker_tpu.analysis.lockgraph import TracedLock
+
+    graph = LockGraph()
+    lk = TracedLock(graph, "x.py:9")
+
+    def deadlocker():
+        with lk:
+            assert not lk.acquire(blocking=False)    # trylock: exempt
+            assert not lk.acquire(timeout=0.05)      # timed: exempt
+            lk.acquire()    # unbounded: records, then parks forever
+
+    t = threading.Thread(target=deadlocker, daemon=True)
+    t.start()
+    for _ in range(100):
+        if graph.cycles():
+            break
+        time.sleep(0.05)
+    cycles = graph.cycles()
+    assert len(cycles) == 1 and cycles[0]["locks"] == ["x.py:9"]
+    edge = cycles[0]["edges"][0]
+    assert edge["from"] == edge["to"] == "x.py:9"
+    assert edge["held_stack"] and edge["acquire_stack"]
+    assert t.is_alive()     # genuinely parked; daemon thread, leaked
+
+
+def test_lockgraph_acquire_count_sums_across_threads():
+    from clawker_tpu.analysis.lockgraph import TracedLock
+
+    graph = LockGraph()
+    lk = TracedLock(graph, "x.py:1")
+
+    def spin():
+        for _ in range(200):
+            with lk:
+                pass
+
+    threads = [threading.Thread(target=spin) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    assert graph.acquires == 800        # per-thread slots: no lost updates
+
+
+def test_lockgraph_uninstall_restores_real_factories():
+    _skip_if_session_traced()
+    install_lock_tracing()
+    uninstall_lock_tracing()
+    lk = threading.Lock()
+    assert type(lk).__module__ == "_thread"
+
+
+def test_lockgraph_nested_install_keeps_outer_tracer_alive():
+    """testenv.lock_tracing() under CLAWKER_TPU_LOCKGRAPH: the inner
+    block pops only its own graph; the outer tracer keeps recording."""
+    _skip_if_session_traced()
+    outer = install_lock_tracing()
+    try:
+        inner = install_lock_tracing()
+        lk = threading.Lock()
+        with lk:
+            pass
+        assert uninstall_lock_tracing() is inner
+        assert not inner.enabled
+        # outer is still the active tracer and still records
+        from clawker_tpu.analysis.lockgraph import installed_graph
+
+        assert installed_graph() is outer and outer.enabled
+        before = outer.acquires
+        with threading.Lock():
+            pass
+        assert outer.acquires == before + 1
+        assert inner.acquires < outer.acquires
+    finally:
+        uninstall_lock_tracing()
+    lk = threading.Lock()
+    assert type(lk).__module__ == "_thread"
+
+
+def test_lockgraph_traced_lock_supports_at_fork_reinit():
+    """concurrent.futures/logging call os.register_at_fork with
+    lock._at_fork_reinit at import time: the wrapper must delegate
+    internals it doesn't model to the real lock."""
+    from clawker_tpu.analysis.lockgraph import TracedLock, TracedRLock
+
+    lk = TracedLock(LockGraph(), "x.py:1")
+    lk._at_fork_reinit()            # must not raise
+    assert lk.acquire(timeout=1)
+    lk.release()
+    rl = TracedRLock(LockGraph(), "x.py:2")
+    rl._at_fork_reinit()
+    with rl:
+        pass
+
+
+# ------------------------------------------------------------- CLI
+
+def test_cli_exit_2_on_new_finding_and_0_after_baseline(tmp_path, capsys):
+    repo = make_repo(tmp_path, WAL_BAD)
+    rc = analyze_main(["--root", str(repo)])
+    assert rc == 2
+    rc = analyze_main(["--root", str(repo), "--baseline-update"])
+    assert rc == 0
+    assert (repo / "analysis-baseline.json").is_file()
+    rc = analyze_main(["--root", str(repo)])
+    assert rc == 0
+
+
+def test_cli_json_shape_is_stable(tmp_path, capsys):
+    repo = make_repo(tmp_path, WAL_BAD)
+    rc = analyze_main(["--root", str(repo), "--json"])
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert rc == 2 and doc["ok"] is False and doc["version"] == 1
+    assert {"new", "grandfathered", "suppressed", "stale_baseline",
+            "checkers", "files_scanned"} <= set(doc)
+    f = doc["new"][0]
+    assert {"checker", "path", "line", "message", "fingerprint"} <= set(f)
+
+
+def test_cli_unknown_checker_errors(tmp_path):
+    repo = make_repo(tmp_path, WAL_GOOD)
+    assert analyze_main(["--root", str(repo), "--checker", "nope"]) == 1
+
+
+def test_clawker_analyze_click_command(tmp_path):
+    click = pytest.importorskip("click")
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.root import cli
+
+    repo = make_repo(tmp_path, WAL_BAD)
+    r = CliRunner().invoke(cli, ["analyze", "--root", str(repo)])
+    assert r.exit_code == 2
+    r = CliRunner().invoke(cli, ["analyze", "--root", str(repo),
+                                 "--baseline-update"])
+    assert r.exit_code == 0
+    r = CliRunner().invoke(cli, ["analyze", "--root", str(repo)])
+    assert r.exit_code == 0
+
+
+# ------------------------------------------------------ repo gates
+
+def test_repo_is_clean_against_committed_baseline():
+    """THE tier-1 gate: a new un-baselined finding anywhere in the repo
+    fails this test (the same check rides `make analyze` and
+    bench-smoke)."""
+    base = Baseline.load(REPO_ROOT / "analysis-baseline.json")
+    report = run_analysis(REPO_ROOT, baseline=base)
+    assert report.new == [], "\n".join(f.render() for f in report.new)
+    # the committed grandfather list stays minimal (ISSUE 12 bar: <= 15)
+    assert len(base) <= 15
+    assert report.stale_baseline == [], (
+        "baseline entries went stale; run `clawker analyze "
+        "--baseline-update`")
+
+
+def test_all_six_checkers_registered():
+    from clawker_tpu.analysis.core import CHECKERS, _load_checkers
+
+    _load_checkers()
+    assert {"wal-before-mutation", "import-layering",
+            "no-blocking-under-lock", "socket-hardening",
+            "registry-parity", "chaos-determinism"} <= set(CHECKERS)
+
+
+def test_analyzer_imports_pure_stdlib():
+    """The bare-host contract: `python -m clawker_tpu.analysis` must not
+    pull JAX/click/numpy (docs/static-analysis.md#bare-host)."""
+    code = (
+        "import sys\n"
+        "import clawker_tpu.analysis\n"
+        "import clawker_tpu.analysis.runner\n"
+        "import clawker_tpu.analysis.checkers\n"
+        "heavy = {'jax', 'jaxlib', 'numpy', 'click'} & set(sys.modules)\n"
+        "assert not heavy, f'analyzer pulled heavy deps: {heavy}'\n"
+        "print('pure')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], cwd=str(REPO_ROOT),
+                         capture_output=True, text=True, timeout=30)
+    assert out.returncode == 0, out.stderr
+    assert "pure" in out.stdout
