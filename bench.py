@@ -1435,6 +1435,194 @@ def bench_telemetry_overhead(n: int = 50_000) -> dict:
     }
 
 
+CONSOLE_REPAINT_BUDGET_MS = 50.0    # p95 frame build+paint at 256 agents
+#                                     across 4 hosted runs (fleet console,
+#                                     docs/fleet-console.md#repaint-budget)
+CONSOLE_FRAME_LINE_BOUND = 140      # row virtualization must bound the
+#                                     frame no matter the agent count
+INGEST_LAG_BUDGET_S = 1.0           # typed bus event -> searchable doc on
+#                                     the fake bulk index (shipper tick
+#                                     cadence + batch seal + flush)
+
+
+def _console_status_doc(runs: int, per_run: int, tick: int,
+                        statuses: dict) -> dict:
+    """Synthetic loopd status RPC doc shaped like LoopdServer._status_doc
+    (the console feed's input contract) for `runs` hosted runs of
+    `per_run` agents each."""
+    workers = [f"w{i}" for i in range(4)]
+    run_docs = []
+    for r in range(runs):
+        agents = []
+        for i in range(per_run):
+            status, iteration = statuses.get((r, i), ("running", 1))
+            agents.append({
+                "agent": f"loop-r{r}-{i:03d}", "worker": workers[i % 4],
+                "status": status, "iteration": iteration,
+                "exit_codes": [0],
+                **({"anomaly_z": 4.2} if i == 7 else {}),
+            })
+        run_docs.append({
+            "run": f"run{r:02d}", "state": "running", "tenant": f"t{r}",
+            "client": "bench", "parallel": per_run, "iterations": 4,
+            "placement": "spread", "agents": agents, "subscribers": 1,
+            "events_dropped": tick % 3,
+        })
+    return {
+        "pid": 4242, "project": "bench", "uptime_s": float(tick),
+        "runs": run_docs,
+        "admission": {
+            "workers": {w: {"inflight": 2, "capacity": 4, "pending": 0,
+                            "inflight_hwm": 3, "dispatched": 40 + tick,
+                            "rejected": 0} for w in workers},
+            "tenants": {f"t{r}": {"weight": 1.0, "inflight": 2,
+                                  "queued": 1, "dispatched": 10 + tick}
+                        for r in range(runs)},
+        },
+        "health": [{"worker": w, "state": "closed",
+                    "breaker_state_gauge": 0, "probe_p50_ms": 1.2,
+                    "probe_p95_ms": 2.0, "probes": 100 + tick,
+                    "probe_failures": 0, "orphaned": 0,
+                    "migrations_out": 0, "migrations_in": 0,
+                    "last_error": ""} for w in workers],
+        "workerd": {w: "ok" for w in workers},
+        "warm_pools": {"run00": {"target_depth": 2, "hits": 9, "misses": 1,
+                                 "refills": 3, "recycled": 0,
+                                 "workers": {w: {"ready": 2, "inflight": 0}
+                                             for w in workers}}},
+        "sentinel": {"enabled": True, "ticks": tick, "rows": []},
+        "shipper": {"enabled": True, "ingested_docs": 100 * tick,
+                    "pending_batches": 0, "dropped_docs": 0},
+        "events_dropped_total": tick % 3,
+    }
+
+
+def bench_console_repaint(agents: int = 256, runs: int = 4,
+                          frames: int = 80) -> dict:
+    """Fleet-console repaint cost at the acceptance shape: 256 agents
+    across 4 hosted runs, a handful of rows changing per tick, span
+    waterfalls tailed from a real flight file.
+
+    Measures per-frame wall (feed normalize + frame build + damage
+    paint into a buffer) and the damage ratio (rows rewritten / rows
+    total) -- virtualization must bound the frame and damage tracking
+    must keep idle rows free."""
+    from clawker_tpu.loopd.feed import console_feed
+    from clawker_tpu.telemetry.spans import SpanRecord
+    from clawker_tpu.ui.fleetconsole import FleetConsole
+    from clawker_tpu.ui.iostreams import IOStreams
+
+    per_run = agents // runs
+    statuses: dict = {}
+    tick = [0]
+
+    with tempfile.TemporaryDirectory(prefix="clawker-console-bench-") as td:
+        logs = Path(td)
+        # a real flight file for run00: the waterfall path must be on
+        # the measured frame, not just the table
+        from clawker_tpu.monitor.ledger import flight_path
+
+        fpath = flight_path(logs, "run00")
+        fpath.parent.mkdir(parents=True, exist_ok=True)
+        with open(fpath, "w", encoding="utf-8") as fh:
+            for i in range(64):
+                root = SpanRecord(
+                    trace_id="run00", span_id=f"s{i}", parent_id="",
+                    name="iteration", agent=f"loop-r0-{i % 8:03d}",
+                    worker=f"w{i % 4}", t_start=float(i),
+                    t_end=float(i) + 0.5, attrs={"iteration": i})
+                child = SpanRecord(
+                    trace_id="run00", span_id=f"c{i}", parent_id=f"s{i}",
+                    name="wait", agent=root.agent, worker=root.worker,
+                    t_start=float(i) + 0.1, t_end=float(i) + 0.4,
+                    attrs={"iteration": i})
+                fh.write(json.dumps(root.to_json()) + "\n")
+                fh.write(json.dumps(child.to_json()) + "\n")
+
+        def feed_fn() -> dict:
+            return console_feed(_console_status_doc(
+                runs, per_run, tick[0], statuses))
+
+        streams, _, out, _ = IOStreams.test()
+        console = FleetConsole(streams, feed_fn, logs_dir=logs)
+        samples = []
+        for f in range(frames):
+            tick[0] = f
+            # 8 rows change per frame -- the steady-state churn shape
+            for j in range(8):
+                statuses[(j % runs, (f + j) % per_run)] = (
+                    "running" if (f + j) % 5 else "done", f)
+            t0 = time.perf_counter()
+            console.render_once()
+            samples.append((time.perf_counter() - t0) * 1000)
+            out.truncate(0)
+            out.seek(0)
+        frame_lines = len(console.frame_lines(feed_fn()))
+        stats = console.painter.stats()
+    samples.sort()
+    return {
+        "agents": agents, "runs": runs, "frames": frames,
+        "frame_p50_ms": round(samples[len(samples) // 2], 2),
+        "frame_p95_ms": round(samples[int(len(samples) * 0.95) - 1], 2),
+        "frame_lines": frame_lines,
+        "bounded": frame_lines <= CONSOLE_FRAME_LINE_BOUND,
+        "rows_total": stats["rows_total"],
+        "rows_painted": stats["rows_painted"],
+        "damage_ratio": round(
+            stats["rows_painted"] / max(1, stats["rows_total"]), 3),
+    }
+
+
+def bench_ingest_lag(bursts: int = 20, per_burst: int = 10) -> dict:
+    """Docs/search lag on the fake monitor stack: typed bus events
+    emitted -> searchable in the fake bulk index through the shipper's
+    seal/flush cadence.  Completeness is part of the gate -- a healthy
+    index must receive every doc."""
+    from clawker_tpu.monitor.events import PLACEMENT_DECISION, EventBus
+    from clawker_tpu.monitor.shipper import (
+        FLEET_EVENTS_INDEX,
+        TelemetryShipper,
+    )
+    from clawker_tpu.telemetry import MetricsRegistry
+    from clawker_tpu.testenv import FakeBulkIndex
+
+    idx = FakeBulkIndex()
+    shipper = TelemetryShipper(idx, registry=MetricsRegistry(),
+                               interval_s=0.05, batch_docs=64,
+                               max_batches=32, source="bench").start()
+    bus = EventBus()
+    bus.add_tap(shipper.bus_tap_for("bench-run"))
+    lags = []
+    emitted = 0
+    try:
+        for _ in range(bursts):
+            t0 = time.perf_counter()
+            last_seq = 0
+            for i in range(per_burst):
+                rec = bus.emit(f"agent-{i}", PLACEMENT_DECISION,
+                               "placed w0 [spread/bench]")
+                last_seq = rec.seq
+            emitted += per_burst
+            deadline = time.perf_counter() + 5.0
+            while time.perf_counter() < deadline:
+                if idx.search(FLEET_EVENTS_INDEX, seq=last_seq):
+                    break
+                time.sleep(0.002)
+            lags.append(time.perf_counter() - t0)
+    finally:
+        shipper.stop()
+    lags.sort()
+    indexed = idx.count(FLEET_EVENTS_INDEX)
+    return {
+        "bursts": bursts, "docs_emitted": emitted,
+        "docs_indexed": indexed,
+        "complete": indexed == emitted,
+        "lag_p50_s": round(lags[len(lags) // 2], 3),
+        "lag_p95_s": round(lags[int(len(lags) * 0.95) - 1], 3),
+        "dropped": shipper.stats()["dropped_docs"],
+    }
+
+
 def synth_egress_records(agents: int = 8, windows: int = 64,
                          per_window: int = 40) -> list[dict]:
     """Deterministic synthetic netlogger stream: `agents` containers with
@@ -1786,6 +1974,8 @@ def main() -> None:
     wd_batch = bench_workerd_event_batch_overhead()
     dials = bench_engine_dials()
     tele = bench_telemetry_overhead()
+    console = bench_console_repaint()
+    ingest = bench_ingest_lag()
     anom = bench_anomaly()
     flag_lat = bench_anomaly_flag_latency()
     score_tick = bench_anomaly_fleet_score_tick()
@@ -1911,6 +2101,24 @@ def main() -> None:
          # the pool holds its acceptance bar
          "vs_baseline": dials["dial_reduction"],
          "detail": dials},
+        {"metric": "console_repaint_p95", "value": console["frame_p95_ms"],
+         "unit": "ms",
+         # the gate IS the acceptance bar: 256 agents / 4 hosted runs
+         # repaint within budget, the frame bounded by virtualization,
+         # and damage tracking actually saving rows -- an unbounded or
+         # full-repaint frame must read FAILED, never merely fast
+         "vs_baseline": (round(
+             CONSOLE_REPAINT_BUDGET_MS / max(console["frame_p95_ms"], 1e-9),
+             1) if console["bounded"] and console["damage_ratio"] <= 0.5
+             else 0.0),
+         "detail": console},
+        {"metric": "ingest_docs_lag", "value": ingest["lag_p95_s"],
+         "unit": "s",
+         # a lossy healthy-index run must read FAILED, never fast
+         "vs_baseline": (round(
+             INGEST_LAG_BUDGET_S / max(ingest["lag_p95_s"], 1e-9), 1)
+             if ingest["complete"] else 0.0),
+         "detail": ingest},
         {"metric": "telemetry_overhead_ns", "value": tele["enabled_ns"],
          "unit": "ns",
          # vs_baseline is headroom under the per-record budget: >= 1

@@ -63,7 +63,8 @@ TERMINAL_STATUSES = ("done", "failed", "stopped")
 def check_invariants(driver, cfg, run_id: str, *, loops=None,
                      cap: int = 0, unfaulted: set[str] | None = None,
                      health=None, kills: int = 0,
-                     sentinel=None, workerd=None) -> list[str]:
+                     sentinel=None, workerd=None,
+                     shipper=None) -> list[str]:
     """Audit one finished scenario; returns human-readable violations
     (empty list = all invariants hold).
 
@@ -209,6 +210,44 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
                 f"workerd-reconcile: {row.get('worker')} channel healed "
                 f"but {row['undelivered']} event(s) were never delivered "
                 "(lost exits)")
+
+    # --- shipper-*: the telemetry shipper's bounded-ingestion contract
+    # (docs/fleet-console.md#degrade-matrix).  ``shipper`` is the
+    # runner's audit dict (shipper.stats() + down_injected +
+    # indexed_docs from the fake index).  Three falsifiable halves:
+    # accounting (every ingested doc is flushed, dropped, or still
+    # buffered -- nothing vanishes uncounted, which is exactly what a
+    # lossy drop path that forgets to count would violate), delivery
+    # (every doc the sink ACKED is actually in the index -- catches a
+    # corrupt bulk payload read as success), and bounded (the buffer
+    # never exceeded its cap, so a down index cannot grow memory).
+    if shipper is not None:
+        accounted = (shipper["flushed_docs"] + shipper["dropped_docs"]
+                     + shipper["pending_docs"] + shipper["open_docs"])
+        if accounted != shipper["ingested_docs"]:
+            violations.append(
+                f"shipper-accounting: {shipper['ingested_docs']} doc(s) "
+                f"ingested but only {accounted} accounted "
+                f"(flushed {shipper['flushed_docs']} + dropped "
+                f"{shipper['dropped_docs']} + buffered "
+                f"{shipper['pending_docs'] + shipper['open_docs']})")
+        if shipper["flushed_docs"] != shipper.get("indexed_docs", 0):
+            violations.append(
+                f"shipper-delivery: sink acked {shipper['flushed_docs']} "
+                f"doc(s) but the index holds "
+                f"{shipper.get('indexed_docs', 0)}")
+        if shipper["pending_batches"] > shipper["max_batches"]:
+            violations.append(
+                f"shipper-bounded: {shipper['pending_batches']} pending "
+                f"batch(es) exceed the {shipper['max_batches']}-batch "
+                "buffer cap")
+        if shipper.get("down_injected") and shipper["failed_flushes"] == 0 \
+                and shipper["dropped_docs"] == 0 \
+                and shipper["ingested_docs"] > 0:
+            violations.append(
+                "shipper-backpressure: the index went down but the "
+                "shipper recorded neither a failed flush nor a drop -- "
+                "the fault never reached the sink path")
 
     # --- span-tree: flight record parses; kill-free runs close every root
     fpath = Path(flight_path(cfg.logs_dir, run_id))

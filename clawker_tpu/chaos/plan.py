@@ -42,6 +42,12 @@ kind                    injection point
                         the deadline and strand their loops WITHOUT a
                         breaker penalty; the fleet degrades that worker
                         to the direct WAN path and still drains
+``index_down``          shipper scenarios: the monitor stack's bulk index
+                        goes down (``arg: "stall"`` wedges it inside the
+                        sink deadline instead) mid-run -- the telemetry
+                        shipper must degrade observe-only: bounded buffer,
+                        oldest batches dropped and counted, the bus and
+                        every scheduler lane untouched
 ======================  ====================================================
 
 Plans with ``sentinel: true`` run with the fleet sentinel attached to
@@ -66,11 +72,11 @@ EVENT_KINDS = (
     "worker_kill", "worker_wedge", "worker_flap", "worker_slow",
     "engine_burst", "probe_drop", "worker_revive", "cli_sigkill",
     "egress_silent", "egress_flood", "sentinel_kill",
-    "workerd_partition", "workerd_kill",
+    "workerd_partition", "workerd_kill", "index_down",
 )
 
 # event kinds that target no worker (worker index is ignored)
-_WORKERLESS_KINDS = ("cli_sigkill", "sentinel_kill")
+_WORKERLESS_KINDS = ("cli_sigkill", "sentinel_kill", "index_down")
 
 # fault gate modes the worker_* / engine_* / probe_* kinds map onto
 GATE_MODE = {
@@ -134,6 +140,7 @@ class FaultPlan:
     max_inflight_per_worker: int = 2
     sentinel: bool = False          # run with the fleet sentinel attached
     workerd: bool = False           # run with per-worker workerd executors
+    shipper: bool = False           # run with the telemetry shipper attached
     events: list[FaultEvent] = field(default_factory=list)
 
     @property
@@ -149,6 +156,7 @@ class FaultPlan:
             "max_inflight_per_worker": self.max_inflight_per_worker,
             "sentinel": self.sentinel,
             "workerd": self.workerd,
+            "shipper": self.shipper,
             "events": [e.to_doc() for e in sorted(self.events,
                                                   key=lambda e: e.at_s)],
         }
@@ -170,6 +178,7 @@ class FaultPlan:
                 doc.get("max_inflight_per_worker", 2)),
             sentinel=bool(doc.get("sentinel", False)),
             workerd=bool(doc.get("workerd", False)),
+            shipper=bool(doc.get("shipper", False)),
             events=[FaultEvent.from_doc(e) for e in doc.get("events") or []],
         )
         _validate(plan)
@@ -298,6 +307,20 @@ def generate_plan(seed: int, scenario: int = 0, *, n_workers: int = 4,
                 at_s=rng.uniform(0.02, horizon_s * 0.4),
                 kind="cli_sigkill", worker=-1,
                 arg="workerd.pre_dispatch"))
+    # shipper rider (drawn strictly AFTER every pre-existing draw, so
+    # the worker-fault/sigkill/sentinel/workerd schedule of a (seed,
+    # scenario) pair is byte-identical to the pre-shipper generator):
+    # about a quarter of scenarios run with the telemetry shipper
+    # attached to a fake bulk index, most of those with the index going
+    # down (or wedging) mid-run -- the observe-only degradation the
+    # fleet-console ingestion contract promises
+    if rng.random() < 0.25:
+        plan.shipper = True
+        if rng.random() < 0.8:
+            events.append(FaultEvent(
+                at_s=rng.uniform(0.05, horizon_s * 0.6),
+                kind="index_down", worker=-1,
+                arg="stall" if rng.random() < 0.3 else None))
     plan.events = sorted(events, key=lambda e: e.at_s)
     _validate(plan)
     return plan

@@ -232,6 +232,23 @@ class ChaosRunner:
                 exs[w.id] = WorkerdExecutor(w.id, sock,
                                             intent_deadline_s=2.0)
             self.executors = ExecutorSet(exs)
+        # shipper scenarios (plan.shipper): the telemetry shipper rides
+        # every generation against an in-memory fake bulk index;
+        # index_down events take the index down (or wedge it inside the
+        # sink deadline) while the standard invariants must keep
+        # holding and the shipper audit proves the bounded-buffer,
+        # drop-oldest, never-blocks degradation
+        self.index = None
+        self.shipper = None
+        self._index_downed = False
+        if plan.shipper:
+            from ..monitor.shipper import TelemetryShipper
+            from ..testenv import FakeBulkIndex
+
+            self.index = FakeBulkIndex(stall_timeout_s=0.2)
+            self.shipper = TelemetryShipper(
+                self.index, interval_s=0.05, batch_docs=16,
+                max_batches=4, source="chaos").start()
 
     @staticmethod
     def _sentinel_available() -> bool:
@@ -290,6 +307,11 @@ class ChaosRunner:
             # flagged set persist across the kill/resume cycle via its
             # run-keyed state file (the --resume persistence contract)
             sched.attach_sentinel(self.sentinel)
+        if self.shipper is not None:
+            # one shipper across generations, like loopd hosting it
+            # across runs: the bounded buffer and drop accounting span
+            # the kill/resume cycle
+            sched.attach_shipper(self.shipper)
         # per-GENERATION completion state: the closure binds these
         # locals, not self, so a stale gen-N thread that finally
         # unblocks (e.g. out of a wedge after the 5s kill wait gave up
@@ -338,6 +360,33 @@ class ChaosRunner:
             self.sentinel.kill_collector()
         _INJECTIONS.labels(ev.kind).inc()
         self.injected += 1
+
+    def _apply_index_fault(self, ev: FaultEvent) -> None:
+        """Monitor-stack faults: the bulk index refuses (down) or
+        wedges inside the sink deadline (``arg: "stall"``).  Hits only
+        the shipper's SINK -- workers, bus, and lanes stay untouched,
+        so the standard invariants double as the never-stalls proof."""
+        self._index_downed = True
+        if self.index is not None:
+            if ev.arg == "stall":
+                self.index.stall()
+            else:
+                self.index.down = True
+        _INJECTIONS.labels(ev.kind).inc()
+        self.injected += 1
+
+    def _shipper_audit(self) -> dict | None:
+        """Shipper evidence for the invariant checker: intake/flush/
+        drop accounting plus what the fake index actually holds.  None
+        when the scenario ran without a shipper."""
+        if self.shipper is None:
+            return None
+        audit = self.shipper.stats()
+        audit["down_injected"] = self._index_downed
+        audit["indexed_docs"] = (
+            sum(len(v) for v in self.index.docs.values())
+            if self.index is not None else 0)
+        return audit
 
     def _workerd_audit(self) -> list[dict] | None:
         """Per-worker workerd evidence for the invariant checker: the
@@ -467,6 +516,10 @@ class ChaosRunner:
                     # data-plane faults hit the workerd channel/daemon,
                     # never the engine: the worker stays unfaulted
                     self._apply_workerd_fault(ev)
+                elif ev.kind == "index_down":
+                    # monitor-stack faults hit the shipper's sink,
+                    # never a worker: the fleet stays unfaulted
+                    self._apply_index_fault(ev)
                 elif ev.kind in ("egress_silent", "egress_flood",
                                  "sentinel_kill"):
                     # stream/collector faults: they hit the SENTINEL's
@@ -510,6 +563,20 @@ class ChaosRunner:
                 self.feeder.stop()
             if self.sentinel is not None:
                 self.sentinel.stop()
+            if self.shipper is not None:
+                # stop the pump, then one deterministic snapshot+flush
+                # so the audit never races the tick cadence: a downed
+                # index records its failed flush, a healthy one lands
+                # the final docs, either way before the counters are
+                # read.  A pump wedged in the sink (kill() False) must
+                # NOT be raced -- the fake sink's stall bound drains it
+                # well inside the scenario deadline, so retry once.
+                if not self.shipper.kill():
+                    if self.index is not None:
+                        self.index.unstall()
+                    self.shipper.kill()
+                self.shipper.snapshot_once()
+                self.shipper.flush_once(budget_s=0.5)
             final.cleanup(remove_containers=True)
             unfaulted = {w.id for i, w in enumerate(self.driver.workers())
                          if i not in faulted}
@@ -518,7 +585,8 @@ class ChaosRunner:
                 loops=final.loops, cap=self.plan.max_inflight_per_worker,
                 unfaulted=unfaulted, health=final.health,
                 kills=self.kills, sentinel=self.sentinel,
-                workerd=self._workerd_audit()))
+                workerd=self._workerd_audit(),
+                shipper=self._shipper_audit()))
         except ClawkerError as e:
             runner_error = True
             result.violations.append(f"runner-error: {e}")
@@ -527,6 +595,10 @@ class ChaosRunner:
                 self.feeder.stop()
             if self.sentinel is not None:
                 self.sentinel.stop()
+            if self.shipper is not None:
+                self.shipper.kill()
+            if self.index is not None:
+                self.index.unstall()    # release any wedged sink thread
             if self.executors is not None:
                 self.executors.close_all()
             for srv in self.workerd_servers:

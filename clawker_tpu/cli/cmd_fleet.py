@@ -803,6 +803,75 @@ def _render_anomaly_rows(rows: list[dict]) -> None:
             "ANOMALOUS" if r.get("flagged") else "-")))
 
 
+@fleet_group.command("console")
+@click.option("--fps", type=float, default=4.0,
+              help="Repaint rate for the live view.")
+@click.option("--once", is_flag=True,
+              help="Render one plain frame and exit (scripts/CI).")
+@click.option("--format", "fmt", type=click.Choice(["table", "json"]),
+              default="table",
+              help="json emits the console feed document -- the same "
+                   "schema `clawker loopd status --format json` "
+                   "carries under its `console` key.")
+@click.option("--no-spans", is_flag=True,
+              help="Skip the flight-recorder span waterfalls.")
+@pass_factory
+def fleet_console(f: Factory, fps, once, fmt, no_spans):
+    """Live multi-run fleet console over the loopd status RPC.
+
+    One pane of glass over every run the daemon hosts: per-loop status
+    with sentinel ANOM-Z flags, per-worker breaker/admission-token/
+    workerd rows, tenant queues, warm pools, ingest state, and span
+    waterfalls tailed from each run's flight recorder.  Damage-tracked
+    repainting with row virtualization past 64 agents keeps 256 agents
+    across 4 hosted runs inside the repaint budget
+    (docs/fleet-console.md).  Requires a running loopd
+    (`clawker loopd start`); Ctrl-C exits the console, never a run.
+    """
+    import time as _time
+
+    from ..errors import ClawkerError
+    from ..loopd.client import discover
+    from ..loopd.feed import console_feed
+    from ..ui.fleetconsole import FleetConsole
+
+    try:
+        project = f.config.project_name()
+    except LookupError:
+        project = None
+    client = discover(f.config, require_project=project)
+    if client is None:
+        click.echo("fleet console: no loopd daemon answering (start one "
+                   "with `clawker loopd start`)", err=True)
+        raise SystemExit(1)
+
+    def feed_fn() -> dict:
+        return console_feed(client.status())
+
+    try:
+        if fmt == "json":
+            click.echo(json.dumps(feed_fn(), indent=2))
+            return
+        console = FleetConsole(
+            f.streams, feed_fn,
+            logs_dir=None if no_spans else f.config.logs_dir, fps=fps)
+        if once or not f.streams.is_stdout_tty():
+            click.echo(console.snapshot())
+            return
+        try:
+            while True:
+                console.render_once()
+                _time.sleep(1.0 / max(0.5, fps))
+        except KeyboardInterrupt:
+            pass
+    except (ClawkerError, OSError) as e:
+        # OSError too: a daemon killed mid-poll surfaces as a raw
+        # BrokenPipe from the socket send, not a wrapped protocol error
+        raise click.ClickException(f"fleet console: loopd went away ({e})")
+    finally:
+        client.close()
+
+
 @fleet_group.command("status")
 @click.option("--format", "fmt", type=click.Choice(["table", "json"]), default="table")
 @pass_factory

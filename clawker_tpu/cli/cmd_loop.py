@@ -146,6 +146,14 @@ class _ClientInterrupt:
                    "typed anomaly.flag events/metrics/spans -- strictly "
                    "observe-only (default: settings sentinel.enable; "
                    "docs/analytics-online.md).")
+@click.option("--ship-telemetry/--no-ship-telemetry", "ship_telemetry",
+              default=None,
+              help="Bulk-ship this run's registry snapshots, typed bus "
+                   "events, and flight spans into the monitor stack's "
+                   "OpenSearch index (default: settings "
+                   "monitoring.shipper.enable).  Bounded backpressure: "
+                   "a slow or down index drops oldest batches, never "
+                   "stalls the run (docs/fleet-console.md#ingestion).")
 @click.option("--chaos-plan", "chaos_plan", type=click.Path(exists=True),
               default=None,
               help="DEV: apply a chaos fault plan (clawker chaos plan "
@@ -177,7 +185,8 @@ def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                placement, tenant, tenant_weight, max_inflight_per_worker,
                warm_pool, image, prompt, worktrees, env_kv, failover,
                orphan_grace, resume_run, metrics_port, sentinel_flag,
-               chaos_plan, as_json, keep, use_daemon, use_workerd, detach):
+               ship_telemetry, chaos_plan, as_json, keep, use_daemon,
+               use_workerd, detach):
     """Fan autonomous agent loops across the runtime's workers."""
     if ctx.invoked_subcommand is not None:
         return
@@ -187,7 +196,7 @@ def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                tenant_weight=tenant_weight,
                max_inflight_per_worker=max_inflight_per_worker,
                warm_pool=warm_pool, sentinel_flag=sentinel_flag,
-               chaos_plan=chaos_plan,
+               ship_telemetry=ship_telemetry, chaos_plan=chaos_plan,
                use_daemon=use_daemon, use_workerd=use_workerd,
                detach=detach)
 
@@ -196,8 +205,9 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
                worktrees, env_kv, failover, orphan_grace, metrics_port,
                as_json, keep, resume_run=None, tenant=None,
                tenant_weight=None, max_inflight_per_worker=None,
-               warm_pool=None, sentinel_flag=None, chaos_plan=None,
-               use_daemon=None, use_workerd=None, detach=False):
+               warm_pool=None, sentinel_flag=None, ship_telemetry=None,
+               chaos_plan=None, use_daemon=None, use_workerd=None,
+               detach=False):
     from .. import telemetry
 
     if use_daemon and (resume_run or chaos_plan):
@@ -333,6 +343,12 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
                         "-- --sentinel is ignored; set settings "
                         "sentinel.enable and restart the daemon "
                         "(docs/analytics-online.md)", err=True)
+                if ship_telemetry:
+                    click.echo(
+                        "note: telemetry shipping is daemon-scoped under "
+                        "loopd -- --ship-telemetry is ignored; set "
+                        "settings monitoring.shipper.enable and restart "
+                        "the daemon (docs/fleet-console.md)", err=True)
                 _run_loops_client(f, client, spec, detach=detach,
                                   as_json=as_json, keep=keep)
                 return
@@ -369,6 +385,21 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
         lane = telemetry.telemetry_lane(f.config)
         if lane is not None:
             shipper = telemetry.MetricsOtlpShipper(lane).start()
+    # --- bulk ingestion into the monitor stack (docs/fleet-console.md):
+    # registry snapshots + typed bus events + flight spans into the
+    # OpenSearch bulk API under bounded batching -- a down index drops
+    # oldest batches, never the run
+    bulk_shipper = None
+    want_ship = (ship_telemetry if ship_telemetry is not None
+                 else f.config.settings.monitoring.shipper.enable)
+    if want_ship:
+        from ..monitor.shipper import TelemetryShipper
+
+        bulk_shipper = TelemetryShipper.from_config(
+            f.config, source=f"loop:{sched.loop_id}").start()
+        sched.attach_shipper(bulk_shipper)
+        click.echo("telemetry: shipping into the monitor stack "
+                   "(bounded; see monitor_ingest_* metrics)", err=True)
     # fleet anomaly scoring rides along whenever the accelerator runtime
     # is importable: scores land in the dashboard's ANOM-Z column, the
     # status JSON, and as scheduler events past the threshold.  With
@@ -462,6 +493,8 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             watch.stop()
         if shipper is not None:
             shipper.stop()
+        if bulk_shipper is not None:
+            bulk_shipper.stop()
         if metrics_server is not None:
             metrics_server.stop()
         if executors is not None:
@@ -568,9 +601,17 @@ def _stream_daemon_run(client, run_id: str, as_json: bool) -> None:
             f"loopd stream ended unexpectedly (daemon died?) -- the "
             f"journal survives: `clawker loop --resume {run_id}`")
     agents = final.get("agents", [])
+    dropped = int(final.get("events_dropped", 0))
+    if dropped:
+        # the live view was lossy (slow subscriber queues); the journal
+        # and flight record were not -- say so instead of looking whole
+        click.echo(f"note: {dropped} event frame(s) dropped on slow "
+                   f"subscriber queues during this run "
+                   f"(loopd_events_dropped_total); the journal and "
+                   f"flight record are complete", err=True)
     if as_json:
-        click.echo(json.dumps({"loop_id": run_id, "agents": agents},
-                              indent=2))
+        click.echo(json.dumps({"loop_id": run_id, "agents": agents,
+                               "events_dropped": dropped}, indent=2))
     else:
         for a in agents:
             codes = ",".join(map(str, a.get("exit_codes", []))) or "-"

@@ -115,7 +115,7 @@ def loopd_stop(f: Factory, force):
 
 
 _RUN_COLUMNS = ("RUN", "STATE", "TENANT", "CLIENT", "LOOPS", "PLACEMENT",
-                "SUBS")
+                "SUBS", "DROPS")
 
 
 @loopd_group.command("status")
@@ -139,6 +139,12 @@ def loopd_status(f: Factory, fmt):
         client.close()
     doc.pop("type", None)
     if fmt == "json":
+        from ..loopd.feed import console_feed
+
+        # `console` is THE script-facing schema -- the exact document
+        # `clawker fleet console --format json` emits, so the TUI and
+        # scripts can never drift (docs/fleet-console.md#feed)
+        doc["console"] = console_feed(doc)
         click.echo(json.dumps(doc, indent=2))
         return
     click.echo(f"loopd pid {doc['pid']} project={doc.get('project') or '-'} "
@@ -150,9 +156,16 @@ def loopd_status(f: Factory, fmt):
         for r in runs:
             click.echo("\t".join(str(x) for x in (
                 r["run"], r["state"], r["tenant"], r["client"],
-                r["parallel"], r["placement"], r["subscribers"])))
+                r["parallel"], r["placement"], r["subscribers"],
+                r.get("events_dropped", 0))))
     else:
         click.echo("no hosted runs")
+    ship = doc.get("shipper") or {}
+    if ship.get("enabled"):
+        click.echo(f"shipper: {ship.get('ingested_docs', 0)} doc(s) in, "
+                   f"{ship.get('flushed_batches', 0)} batch(es) shipped, "
+                   f"{ship.get('pending_batches', 0)} pending, "
+                   f"{ship.get('dropped_docs', 0)} dropped")
     adm = doc.get("admission", {})
     for wid, w in sorted(adm.get("workers", {}).items()):
         click.echo(f"worker {wid}: tokens {w['inflight']}/{w['capacity']} "

@@ -328,12 +328,38 @@ class FirewallSettings:
 
 
 @dataclass
+class ShipperSettings:
+    """Fleet-telemetry bulk ingestion into the monitor stack
+    (docs/fleet-console.md#ingestion).
+
+    With ``enable``, a loopd daemon hosts one
+    :class:`~clawker_tpu.monitor.shipper.TelemetryShipper` for its
+    lifetime (in-process runs attach one with ``clawker loop
+    --ship-telemetry``): registry snapshots, typed bus events, and
+    flight-recorder spans batch into the OpenSearch bulk API.  Bounded
+    by design -- at most ``max_batches`` sealed batches wait in memory;
+    a slow or down index drops the OLDEST batches (counted in
+    ``monitor_ingest_dropped_total``) and can never stall the event bus
+    or a scheduler lane."""
+
+    enable: bool = False
+    url: str = ""                   # bulk endpoint override; "" = the
+    #                                 local stack's opensearch port
+    interval_s: float = 2.0         # snapshot + flush cadence
+    batch_docs: int = 256           # docs per sealed bulk batch
+    max_batches: int = 64           # sealed batches buffered before
+    #                                 drop-oldest backpressure
+    timeout_s: float = 5.0          # per-bulk-POST deadline
+
+
+@dataclass
 class MonitoringSettings:
     enable: bool = False
     opensearch_port: int = 9200
     dashboards_port: int = 5601
     prometheus_port: int = 9090
     otlp_grpc_port: int = 4317
+    shipper: ShipperSettings = field(default_factory=ShipperSettings)
 
 
 @dataclass

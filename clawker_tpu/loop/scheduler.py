@@ -457,6 +457,9 @@ class LoopScheduler:
         self.tracer = Tracer(
             self.loop_id,
             on_span=self._record_span if spec.telemetry else None)
+        self._span_sinks: list = []     # extra structured-span consumers
+        #                                 (the monitor shipper); tee'd in
+        #                                 _record_span, never load-bearing
         self._queue_wait: dict[str, float] = {}   # agent -> launch queue s
         self._iter_started: dict[tuple[str, int], float] = {}  # wait-span t0
         # --- durability: the write-ahead run journal (docs/loop-resume.md).
@@ -523,6 +526,11 @@ class LoopScheduler:
         if self.flight is not None:
             self.flight.append(rec.to_json())
         self.events.emit(rec.agent, TRACE_SPAN, rec.detail())
+        for sink in self._span_sinks:
+            try:
+                sink(rec)
+            except Exception:   # noqa: BLE001 -- telemetry never raises
+                pass            # into the scheduler hot path
 
     def _journal(self, kind: str, *, durable: bool = False, **fields) -> None:
         """Append one journal record; a disabled/degraded journal no-ops
@@ -564,6 +572,16 @@ class LoopScheduler:
         self.attach_anomaly_watch(sentinel)
         sentinel.bind_run(run_id=self.loop_id, events=self.events,
                           flight=self.flight)
+
+    def attach_shipper(self, shipper) -> None:
+        """Attach a :class:`~clawker_tpu.monitor.shipper.
+        TelemetryShipper`: this run's typed bus events and completed
+        spans flow into its bounded batches tagged with the run id.
+        Strictly observe-only and non-blocking by the shipper's intake
+        contract -- a slow or down index can never stall the bus or a
+        lane (docs/fleet-console.md#degrade-matrix)."""
+        self.events.add_tap(shipper.bus_tap_for(self.loop_id))
+        self._span_sinks.append(shipper.span_sink_for(self.loop_id))
 
     # -------------------------------------------------------------- set up
 

@@ -1,0 +1,87 @@
+"""The console feed: ONE schema over the loopd status RPC.
+
+``clawker fleet console`` (the live multi-run TUI), ``clawker loopd
+status --format json`` (scripts), and the repaint-budget tests all read
+the same normalized document, built here from a raw status RPC reply --
+so a field the console renders is by construction a field scripts can
+select on, and the two can never drift (docs/fleet-console.md#feed).
+
+Normalizations over the raw RPC doc:
+
+- every hosted run gets uniform ``agents`` rows (``agent``, ``worker``,
+  ``status``, ``iteration``, ``exits`` as a comma string,
+  ``anomaly_z``) with the daemon sentinel's latest per-agent z merged
+  in -- the RPC carries sentinel rows separately because the sentinel
+  outlives any one run;
+- per-run ``events_dropped`` (the run's slice of
+  ``loopd_events_dropped_total``) always present, 0 when nothing
+  dropped;
+- admission/health/workerd/warm-pool/shipper blocks pass through under
+  stable keys with absent sections as empty containers, so consumers
+  never need ``.get`` chains.
+"""
+
+from __future__ import annotations
+
+
+def _agent_rows(run: dict, anom: dict[str, float]) -> list[dict]:
+    rows = []
+    for a in run.get("agents") or []:
+        agent = str(a.get("agent", ""))
+        z = a.get("anomaly_z")
+        if z is None:
+            z = anom.get(agent)
+        rows.append({
+            "agent": agent,
+            "worker": str(a.get("worker", "")),
+            "status": str(a.get("status", "")),
+            "iteration": int(a.get("iteration", 0)),
+            "exits": ",".join(map(str, a.get("exit_codes") or [])) or "-",
+            "anomaly_z": (round(float(z), 2) if z is not None else None),
+        })
+    return rows
+
+
+def console_feed(doc: dict) -> dict:
+    """Raw loopd status RPC reply -> the normalized console feed."""
+    doc = doc or {}
+    sentinel = doc.get("sentinel") or {"enabled": False}
+    anom: dict[str, float] = {}
+    for r in sentinel.get("rows") or []:
+        agent = str(r.get("agent", ""))
+        try:
+            z = float(r.get("latest_z", 0.0))
+        except (TypeError, ValueError):
+            continue
+        if agent and (agent not in anom or z > anom[agent]):
+            anom[agent] = z
+    runs = []
+    for r in doc.get("runs") or []:
+        runs.append({
+            "run": str(r.get("run", "")),
+            "state": str(r.get("state", "")),
+            "tenant": str(r.get("tenant", "")),
+            "client": str(r.get("client", "")),
+            "parallel": int(r.get("parallel", 0)),
+            "iterations": int(r.get("iterations", 0)),
+            "placement": str(r.get("placement", "")),
+            "subscribers": int(r.get("subscribers", 0)),
+            "events_dropped": int(r.get("events_dropped", 0)),
+            **({"ok": r.get("ok")} if "ok" in r else {}),
+            "agents": _agent_rows(r, anom),
+        })
+    admission = doc.get("admission") or {}
+    return {
+        "pid": doc.get("pid"),
+        "project": str(doc.get("project") or ""),
+        "uptime_s": float(doc.get("uptime_s") or 0.0),
+        "runs": runs,
+        "workers": admission.get("workers") or {},
+        "tenants": admission.get("tenants") or {},
+        "health": doc.get("health") or [],
+        "workerd": doc.get("workerd") or {},
+        "warm_pools": doc.get("warm_pools") or {},
+        "sentinel": sentinel,
+        "shipper": doc.get("shipper") or {"enabled": False},
+        "events_dropped_total": int(doc.get("events_dropped_total", 0)),
+    }

@@ -123,6 +123,11 @@ class _DaemonRun:
     ring: collections.deque = field(
         default_factory=lambda: collections.deque(maxlen=EVENT_RING))
     subs: dict[int, queue.Queue] = field(default_factory=dict)
+    dropped: int = 0                    # frames dropped off slow subscriber
+    #                                     queues (the per-run view of
+    #                                     loopd_events_dropped_total; the
+    #                                     status feed and the attach-stream
+    #                                     footer both surface it)
     _next_sub: int = 0
 
     def subscribe(self) -> tuple[int, queue.Queue, list[dict], bool]:
@@ -164,6 +169,7 @@ class _DaemonRun:
                             q.get_nowait()
                         except queue.Empty:
                             continue
+                        self.dropped += 1
                         _EVENTS_DROPPED.inc()
 
     def status_doc(self) -> dict:
@@ -178,6 +184,7 @@ class _DaemonRun:
             "placement": self.spec.placement,
             "agents": sched.status() if sched is not None else [],
             "subscribers": len(self.subs),
+            "events_dropped": self.dropped,
             **({"ok": self.result.get("ok")} if self.done.is_set() else {}),
         }
 
@@ -214,6 +221,8 @@ class LoopdServer:
         self._metrics_server = None
         self.sentinel = None        # daemon-lifetime FleetSentinel when
         #                             settings sentinel.enable + jax
+        self.shipper = None         # daemon-lifetime TelemetryShipper when
+        #                             settings monitoring.shipper.enable
 
     # ----------------------------------------------------------- lifecycle
 
@@ -249,6 +258,7 @@ class LoopdServer:
         self.health = HealthMonitor(self.driver)
         self.health.start()
         self._start_sentinel()
+        self._start_shipper()
         if self._metrics_port:
             self._metrics_server = telemetry.MetricsServer(
                 self._metrics_port).start()
@@ -285,6 +295,26 @@ class LoopdServer:
         except Exception:           # noqa: BLE001 -- observe-only rider
             log.exception("loopd sentinel failed to start; continuing")
             self.sentinel = None
+
+    def _start_shipper(self) -> None:
+        """Bring up the daemon-lifetime fleet-telemetry shipper when
+        settings ``monitoring.shipper.enable`` is set: every hosted
+        run's typed events + spans, plus periodic registry snapshots,
+        batch into the monitor stack's bulk API
+        (docs/fleet-console.md#ingestion).  Failure degrades to no
+        shipper -- indexing is a rider, never the daemon's job."""
+        if not self.cfg.settings.monitoring.shipper.enable:
+            return
+        try:
+            from ..monitor.shipper import TelemetryShipper
+
+            self.shipper = TelemetryShipper.from_config(
+                self.cfg, source=f"loopd:{os.getpid()}").start()
+            log.info("loopd shipper up (interval %.1fs)",
+                     self.shipper.interval_s)
+        except Exception:           # noqa: BLE001 -- observe-only rider
+            log.exception("loopd shipper failed to start; continuing")
+            self.shipper = None
 
     def _socket_answers(self) -> bool:
         try:
@@ -333,6 +363,8 @@ class LoopdServer:
             self.health.stop()
         if self.sentinel is not None:
             self.sentinel.stop()
+        if self.shipper is not None:
+            self.shipper.stop()
         if self._metrics_server is not None:
             self._metrics_server.stop()
         self.lanes.close_all()
@@ -361,6 +393,8 @@ class LoopdServer:
             self.health.stop()
         if self.sentinel is not None:
             self.sentinel.kill_collector()
+        if self.shipper is not None:
+            self.shipper.kill()
         if self._metrics_server is not None:
             self._metrics_server.stop()
         self._stopped.set()
@@ -600,6 +634,11 @@ class LoopdServer:
                 # sentinel's behavioral features (observe-only: the tap
                 # reads records, the sentinel holds no scheduler ref)
                 sched.events.add_tap(self.sentinel.behavior)
+            if self.shipper is not None:
+                # typed events + spans into the monitor stack, tagged
+                # with this run id (bounded intake: a down index can
+                # never stall the bus -- docs/fleet-console.md)
+                sched.attach_shipper(self.shipper)
             if self._aborted:
                 sched.kill()        # kill() raced the construction
                 return
@@ -625,7 +664,11 @@ class LoopdServer:
             _ACTIVE_RUNS.set(sum(1 for r in self.runs.values()
                                  if not r.done.is_set()))
         run.publish({"type": "run_done", "run": run.run_id,
-                     "agents": agents, "ok": ok})
+                     "agents": agents, "ok": ok,
+                     # surfaced in the attach-stream footer: drops mean
+                     # the live view was lossy, the journal/flight
+                     # record were not
+                     "events_dropped": run.dropped})
         run.publish(None)
 
     def _resolve_run(self, ref: str) -> _DaemonRun:
@@ -694,7 +737,8 @@ class LoopdServer:
                 protocol.write_msg(conn, {
                     "type": "run_done", "run": run.run_id,
                     "agents": run.result.get("agents", []),
-                    "ok": run.result.get("ok", False)})
+                    "ok": run.result.get("ok", False),
+                    "events_dropped": run.dropped})
                 return
             while not detached.is_set():
                 frame = q.get()
@@ -773,6 +817,11 @@ class LoopdServer:
             "sentinel": (self.sentinel.status_doc()
                          if self.sentinel is not None
                          else {"enabled": False}),
+            "shipper": ({"enabled": True, **self.shipper.stats()}
+                        if self.shipper is not None
+                        else {"enabled": False}),
+            "events_dropped_total": sum(r.get("events_dropped", 0)
+                                        for r in runs),
             "settings": {
                 "max_inflight_per_worker":
                     self.cfg.settings.loop.placement.max_inflight_per_worker,

@@ -72,6 +72,23 @@ def _lane_template(index: str, default_pipeline: str | None,
     }
 
 
+def _fleet_template(index: str, props: dict) -> dict:
+    """Bulk-ingested fleet-telemetry index: top-level document fields
+    (no OTLP envelope -- the shipper stamps ``@timestamp`` itself),
+    composed on clawker-common for the shared time/trace mappings and
+    keeping the envelope-normalize backstop every clawker index
+    carries (a no-op for docs that already arrive stamped)."""
+    return {
+        "index_patterns": [index, f"{index}-*"],
+        "priority": 100,
+        "composed_of": ["clawker-common"],
+        "template": {
+            "settings": {"index": {"final_pipeline": "envelope-normalize"}},
+            "mappings": {"properties": props},
+        },
+    }
+
+
 def index_templates() -> dict[str, dict]:
     """Per-lane index templates for the base log indices."""
     kw = {"type": "keyword"}
@@ -108,6 +125,31 @@ def index_templates() -> dict[str, dict]:
                 "l4_proto": kw, "l4_proto_code": {"type": "integer"},
                 "zone_hash": kw, "dst_host": kw,
             }),
+        # fleet-telemetry ingestion (monitor/shipper.py,
+        # docs/fleet-console.md#ingestion): these docs arrive over the
+        # bulk API with top-level fields, not OTLP attributes, so the
+        # templates map the document root directly
+        "clawker-fleet-metrics": _fleet_template("clawker-fleet-metrics", {
+            "type": kw, "source": kw, "metric": kw, "kind": kw,
+            "labels": {"type": "object", "dynamic": True},
+            "value": {"type": "double"}, "sum": {"type": "double"},
+        }),
+        "clawker-fleet-events": _fleet_template("clawker-fleet-events", {
+            "type": kw, "source": kw, "event": kw, "run": kw,
+            "agent": kw, "worker": kw, "seq": {"type": "long"},
+            "policy": kw, "tenant": kw, "action": kw,
+            "old_state": kw, "new_state": kw, "reason": kw,
+            "kind": kw, "z": {"type": "float"},
+            "detail": {"type": "text"},
+        }),
+        "clawker-fleet-spans": _fleet_template("clawker-fleet-spans", {
+            "type": kw, "source": kw, "run": kw, "trace_id": kw,
+            "span_id": kw, "parent_id": kw, "name": kw, "agent": kw,
+            "worker": kw, "status": kw,
+            "t_start": {"type": "double"}, "t_end": {"type": "double"},
+            "wall_ms": {"type": "float"},
+            "attrs": {"type": "object", "dynamic": True},
+        }),
     }
 
 

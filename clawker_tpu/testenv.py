@@ -290,3 +290,89 @@ class StubDockerDaemon:
                 conn.close()
             except OSError:
                 pass
+
+
+class FakeBulkIndex:
+    """In-memory OpenSearch ``_bulk`` endpoint (test/bench support for
+    the monitor shipper, docs/fleet-console.md#ingestion).
+
+    Implements the shipper's sink contract -- ``bulk(payload) -> bool``
+    -- by parsing the ndjson action/doc pairs into per-index doc lists,
+    so tests and the ``ingest_docs_lag`` bench gate assert on what the
+    index would actually hold.  Fault knobs model the chaos the shipper
+    must degrade under:
+
+    - ``down = True``: every bulk POST refuses (connection-refused
+      index);
+    - ``stall()`` / ``unstall()``: bulk POSTs block until released or
+      ``stall_timeout_s`` passes, then fail -- a wedged index that eats
+      the sink's deadline without answering;
+    - ``delay_s``: fixed per-POST latency (a slow-but-healthy index).
+    """
+
+    def __init__(self, *, delay_s: float = 0.0,
+                 stall_timeout_s: float = 2.0):
+        import json
+
+        self._json = json
+        self.delay_s = delay_s
+        self.stall_timeout_s = stall_timeout_s
+        self.down = False
+        self.docs: dict[str, list[dict]] = {}
+        self.bulk_calls = 0
+        self.refused = 0
+        self._lock = threading.Lock()
+        self._stalled = threading.Event()
+        self._release = threading.Event()
+        self._release.set()
+
+    # fault knobs ---------------------------------------------------------
+
+    def stall(self) -> None:
+        self._release.clear()
+        self._stalled.set()
+
+    def unstall(self) -> None:
+        self._release.set()
+        self._stalled.clear()
+
+    # sink contract -------------------------------------------------------
+
+    def bulk(self, payload: bytes) -> bool:
+        with self._lock:
+            self.bulk_calls += 1
+        if self._stalled.is_set():
+            if not self._release.wait(self.stall_timeout_s):
+                with self._lock:
+                    self.refused += 1
+                return False
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.down:
+            with self._lock:
+                self.refused += 1
+            return False
+        lines = payload.decode().splitlines()
+        with self._lock:
+            for action_line, doc_line in zip(lines[0::2], lines[1::2]):
+                try:
+                    action = self._json.loads(action_line)
+                    doc = self._json.loads(doc_line)
+                except ValueError:
+                    continue
+                index = str(action.get("index", {}).get("_index", ""))
+                self.docs.setdefault(index, []).append(doc)
+        return True
+
+    # assertions ----------------------------------------------------------
+
+    def count(self, index: str) -> int:
+        with self._lock:
+            return len(self.docs.get(index, []))
+
+    def search(self, index: str, **match) -> list[dict]:
+        """Every doc in ``index`` whose fields equal ``match``."""
+        with self._lock:
+            rows = list(self.docs.get(index, []))
+        return [d for d in rows
+                if all(d.get(k) == v for k, v in match.items())]

@@ -19,6 +19,7 @@ import time
 from pathlib import Path
 
 from .colors import visible_len
+from .damage import DamagePainter
 from .iostreams import IOStreams
 from .table import render_table
 
@@ -74,7 +75,11 @@ class LoopDashboard:
         self.fps = fps
         self.events: collections.deque = collections.deque(maxlen=64)
         self.started = time.monotonic()
-        self._painted = 0
+        # damage-tracked repaint (ui/damage.py): an idle fleet's tick
+        # costs cursor motion, not a full-frame rewrite -- the same
+        # painter the fleet console budgets at 256 agents
+        self.painter = DamagePainter(streams.stdout.write,
+                                     streams.stdout.flush)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -176,19 +181,7 @@ class LoopDashboard:
     def render_once(self) -> None:
         if not self.streams.is_stdout_tty():
             return
-        lines = self._frame_lines()
-        w = self.streams.stdout.write
-        if self._painted:
-            w(f"\x1b[{self._painted}A")
-        for line in lines:
-            w("\x1b[2K" + line + "\n")
-        # a shrinking frame must not leave stale tail lines
-        for _ in range(max(0, self._painted - len(lines))):
-            w("\x1b[2K\n")
-        if self._painted > len(lines):
-            w(f"\x1b[{self._painted - len(lines)}A")
-        self.streams.stdout.flush()
-        self._painted = len(lines)
+        self.painter.paint(self._frame_lines())
 
     # ----------------------------------------------------------- lifecycle
 

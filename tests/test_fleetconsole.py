@@ -1,0 +1,465 @@
+"""Fleet console suite (ISSUE 13): daemon-backed multi-run TUI.
+
+The acceptance shape: the console renders everything one loopd hosts
+(per-loop status, breakers, pools, tenants, workerd, ANOM-Z, span
+waterfalls) from the SAME console-feed schema `loopd status --format
+json` serves scripts; damage-tracked painting plus row virtualization
+hold the repaint budget at 256 agents across 4 hosted runs; and the
+per-run dashboard reuses the dirty-row painter instead of repainting
+the full table every tick.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.config import load_config
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.loopd.feed import console_feed
+from clawker_tpu.telemetry.spans import SpanRecord
+from clawker_tpu.testenv import TestEnv
+from clawker_tpu.ui.damage import DamagePainter
+from clawker_tpu.ui.fleetconsole import (
+    MAX_AGENT_ROWS,
+    FleetConsole,
+    SpanTail,
+    virtualize,
+)
+from clawker_tpu.ui.iostreams import IOStreams
+
+IMAGE = "clawker-consoleproj:default"
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text(
+            "project: consoleproj\n")
+        cfg = load_config(proj)
+        yield tenv, proj, cfg
+
+
+def driver_with(n_workers: int, behavior=None):
+    drv = FakeDriver(n_workers=n_workers)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, behavior or exit_behavior(b"done\n", 0))
+    return drv
+
+
+class _Sink:
+    def __init__(self):
+        self.chunks: list[str] = []
+
+    def write(self, s: str) -> None:
+        self.chunks.append(s)
+
+    def flush(self) -> None:
+        pass
+
+    def text(self) -> str:
+        return "".join(self.chunks)
+
+
+# --------------------------------------------------------------- painter
+
+
+def test_damage_painter_first_frame_paints_all():
+    sink = _Sink()
+    p = DamagePainter(sink.write, sink.flush)
+    assert p.paint(["a", "b", "c"]) == 3
+    assert sink.text() == "\x1b[2Ka\n\x1b[2Kb\n\x1b[2Kc\n"
+
+
+def test_damage_painter_unchanged_frame_paints_nothing():
+    sink = _Sink()
+    p = DamagePainter(sink.write, sink.flush)
+    p.paint(["a", "b", "c"])
+    sink.chunks.clear()
+    assert p.paint(["a", "b", "c"]) == 0
+    # one cursor-up, one batched cursor-down, zero rewrites
+    assert sink.text() == "\x1b[3A\x1b[3B"
+
+
+def test_damage_painter_rewrites_only_dirty_rows():
+    sink = _Sink()
+    p = DamagePainter(sink.write, sink.flush)
+    p.paint(["a", "b", "c", "d"])
+    sink.chunks.clear()
+    assert p.paint(["a", "B", "c", "d"]) == 1
+    out = sink.text()
+    assert "\x1b[2KB\n" in out and "\x1b[2Ka" not in out
+    assert out.startswith("\x1b[4A\x1b[1B")     # skip a, rewrite B, skip c+d
+    assert out.endswith("\x1b[2B")
+
+
+def test_damage_painter_growth_and_shrink():
+    sink = _Sink()
+    p = DamagePainter(sink.write, sink.flush)
+    p.paint(["a"])
+    assert p.paint(["a", "b", "c"]) == 2        # growth appends
+    sink.chunks.clear()
+    assert p.paint(["a"]) == 0                  # shrink: erase stale tail
+    out = sink.text()
+    assert out.count("\x1b[2K\n") == 2 and out.endswith("\x1b[2A")
+    # after a shrink, a repaint of the same frame is still clean
+    assert p.paint(["a"]) == 0
+
+
+def test_damage_painter_reset_forces_full_repaint():
+    sink = _Sink()
+    p = DamagePainter(sink.write, sink.flush)
+    p.paint(["a", "b"])
+    p.reset()
+    assert p.paint(["a", "b"]) == 2
+
+
+# ------------------------------------------------------------------ feed
+
+
+def _status_doc() -> dict:
+    return {
+        "pid": 99, "project": "p", "uptime_s": 7.5,
+        "runs": [{
+            "run": "r1", "state": "running", "tenant": "t", "client": "c",
+            "parallel": 2, "iterations": 3, "placement": "spread",
+            "subscribers": 1, "events_dropped": 4,
+            "agents": [
+                {"agent": "a0", "worker": "w0", "status": "running",
+                 "iteration": 2, "exit_codes": [0, 0]},
+                {"agent": "a1", "worker": "w1", "status": "failed",
+                 "iteration": 1, "exit_codes": []},
+            ]}],
+        "admission": {"workers": {"w0": {"inflight": 1, "capacity": 4,
+                                         "pending": 0, "rejected": 0}},
+                      "tenants": {"t": {"weight": 1.0, "inflight": 1,
+                                        "queued": 0, "dispatched": 3}}},
+        "health": [{"worker": "w0", "state": "closed",
+                    "breaker_state_gauge": 0, "probe_p50_ms": 1.0}],
+        "workerd": {"w0": "ok"},
+        "warm_pools": {},
+        "sentinel": {"enabled": True, "rows": [
+            {"agent": "a1", "worker": "w1", "latest_z": 4.4,
+             "flagged": True}]},
+        "shipper": {"enabled": True, "ingested_docs": 10,
+                    "pending_batches": 0, "dropped_docs": 0},
+        "events_dropped_total": 4,
+    }
+
+
+def test_console_feed_normalizes_runs_and_merges_sentinel():
+    feed = console_feed(_status_doc())
+    assert feed["pid"] == 99 and feed["events_dropped_total"] == 4
+    (run,) = feed["runs"]
+    assert run["events_dropped"] == 4 and run["subscribers"] == 1
+    a0, a1 = run["agents"]
+    assert a0["exits"] == "0,0" and a0["anomaly_z"] is None
+    # the daemon sentinel's latest z lands on the matching agent row
+    assert a1["anomaly_z"] == 4.4 and a1["status"] == "failed"
+    assert feed["workers"]["w0"]["capacity"] == 4
+    assert feed["shipper"]["enabled"]
+
+
+def test_console_feed_tolerates_sparse_docs():
+    feed = console_feed({})
+    assert feed["runs"] == [] and feed["health"] == []
+    assert feed["shipper"] == {"enabled": False}
+
+
+# -------------------------------------------------------- virtualization
+
+
+def _agents(n: int, run: int, status: str = "running") -> list[dict]:
+    return [{"agent": f"r{run}-a{i:03d}", "worker": f"w{i % 4}",
+             "status": status, "iteration": 1, "exits": "-",
+             "anomaly_z": None} for i in range(n)]
+
+
+def test_virtualize_below_budget_shows_everything():
+    runs = [{"run": "r0", "agents": _agents(10, 0)}]
+    ((_, visible, hidden),) = virtualize(runs)
+    assert len(visible) == 10 and hidden == 0
+
+
+def test_virtualize_bounds_rows_and_keeps_interesting_first():
+    runs = []
+    for r in range(4):
+        agents = _agents(64, r)
+        agents[50]["status"] = "failed"
+        agents[51]["anomaly_z"] = 9.9
+        runs.append({"run": f"r{r}", "agents": agents})
+    out = virtualize(runs, budget=MAX_AGENT_ROWS)
+    total = sum(len(v) for _, v, _ in out)
+    assert total <= MAX_AGENT_ROWS
+    for _, visible, hidden in out:
+        names = {a["agent"] for a in visible}
+        assert hidden == 64 - len(visible)
+        # the failed row and the hottest-anomaly row survive the cut
+        assert any(a["status"] == "failed" for a in visible)
+        assert any(a.get("anomaly_z") == 9.9 for a in visible)
+        assert names == set(sorted(names))      # stable render order
+
+
+# -------------------------------------------------------------- spantail
+
+
+def _write_spans(path, n, t0=0.0):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "a", encoding="utf-8") as fh:
+        for i in range(n):
+            root = SpanRecord(
+                trace_id="r1", span_id=f"s{t0}-{i}", parent_id="",
+                name="iteration", agent=f"a{i % 4}", worker="w0",
+                t_start=t0 + i, t_end=t0 + i + 0.8,
+                attrs={"iteration": i})
+            child = SpanRecord(
+                trace_id="r1", span_id=f"c{t0}-{i}",
+                parent_id=f"s{t0}-{i}", name="wait", agent=root.agent,
+                worker="w0", t_start=t0 + i + 0.2, t_end=t0 + i + 0.7)
+            fh.write(json.dumps(root.to_json()) + "\n")
+            fh.write(json.dumps(child.to_json()) + "\n")
+
+
+def test_spantail_incremental_and_bounded(tmp_path):
+    from clawker_tpu.ui.colors import ColorScheme
+
+    path = tmp_path / "flight.jsonl"
+    _write_spans(path, 3)
+    tail = SpanTail(path, limit=8)
+    assert tail.poll() == 6
+    lines = tail.waterfall_lines(ColorScheme(enabled=False))
+    assert len(lines) == 3
+    assert all("|" in l and "ms" in l for l in lines)
+    assert "=" in lines[0]                      # the wait phase drew
+    # incremental: only NEW records parse on the next poll
+    _write_spans(path, 2, t0=100.0)
+    assert tail.poll() == 4
+    # bounded: the window holds the newest `limit` records
+    assert len(tail.records) == 8
+
+
+# ---------------------------------------------------- repaint budget @256
+
+
+def test_repaint_budget_256_agents_4_runs():
+    """The acceptance gate's test twin: 4 hosted runs x 64 agents --
+    the frame is bounded by virtualization, steady-state frames with a
+    handful of changed rows repaint a small fraction of their rows, and
+    a frame builds+paints inside a generous wall ceiling."""
+    statuses: dict = {}
+
+    def doc() -> dict:
+        runs = []
+        for r in range(4):
+            agents = []
+            for i in range(64):
+                status, iteration = statuses.get((r, i), ("running", 1))
+                agents.append({"agent": f"loop-r{r}-{i:03d}",
+                               "worker": f"w{i % 4}", "status": status,
+                               "iteration": iteration, "exit_codes": [0]})
+            runs.append({"run": f"run{r}", "state": "running",
+                         "tenant": f"t{r}", "client": "x", "parallel": 64,
+                         "iterations": 4, "placement": "spread",
+                         "subscribers": 1, "events_dropped": 0,
+                         "agents": agents})
+        return {"pid": 1, "project": "p", "uptime_s": 1.0, "runs": runs,
+                "admission": {"workers": {}, "tenants": {}}, "health": [],
+                "workerd": {}, "warm_pools": {},
+                "sentinel": {"enabled": False},
+                "shipper": {"enabled": False}, "events_dropped_total": 0}
+
+    streams, _, out, _ = IOStreams.test()
+    console = FleetConsole(streams, lambda: console_feed(doc()))
+    console.render_once()                       # frame 0 paints everything
+    base = dict(console.painter.stats())
+    walls = []
+    for f in range(12):
+        for j in range(8):                      # 8 rows churn per tick,
+            statuses[(j % 4, (f + j) % 64)] = (  # mostly still running --
+                "running" if (f + j) % 5 else "done", f)  # steady state
+        t0 = time.perf_counter()
+        console.render_once()
+        walls.append(time.perf_counter() - t0)
+        out.truncate(0)
+        out.seek(0)
+    frame = console.frame_lines(console_feed(doc()))
+    agent_rows = sum(1 for l in frame if "loop-r" in l and "spans" not in l)
+    assert agent_rows <= MAX_AGENT_ROWS         # virtualized at 256 agents
+    assert len(frame) <= 140                    # whole frame bounded
+    assert any("+" in l and "more" in l for l in frame)
+    stats = console.painter.stats()
+    painted = stats["rows_painted"] - base["rows_painted"]
+    total = stats["rows_total"] - base["rows_total"]
+    # steady-state damage: most rows are clean most frames
+    assert painted < total * 0.5, (painted, total)
+    # generous wall ceiling -- the bench gate owns the tight budget;
+    # this catches an accidental O(agents^2) or full-file re-read
+    assert sorted(walls)[len(walls) // 2] < 0.25
+
+
+def test_console_renders_all_sections(env):
+    tenv, proj, cfg = env
+    doc = _status_doc()
+    streams, _, out, _ = IOStreams.test()
+    console = FleetConsole(streams, lambda: console_feed(doc),
+                           logs_dir=cfg.logs_dir)
+    from clawker_tpu.monitor.ledger import flight_path
+
+    _write_spans(flight_path(cfg.logs_dir, "r1"), 2)
+    text = console.snapshot()
+    assert "fleet console" in text and "run r1" in text
+    assert "a0" in text and "a1" in text
+    assert "ANOM-Z" in text and "4.4" in text   # sentinel flag column
+    assert "workers" in text and "workerd=ok" in text
+    assert "tenants" in text
+    assert "drops=4" in text                    # per-run dropped frames
+    assert "spans" in text and "ms" in text     # waterfall rendered
+    assert "ship:0p/0d" in text                 # shipper state in the bar
+
+
+# ------------------------------------------------ dashboard reuses painter
+
+
+def test_dashboard_repaints_only_dirty_rows():
+    from clawker_tpu.ui.dashboard import LoopDashboard
+
+    class _Sched:
+        loop_id = "dash1"
+
+        def status(self):
+            return [{"agent": f"a{i}", "worker": "w0", "status": "running",
+                     "iteration": 1, "exit_codes": []} for i in range(16)]
+
+    streams, _, out, _ = IOStreams.test()
+    for stream in (streams.stdin, streams.stdout, streams.stderr):
+        stream.isatty = lambda: True
+    dash = LoopDashboard(streams, _Sched())
+    dash.render_once()
+    first = dash.painter.stats()["rows_painted"]
+    assert first == dash.painter.stats()["rows_total"]
+    dash.render_once()
+    second = dash.painter.stats()["rows_painted"] - first
+    # only the rows carrying elapsed time may repaint; the 16-row agent
+    # table must not (the ISSUE 13 dirty-row fix)
+    assert second <= 2, second
+
+
+# --------------------------------------------------------------- CLI/RPC
+
+
+def _submit_and_wait(cfg, drv, parallel=2):
+    from clawker_tpu.loopd.client import LoopdClient
+    from clawker_tpu.loopd.server import LoopdServer
+
+    srv = LoopdServer(cfg, drv).start()
+    with LoopdClient(srv.sock_path) as client:
+        client.submit_run({"parallel": parallel, "iterations": 1},
+                          stream=True)
+        for frame in client.events():
+            if frame.get("type") == "run_done":
+                break
+    return srv
+
+
+def test_cli_fleet_console_once_and_json(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    srv = _submit_and_wait(cfg, drv)
+    try:
+        res = CliRunner().invoke(
+            cli, ["fleet", "console", "--once"],
+            obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+        assert res.exit_code == 0, res.output
+        assert "fleet console" in res.output and "run " in res.output
+        res2 = CliRunner().invoke(
+            cli, ["fleet", "console", "--format", "json"],
+            obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+        assert res2.exit_code == 0, res2.output
+        feed = json.loads(res2.output[res2.output.index("{"):])
+        assert feed["runs"] and feed["runs"][0]["agents"]
+        assert "events_dropped" in feed["runs"][0]
+    finally:
+        srv.stop()
+
+
+def test_cli_fleet_console_without_daemon_exits_nonzero(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    res = CliRunner().invoke(
+        cli, ["fleet", "console", "--once"],
+        obj=Factory(cwd=proj, driver=driver_with(1)))
+    assert res.exit_code == 1
+    assert "loopd" in res.output + (res.stderr or "")
+
+
+def test_loopd_status_json_carries_console_feed(env):
+    """The satellite contract: `loopd status --format json` and the
+    console share one schema -- the feed rides under `console`, with
+    per-run dropped-frame counts."""
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    srv = _submit_and_wait(cfg, drv)
+    try:
+        res = CliRunner().invoke(
+            cli, ["loopd", "status", "--format", "json"],
+            obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+        assert res.exit_code == 0, res.output
+        doc = json.loads(res.output[res.output.index("{"):])
+        feed = doc["console"]
+        assert feed["runs"] and feed == console_feed(doc)
+        run = feed["runs"][0]
+        assert {"run", "state", "agents", "events_dropped"} <= set(run)
+        assert all({"agent", "worker", "status", "iteration", "exits",
+                    "anomaly_z"} <= set(a) for a in run["agents"])
+    finally:
+        srv.stop()
+
+
+def test_console_bounds_run_count_live_runs_first():
+    """Review fix: loopd retains up to 64 done runs -- the console must
+    bound run sections (live first, newest done next) or the frame
+    blows the repaint budget and the painter's cursor math."""
+    runs = []
+    for i in range(70):
+        runs.append({"run": f"done{i:02d}", "state": "done", "tenant": "t",
+                     "client": "c", "parallel": 2, "iterations": 1,
+                     "placement": "spread", "subscribers": 0,
+                     "events_dropped": 0, "agents": _agents(2, i, "done")})
+    runs.append({"run": "liveA", "state": "running", "tenant": "t",
+                 "client": "c", "parallel": 2, "iterations": 1,
+                 "placement": "spread", "subscribers": 1,
+                 "events_dropped": 0, "agents": _agents(2, 99)})
+    feed = {"pid": 1, "project": "p", "uptime_s": 0.0, "runs": runs,
+            "workers": {}, "tenants": {}, "health": [], "workerd": {},
+            "warm_pools": {}, "sentinel": {"enabled": False},
+            "shipper": {"enabled": False}, "events_dropped_total": 0}
+    streams, _, _, _ = IOStreams.test()
+    console = FleetConsole(streams, lambda: feed)
+    frame = console.frame_lines(feed)
+    assert len(frame) <= 140, len(frame)
+    text = "\n".join(frame)
+    assert "run liveA" in text                  # live run always shown
+    assert "run done69" in text                 # newest done kept
+    assert "run done00" not in text             # oldest done collapsed
+    assert "more run(s) not shown" in text

@@ -1,0 +1,409 @@
+"""Monitor-stack ingestion suite (ISSUE 13): the telemetry shipper.
+
+The acceptance shape: registry snapshots, typed bus events, and flight
+spans batch into the bulk API; a stalled or down index drops OLDEST
+batches (counted, conservation holds: every ingested doc is flushed,
+dropped, or still buffered) and never blocks the event bus or a
+scheduler lane; loopd hosts a shipper for its lifetime; the chaos
+``index_down`` scenario runs green with the shipper invariants.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from clawker_tpu import consts, telemetry
+from clawker_tpu.config import load_config
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.loop import LoopScheduler, LoopSpec
+from clawker_tpu.monitor.events import (
+    ANOMALY_FLAG,
+    PLACEMENT_DECISION,
+    WORKER_HEALTH,
+    EventBus,
+)
+from clawker_tpu.monitor.shipper import (
+    FLEET_EVENTS_INDEX,
+    FLEET_METRICS_INDEX,
+    FLEET_SPANS_INDEX,
+    TelemetryShipper,
+    bulk_payload,
+    event_doc,
+    metric_docs,
+    span_doc,
+)
+from clawker_tpu.telemetry import MetricsRegistry
+from clawker_tpu.telemetry.spans import SpanRecord
+from clawker_tpu.testenv import FakeBulkIndex, TestEnv
+
+IMAGE = "clawker-shipproj:default"
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: shipproj\n")
+        cfg = load_config(proj)
+        yield tenv, proj, cfg
+
+
+def driver_with(n_workers: int, behavior=None):
+    drv = FakeDriver(n_workers=n_workers)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, behavior or exit_behavior(b"done\n", 0))
+    return drv
+
+
+def make_shipper(idx, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("interval_s", 0.05)
+    return TelemetryShipper(idx, **kw)
+
+
+# ------------------------------------------------------------ doc builders
+
+
+def test_event_doc_rehydrates_typed_payloads():
+    from clawker_tpu.monitor.events import EventRecord
+
+    rec = EventRecord(1, 1, "agent-0", PLACEMENT_DECISION,
+                      "placed w2 [spread/teamA]: rescued")
+    doc = event_doc(rec, run="r1", source="test")
+    assert doc["type"] == "placement" and doc["run"] == "r1"
+    assert (doc["worker"], doc["policy"], doc["tenant"], doc["action"],
+            doc["reason"]) == ("w2", "spread", "teamA", "placed", "rescued")
+
+    rec = EventRecord(2, 1, "w0", WORKER_HEALTH, "closed->open: timeout")
+    doc = event_doc(rec, run="r1")
+    assert (doc["type"], doc["old_state"], doc["new_state"],
+            doc["reason"]) == ("health", "closed", "open", "timeout")
+
+    rec = EventRecord(3, 1, "agent-1", ANOMALY_FLAG,
+                      "egress z=4.20 worker=w3")
+    doc = event_doc(rec, run="r1")
+    assert (doc["type"], doc["worker"], doc["kind"]) == (
+        "anomaly", "w3", "egress")
+    assert doc["z"] == pytest.approx(4.2)
+
+    # lifecycle noise ships nothing
+    assert event_doc(EventRecord(4, 1, "a", "iteration_done", "0")) is None
+
+
+def test_metric_and_span_docs_shape():
+    reg = MetricsRegistry()
+    reg.counter("ship_test_total", "t", labels=("worker",)).labels("w0").inc(3)
+    docs = metric_docs(reg.snapshot(), source="s", ts=0.0)
+    assert docs == [{
+        "@timestamp": "1970-01-01T00:00:00.000Z", "type": "metric",
+        "source": "s", "metric": "ship_test_total", "kind": "counter",
+        "labels": {"worker": "w0"}, "value": 3.0}]
+    rec = SpanRecord(trace_id="r1", span_id="s1", parent_id="",
+                     name="iteration", agent="a0", worker="w0",
+                     t_start=10.0, t_end=10.25, attrs={"iteration": 2})
+    doc = span_doc(rec, run="r1", source="s")
+    assert doc["wall_ms"] == 250.0 and doc["name"] == "iteration"
+    assert doc["type"] == "span" and doc["attrs"] == {"iteration": 2}
+
+
+def test_bulk_payload_is_parseable_action_doc_pairs():
+    idx = FakeBulkIndex()
+    assert idx.bulk(bulk_payload([("i1", {"a": 1}), ("i2", {"b": 2})]))
+    assert idx.count("i1") == 1 and idx.search("i2", b=2)
+
+
+# --------------------------------------------------------- batching / flush
+
+
+def test_shipper_routes_doc_types_to_their_indices():
+    idx = FakeBulkIndex()
+    shipper = make_shipper(idx, batch_docs=1000)
+    shipper.registry.counter("ship_route_total", "t").inc()
+    shipper.snapshot_once()
+    tap = shipper.bus_tap_for("run-1")
+    from clawker_tpu.monitor.events import EventRecord
+
+    tap(EventRecord(1, 1, "a0", PLACEMENT_DECISION,
+                    "placed w0 [spread/default]"))
+    tap(EventRecord(2, 2, "a0", "iteration_start", "0"))   # not indexed
+    shipper.span_sink_for("run-1")(SpanRecord(
+        trace_id="run-1", span_id="x", parent_id="", name="iteration",
+        agent="a0", worker="w0", t_start=0.0, t_end=1.0))
+    shipper.flush_once()
+    assert idx.count(FLEET_METRICS_INDEX) == 1
+    assert idx.search(FLEET_EVENTS_INDEX, run="run-1", type="placement")
+    assert idx.count(FLEET_EVENTS_INDEX) == 1
+    assert idx.search(FLEET_SPANS_INDEX, run="run-1")
+
+
+def test_pump_ships_periodically_and_stop_flushes_tail():
+    idx = FakeBulkIndex()
+    shipper = make_shipper(idx, batch_docs=4).start()
+    for i in range(3):
+        shipper.ingest("i", {"n": i})
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and idx.count("i") < 3:
+        time.sleep(0.01)
+    assert idx.count("i") == 3          # interval seal shipped a partial
+    shipper.ingest("i", {"n": 99})
+    shipper.stop()
+    assert idx.search("i", n=99)        # final flush got the tail
+
+
+# ------------------------------------------------------------- backpressure
+
+
+def test_down_index_drops_oldest_batches_and_counts():
+    idx = FakeBulkIndex()
+    idx.down = True
+    shipper = make_shipper(idx, batch_docs=2, max_batches=3)
+    for i in range(20):
+        shipper.ingest("i", {"n": i})
+        shipper.flush_once()            # every attempt fails; buffer bounded
+    st = shipper.stats()
+    assert st["dropped_docs"] > 0
+    assert st["pending_batches"] <= st["max_batches"]
+    assert st["failed_flushes"] > 0
+    # conservation: nothing vanishes uncounted
+    assert st["ingested_docs"] == (st["flushed_docs"] + st["dropped_docs"]
+                                   + st["pending_docs"] + st["open_docs"])
+    # recovery: the SURVIVING batches are the newest docs (drop-oldest)
+    idx.down = False
+    shipper.flush_once()
+    kept = sorted(d["n"] for d in idx.docs.get("i", []))
+    assert kept and kept[-1] == 19
+    assert kept == list(range(20 - len(kept), 20))
+
+
+def test_stalled_index_never_blocks_the_event_bus():
+    """The ISSUE 13 acceptance shape: a wedged index (sink blocks until
+    its deadline) while typed events pour in -- every emit returns
+    promptly, the bus drains, drops are counted, counters match."""
+    idx = FakeBulkIndex(stall_timeout_s=0.3)
+    idx.stall()
+    dropped_c = telemetry.REGISTRY.counter(
+        "monitor_ingest_dropped_total")._child(())
+    dropped_before = dropped_c.peek()
+    shipper = make_shipper(idx, batch_docs=8, max_batches=2).start()
+    delivered = []
+    bus = EventBus(lambda agent, event, detail: delivered.append(agent))
+    bus.add_tap(shipper.bus_tap_for("run-stall"))
+    t0 = time.monotonic()
+    for i in range(400):
+        bus.emit(f"agent-{i % 8}", PLACEMENT_DECISION,
+                 f"placed w{i % 4} [spread/default]")
+    emit_wall = time.monotonic() - t0
+    assert emit_wall < 5.0              # emits never waited on the sink
+    assert bus.flush(10.0)              # the bus drains regardless
+    assert len(delivered) == 400
+    shipper.kill()
+    idx.unstall()
+    st = shipper.stats()
+    assert st["ingested_docs"] >= 400
+    assert st["dropped_docs"] > 0       # bounded buffer actually dropped
+    assert st["pending_batches"] <= st["max_batches"]
+    assert st["ingested_docs"] == (st["flushed_docs"] + st["dropped_docs"]
+                                   + st["pending_docs"] + st["open_docs"])
+    # the registry counter moved in lockstep with the stats tally
+    assert dropped_c.peek() - dropped_before >= st["dropped_docs"]
+    bus.close()
+
+
+def test_intake_is_concurrency_safe_under_contention():
+    idx = FakeBulkIndex()
+    shipper = make_shipper(idx, batch_docs=16, max_batches=1000)
+
+    def produce(k):
+        for i in range(200):
+            shipper.ingest("i", {"k": k, "i": i})
+
+    threads = [threading.Thread(target=produce, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    shipper.flush_once()
+    st = shipper.stats()
+    assert st["ingested_docs"] == 1600 and st["dropped_docs"] == 0
+    assert idx.count("i") == 1600
+
+
+# ------------------------------------------------------------ run plumbing
+
+
+def test_scheduler_attach_shipper_ships_events_and_spans(env):
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    idx = FakeBulkIndex()
+    shipper = make_shipper(idx, batch_docs=10_000)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=1))
+    sched.attach_shipper(shipper)
+    sched.start()
+    sched.run(poll_s=0.02)
+    sched.cleanup(remove_containers=True)
+    sched.events.flush(5.0)
+    shipper.flush_once()
+    run = sched.loop_id
+    placements = idx.search(FLEET_EVENTS_INDEX, run=run, type="placement")
+    assert len(placements) >= 2         # one landed placement per loop
+    spans = idx.search(FLEET_SPANS_INDEX, run=run, name="iteration")
+    assert len(spans) >= 2              # every iteration root shipped
+    assert all(s["status"] == "ok" for s in spans)
+
+
+def test_loopd_hosts_shipper_and_status_reports_it(env, monkeypatch):
+    from clawker_tpu.loopd.client import LoopdClient
+    from clawker_tpu.loopd.server import LoopdServer
+    from clawker_tpu.monitor import shipper as shipmod
+
+    tenv, proj, cfg = env
+    tenv.write_settings("monitoring:\n  shipper:\n    enable: true\n"
+                        "    interval_s: 0.05\n")
+    cfg = load_config(proj)
+    idx = FakeBulkIndex()
+    monkeypatch.setattr(shipmod, "resolve_sink", lambda _cfg: idx)
+    drv = driver_with(2)
+    srv = LoopdServer(cfg, drv).start()
+    try:
+        assert srv.shipper is not None
+        with LoopdClient(srv.sock_path) as client:
+            ack = client.submit_run({"parallel": 2, "iterations": 1,
+                                     "image": IMAGE})
+            final = None
+            for frame in client.events():
+                if frame.get("type") == "run_done":
+                    final = frame
+            assert final and final["ok"]
+            assert "events_dropped" in final    # attach-footer contract
+            with LoopdClient(srv.sock_path) as c2:
+                doc = c2.status()
+        assert doc["shipper"]["enabled"]
+        assert doc["shipper"]["ingested_docs"] > 0
+        run_id = str(ack["run"])
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not idx.search(
+                FLEET_EVENTS_INDEX, run=run_id, type="placement"):
+            time.sleep(0.02)
+        assert idx.search(FLEET_EVENTS_INDEX, run=run_id, type="placement")
+        assert idx.count(FLEET_METRICS_INDEX) > 0
+    finally:
+        srv.stop()
+
+
+def test_cli_loop_ship_telemetry_flag(env, monkeypatch):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+    from clawker_tpu.monitor import shipper as shipmod
+
+    tenv, proj, cfg = env
+    idx = FakeBulkIndex()
+    monkeypatch.setattr(shipmod, "resolve_sink", lambda _cfg: idx)
+    drv = driver_with(2)
+    res = CliRunner().invoke(
+        cli, ["loop", "-p", "2", "-n", "1", "--no-daemon", "--no-workerd",
+              "--ship-telemetry"],
+        obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert idx.count(FLEET_EVENTS_INDEX) > 0
+    assert idx.count(FLEET_SPANS_INDEX) > 0
+
+
+# ------------------------------------------------------------------- chaos
+
+
+def test_chaos_index_down_scenario_green(env):
+    from clawker_tpu.chaos.plan import FaultEvent, FaultPlan
+    from clawker_tpu.chaos.runner import run_plan
+
+    plan = FaultPlan(seed=7, scenario=0, n_workers=2, n_loops=3,
+                     iterations=1, shipper=True, events=[
+                         FaultEvent(at_s=0.05, kind="index_down",
+                                    worker=-1)])
+    result = run_plan(plan)
+    assert result.ok, result.violations
+
+
+def test_chaos_index_stall_scenario_green(env):
+    from clawker_tpu.chaos.plan import FaultEvent, FaultPlan
+    from clawker_tpu.chaos.runner import run_plan
+
+    plan = FaultPlan(seed=8, scenario=0, n_workers=2, n_loops=3,
+                     iterations=1, shipper=True, events=[
+                         FaultEvent(at_s=0.05, kind="index_down",
+                                    worker=-1, arg="stall"),
+                         FaultEvent(at_s=0.1, kind="worker_kill", worker=1),
+                         FaultEvent(at_s=0.3, kind="worker_revive",
+                                    worker=1)])
+    result = run_plan(plan)
+    assert result.ok, result.violations
+
+
+def test_shipper_invariants_catch_unaccounted_loss():
+    from clawker_tpu.chaos.invariants import check_invariants
+
+    # a fabricated audit that "lost" docs without counting them must
+    # violate; the checker needs no driver/journal for the shipper leg
+    good = {"ingested_docs": 10, "flushed_docs": 6, "dropped_docs": 4,
+            "pending_docs": 0, "open_docs": 0, "pending_batches": 0,
+            "max_batches": 4, "failed_flushes": 1, "indexed_docs": 6,
+            "down_injected": True}
+    bad = dict(good, dropped_docs=0)
+
+    class _NoDriver:
+        apis = []
+
+        def workers(self):
+            return []
+
+    with TestEnv() as tenv:
+        proj = tenv.base / "p"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: shipinv\n")
+        cfg = load_config(proj)
+        ok = check_invariants(_NoDriver(), cfg, "norun", shipper=good)
+        assert not [v for v in ok if v.startswith("shipper")]
+        viol = check_invariants(_NoDriver(), cfg, "norun", shipper=bad)
+        assert any(v.startswith("shipper-accounting") for v in viol)
+
+
+def test_stop_skips_final_flush_while_pump_is_wedged():
+    """Review fix: a pump wedged inside the sink past the join deadline
+    must not race the caller's final snapshot/flush -- stop() backs
+    off, kill() reports False, and counters stay consistent once the
+    sink drains."""
+
+    class _WedgedSink:
+        def __init__(self):
+            self.release = threading.Event()
+            self.calls = 0
+
+        def bulk(self, payload: bytes) -> bool:
+            self.calls += 1
+            self.release.wait(30.0)
+            return False
+
+    sink = _WedgedSink()
+    shipper = TelemetryShipper(sink, registry=MetricsRegistry(),
+                               interval_s=0.01, batch_docs=1).start()
+    shipper.ingest("i", {"n": 1})
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and sink.calls == 0:
+        time.sleep(0.005)
+    assert sink.calls                   # the pump is now parked in bulk()
+    assert shipper.kill() is False      # wedged: join times out
+    flushed_before = shipper.stats()["failed_flushes"]
+    shipper.stop()                      # must NOT run a concurrent flush
+    assert shipper.stats()["failed_flushes"] == flushed_before
+    sink.release.set()
+    assert shipper.kill() is True       # drains once the sink releases
+    st = shipper.stats()
+    assert st["ingested_docs"] == (st["flushed_docs"] + st["dropped_docs"]
+                                   + st["pending_docs"] + st["open_docs"])
