@@ -34,6 +34,7 @@ SCOPED_FILES = (
     "clawker_tpu/loop/scheduler.py",
     "clawker_tpu/loop/warmpool.py",
     "clawker_tpu/workerd/server.py",
+    "clawker_tpu/capacity/controller.py",
 )
 
 # attribute names that are unambiguous engine mutations anywhere
@@ -43,8 +44,16 @@ MUTATIONS = {"create_container", "start_container", "restart_container",
 # bare names are far too generic to match on any receiver)
 RT_MUTATIONS = {"create", "start", "adopt_pooled"}
 RT_RECEIVERS = {"rt", "runtime"}
+# fleet-scaler mutations (capacity controller): provisioning or
+# draining a worker must be dominated by a journaled REC_CAPACITY_*
+# record exactly like an engine mutation (docs/elastic-capacity.md)
+SCALER_MUTATIONS = {"provision", "drain"}
+SCALER_RECEIVERS = {"scaler"}
 
 WAL_MARKERS = {"_journal"}
+# the capacity controller journals through its hooks bag
+# (self.hooks.journal(...)): same WAL, different spelling
+HOOKS_WAL = ("journal", "hooks")
 SEAM_MARKERS = {"fire"}
 
 
@@ -52,12 +61,16 @@ def _is_mutation(call: ast.Call) -> bool:
     tail = call_tail(call)
     if tail in MUTATIONS:
         return True
+    if tail in SCALER_MUTATIONS and receiver(call) in SCALER_RECEIVERS:
+        return True
     return tail in RT_MUTATIONS and receiver(call) in RT_RECEIVERS
 
 
 def _is_wal_marker(call: ast.Call, journaling_helpers: set[str]) -> bool:
     tail = call_tail(call)
     if tail in WAL_MARKERS:
+        return True
+    if tail == HOOKS_WAL[0] and receiver(call) == HOOKS_WAL[1]:
         return True
     if tail in SEAM_MARKERS and receiver(call) in {"seams", "self"}:
         return True
@@ -97,6 +110,8 @@ class WriteAheadChecker(Checker):
         for fn in functions(src.tree):
             for c in body_calls(fn):
                 if call_tail(c) in WAL_MARKERS or (
+                        call_tail(c) == HOOKS_WAL[0]
+                        and receiver(c) == HOOKS_WAL[1]) or (
                         call_tail(c) in SEAM_MARKERS
                         and receiver(c) in {"seams", "self"}):
                     journaling_helpers.add(fn.name)

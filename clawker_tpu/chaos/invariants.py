@@ -39,6 +39,11 @@ with; docs/chaos.md#invariants):
   ``runner.run_observe_only_check``) compares a fixed-seed run's
   journaled placements and daemon-side create counts with and without
   ``--sentinel``: they must be identical.
+- ``stranded-by-drain``: a capacity scale-down never strands a
+  journaled run (docs/elastic-capacity.md).  Folding the record stream
+  in order with the same liveness rule the controller's journal-replay
+  gate uses, every ``capacity_scale`` drain-done record must land at a
+  point where its victim hosts no live loop or pool member.
 - ``workerd-reconcile``: journaled intent reconciles on link heal.
   A channel that ends the scenario LIVE (any partition healed) must
   leave zero undelivered events on its daemon -- no lost exits -- and
@@ -60,6 +65,15 @@ from ..health import BREAKER_CLOSED
 TERMINAL_STATUSES = ("done", "failed", "stopped")
 
 
+def _daemon_view(driver) -> list:
+    """(worker, api) pairs for every daemon the scenario ever had --
+    the audit must include workers the capacity controller drained
+    mid-run (their call recorders survive the fake VM deletion)."""
+    all_workers = getattr(driver, "all_workers", None)
+    workers = all_workers() if all_workers is not None else driver.workers()
+    return list(zip(workers, driver.apis))
+
+
 def check_invariants(driver, cfg, run_id: str, *, loops=None,
                      cap: int = 0, unfaulted: set[str] | None = None,
                      health=None, kills: int = 0,
@@ -77,10 +91,16 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
     legitimately lose un-flushed spans, so the span audit loosens).
     """
     from ..loop.journal import (
+        REC_CAPACITY_SCALE,
+        REC_CAPACITY_TOKENS,
         REC_EXITED,
         REC_LOOP_END,
+        REC_MIGRATED,
         REC_PLACEMENT,
         REC_POOL_ADD,
+        REC_POOL_ADOPT,
+        REC_POOL_READY,
+        REC_POOL_REMOVE,
         RunJournal,
         journal_path,
         replay,
@@ -143,7 +163,7 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
     name_to_agent = {}
     for (agent, _w) in placements:
         name_to_agent[container_name(project, agent)] = agent
-    for worker, api in zip(driver.workers(), driver.apis):
+    for worker, api in _daemon_view(driver):
         creates: dict[str, int] = {}
         for (args, _kw) in api.calls_named("container_create"):
             cname = str(args[0]) if args else ""
@@ -160,7 +180,7 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
                     "placement(s) authorized one")
 
     # --- leaked-container: nothing labeled with the run id survives
-    for worker, api in zip(driver.workers(), driver.apis):
+    for worker, api in _daemon_view(driver):
         for c in list(api.containers.values()):
             if c.labels.get(consts.LABEL_LOOP) == run_id:
                 violations.append(
@@ -171,12 +191,65 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
 
     # --- admission-cap: daemon-side concurrency high-water vs the bucket
     if cap > 0:
-        for worker, gate in zip(driver.workers(), driver.gates):
-            if gate.launch_hwm > cap:
+        all_workers = getattr(driver, "all_workers", None)
+        audit_workers = (all_workers() if all_workers is not None
+                         else driver.workers())
+        # the SLO loop may legitimately scale a worker's bucket above
+        # the static cap; journaled REC_CAPACITY_TOKENS records bound
+        # how far (the audit stays falsifiable -- an unjournaled
+        # overshoot is still a violation)
+        cap_by_worker: dict[str, int] = {}
+        for rec in records:
+            if rec.get("kind") == REC_CAPACITY_TOKENS:
+                wid = str(rec.get("worker", ""))
+                c = int(rec.get("cap", 0))
+                cap_by_worker[wid] = max(cap_by_worker.get(wid, cap), c)
+        for worker, gate in zip(audit_workers, driver.gates):
+            allowed = max(cap, cap_by_worker.get(worker.id, cap))
+            if gate.launch_hwm > allowed:
                 violations.append(
                     f"admission-cap: {worker.id} daemon saw "
                     f"{gate.launch_hwm} concurrent launches "
-                    f"(cap {cap})")
+                    f"(cap {allowed})")
+
+    # --- stranded-by-drain: a capacity scale-down must never strand a
+    # journaled run.  Fold the record stream in order, tracking which
+    # loops and pool members are live on which worker at every point --
+    # the SAME liveness rule the controller's journal-replay gate uses
+    # (non-terminal loops count; done/failed do not; pending/ready pool
+    # members count) -- and require that every ``drain done`` record
+    # lands at a point where its victim hosts nothing live.
+    placed_on: dict[str, str] = {}      # agent -> worker
+    live_agents: set[str] = set()
+    pool_on: dict[str, str] = {}        # pool member -> worker, while live
+    for rec in records:
+        kind = rec.get("kind", "")
+        agent = str(rec.get("agent", ""))
+        if kind == REC_PLACEMENT and agent:
+            placed_on[agent] = str(rec.get("worker", ""))
+            live_agents.add(agent)
+        elif kind == REC_MIGRATED and agent:
+            placed_on[agent] = str(rec.get("dst",
+                                           placed_on.get(agent, "")))
+        elif kind == REC_LOOP_END and agent:
+            if str(rec.get("status", "")) in ("done", "failed"):
+                live_agents.discard(agent)
+            # "stopped" stays live: the run resumes onto that worker
+        elif kind in (REC_POOL_ADD, REC_POOL_READY) and agent:
+            pool_on[agent] = str(rec.get("worker", pool_on.get(agent, "")))
+        elif kind in (REC_POOL_ADOPT, REC_POOL_REMOVE) and agent:
+            pool_on.pop(agent, None)
+        elif kind == REC_CAPACITY_SCALE \
+                and str(rec.get("action", "")) == "drain" \
+                and str(rec.get("phase", "")) == "done":
+            wid = str(rec.get("worker", ""))
+            stranded = sorted(
+                [a for a in live_agents if placed_on.get(a) == wid]
+                + [p for p, w in pool_on.items() if w == wid])
+            for victim in stranded:
+                violations.append(
+                    f"stranded-by-drain: capacity drained {wid} while "
+                    f"the journal shows {victim} still live on it")
 
     # --- spurious-quarantine: untouched workers end healthy
     if health is not None and unfaulted:

@@ -48,6 +48,16 @@ kind                    injection point
                         shipper must degrade observe-only: bounded buffer,
                         oldest batches dropped and counted, the bus and
                         every scheduler lane untouched
+``traffic_burst``       capacity scenarios: ``arg`` open-loop synthetic
+                        arrivals spike the worker's admission queue (the
+                        bursty production shape the elastic controller
+                        exists for); real launches must still drain and
+                        every standard invariant hold
+``scale_down``          capacity scenarios: ask the elastic controller to
+                        drain the worker -- the drain must stay gated on
+                        journal replay proving zero live placements
+                        (``stranded-by-drain`` invariant), deferring for
+                        as long as the run keeps the worker busy
 ======================  ====================================================
 
 Plans with ``sentinel: true`` run with the fleet sentinel attached to
@@ -73,6 +83,7 @@ EVENT_KINDS = (
     "engine_burst", "probe_drop", "worker_revive", "cli_sigkill",
     "egress_silent", "egress_flood", "sentinel_kill",
     "workerd_partition", "workerd_kill", "index_down",
+    "traffic_burst", "scale_down",
 )
 
 # event kinds that target no worker (worker index is ignored)
@@ -141,6 +152,8 @@ class FaultPlan:
     sentinel: bool = False          # run with the fleet sentinel attached
     workerd: bool = False           # run with per-worker workerd executors
     shipper: bool = False           # run with the telemetry shipper attached
+    capacity: bool = False          # run with the elastic-capacity
+    #                                 controller attached
     events: list[FaultEvent] = field(default_factory=list)
 
     @property
@@ -157,6 +170,7 @@ class FaultPlan:
             "sentinel": self.sentinel,
             "workerd": self.workerd,
             "shipper": self.shipper,
+            "capacity": self.capacity,
             "events": [e.to_doc() for e in sorted(self.events,
                                                   key=lambda e: e.at_s)],
         }
@@ -179,6 +193,7 @@ class FaultPlan:
             sentinel=bool(doc.get("sentinel", False)),
             workerd=bool(doc.get("workerd", False)),
             shipper=bool(doc.get("shipper", False)),
+            capacity=bool(doc.get("capacity", False)),
             events=[FaultEvent.from_doc(e) for e in doc.get("events") or []],
         )
         _validate(plan)
@@ -321,6 +336,25 @@ def generate_plan(seed: int, scenario: int = 0, *, n_workers: int = 4,
                 at_s=rng.uniform(0.05, horizon_s * 0.6),
                 kind="index_down", worker=-1,
                 arg="stall" if rng.random() < 0.3 else None))
+    # capacity rider (drawn strictly AFTER every pre-existing draw, so
+    # the worker-fault/sigkill/sentinel/workerd/shipper schedule of a
+    # (seed, scenario) pair is byte-identical to the pre-capacity
+    # generator): about a third of scenarios run with the elastic
+    # controller attached -- most with an open-loop traffic burst
+    # spiking one worker's admission queue, and roughly half asking
+    # for a scale-down whose drain must stay gated on journal replay
+    # (the stranded-by-drain invariant audits every drain that fires)
+    if rng.random() < 0.35:
+        plan.capacity = True
+        if rng.random() < 0.8:
+            events.append(FaultEvent(
+                at_s=rng.uniform(0.05, horizon_s * 0.5),
+                kind="traffic_burst", worker=rng.randrange(n_workers),
+                arg=rng.randint(6, 18)))
+        if rng.random() < 0.5:
+            events.append(FaultEvent(
+                at_s=rng.uniform(0.1, horizon_s * 0.7),
+                kind="scale_down", worker=rng.randrange(n_workers)))
     plan.events = sorted(events, key=lambda e: e.at_s)
     _validate(plan)
     return plan

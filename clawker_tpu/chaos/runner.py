@@ -249,6 +249,38 @@ class ChaosRunner:
             self.shipper = TelemetryShipper(
                 self.index, interval_s=0.05, batch_docs=16,
                 max_batches=4, source="chaos").start()
+        # capacity scenarios (plan.capacity): the elastic controller
+        # rides each generation (re-attached like the sentinel; its
+        # journaled state survives the kill/resume cycle via
+        # RunImage.capacity).  traffic_burst events spike admission
+        # queues open-loop; scale_down events request drains whose
+        # firing stays gated on journal replay -- every standard
+        # invariant must keep holding, and stranded-by-drain audits
+        # the drains that fired.  Autoscale GROWTH stays off in chaos:
+        # drains are event-driven, so the scenario shape stays the
+        # plan's.
+        self.capacity_ctrl = None
+        self.capacity_scaler = None
+        self._drain_requests: list[str] = []
+        if plan.capacity:
+            from ..capacity import FakeFleetScaler
+            from ..config.schema import (
+                CapacityAutoscaleSettings,
+                CapacitySettings,
+            )
+
+            self.capacity_scaler = FakeFleetScaler(
+                self.driver, max_workers=plan.n_workers)
+            self._cap_settings = CapacitySettings(
+                enable=True, interval_s=0.05,
+                pool_min_depth=0,
+                pool_max_depth=max(2, plan.warm_pool_depth),
+                autoscale=CapacityAutoscaleSettings(
+                    enable=True, min_workers=1,
+                    max_workers=plan.n_workers,
+                    queue_high=10_000,      # growth off: event-driven only
+                    idle_low=0.0,           # idle drains off: ditto
+                    sustain_s=3600.0))
 
     @staticmethod
     def _sentinel_available() -> bool:
@@ -312,6 +344,20 @@ class ChaosRunner:
             # across runs: the bounded buffer and drop accounting span
             # the kill/resume cycle
             sched.attach_shipper(self.shipper)
+        if self.plan.capacity:
+            # a fresh controller per generation, bound to the fresh
+            # scheduler's hooks; journaled targets restore through
+            # RunImage.capacity, and un-fired drain requests re-queue
+            # so a kill between request and gate cannot lose the drain
+            from ..capacity import CapacityController
+
+            self.capacity_ctrl = CapacityController(
+                self._cap_settings, scaler=self.capacity_scaler)
+            sched.attach_capacity(self.capacity_ctrl)
+            drained = set(self.capacity_scaler.drained)
+            for wid in self._drain_requests:
+                if wid not in drained:
+                    self.capacity_ctrl.request_drain(wid)
         # per-GENERATION completion state: the closure binds these
         # locals, not self, so a stale gen-N thread that finally
         # unblocks (e.g. out of a wedge after the 5s kill wait gave up
@@ -424,6 +470,35 @@ class ChaosRunner:
         _INJECTIONS.labels(ev.kind).inc()
         self.injected += 1
 
+    def _apply_capacity_fault(self, ev: FaultEvent) -> None:
+        """Capacity-scenario faults: an open-loop traffic burst against
+        one worker's admission queue, or a scale-down request.  Neither
+        touches a worker's ENGINE -- the worker stays in the unfaulted
+        set, so spurious-quarantine also proves capacity chaos can
+        never open a breaker."""
+        sched = self._sched
+        workers = self.driver.all_workers()
+        if sched is None or not 0 <= ev.worker < len(workers):
+            return
+        wid = workers[ev.worker].id
+        if ev.kind == "traffic_burst":
+            # open-loop synthetic arrivals: each holds a token briefly
+            # (like a short launch) so the queue genuinely deepens, but
+            # performs no engine call -- pure admission pressure
+            def hold(release) -> None:
+                t = threading.Timer(0.03, release)
+                t.daemon = True
+                t.start()
+
+            for _ in range(int(ev.arg or 10)):
+                sched.admission.submit(wid, "~burst", hold)
+        elif ev.kind == "scale_down":
+            self._drain_requests.append(wid)
+            if self.capacity_ctrl is not None:
+                self.capacity_ctrl.request_drain(wid)
+        _INJECTIONS.labels(ev.kind).inc()
+        self.injected += 1
+
     def _arm_sigkill(self, ev: FaultEvent, sched=None) -> None:
         """Arm a crash seam on the current (or given) generation.
         Several seams may be armed at once -- whichever fires first
@@ -520,6 +595,11 @@ class ChaosRunner:
                     # monitor-stack faults hit the shipper's sink,
                     # never a worker: the fleet stays unfaulted
                     self._apply_index_fault(ev)
+                elif ev.kind in ("traffic_burst", "scale_down"):
+                    # capacity faults hit the admission queue / the
+                    # elastic controller, never an engine: the worker
+                    # stays unfaulted
+                    self._apply_capacity_fault(ev)
                 elif ev.kind in ("egress_silent", "egress_flood",
                                  "sentinel_kill"):
                     # stream/collector faults: they hit the SENTINEL's
@@ -879,6 +959,44 @@ class ChaosController:
 
                 self.sched.seams.arm(seam, die)
                 _INJECTIONS.labels(ev.kind).inc()
+                continue
+            if ev.kind in ("traffic_burst", "scale_down"):
+                # capacity events act on the live scheduler's admission
+                # queue / attached controller, not the driver.  Index
+                # into the ALL-workers view where the driver has one: a
+                # scale_down earlier in this same plan may have shrunk
+                # workers(), and the fixed-seed schedule's indices must
+                # keep naming the workers the generator chose
+                all_workers = getattr(self.driver, "all_workers", None)
+                workers = (all_workers() if all_workers is not None
+                           else self.driver.workers())
+                if not 0 <= ev.worker < len(workers):
+                    self.sched.on_event(
+                        "chaos", "skipped",
+                        f"{ev.kind} worker={ev.worker}: outside the "
+                        f"{len(workers)}-worker fleet")
+                    continue
+                wid = workers[ev.worker].id
+                if ev.kind == "traffic_burst":
+                    # each synthetic arrival holds its token briefly
+                    # (like a short launch) so the queue genuinely
+                    # deepens -- an instant release would exert zero
+                    # admission pressure
+                    def hold(release) -> None:
+                        t = threading.Timer(0.03, release)
+                        t.daemon = True
+                        t.start()
+
+                    for _ in range(int(ev.arg or 10)):
+                        self.sched.admission.submit(wid, "~burst", hold)
+                    _INJECTIONS.labels(ev.kind).inc()
+                elif self.sched.capacity is not None:
+                    self.sched.capacity.request_drain(wid)
+                    _INJECTIONS.labels(ev.kind).inc()
+                else:
+                    self.sched.on_event(
+                        "chaos", "skipped",
+                        f"{ev.kind}: no capacity controller attached")
                 continue
             if not injectable:
                 self.sched.on_event(

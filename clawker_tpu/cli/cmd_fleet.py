@@ -322,6 +322,10 @@ def fleet_placement(f: Factory, policy, slots, probes, metrics_url, fmt,
     latencies, token counts, and tenant queues come straight off the
     daemon's status RPC -- the LIVE admission state every concurrent
     run bills against -- instead of a fresh CLI-side probe round.
+    When the daemon hosts an elastic-capacity controller
+    (docs/elastic-capacity.md) the view adds its live state: the
+    SLO-scaled token cap per worker, shed/queueing mode with the
+    current retry_after_s, and per-tenant SLO headroom.
     Otherwise probes every worker of the active runtime driver (the
     same breakers `clawker loop` places against), derives the pod
     topology, and shows how the chosen policy would spread N loop
@@ -384,6 +388,15 @@ def fleet_placement(f: Factory, policy, slots, probes, metrics_url, fmt,
                           f"/{aw.get('capacity', cap)}",
                 "rejections": aw.get("rejected", 0),
             })
+        cstats = daemon_doc.get("capacity") or {}
+        if cstats.get("enabled"):
+            # live adaptive state joins the static columns: the token
+            # cap each worker's bucket was scaled to, and whether its
+            # queue is shedding (docs/elastic-capacity.md)
+            for r in rows:
+                cw = (cstats.get("workers") or {}).get(r["worker"]) or {}
+                r["scaled_cap"] = cw.get("token_cap", 0)
+                r["shed_retry_after_s"] = cw.get("shed_retry_after_s", 0.0)
         doc = {
             "source": f"loopd:{daemon_doc.get('pid')}",
             "policy": policy_name,
@@ -402,6 +415,8 @@ def fleet_placement(f: Factory, policy, slots, probes, metrics_url, fmt,
                 for t, s in astats.get("tenants", {}).items()},
             "workers": rows,
         }
+        if cstats.get("enabled"):
+            doc["capacity"] = cstats
         if fmt == "table":
             click.echo(f"source: loopd (pid {daemon_doc.get('pid')}, "
                        f"{len(daemon_doc.get('runs', []))} hosted "
@@ -493,6 +508,22 @@ def _render_placement(doc: dict, topo, fmt: str) -> None:
     for t, info in doc["tenants"].items():
         pairs = " ".join(f"{k}={v}" for k, v in info.items())
         click.echo(f"tenant {t}: {pairs}")
+    cstats = doc.get("capacity")
+    if cstats:
+        # the elastic controller's live view (docs/elastic-capacity.md):
+        # scaled token caps, shed state, and per-tenant SLO headroom
+        click.echo(f"capacity: slo={cstats.get('slo_s') or 'off'} "
+                   f"ticks={cstats.get('ticks', 0)} "
+                   f"autoscale={'on' if (cstats.get('autoscale') or {}).get('enabled') else 'off'}")
+        for wid, cw in sorted((cstats.get("workers") or {}).items()):
+            shed = cw.get("shed_retry_after_s", 0.0)
+            click.echo(
+                f"  {wid}\tcap={cw.get('token_cap') or '-'}\t"
+                f"rate={cw.get('arrival_rate', 0.0)}/s\t"
+                + (f"SHED retry_after={shed}s" if shed else "queueing"))
+        for t, info in sorted((cstats.get("tenants") or {}).items()):
+            click.echo(f"  slo {t}: {info.get('slo_s')}s "
+                       f"headroom={info.get('headroom_s')}s")
     if unhealthy:
         raise SystemExit(1)
 
@@ -552,11 +583,13 @@ def fleet_warmpool(f: Factory, metrics_url, run_ref, fmt, no_daemon):
     loop placements adopt instead of paying a full create
     (docs/loop-warmpool.md).  With a loopd daemon running
     (docs/loopd.md) this shows every hosted run's live pool state over
-    the status RPC; with ``--metrics-url`` pointing at a live run's
-    metrics port it shows the run's actual per-worker depth and
-    hit/miss/refill counters; with ``--run`` it replays that run's
-    journal and lists every pool member's journaled state (what a
-    ``--resume`` would restore or sweep).
+    the status RPC -- including the elastic controller's adaptive
+    TARGET/ACTUAL depth and arrival rate per worker when capacity is
+    enabled (docs/elastic-capacity.md); with ``--metrics-url``
+    pointing at a live run's metrics port it shows the run's actual
+    per-worker depth and hit/miss/refill counters; with ``--run`` it
+    replays that run's journal and lists every pool member's journaled
+    state (what a ``--resume`` would restore or sweep).
     """
     import json as _json
 
@@ -574,6 +607,9 @@ def fleet_warmpool(f: Factory, metrics_url, run_ref, fmt, no_daemon):
         if daemon_doc is not None:
             doc["source"] = f"loopd:{daemon_doc.get('pid')}"
             doc["daemon_pools"] = daemon_doc.get("warm_pools", {})
+            cstats = daemon_doc.get("capacity") or {}
+            if cstats.get("enabled"):
+                doc["capacity"] = cstats
     if metrics_url:
         doc["live"] = _scrape_warmpool_metrics(metrics_url)
     if run_ref:
@@ -601,12 +637,25 @@ def fleet_warmpool(f: Factory, metrics_url, run_ref, fmt, no_daemon):
         if not pools:
             click.echo("no pooled runs hosted by loopd")
         for run_id, st in sorted(pools.items()):
-            click.echo(f"run {run_id}: target_depth={st['target_depth']} "
-                       f"hits={st['hits']} misses={st['misses']} "
+            click.echo(f"run {run_id}: target_depth={st['target_depth']}"
+                       + (" (adaptive)" if st.get("adaptive") else "")
+                       + f" hits={st['hits']} misses={st['misses']} "
                        f"refills={st['refills']} recycled={st['recycled']}")
+            # TARGET is the live (possibly capacity-adapted) per-worker
+            # target; ACTUAL the adoptable depth right now
             for wid, w in sorted(st.get("workers", {}).items()):
-                click.echo(f"  {wid}\tready={w['ready']}\t"
+                click.echo(f"  {wid}\ttarget={w.get('target', st['target_depth'])}\t"
+                           f"ready={w['ready']}\t"
                            f"inflight={w['inflight']}")
+    cstats = doc.get("capacity")
+    if cstats:
+        click.echo(f"capacity: slo={cstats.get('slo_s') or 'off'} "
+                   f"ticks={cstats.get('ticks', 0)}")
+        for wid, cw in sorted((cstats.get("workers") or {}).items()):
+            click.echo(f"  {wid}\tTARGET={cw.get('pool_target', 0)}\t"
+                       f"ACTUAL={cw.get('pool_ready', 0)}\t"
+                       f"cap={cw.get('token_cap') or '-'}\t"
+                       f"rate={cw.get('arrival_rate', 0.0)}/s")
     live = doc.get("live")
     if live is not None:
         click.echo("WORKER\tDEPTH\tHITS\tMISSES\tREFILLS\tRECYCLED")

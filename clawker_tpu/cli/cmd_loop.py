@@ -363,6 +363,23 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
         executors = discover_workerd(worktrees)
         sched = LoopScheduler(f.config, f.driver, spec, on_event=on_event,
                               executors=executors)
+    # --- elastic capacity (docs/elastic-capacity.md): for in-process
+    # runs the controller ticks on the scheduler's run thread -- the
+    # same three loops loopd runs daemon-wide.  Settings-driven: a
+    # loopd-hosted run gets the daemon's controller instead.
+    cs = f.config.settings.capacity
+    if cs.enable:
+        from ..capacity import CapacityController, make_scaler
+
+        scaler = (make_scaler(f.driver, f.config,
+                              max_workers=cs.autoscale.max_workers)
+                  if cs.autoscale.enable else None)
+        sched.attach_capacity(CapacityController(cs, scaler=scaler))
+        click.echo("capacity: elastic controller attached (pool "
+                   f"[{cs.pool_min_depth},{cs.pool_max_depth}], "
+                   f"slo={cs.slo.default_s or 'off'}, "
+                   f"autoscale={'on' if cs.autoscale.enable else 'off'})",
+                   err=True)
     chaos = None
     if chaos_plan:
         from ..chaos.plan import FaultPlan

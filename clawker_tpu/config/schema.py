@@ -581,6 +581,65 @@ class ChaosSettings:
 
 
 @dataclass
+class CapacitySloSettings:
+    """Per-tenant latency SLOs the admission scaling law targets
+    (docs/elastic-capacity.md).  A tenant's SLO bounds the admission
+    wait its launches may see; the tightest configured SLO drives the
+    per-worker token scaling, and a queue that provably cannot drain
+    inside it flips to reject-with-``retry_after_s``.  0 = no SLO."""
+
+    default_s: float = 0.0          # SLO for tenants not listed below
+    tenants: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class CapacityAutoscaleSettings:
+    """Fleet autoscaling thresholds (docs/elastic-capacity.md).
+
+    Sustained per-worker queue depth past ``queue_high`` provisions a
+    worker through the concurrent fleet provisioner; sustained busy
+    fraction under ``idle_low`` drains the least-loaded worker --
+    gated on journal replay proving zero live placements on the victim
+    (a journaled run is never stranded by scale-down)."""
+
+    enable: bool = False
+    min_workers: int = 1
+    max_workers: int = 8
+    queue_high: int = 8             # sustained pending per worker -> grow
+    idle_low: float = 0.25          # sustained busy fraction under -> drain
+    sustain_s: float = 5.0          # how long a signal must hold
+
+
+@dataclass
+class CapacitySettings:
+    """The elastic-capacity controller (docs/elastic-capacity.md).
+
+    With ``enable``, loopd ticks one controller across its hosted runs
+    (in-process ``--no-daemon`` runs tick their own): warm-pool depth
+    follows the EWMA arrival rate per worker within
+    ``[pool_min_depth, pool_max_depth]``, admission tokens scale from
+    measured launch latency against the ``slo`` block, and the
+    ``autoscale`` block provisions/drains workers.  Every decision is
+    journaled (``REC_CAPACITY_*``) and emitted as a typed
+    ``capacity.decision`` bus event."""
+
+    enable: bool = False
+    interval_s: float = 1.0         # controller tick cadence
+    pool_min_depth: int = 0         # adaptive target clamp, per worker
+    pool_max_depth: int = 8
+    refill_lead_s: float = 0.0      # arrival window one pool member must
+    #                                 cover; 0 = use measured launch latency
+    alpha_up: float = 0.5           # arrival EWMA: burst response
+    alpha_down: float = 0.08        # arrival EWMA: decay to quiet baseline
+    token_min: int = 0              # token scaling floor; 0 = the static
+    #                                 loop.placement.max_inflight_per_worker
+    token_max: int = 16             # token scaling ceiling per worker
+    slo: CapacitySloSettings = field(default_factory=CapacitySloSettings)
+    autoscale: CapacityAutoscaleSettings = field(
+        default_factory=CapacityAutoscaleSettings)
+
+
+@dataclass
 class CredentialSettings:
     """Host-credential staging policy (off by default).
 
@@ -610,6 +669,7 @@ class Settings:
     credentials: CredentialSettings = field(default_factory=CredentialSettings)
     chaos: ChaosSettings = field(default_factory=ChaosSettings)
     sentinel: SentinelSettings = field(default_factory=SentinelSettings)
+    capacity: CapacitySettings = field(default_factory=CapacitySettings)
 
     @staticmethod
     def merge_strategies() -> dict[str, str]:

@@ -202,12 +202,15 @@ class FakeDriver(RuntimeDriver):
             )
             for i, gate in enumerate(self.gates)
         ]
+        self._drained: set[str] = set()     # scaled-down worker ids
 
     def connect(self) -> list[Worker]:
-        return self._workers
+        return self.workers()
 
     def workers(self) -> list[Worker]:
-        return self._workers
+        if not self._drained:
+            return self._workers
+        return [w for w in self._workers if w.id not in self._drained]
 
     @property
     def api(self) -> FakeDockerAPI:
@@ -239,6 +242,49 @@ class FakeDriver(RuntimeDriver):
     def clear_fault(self, index: int) -> None:
         """Revive worker ``index`` (blocked 'wedge' calls proceed)."""
         self.gates[index].set_fault(None)
+
+    # ----------------------------------------------------------- elasticity
+    # The fake pod can grow and shrink in place: what the capacity
+    # controller's FakeFleetScaler scales (docs/elastic-capacity.md).
+    # Consumers that re-read workers() each tick (placement context,
+    # pool tick) pick the change up naturally; ids are never reused and
+    # apis/gates stay index-aligned with all_workers(), so audits over
+    # a drained worker's call history keep working.
+
+    def add_worker(self) -> Worker:
+        """Provision one more fake worker; returns it."""
+        index = len(self.apis)
+        api = FakeDockerAPI()
+        gate = _FaultGate(api)
+        self.apis.append(api)
+        self.gates.append(gate)
+        worker = Worker(id=f"fake-{index}", index=index,
+                        hostname=f"fake-worker-{index}",
+                        engine=Engine(gate))
+        self._workers.append(worker)
+        self._drained.discard(worker.id)
+        return worker
+
+    def remove_worker(self, worker_id: str) -> bool:
+        """Drain one worker out of the serving set, modeling VM
+        deletion: it leaves ``workers()`` (no more placements, probes,
+        or sweeps) and its containers vanish with the VM -- but its
+        api/gate call recorders survive, index-aligned under
+        ``all_workers()``, so post-scenario audits still see every call
+        the daemon ever executed."""
+        for w in self._workers:
+            if w.id == worker_id and w.id not in self._drained:
+                self._drained.add(w.id)
+                i = next(j for j, x in enumerate(self._workers)
+                         if x.id == worker_id)
+                self.apis[i].containers.clear()
+                return True
+        return False
+
+    def all_workers(self) -> list[Worker]:
+        """Every worker ever provisioned (drained included), aligned
+        index-for-index with ``apis``/``gates`` -- the audit view."""
+        return list(self._workers)
 
     def close(self) -> None:
         for w in self._workers:

@@ -42,6 +42,12 @@ import time
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from ..capacity import (
+    REC_CAPACITY_POOL,
+    REC_CAPACITY_QUEUE,
+    REC_CAPACITY_SCALE,
+    REC_CAPACITY_TOKENS,
+)
 from ..monitor.ledger import read_jsonl
 
 RUNS_DIR = "runs"               # under Config.logs_dir
@@ -71,6 +77,14 @@ REC_POOL_READY = "pool_ready"   # pool member created; cid known
 REC_POOL_ADOPT = "pool_adopt"   # member consumed by a placement (pre-
 #                                 finalize WAL: `by` names the adopter)
 REC_POOL_REMOVE = "pool_remove"  # member recycled/swept/drained
+# elastic-capacity decisions (clawker_tpu/capacity,
+# docs/elastic-capacity.md): pool targets, token caps, queue-mode
+# flips, and fleet provision/drain -- journaled through the same WAL so
+# --resume restores the controller's state and the chaos
+# stranded-by-drain invariant can audit every drain against the
+# placements live at that point in the record stream.  The kind
+# constants live in the capacity package (rank 2) and are re-exported
+# here for replay's convenience.
 
 
 def journal_path(logs_dir: Path, run_id: str) -> Path:
@@ -223,6 +237,11 @@ class RunImage:
     pool: dict[str, PoolImage] = field(default_factory=dict)
     clean_shutdown: bool = False
     generation: int = 0         # how many resumes already hit this run
+    capacity: dict = field(default_factory=dict)
+    #                             latest elastic-capacity controller
+    #                             state: {pool_targets, token_caps,
+    #                             queue_modes, drained} -- what a resume
+    #                             hands CapacityController.restore()
     queued_order: list[str] = field(default_factory=list)
     #                             agents whose latest launch entered the
     #                             admission queue but never reached a
@@ -258,6 +277,36 @@ def replay(records: list[dict]) -> RunImage:
             continue
         if kind == REC_RESUME:
             img.generation = int(rec.get("generation", img.generation + 1))
+            continue
+        if kind in (REC_CAPACITY_POOL, REC_CAPACITY_TOKENS,
+                    REC_CAPACITY_QUEUE, REC_CAPACITY_SCALE):
+            # capacity decisions fold latest-wins into their own table:
+            # a resume restores the controller where it left off
+            cap = img.capacity
+            wid = str(rec.get("worker", ""))
+            if kind == REC_CAPACITY_POOL and wid:
+                cap.setdefault("pool_targets", {})[wid] = int(
+                    rec.get("target", 0))
+            elif kind == REC_CAPACITY_TOKENS and wid:
+                cap.setdefault("token_caps", {})[wid] = int(
+                    rec.get("cap", 0))
+            elif kind == REC_CAPACITY_QUEUE and wid:
+                cap.setdefault("queue_modes", {})[wid] = (
+                    float(rec.get("retry_after_s", 0.0))
+                    if str(rec.get("mode", "")) == "reject" else 0.0)
+            elif kind == REC_CAPACITY_SCALE:
+                if str(rec.get("action", "")) != "drain" or not wid:
+                    continue
+                phase = str(rec.get("phase", ""))
+                pending = cap.setdefault("pending_drain", [])
+                if phase in ("blocked", "intent"):
+                    if wid not in pending:
+                        pending.append(wid)
+                elif phase in ("done", "failed"):
+                    if wid in pending:
+                        pending.remove(wid)
+                    if phase == "done":
+                        cap.setdefault("drained", []).append(wid)
             continue
         if kind in (REC_POOL_ADD, REC_POOL_READY, REC_POOL_ADOPT,
                     REC_POOL_REMOVE):

@@ -92,13 +92,20 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 from .. import consts, logsetup, telemetry
+from ..capacity import CapacityHooks
 from ..chaos.seams import NULL_SEAMS
 from ..config import Config
 from ..engine.drivers import RuntimeDriver, Worker
 from ..errors import ClawkerError, DriverError, NotFoundError
 from ..fleet.inventory import pod_topology
 from ..health import BREAKER_CLOSED, BREAKER_OPEN, HealthConfig, HealthMonitor
-from ..monitor.events import PLACEMENT_DECISION, TRACE_SPAN, EventBus, PlacementEvent
+from ..monitor.events import (
+    CAPACITY_DECISION,
+    PLACEMENT_DECISION,
+    TRACE_SPAN,
+    EventBus,
+    PlacementEvent,
+)
 from ..placement import (
     ADMISSION_REJECTED,
     AdmissionController,
@@ -138,6 +145,7 @@ from .journal import (
     RunImage,
     RunJournal,
     journal_path,
+    replay,
 )
 from .warmpool import WarmPool
 
@@ -253,6 +261,10 @@ class AgentLoop:
     worktree: Path | None = None
     fresh_container: bool = True     # next start needs the full bootstrap
     migrations: int = 0
+    retry_at: float = 0.0            # rejected-with-backoff: the rescue
+    #                                  pass honors the admission queue's
+    #                                  retry_after_s instead of re-placing
+    #                                  at the very next tick
     strands: int = 0                 # consecutive stranded create/starts
     #                                  (reset once an iteration starts)
     epoch: int = 0                   # bumped at orphan time: stale lane
@@ -506,6 +518,11 @@ class LoopScheduler:
         self.executors = executors
         if executors is not None:
             executors.bind(self)
+        # --- elastic capacity (docs/elastic-capacity.md): the rank-2
+        # controller attached via attach_capacity; ticked on the run
+        # thread for in-process (--no-daemon) runs, exactly like the
+        # pool tick.  None = every capacity knob stays static.
+        self.capacity = None
         self._remote_exits: queue.SimpleQueue = queue.SimpleQueue()
         self._placed_workers: set[str] = set()  # every worker a launch or
         #                           refill was EVER submitted to: the
@@ -572,6 +589,71 @@ class LoopScheduler:
         self.attach_anomaly_watch(sentinel)
         sentinel.bind_run(run_id=self.loop_id, events=self.events,
                           flight=self.flight)
+
+    def attach_capacity(self, controller) -> None:
+        """Wire the elastic-capacity controller
+        (:class:`~clawker_tpu.capacity.CapacityController`,
+        docs/elastic-capacity.md) to this run's surfaces.
+
+        The controller is rank-2 and never imports the scheduler: it
+        acts through callables over the warm pool's per-worker targets,
+        the admission controller's token caps and queue mode, this
+        run's write-ahead journal (``REC_CAPACITY_*`` records), and the
+        event bus (typed ``capacity.decision`` events).  The drain gate
+        is a literal journal replay -- a scale-down can only fire once
+        this run's WAL proves zero live placements (loops or pool
+        members) on the victim.  A resumed run restores the journaled
+        controller state before the first tick."""
+        if self.warmpool is None and not self.spec.worktrees:
+            # adaptive sizing needs a pool to size, even when the run
+            # was configured depth-0: targets start at zero and only
+            # the controller raises them
+            wps = self.cfg.settings.loop.warm_pool
+            self.warmpool = WarmPool(
+                self.loop_id, depth=0, max_age_s=wps.max_age_s,
+                journal=self._journal)
+            self.admission.register_tenant(
+                self.warmpool.tenant, weight=wps.tenant_weight)
+        wp = self.warmpool
+        controller.bind(CapacityHooks(
+            workers=lambda: [w.id for w in self.driver.workers()
+                             if w.engine is not None],
+            admission_stats=self.admission.stats,
+            set_token_cap=self.admission.set_worker_capacity,
+            set_shed=self.admission.set_shed,
+            pool_stats=wp.stats if wp is not None else None,
+            set_pool_target=wp.set_target if wp is not None else None,
+            live_placements=self._journaled_live_placements,
+            journal=self._journal,
+            emit=lambda ev: self.on_event(
+                "capacity", CAPACITY_DECISION, ev.detail()),
+        ))
+        self.capacity = controller
+        if self._image is not None and self._image.capacity:
+            controller.restore(self._image.capacity)
+
+    def _journaled_live_placements(self, worker_id: str) -> int:
+        """Live placements on ``worker_id`` according to this run's
+        write-ahead journal -- the scale-down gate.  A drain decision
+        reads the REPLAYED journal, not in-memory loop state, so the
+        proof is exactly what a post-crash resume would reconstruct: a
+        journaled run can never be stranded by a drain its own WAL
+        didn't authorize.  With journaling disabled, the live loop
+        table is the (weaker) fallback."""
+        if self.journal is None:
+            return sum(
+                1 for l in self.loops
+                if l.worker.id == worker_id
+                and l.status in ("pending", "running", "orphaned"))
+        self.journal.sync()
+        image = replay(RunJournal.read(self.journal.path))
+        live = sum(1 for li in image.loops.values()
+                   if li.worker == worker_id
+                   and li.status not in ("done", "failed"))
+        live += sum(1 for m in image.pool.values()
+                    if m.worker == worker_id
+                    and m.state in ("pending", "ready"))
+        return live
 
     def attach_shipper(self, shipper) -> None:
         """Attach a :class:`~clawker_tpu.monitor.shipper.
@@ -729,11 +811,20 @@ class LoopScheduler:
         st = self.admission.submit(worker.id, self.spec.tenant, dispatch,
                                    cancelled=cancelled, on_cancel=on_cancel)
         if st == ADMISSION_REJECTED:
+            # the rejection carries its backoff hint (satellite of
+            # docs/elastic-capacity.md): surface it in the typed event
+            # and pin the rescue pass behind it -- an immediate re-place
+            # would bounce straight off the same full (or shed) queue
+            retry_after = getattr(st, "retry_after_s", 0.0)
+            why = getattr(st, "reason", "") or "admission queue full"
             self.on_event(agent, PLACEMENT_DECISION, PlacementEvent(
                 agent, worker.id, self.policy.name, self.spec.tenant,
-                "rejected", "admission queue full").detail())
+                "rejected", why, retry_after_s=retry_after).detail())
+            loop.retry_at = time.monotonic() + max(0.0, retry_after)
             self._strand(loop, epoch,
-                         f"admission queue full on {worker.id}",
+                         f"{why} on {worker.id}"
+                         + (f" (retry in {retry_after:.2f}s)"
+                            if retry_after > 0 else ""),
                          penalize=False)
             if not handle.done():
                 handle.set_result(None)
@@ -1944,6 +2035,7 @@ class LoopScheduler:
                 # ceiling: a busy-but-healthy worker's queue draining is
                 # not a deterministic daemon fault
                 loop.strands += 1
+                loop.retry_at = 0.0     # only rejections carry a backoff
         self._journal(REC_ORPHANED, agent=loop.agent, worker=wid,
                       cid=stranded_cid, reason=reason)
         if self.health is not None:
@@ -2147,6 +2239,11 @@ class LoopScheduler:
                 # placements) and dispatch anything their removal unblocks
                 self.admission.sweep()
                 self._pool_tick()
+                if self.capacity is not None:
+                    # elastic capacity rides the run thread at its own
+                    # interval (docs/elastic-capacity.md); in loopd the
+                    # daemon ticks one controller across hosted runs
+                    self.capacity.maybe_tick()
                 # a loop is busy while running or orphaned (awaiting
                 # failover), or while its create/start/restart is still
                 # queued on a (possibly wedged) worker lane
@@ -2427,6 +2524,8 @@ class LoopScheduler:
                                           status="orphaned")
                 self._iter_started.pop((loop.agent, loop.iteration), None)
                 loop.status = "orphaned"
+                loop.retry_at = 0.0     # a worker death supersedes any
+                #                         admission backoff hint
                 self._waited.discard((loop.agent, loop.iteration))
                 if loop.container_id:
                     loop.abandoned.append((loop.worker, loop.container_id))
@@ -2480,6 +2579,13 @@ class LoopScheduler:
                 self._fail_orphan(loop, f"{loop.strands} consecutive "
                                         "stranded create/starts")
                 continue
+            # a rejected-with-backoff loop honors the queue's
+            # retry_after_s: re-placing before it would bounce straight
+            # off the same full (or shed) queue -- the orphan-grace
+            # clock keeps running above, so the backoff can never
+            # extend a run past --orphan-grace
+            if loop.retry_at and now < loop.retry_at:
+                continue
             if policy == "fail":
                 self._fail_orphan(loop, f"worker {loop.worker.id} "
                                         "unhealthy (failover=fail)")
@@ -2510,6 +2616,7 @@ class LoopScheduler:
                 loop.worker = target
                 loop.status = "pending"
                 loop.fresh_container = True
+                loop.retry_at = 0.0
             # NOTE: _orphan_since is NOT cleared here -- only an ADMITTED
             # re-submission clears it (_submit_launch), so a loop cycling
             # orphan -> re-place -> admission-rejected stays on the
@@ -2534,12 +2641,15 @@ class LoopScheduler:
             self.tracer.begin_iteration(loop.agent, loop.iteration,
                                         target.id, epoch=loop.epoch,
                                         resumed=True)
-            now = self.tracer.now()
+            # NOT `now`: the tracer clock is epoch time, and clobbering
+            # the pass's monotonic `now` here would feed the NEXT
+            # orphan's grace/backoff checks a 50-year delta
+            t_span = self.tracer.now()
             if target.id != old.id:
                 loop.migrations += 1
                 self.health.note_migration(old.id, target.id)
                 self.tracer.child(loop.agent, loop.iteration, SPAN_MIGRATE,
-                                  now, now, worker=target.id,
+                                  t_span, t_span, worker=target.id,
                                   src=old.id, dst=target.id,
                                   hop=loop.migrations)
                 self.on_event(loop.agent, "migrated",

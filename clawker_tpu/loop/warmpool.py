@@ -106,6 +106,11 @@ class WarmPool:
         self._clock = clock
         self._lock = threading.Lock()
         self._pools: dict[str, _WorkerPool] = {}
+        self._targets: dict[str, int] = {}   # per-worker adaptive target
+        #                             overrides (the elastic-capacity
+        #                             controller's seam; docs/
+        #                             elastic-capacity.md) -- absent
+        #                             workers fall back to self.depth
         self._seq = 0
         self.draining = False
         self.hits = 0
@@ -159,25 +164,45 @@ class WarmPool:
 
     # --------------------------------------------------------------- refill
 
+    def target_of(self, worker_id: str) -> int:
+        """The worker's live target depth: the adaptive per-worker
+        override when the capacity controller set one, else the static
+        ``depth`` the run was configured with."""
+        with self._lock:
+            return self._target_locked(worker_id)
+
+    def _target_locked(self, worker_id: str) -> int:
+        return self._targets.get(worker_id, self.depth)
+
+    def set_target(self, worker_id: str, depth: int) -> None:
+        """Adjust one worker's target depth (the elastic-capacity
+        seam).  Raising takes effect at the next refill tick; lowering
+        never removes ready members eagerly -- placements adopt the
+        surplus down (oldest first), so shrink costs nothing."""
+        with self._lock:
+            self._targets[worker_id] = max(0, int(depth))
+
     def want(self, worker_id: str) -> int:
         """How many refills ``worker_id`` needs to reach target depth."""
         with self._lock:
-            if self.draining or not self.depth:
+            target = self._target_locked(worker_id)
+            if self.draining or not target:
                 return 0
             pool = self._pools.get(worker_id)
             if pool is None:
-                return self.depth
-            return max(0, self.depth - len(pool.ready) - pool.inflight)
+                return target
+            return max(0, target - len(pool.ready) - pool.inflight)
 
     def begin_refill(self, worker: Worker) -> str | None:
         """Reserve one refill slot; returns the new member's placeholder
         agent name (journaled write-ahead, durable BEFORE the caller
         submits the create) or None when the pool needs nothing."""
         with self._lock:
-            if self.draining or not self.depth:
+            target = self._target_locked(worker.id)
+            if self.draining or not target:
                 return None
             pool = self._pool(worker)
-            if len(pool.ready) + pool.inflight >= self.depth:
+            if len(pool.ready) + pool.inflight >= target:
                 return None
             self._seq += 1
             agent = f"pool-{self.run_id[:6]}-p{self._seq}"
@@ -223,10 +248,11 @@ class WarmPool:
         """Re-adopt a journaled member found still ``created`` at
         resume reconcile.  Refuses (caller sweeps) past target depth."""
         with self._lock:
-            if self.draining or not self.depth:
+            target = self._target_locked(worker.id)
+            if self.draining or not target:
                 return False
             pool = self._pool(worker)
-            if len(pool.ready) + pool.inflight >= self.depth:
+            if len(pool.ready) + pool.inflight >= target:
                 return False
             # a fresh generation's seq restarts at 1: bump it past the
             # restored member so a refill can never reuse a LIVE
@@ -308,14 +334,21 @@ class WarmPool:
 
     def stats(self) -> dict:
         with self._lock:
+            workers = sorted(set(self._pools) | set(self._targets))
             return {
                 "target_depth": self.depth,
+                "adaptive": bool(self._targets),
                 "hits": self.hits,
                 "misses": self.misses,
                 "refills": self.refills,
                 "recycled": self.recycled,
                 "workers": {
-                    wid: {"ready": len(p.ready), "inflight": p.inflight}
-                    for wid, p in sorted(self._pools.items())
+                    wid: {
+                        "ready": len(self._pools[wid].ready)
+                        if wid in self._pools else 0,
+                        "inflight": self._pools[wid].inflight
+                        if wid in self._pools else 0,
+                        "target": self._target_locked(wid),
+                    } for wid in workers
                 },
             }

@@ -223,6 +223,12 @@ class LoopdServer:
         #                             settings sentinel.enable + jax
         self.shipper = None         # daemon-lifetime TelemetryShipper when
         #                             settings monitoring.shipper.enable
+        self.capacity = None        # daemon-lifetime CapacityController
+        #                             when settings capacity.enable
+        #                             (docs/elastic-capacity.md)
+        self._capacity_journal = None   # the daemon's own capacity WAL:
+        #                             durable scale intents land here even
+        #                             with zero hosted runs to fan out to
 
     # ----------------------------------------------------------- lifecycle
 
@@ -259,6 +265,7 @@ class LoopdServer:
         self.health.start()
         self._start_sentinel()
         self._start_shipper()
+        self._start_capacity()
         if self._metrics_port:
             self._metrics_server = telemetry.MetricsServer(
                 self._metrics_port).start()
@@ -316,6 +323,130 @@ class LoopdServer:
             log.exception("loopd shipper failed to start; continuing")
             self.shipper = None
 
+    def _start_capacity(self) -> None:
+        """Bring up the daemon-lifetime elastic-capacity controller
+        when settings ``capacity.enable`` is set
+        (docs/elastic-capacity.md).  The controller governs the
+        DAEMON's shared admission buckets and every live hosted run's
+        warm pool: pool targets split across pooled runs, journal
+        records fan out to each live run's WAL (so any of them resumes
+        the controller state), and the drain gate is the max of every
+        live run's journal-replay count -- a drain fires only when NO
+        hosted run has a live placement on the victim.  Failure
+        degrades to static capacity -- supervision, not scaling, is the
+        daemon's job."""
+        cs = self.cfg.settings.capacity
+        if not cs.enable:
+            return
+        try:
+            from ..capacity import (
+                CapacityController,
+                CapacityHooks,
+                make_scaler,
+            )
+            from ..loop.journal import RunJournal, journal_path
+
+            # the daemon's own capacity WAL: decisions fan out to every
+            # live run's journal, but with ZERO hosted runs a durable
+            # provision/drain intent must still land SOMEWHERE before
+            # the scaler acts -- an idle daemon deleting a VM with no
+            # auditable intent would break exactly the write-ahead
+            # promise the controller makes
+            self._capacity_journal = RunJournal(
+                journal_path(self.cfg.logs_dir, "loopd-capacity"))
+            scaler = (make_scaler(self.driver, self.cfg,
+                                  max_workers=cs.autoscale.max_workers)
+                      if cs.autoscale.enable else None)
+            self.capacity = CapacityController(
+                cs, hooks=self._capacity_hooks(CapacityHooks),
+                scaler=scaler)
+            threading.Thread(target=self._capacity_loop, daemon=True,
+                             name="loopd-capacity").start()
+            log.info("loopd capacity controller up (interval %.1fs)",
+                     cs.interval_s)
+        except Exception:       # noqa: BLE001 -- elastic is a rider
+            log.exception("loopd capacity controller failed to start")
+            self.capacity = None
+
+    def _live_scheds(self) -> list:
+        with self._runs_lock:
+            return [r.sched for r in self.runs.values()
+                    if not r.done.is_set() and r.sched is not None]
+
+    def _capacity_hooks(self, hooks_cls):
+        def pooled():
+            return [s for s in self._live_scheds() if s.warmpool is not None]
+
+        def pool_stats() -> dict:
+            agg: dict = {"workers": {}}
+            for sched in pooled():
+                for wid, row in sched.warmpool.stats()["workers"].items():
+                    cur = agg["workers"].setdefault(
+                        wid, {"ready": 0, "inflight": 0, "target": 0})
+                    cur["ready"] += row.get("ready", 0)
+                    cur["inflight"] += row.get("inflight", 0)
+                    cur["target"] += row.get("target", 0)
+            return agg
+
+        def set_pool_target(wid: str, target: int) -> None:
+            runs = pooled()
+            if not runs:
+                return
+            # the fleet-wide target splits across pooled runs (their
+            # arrival counters all feed the same registry): floor plus
+            # one-each of the remainder, so the sum equals the
+            # controller's ask exactly -- a ceil-everywhere split would
+            # overshoot by up to len(runs)-1 idle containers per worker
+            target = max(0, int(target))
+            base, extra = divmod(target, len(runs))
+            for i, sched in enumerate(runs):
+                sched.warmpool.set_target(wid, base + (1 if i < extra
+                                                       else 0))
+
+        def live_placements(wid: str) -> int:
+            return sum(s._journaled_live_placements(wid)
+                       for s in self._live_scheds())
+
+        def journal(kind: str, *, durable: bool = False, **fields) -> None:
+            # the daemon WAL first (it exists even with zero hosted
+            # runs), then fan out so every run's --resume can restore
+            # the controller state
+            if self._capacity_journal is not None:
+                self._capacity_journal.append(kind, durable=durable,
+                                              **fields)
+            for sched in self._live_scheds():
+                sched._journal(kind, durable=durable, **fields)
+
+        def emit(ev) -> None:
+            from ..monitor.events import CAPACITY_DECISION
+
+            for sched in self._live_scheds():
+                sched.on_event("capacity", CAPACITY_DECISION, ev.detail())
+
+        return hooks_cls(
+            workers=lambda: [w.id for w in self.driver.workers()
+                             if w.engine is not None],
+            admission_stats=self.admission.stats,
+            set_token_cap=self.admission.set_worker_capacity,
+            set_shed=self.admission.set_shed,
+            pool_stats=pool_stats,
+            set_pool_target=set_pool_target,
+            live_placements=live_placements,
+            journal=journal,
+            emit=emit,
+        )
+
+    def _capacity_loop(self) -> None:
+        interval = max(0.05, self.cfg.settings.capacity.interval_s)
+        while not self._stop.wait(interval):
+            controller = self.capacity
+            if controller is None:
+                return
+            try:
+                controller.tick()
+            except Exception:   # noqa: BLE001 -- a bad tick must never
+                log.exception("capacity tick failed")  # kill the loop
+
     def _socket_answers(self) -> bool:
         try:
             with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
@@ -367,6 +498,8 @@ class LoopdServer:
             self.shipper.stop()
         if self._metrics_server is not None:
             self._metrics_server.stop()
+        if self._capacity_journal is not None:
+            self._capacity_journal.close()
         self.lanes.close_all()
         self._drop_conns()
         pidfile_path(self.cfg).unlink(missing_ok=True)
@@ -814,6 +947,9 @@ class LoopdServer:
             "health": self._health_stats(),
             "workerd": self._workerd_rows(),
             "warm_pools": pools,
+            "capacity": ({"enabled": True, **self.capacity.state()}
+                         if self.capacity is not None
+                         else {"enabled": False}),
             "sentinel": (self.sentinel.status_doc()
                          if self.sentinel is not None
                          else {"enabled": False}),

@@ -54,6 +54,43 @@ PLACEMENT_DECISION = "placement.decision"
 # observational -- nothing on the bus consumes it to change scheduling.
 ANOMALY_FLAG = "anomaly.flag"
 
+# Event name elastic-capacity decisions ride the bus under
+# (clawker_tpu/capacity + docs/elastic-capacity.md): pool-target /
+# token-cap / queue-mode / fleet-scale changes, typed so the console
+# and tests can replay what the controller did and why.
+CAPACITY_DECISION = "capacity.decision"
+
+
+@dataclass(frozen=True)
+class CapacityDecisionEvent:
+    """Typed payload of a ``capacity.decision`` event.
+
+    ``kind`` names the control loop that acted: ``pool`` (adaptive
+    warm-pool target), ``tokens`` (SLO-scaled bucket cap), ``queue``
+    (reject-with-retry-after flip), ``provision`` / ``drain`` /
+    ``drain_blocked`` (fleet autoscale).  ``value`` is the compact
+    outcome (``target=4``, ``cap=8``, ``reject retry_after_s=0.40``);
+    ``reason`` carries the telemetry that drove it.  Rides as the
+    detail string like the other typed events; structured consumers
+    round-trip with :meth:`parse`.
+    """
+
+    kind: str
+    worker: str
+    value: str
+    reason: str = ""
+
+    def detail(self) -> str:
+        base = f"{self.kind} {self.worker or '-'} {self.value}"
+        return f"{base}: {self.reason}" if self.reason else base
+
+    @classmethod
+    def parse(cls, detail: str) -> "CapacityDecisionEvent":
+        head, _, reason = detail.partition(": ")
+        kind, _, rest = head.partition(" ")
+        worker, _, value = rest.partition(" ")
+        return cls(kind, "" if worker == "-" else worker, value, reason)
+
 
 @dataclass(frozen=True)
 class AnomalyFlagEvent:
@@ -102,9 +139,15 @@ class PlacementEvent:
     tenant: str
     action: str
     reason: str = ""
+    retry_after_s: float = 0.0      # rejected only: the backoff hint the
+    #                                 admission controller handed back --
+    #                                 how long until the queue is expected
+    #                                 to have room (docs/elastic-capacity.md)
 
     def detail(self) -> str:
         base = f"{self.action} {self.worker} [{self.policy}/{self.tenant}]"
+        if self.retry_after_s > 0:
+            base += f" retry_after_s={self.retry_after_s:.3f}"
         return f"{base}: {self.reason}" if self.reason else base
 
     @classmethod
@@ -112,8 +155,14 @@ class PlacementEvent:
         head, _, reason = detail.partition(": ")
         action, _, rest = head.partition(" ")
         worker, _, tagged = rest.partition(" [")
+        tagged, _, retry_raw = tagged.partition(" retry_after_s=")
         policy, _, tenant = tagged.rstrip("]").partition("/")
-        return cls(agent, worker, policy, tenant, action, reason)
+        try:
+            retry = float(retry_raw) if retry_raw else 0.0
+        except ValueError:
+            retry = 0.0
+        return cls(agent, worker, policy, tenant.rstrip("]"), action,
+                   reason, retry)
 
 
 @dataclass(frozen=True)

@@ -29,6 +29,7 @@ from .admission import (
     ADMISSION_QUEUED,
     ADMISSION_REJECTED,
     AdmissionController,
+    AdmissionOutcome,
     AdmissionTicket,
 )
 from .policy import (
@@ -41,6 +42,7 @@ from .policy import (
 
 __all__ = [
     "ADMISSION_DISPATCHED", "ADMISSION_QUEUED", "ADMISSION_REJECTED",
-    "AdmissionController", "AdmissionTicket", "PLACEMENT_POLICIES",
-    "PlacementContext", "PlacementPolicy", "get_policy", "note_decision",
+    "AdmissionController", "AdmissionOutcome", "AdmissionTicket",
+    "PLACEMENT_POLICIES", "PlacementContext", "PlacementPolicy",
+    "get_policy", "note_decision",
 ]

@@ -44,11 +44,34 @@ log = logsetup.get("placement.admission")
 
 DEFAULT_MAX_INFLIGHT = 4
 DEFAULT_MAX_PENDING = 256
+DEFAULT_RETRY_AFTER_S = 0.25    # backoff hint before any launch latency
+#                                 was measured (one fallback tick)
+LAUNCH_EWMA_ALPHA = 0.2         # dispatch->release latency smoothing
 
 # submit() outcomes
 ADMISSION_DISPATCHED = "dispatched"
 ADMISSION_QUEUED = "queued"
 ADMISSION_REJECTED = "rejected"
+
+
+class AdmissionOutcome(str):
+    """A submit() outcome that still compares equal to the bare outcome
+    strings (``st == ADMISSION_REJECTED`` keeps working everywhere) but
+    carries the backoff hint a rejection owes its caller: how long
+    until the worker's queue is expected to have room, derived from the
+    queue depth and the measured launch latency.  0.0 on non-rejected
+    outcomes.  ``reason`` distinguishes a full queue from a capacity-
+    controller shed (SLO unattainable)."""
+
+    retry_after_s: float
+    reason: str
+
+    def __new__(cls, value: str, retry_after_s: float = 0.0,
+                reason: str = ""):
+        self = super().__new__(cls, value)
+        self.retry_after_s = float(retry_after_s)
+        self.reason = reason
+        return self
 
 _QUEUE_DEPTH = telemetry.gauge(
     "placement_queue_depth", "Launches waiting in the admission queue",
@@ -109,6 +132,13 @@ class _WorkerGate:
         self.pending: list[AdmissionTicket] = []
         self.dispatched = 0
         self.rejected = 0
+        self.launch_ewma_s = 0.0    # dispatch->release wall EWMA: what the
+        #                             capacity controller scales tokens from
+        #                             and retry_after estimates divide by
+        self.shed_retry_after_s = 0.0   # > 0: the capacity controller
+        #                             flipped this worker's bounded queue
+        #                             to reject-with-retry-after (the SLO
+        #                             is unattainable at current depth)
 
 
 class AdmissionController:
@@ -160,13 +190,15 @@ class AdmissionController:
     def submit(self, worker_id: str, tenant: str,
                run: Callable[[Callable[[], None]], None], *,
                cancelled: Callable[[], bool] | None = None,
-               on_cancel: Callable[[], None] | None = None) -> str:
+               on_cancel: Callable[[], None] | None = None) -> AdmissionOutcome:
         """Admit a launch against ``worker_id`` billed to ``tenant``.
 
         Returns ``dispatched`` (token acquired, ``run`` called before
         returning), ``queued`` (waiting for a token or its tenant's
-        cap), or ``rejected`` (pending queue full -- nothing retained;
-        the caller owns the retry/re-place)."""
+        cap), or ``rejected`` (pending queue full, or the capacity
+        controller shed the queue -- nothing retained; the caller owns
+        the retry/re-place, and the outcome's ``retry_after_s`` says
+        when the queue is expected to have room)."""
         ticket = AdmissionTicket(
             worker_id=worker_id, tenant=tenant, run=run,
             cancelled=cancelled or (lambda: False), on_cancel=on_cancel,
@@ -175,11 +207,24 @@ class AdmissionController:
         with self._lock:
             gate = self._gate(worker_id)
             share = self._tenant(tenant)
-            if len(gate.pending) >= self.max_pending:
+            full = len(gate.pending) >= self.max_pending
+            # shed mode (docs/elastic-capacity.md): the SLO is provably
+            # unattainable at current queue depth, so a submission that
+            # would QUEUE is rejected with the honest backoff instead
+            # of joining a line it cannot clear in time.  A submission
+            # a free token would dispatch immediately still goes in.
+            shed = (gate.shed_retry_after_s > 0
+                    and (gate.pending or gate.inflight >= gate.capacity))
+            if full or shed:
                 gate.rejected += 1
                 share.rejected += 1
                 _REJECTIONS.labels(worker_id).inc()
-                return ADMISSION_REJECTED
+                retry = (gate.shed_retry_after_s if shed
+                         else self._retry_after_locked(gate))
+                return AdmissionOutcome(
+                    ADMISSION_REJECTED, retry,
+                    "queue shed (SLO unattainable)" if shed
+                    else "admission queue full")
             # WFQ stamp: the ticket finishes 1/weight of virtual time
             # after the later of the global clock and the tenant's last
             # enqueue -- back-to-back bursts from one tenant stack up,
@@ -193,7 +238,18 @@ class AdmissionController:
             self._pump_locked(dispatches)
             queued = not any(t is ticket for t in dispatches)
         self._run_dispatches(dispatches)
-        return ADMISSION_QUEUED if queued else ADMISSION_DISPATCHED
+        return AdmissionOutcome(ADMISSION_QUEUED if queued
+                                else ADMISSION_DISPATCHED)
+
+    def _retry_after_locked(self, gate: _WorkerGate) -> float:
+        """Backoff hint for a full-queue rejection: the time the current
+        backlog needs to drain at the measured launch rate.  Before any
+        launch completed there is no rate -- one fallback tick."""
+        if gate.launch_ewma_s <= 0:
+            return DEFAULT_RETRY_AFTER_S
+        backlog = len(gate.pending) + gate.inflight
+        return max(0.05, backlog * gate.launch_ewma_s
+                   / max(1, gate.capacity))
 
     # ------------------------------------------------------------ dispatch
 
@@ -275,16 +331,24 @@ class AdmissionController:
         here would race a reset_worker landing between dispatch and this
         call and hand the stranded launch the NEW epoch."""
         done = threading.Event()
+        t_dispatch = self._clock()
 
         def release() -> None:
             if done.is_set():
                 return
             done.set()
+            held_s = max(0.0, self._clock() - t_dispatch)
             dispatches: list[AdmissionTicket] = []
             with self._lock:
                 gate = self._workers.get(worker_id)
                 if gate is None or gate.epoch != epoch:
                     return
+                # dispatch->release wall: the launch latency the SLO
+                # scaling law and retry_after estimates divide by
+                gate.launch_ewma_s = (
+                    held_s if gate.launch_ewma_s <= 0 else
+                    gate.launch_ewma_s + LAUNCH_EWMA_ALPHA
+                    * (held_s - gate.launch_ewma_s))
                 gate.inflight = max(0, gate.inflight - 1)
                 held = gate.inflight_by_tenant.get(tenant, 0)
                 if held > 1:
@@ -298,6 +362,38 @@ class AdmissionController:
             self._run_dispatches(dispatches)
 
         return release
+
+    # ----------------------------------------------------- capacity seams
+
+    def set_worker_capacity(self, worker_id: str, capacity: int) -> None:
+        """Scale one worker's token bucket (the elastic-capacity
+        controller's SLO loop; docs/elastic-capacity.md).  Raising the
+        cap pumps immediately so queued launches take the new tokens;
+        lowering never revokes outstanding ones -- in-flight launches
+        drain naturally and the bucket settles at the new cap."""
+        dispatches: list[AdmissionTicket] = []
+        with self._lock:
+            gate = self._gate(worker_id)
+            gate.capacity = max(1, int(capacity))
+            self._pump_locked(dispatches)
+        self._run_dispatches(dispatches)
+
+    def set_shed(self, worker_id: str, retry_after_s: float) -> None:
+        """Flip one worker's bounded queue into reject-with-retry-after
+        (``retry_after_s > 0``) or back to normal queueing (``0``).
+        While shedding, a submission that would QUEUE is rejected with
+        the given backoff; one a free token can dispatch immediately is
+        still admitted -- the SLO is unattainable for the QUEUE, not
+        for work that starts now."""
+        with self._lock:
+            self._gate(worker_id).shed_retry_after_s = max(
+                0.0, float(retry_after_s))
+
+    def launch_latency_s(self, worker_id: str) -> float:
+        """The measured dispatch->release launch latency EWMA."""
+        with self._lock:
+            gate = self._workers.get(worker_id)
+            return gate.launch_ewma_s if gate is not None else 0.0
 
     # ----------------------------------------------------------- lifecycle
 
@@ -356,6 +452,9 @@ class AdmissionController:
                         "pending": len(g.pending),
                         "dispatched": g.dispatched,
                         "rejected": g.rejected,
+                        "launch_ewma_ms": round(g.launch_ewma_s * 1000, 2),
+                        "shed_retry_after_s": round(
+                            g.shed_retry_after_s, 3),
                     } for wid, g in sorted(self._workers.items())
                 },
                 "tenants": {

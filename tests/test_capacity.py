@@ -1,0 +1,632 @@
+"""Elastic-capacity suite (ISSUE 14 tentpole): the EWMA arrival
+estimator, the SLO token-scaling law, reject-with-retry-after under
+saturation, journal-gated scale-down, resume restoring controller
+state, the chaos stranded-by-drain detector, and the `clawker fleet`
+capacity views (docs/elastic-capacity.md)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from clawker_tpu import consts, telemetry
+from clawker_tpu.capacity import (
+    REC_CAPACITY_POOL,
+    REC_CAPACITY_SCALE,
+    REC_CAPACITY_TOKENS,
+    CapacityController,
+    CapacityHooks,
+    EwmaRate,
+    FakeFleetScaler,
+    NullScaler,
+    tokens_for,
+)
+from clawker_tpu.config import load_config
+from clawker_tpu.config.schema import (
+    CapacityAutoscaleSettings,
+    CapacitySettings,
+    CapacitySloSettings,
+)
+from clawker_tpu.engine.drivers import FakeDriver, Worker
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.loop import LoopScheduler, LoopSpec
+from clawker_tpu.loop.journal import RunJournal, journal_path, replay
+from clawker_tpu.loop.warmpool import WarmPool
+from clawker_tpu.placement import (
+    ADMISSION_DISPATCHED,
+    ADMISSION_QUEUED,
+    ADMISSION_REJECTED,
+    AdmissionController,
+)
+from clawker_tpu.testenv import TestEnv
+
+IMAGE = "clawker-capproj:default"
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: capproj\n")
+        cfg = load_config(proj)
+        yield tenv, proj, cfg
+
+
+def driver_with(n_workers: int, behavior=None):
+    drv = FakeDriver(n_workers=n_workers)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, behavior or exit_behavior(b"done\n", 0,
+                                                          delay=0.02))
+    return drv
+
+
+def wait_for(pred, timeout=10.0, interval=0.01) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ------------------------------------------------------- EWMA estimator
+
+
+def test_ewma_converges_to_constant_rate():
+    r = EwmaRate(alpha_up=0.5, alpha_down=0.1)
+    for _ in range(60):
+        r.observe(10, 1.0)          # 10 events/s, forever
+    assert r.value == pytest.approx(10.0, abs=0.01)
+    # from above too (decay side)
+    r2 = EwmaRate(alpha_up=0.5, alpha_down=0.1)
+    r2.observe(100, 1.0)            # seeded high
+    for _ in range(120):
+        r2.observe(10, 1.0)
+    assert r2.value == pytest.approx(10.0, abs=0.1)
+
+
+def test_ewma_asymmetry_bursts_fast_decays_slow():
+    r = EwmaRate(alpha_up=0.5, alpha_down=0.05)
+    r.observe(1, 1.0)               # quiet baseline
+    r.observe(100, 1.0)             # burst: must jump within one tick
+    after_burst = r.value
+    assert after_burst > 40.0
+    r.observe(1, 1.0)               # back to quiet: must NOT collapse
+    assert r.value > after_burst * 0.9
+
+
+def test_ewma_first_sample_seeds():
+    r = EwmaRate()
+    r.observe(50, 1.0)
+    assert r.value == 50.0          # no blend against the 0.0 prior
+
+
+def test_pool_target_clamped_to_limits(env):
+    """The controller's pool loop clamps targets to
+    [pool_min_depth, pool_max_depth] no matter what the rate says."""
+    tenv, proj, cfg = env
+    telemetry.REGISTRY.reset()
+    pool = WarmPool("caprun", depth=0)
+    w = Worker(id="cw0", index=0, hostname="cw0", engine=None)
+    adm = AdmissionController()
+    ctrl = CapacityController(
+        CapacitySettings(enable=True, interval_s=0.01, pool_min_depth=1,
+                         pool_max_depth=3),
+        hooks=CapacityHooks(
+            workers=lambda: ["cw0"],
+            admission_stats=adm.stats,
+            set_token_cap=adm.set_worker_capacity,
+            set_shed=adm.set_shed,
+            pool_stats=pool.stats,
+            set_pool_target=pool.set_target))
+    ctrl.tick()
+    # a storm of misses (cold checkouts) -> rate explodes; target must
+    # stop at max_depth
+    for _ in range(500):
+        pool.checkout("cw0", by="t", epoch=0)
+    time.sleep(0.02)
+    ctrl.tick()
+    assert ctrl.pool_targets["cw0"] == 3
+    assert pool.target_of("cw0") == 3
+    # silence decays the rate; the floor holds at min_depth
+    for _ in range(300):
+        time.sleep(0.001)
+        ctrl.tick()
+    assert ctrl.pool_targets["cw0"] == 1
+
+
+# ----------------------------------------------------- SLO token scaling
+
+
+def test_tokens_for_monotone_grid():
+    """The scaling law is monotone: non-decreasing in queue depth and
+    launch latency, non-increasing in SLO; always inside [lo, hi]."""
+    queues = [0, 1, 4, 16, 64]
+    latencies = [0.005, 0.02, 0.1, 0.5]
+    slos = [0.05, 0.25, 1.0, 4.0]
+    for lat in latencies:
+        for slo in slos:
+            caps = [tokens_for(q, 0, lat, slo, 2, 16)[0] for q in queues]
+            assert caps == sorted(caps), (lat, slo, caps)
+            assert all(2 <= c <= 16 for c in caps)
+    for q in queues:
+        for slo in slos:
+            caps = [tokens_for(q, 0, lat, slo, 2, 16)[0]
+                    for lat in latencies]
+            assert caps == sorted(caps), (q, slo, caps)
+    for q in queues:
+        for lat in latencies:
+            caps = [tokens_for(q, 0, lat, slo, 2, 16)[0] for slo in slos]
+            assert caps == sorted(caps, reverse=True), (q, lat, caps)
+
+
+def test_tokens_for_disabled_slo_returns_floor():
+    assert tokens_for(100, 4, 0.1, 0.0, 3, 16) == (3, 0.0)
+    assert tokens_for(100, 4, 0.0, 1.0, 3, 16) == (3, 0.0)
+
+
+def test_slo_scaling_raises_cap_and_dispatches_queue():
+    """A queued backlog under a tight SLO scales the worker's bucket up
+    through the admission seam, and the raise pumps queued tickets."""
+    adm = AdmissionController(max_inflight_per_worker=1)
+    running: list = []
+
+    def launch(release):
+        running.append(release)     # holds its token until released
+
+    for _ in range(6):
+        adm.submit("w0", "t", launch)
+    assert len(running) == 1        # one token, five queued
+    adm.set_worker_capacity("w0", 4)
+    assert len(running) == 4        # the raise pumped three more out
+    stats = adm.stats()["workers"]["w0"]
+    assert stats["capacity"] == 4
+    for r in list(running):
+        r()
+
+
+# ------------------------------------- reject-with-retry-after (shed)
+
+
+def test_full_queue_rejection_carries_retry_after():
+    adm = AdmissionController(max_inflight_per_worker=1,
+                              max_pending_per_worker=1)
+    adm.submit("w0", "t", lambda release: None)     # takes the token
+    adm.submit("w0", "t", lambda release: None)     # fills the queue
+    st = adm.submit("w0", "t", lambda release: None)
+    assert st == ADMISSION_REJECTED
+    assert st.retry_after_s > 0
+    assert "queue full" in st.reason
+
+
+def test_shed_mode_rejects_would_queue_with_retry_after():
+    adm = AdmissionController(max_inflight_per_worker=1)
+    adm.submit("w0", "t", lambda release: None)     # token held
+    adm.set_shed("w0", 0.7)
+    st = adm.submit("w0", "t", lambda release: None)
+    assert st == ADMISSION_REJECTED
+    assert st.retry_after_s == pytest.approx(0.7)
+    assert "shed" in st.reason
+    # a submission a free token can take immediately still dispatches
+    adm.set_shed("w0", 0.0)
+    adm.reset_worker("w0")
+    ran: list = []
+    st = adm.submit("w0", "t", lambda release: ran.append(1))
+    assert st == ADMISSION_DISPATCHED and ran
+
+
+def test_controller_sheds_when_slo_unattainable_and_restores():
+    """Saturation past what token_max can drain inside the SLO flips
+    the queue to reject-with-retry-after; draining flips it back."""
+    clock = [0.0]
+    adm = AdmissionController(max_inflight_per_worker=1,
+                              clock=lambda: clock[0])
+    held: list = []
+    for _ in range(40):
+        adm.submit("w0", "t", lambda release: held.append(release))
+    # teach the gate a launch latency: release one token at +1s
+    clock[0] = 1.0
+    held.pop(0)()
+    journaled: list = []
+    ctrl = CapacityController(
+        CapacitySettings(enable=True, interval_s=0.01, token_max=2,
+                         slo=CapacitySloSettings(default_s=0.2)),
+        hooks=CapacityHooks(
+            workers=lambda: ["w0"],
+            admission_stats=adm.stats,
+            set_token_cap=adm.set_worker_capacity,
+            set_shed=adm.set_shed,
+            journal=lambda kind, **f: journaled.append((kind, f))))
+    ctrl.tick()
+    assert ctrl.shedding.get("w0", 0.0) > 0
+    st = adm.submit("w0", "t", lambda release: None)
+    assert st == ADMISSION_REJECTED and st.retry_after_s > 0
+    assert any(k == "capacity_queue" and f["mode"] == "reject"
+               for k, f in journaled)
+    # drain the backlog (each release dispatches the next queued
+    # ticket, which appends its own release); the next tick restores
+    # queueing
+    while held:
+        held.pop(0)()
+    time.sleep(0.02)
+    ctrl.tick()
+    assert ctrl.shedding.get("w0", 0.0) == 0.0
+    assert any(k == "capacity_queue" and f["mode"] == "queue"
+               for k, f in journaled)
+
+
+def test_scheduler_rescue_honors_retry_after(env):
+    """A rejected launch re-places only after the rejection's
+    retry_after_s elapsed -- never an immediate bounce -- and the typed
+    placement.decision event carries the hint."""
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    adm = AdmissionController(max_inflight_per_worker=1,
+                              max_pending_per_worker=1)
+    events: list = []
+    sched = LoopScheduler(
+        cfg, drv, LoopSpec(parallel=4, iterations=1, placement="pack"),
+        admission=adm,
+        on_event=lambda a, e, d="": events.append((a, e, d)))
+    sched.start()
+    loops = sched.run(poll_s=0.05)
+    sched.cleanup(remove_containers=True)
+    assert all(l.status == "done" for l in loops)
+    rejected = [d for _a, e, d in events
+                if e == "placement.decision" and "rejected" in d]
+    assert rejected and all("retry_after_s=" in d for d in rejected)
+
+
+# --------------------------------------------- drain gating / autoscale
+
+
+def _controller_for(sched, drv, **kw):
+    settings = CapacitySettings(
+        enable=True, interval_s=0.01, pool_max_depth=4,
+        autoscale=CapacityAutoscaleSettings(
+            enable=True, min_workers=1, max_workers=len(drv.workers()),
+            queue_high=10_000, idle_low=0.0, sustain_s=3600.0), **kw)
+    ctrl = CapacityController(settings,
+                              scaler=FakeFleetScaler(drv))
+    sched.attach_capacity(ctrl)
+    return ctrl
+
+
+def test_drain_blocked_by_live_placement_then_fires(env):
+    """A requested drain defers while the victim's journal shows live
+    placements, and fires once the run has drained off it."""
+    tenv, proj, cfg = env
+    drv = driver_with(2, exit_behavior(b"", 0, delay=0.05))
+    sched = LoopScheduler(cfg, drv,
+                          LoopSpec(parallel=2, iterations=1,
+                                   placement="spread"))
+    ctrl = _controller_for(sched, drv)
+    ctrl.request_drain("fake-1")
+    sched.start()
+    # while the run is live on fake-1 the drain must be BLOCKED
+    ctrl.tick()
+    assert "fake-1" in ctrl._pending_drain
+    assert ctrl.drained == []
+    loops = sched.run(poll_s=0.05)
+    assert all(l.status == "done" for l in loops)
+    # terminal run: the journal now proves zero live placements
+    ctrl.tick()
+    assert ctrl.drained == ["fake-1"]
+    assert [w.id for w in drv.workers()] == ["fake-0"]
+    sched.cleanup(remove_containers=True)
+    records = RunJournal.read(journal_path(cfg.logs_dir, sched.loop_id))
+    kinds = [(r.get("kind"), r.get("phase")) for r in records
+             if r.get("kind") == REC_CAPACITY_SCALE]
+    assert (REC_CAPACITY_SCALE, "blocked") in kinds
+    assert (REC_CAPACITY_SCALE, "intent") in kinds
+    assert (REC_CAPACITY_SCALE, "done") in kinds
+    # WAL order: the durable intent precedes the done
+    assert kinds.index((REC_CAPACITY_SCALE, "intent")) \
+        < kinds.index((REC_CAPACITY_SCALE, "done"))
+
+
+def test_stranded_by_drain_detector_fires_on_bad_journal():
+    """The invariant detector flags a drain journaled while placements
+    were live -- the violation the gate exists to prevent."""
+    from clawker_tpu.chaos.invariants import check_invariants
+
+    class _NoJournal:
+        @staticmethod
+        def read(path):
+            return [
+                {"kind": "run", "run": "r1", "spec": {}},
+                {"kind": "placement", "agent": "a0", "worker": "w1"},
+                {"kind": REC_CAPACITY_SCALE, "action": "drain",
+                 "worker": "w1", "phase": "done"},
+            ]
+
+    import clawker_tpu.chaos.invariants as inv
+    import clawker_tpu.loop.journal as journal_mod
+
+    real = journal_mod.RunJournal.read
+    journal_mod.RunJournal.read = _NoJournal.read
+    try:
+        drv = FakeDriver(n_workers=1)
+        with TestEnv() as tenv:
+            proj = tenv.base / "proj"
+            proj.mkdir()
+            (proj / consts.PROJECT_FLAT_FORM).write_text(
+                "project: capproj\n")
+            cfg = load_config(proj)
+            violations = check_invariants(drv, cfg, "r1", loops=[])
+        drv.close()
+    finally:
+        journal_mod.RunJournal.read = real
+    assert any(v.startswith("stranded-by-drain") and "a0" in v
+               for v in violations)
+
+
+def test_stranded_by_drain_detector_accepts_gated_drain():
+    from clawker_tpu.chaos.invariants import check_invariants
+
+    import clawker_tpu.loop.journal as journal_mod
+
+    recs = [
+        {"kind": "run", "run": "r1", "spec": {}},
+        {"kind": "placement", "agent": "a0", "worker": "w1"},
+        {"kind": "loop_end", "agent": "a0", "status": "done"},
+        {"kind": REC_CAPACITY_SCALE, "action": "drain",
+         "worker": "w1", "phase": "done"},
+    ]
+    real = journal_mod.RunJournal.read
+    journal_mod.RunJournal.read = staticmethod(lambda path: recs)
+    try:
+        drv = FakeDriver(n_workers=1)
+        with TestEnv() as tenv:
+            proj = tenv.base / "proj"
+            proj.mkdir()
+            (proj / consts.PROJECT_FLAT_FORM).write_text(
+                "project: capproj\n")
+            cfg = load_config(proj)
+            violations = check_invariants(drv, cfg, "r1", loops=[])
+        drv.close()
+    finally:
+        journal_mod.RunJournal.read = real
+    assert not any(v.startswith("stranded-by-drain") for v in violations)
+
+
+def test_chaos_capacity_scenario_green(env):
+    """A hand-written capacity plan (traffic burst + scale-down under
+    load) runs green end to end: the drain never strands the run and
+    every standard invariant holds."""
+    from clawker_tpu.chaos.plan import FaultEvent, FaultPlan
+    from clawker_tpu.chaos.runner import run_plan
+
+    plan = FaultPlan(
+        seed=7, scenario=0, n_workers=3, n_loops=4, iterations=1,
+        warm_pool_depth=1, capacity=True,
+        events=[
+            FaultEvent(at_s=0.05, kind="traffic_burst", worker=0, arg=8),
+            FaultEvent(at_s=0.1, kind="scale_down", worker=2),
+        ])
+    result = run_plan(plan)
+    assert result.ok, result.violations
+    assert result.injected >= 2
+
+
+# ------------------------------------------------------ resume restores
+
+
+def test_resume_restores_controller_state(env):
+    """Journaled REC_CAPACITY_* records rebuild the controller's pool
+    targets, token caps, and pending drains on --resume."""
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    sched = LoopScheduler(cfg, drv,
+                          LoopSpec(parallel=2, iterations=2,
+                                   warm_pool_depth=1))
+    ctrl = _controller_for(sched, drv)
+    sched.start()
+    runner = threading.Thread(target=sched.run, kwargs={"poll_s": 0.05},
+                              daemon=True)
+    runner.start()
+    assert wait_for(lambda: ctrl.ticks >= 1)
+    # force a recognizable journaled state, then die mid-run
+    sched._journal(REC_CAPACITY_POOL, worker="fake-0", target=3, rate=9.0)
+    sched._journal(REC_CAPACITY_TOKENS, worker="fake-1", cap=7,
+                   launch_ms=20.0)
+    sched._journal(REC_CAPACITY_SCALE, action="drain", worker="fake-1",
+                   phase="blocked", live=1)
+    sched.journal.sync()
+    sched.kill()
+    runner.join(5.0)
+
+    image = replay(RunJournal.read(journal_path(cfg.logs_dir,
+                                                sched.loop_id)))
+    assert image.capacity["pool_targets"]["fake-0"] == 3
+    assert image.capacity["token_caps"]["fake-1"] == 7
+    assert image.capacity["pending_drain"] == ["fake-1"]
+
+    resumed = LoopScheduler.resume(cfg, drv, image)
+    ctrl2 = CapacityController(
+        CapacitySettings(enable=True, interval_s=0.01, pool_max_depth=4),
+        scaler=NullScaler())
+    resumed.attach_capacity(ctrl2)
+    assert ctrl2.pool_targets["fake-0"] == 3
+    assert resumed.warmpool.target_of("fake-0") == 3
+    assert ctrl2.token_caps["fake-1"] == 7
+    assert resumed.admission.stats()["workers"]["fake-1"]["capacity"] == 7
+    assert "fake-1" in ctrl2._pending_drain
+    resumed.reconcile()
+    loops = resumed.run(poll_s=0.05)
+    resumed.cleanup(remove_containers=True)
+    assert all(l.status in ("done", "stopped") for l in loops)
+
+
+# ------------------------------------------------- warm pool seam bits
+
+
+def test_warmpool_per_worker_targets():
+    pool = WarmPool("caprun", depth=2)
+    w = Worker(id="w0", index=0, hostname="w0", engine=None)
+    assert pool.target_of("w0") == 2        # static default
+    pool.set_target("w0", 4)
+    assert pool.target_of("w0") == 4
+    assert pool.want("w0") == 4
+    assert pool.target_of("other") == 2     # untouched workers keep static
+    pool.set_target("w0", 0)
+    assert pool.want("w0") == 0
+    assert pool.begin_refill(w) is None
+    stats = pool.stats()
+    assert stats["adaptive"] is True
+    assert stats["workers"]["w0"]["target"] == 0
+
+
+# ----------------------------------------------------------------- CLI
+
+
+def _daemon_doc() -> dict:
+    return {
+        "type": "status", "pid": 4242, "runs": [],
+        "health": [{"worker": "fake-0", "state": "closed",
+                    "breaker_state_gauge": 0, "probe_p50_ms": 1.0}],
+        "admission": {
+            "max_inflight_per_worker": 4, "max_pending_per_worker": 256,
+            "workers": {"fake-0": {
+                "inflight": 1, "inflight_hwm": 2, "capacity": 8,
+                "pending": 3, "dispatched": 11, "rejected": 2,
+                "launch_ewma_ms": 20.0, "shed_retry_after_s": 0.0}},
+            "tenants": {"default": {
+                "weight": 1.0, "queued": 3, "inflight": 1,
+                "dispatched": 11, "max_inflight": 0, "inflight_hwm": 2,
+                "rejected": 2, "cancelled": 0}},
+        },
+        "warm_pools": {"run1": {
+            "target_depth": 0, "adaptive": True, "hits": 5, "misses": 1,
+            "refills": 6, "recycled": 0,
+            "workers": {"fake-0": {"ready": 2, "inflight": 1,
+                                   "target": 3}}}},
+        "capacity": {
+            "enabled": True, "ticks": 12, "slo_s": 0.5,
+            "workers": {"fake-0": {
+                "pool_target": 3, "pool_ready": 2, "token_cap": 8,
+                "arrival_rate": 4.5, "shed_retry_after_s": 0.0}},
+            "tenants": {"default": {"slo_s": 0.5, "headroom_s": 0.41}},
+            "autoscale": {"enabled": True, "pending_drain": [],
+                          "drained": [], "provisioned": []},
+        },
+        "workerd": {},
+        "sentinel": {"enabled": False},
+        "shipper": {"enabled": False},
+        "events_dropped_total": 0,
+        "settings": {"max_inflight_per_worker": 4,
+                     "max_pending_per_worker": 256, "metrics_port": 0},
+    }
+
+
+def test_fleet_warmpool_cli_renders_adaptive_targets(env, monkeypatch):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli import cmd_fleet
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    monkeypatch.setattr(cmd_fleet, "_loopd_status",
+                        lambda f, no_daemon: _daemon_doc())
+    res = CliRunner().invoke(
+        cli, ["fleet", "warmpool"],
+        obj=Factory(cwd=proj, driver=FakeDriver()), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert "target=3" in res.output            # per-run live target
+    assert "TARGET=3" in res.output and "ACTUAL=2" in res.output
+    assert "(adaptive)" in res.output
+    # --json parity: the same capacity doc rides the JSON form
+    res = CliRunner().invoke(
+        cli, ["fleet", "warmpool", "--format", "json"],
+        obj=Factory(cwd=proj, driver=FakeDriver()), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    doc = json.loads(res.output)
+    assert doc["capacity"]["workers"]["fake-0"]["pool_target"] == 3
+    assert doc["daemon_pools"]["run1"]["workers"]["fake-0"]["target"] == 3
+
+
+def test_fleet_placement_cli_renders_scaled_caps(env, monkeypatch):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli import cmd_fleet
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    monkeypatch.setattr(cmd_fleet, "_loopd_status",
+                        lambda f, no_daemon: _daemon_doc())
+    res = CliRunner().invoke(
+        cli, ["fleet", "placement"],
+        obj=Factory(cwd=proj, driver=FakeDriver()), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    assert "1/8" in res.output                 # the SLO-scaled cap
+    assert "slo default: 0.5s headroom=0.41s" in res.output
+    res = CliRunner().invoke(
+        cli, ["fleet", "placement", "--format", "json"],
+        obj=Factory(cwd=proj, driver=FakeDriver()), catch_exceptions=False)
+    assert res.exit_code == 0, res.output
+    doc = json.loads(res.output)
+    row = doc["workers"][0]
+    assert row["scaled_cap"] == 8
+    assert doc["capacity"]["tenants"]["default"]["headroom_s"] == 0.41
+
+
+def test_loopd_hosts_capacity_controller(env):
+    """With settings capacity.enable, loopd ticks one daemon-lifetime
+    controller: its state rides the status RPC and hosted runs' pools
+    pick up the adaptive targets."""
+    from clawker_tpu.loopd.client import LoopdClient
+    from clawker_tpu.loopd.server import LoopdServer
+
+    tenv, proj, cfg = env
+    cfg.settings.capacity.enable = True
+    cfg.settings.capacity.interval_s = 0.02
+    cfg.settings.capacity.pool_max_depth = 3
+    drv = driver_with(2)
+    srv = LoopdServer(cfg, drv).start()
+    try:
+        assert srv.capacity is not None
+        client = LoopdClient(srv.sock_path)
+        ack = client.submit_run({"parallel": 2, "iterations": 1,
+                                 "image": IMAGE, "warm_pool_depth": 1},
+                                stream=False)
+        assert ack.get("run")
+        client.close()
+        assert wait_for(lambda: srv.capacity.ticks >= 3)
+        run = srv.runs[ack["run"]]
+        assert wait_for(lambda: run.done.is_set())
+        status = LoopdClient(srv.sock_path)
+        doc = status.status()
+        status.close()
+        assert doc["capacity"]["enabled"] is True
+        assert doc["capacity"]["ticks"] >= 3
+    finally:
+        srv.stop()
+        drv.close()
+
+
+# --------------------------------------------------- plan determinism
+
+
+def test_capacity_rider_preserves_existing_draws():
+    """The capacity rider draws strictly AFTER every pre-existing draw:
+    a (seed, scenario) pair's worker-fault/sigkill/sentinel/workerd/
+    shipper schedule is byte-identical to the pre-capacity generator's
+    (simulated here by stripping the rider's own additions)."""
+    from clawker_tpu.chaos.plan import generate_plan
+
+    for i in range(12):
+        plan = generate_plan(99, i)
+        base = [e.to_doc() for e in plan.events
+                if e.kind not in ("traffic_burst", "scale_down")]
+        again = generate_plan(99, i)
+        base2 = [e.to_doc() for e in again.events
+                 if e.kind not in ("traffic_burst", "scale_down")]
+        assert base == base2
+        assert plan.capacity == again.capacity
