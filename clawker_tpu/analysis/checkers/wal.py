@@ -35,6 +35,8 @@ SCOPED_FILES = (
     "clawker_tpu/loop/warmpool.py",
     "clawker_tpu/workerd/server.py",
     "clawker_tpu/capacity/controller.py",
+    "clawker_tpu/workspace/strategy.py",
+    "clawker_tpu/gitx/git.py",
 )
 
 # attribute names that are unambiguous engine mutations anywhere

@@ -39,6 +39,12 @@ with; docs/chaos.md#invariants):
   ``runner.run_observe_only_check``) compares a fixed-seed run's
   journaled placements and daemon-side create counts with and without
   ``--sentinel``: they must be identical.
+- ``worktree-isolation``: branch-per-agent provisioning never crosses
+  agents.  Every journaled ``seed_worktree`` record maps one agent to
+  exactly one (path, branch) pair, and no path or branch is ever
+  claimed by two agents -- the zero-cross-agent-writes guarantee the
+  swarm scenario rests on (docs/loop-worktrees.md).  A kill/resume
+  cycle re-attaching worktrees must fold to the same single claim.
 - ``stranded-by-drain``: a capacity scale-down never strands a
   journaled run (docs/elastic-capacity.md).  Folding the record stream
   in order with the same liveness rule the controller's journal-replay
@@ -101,6 +107,7 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
         REC_POOL_ADOPT,
         REC_POOL_READY,
         REC_POOL_REMOVE,
+        REC_SEED_WORKTREE,
         RunJournal,
         journal_path,
         replay,
@@ -211,6 +218,39 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
                     f"admission-cap: {worker.id} daemon saw "
                     f"{gate.launch_hwm} concurrent launches "
                     f"(cap {allowed})")
+
+    # --- worktree-isolation: one agent, one (path, branch); no sharing.
+    # Folded from the write-ahead ``seed_worktree`` records: an agent
+    # that re-attaches after kill/resume journals the same claim (WAL
+    # dedup), so >1 distinct claim per agent, or any path/branch shared
+    # across agents, means two containers could write the same tree.
+    claims: dict[str, set[tuple[str, str]]] = {}
+    for rec in records:
+        if rec.get("kind") == REC_SEED_WORKTREE:
+            agent = str(rec.get("agent", ""))
+            claims.setdefault(agent, set()).add(
+                (str(rec.get("path", "")), str(rec.get("branch", ""))))
+    for agent, pairs in sorted(claims.items()):
+        if len(pairs) > 1:
+            violations.append(
+                f"worktree-isolation: {agent} journaled {len(pairs)} "
+                f"distinct worktree claims: {sorted(pairs)}")
+    by_path: dict[str, str] = {}
+    by_branch: dict[str, str] = {}
+    for agent, pairs in sorted(claims.items()):
+        for path, branch in sorted(pairs):
+            if path and path in by_path and by_path[path] != agent:
+                violations.append(
+                    f"worktree-isolation: path {path} claimed by both "
+                    f"{by_path[path]} and {agent} (cross-agent writes)")
+            elif path:
+                by_path[path] = agent
+            if branch and branch in by_branch and by_branch[branch] != agent:
+                violations.append(
+                    f"worktree-isolation: branch {branch} claimed by both "
+                    f"{by_branch[branch]} and {agent}")
+            elif branch:
+                by_branch[branch] = agent
 
     # --- stranded-by-drain: a capacity scale-down must never strand a
     # journaled run.  Fold the record stream in order, tracking which

@@ -58,6 +58,11 @@ kind                    injection point
                         journal replay proving zero live placements
                         (``stranded-by-drain`` invariant), deferring for
                         as long as the run keeps the worker busy
+``seed_cache_evict``    workerd scenarios: drop the worker's resident
+                        workspace-seed store mid-run (restart-equivalent
+                        cold cache) -- later creates referencing the
+                        digest must degrade to the per-create fallback
+                        walk, never fail or cross-seed another agent
 ======================  ====================================================
 
 Plans with ``sentinel: true`` run with the fleet sentinel attached to
@@ -83,7 +88,7 @@ EVENT_KINDS = (
     "engine_burst", "probe_drop", "worker_revive", "cli_sigkill",
     "egress_silent", "egress_flood", "sentinel_kill",
     "workerd_partition", "workerd_kill", "index_down",
-    "traffic_burst", "scale_down",
+    "traffic_burst", "scale_down", "seed_cache_evict",
 )
 
 # event kinds that target no worker (worker index is ignored)
@@ -355,6 +360,18 @@ def generate_plan(seed: int, scenario: int = 0, *, n_workers: int = 4,
             events.append(FaultEvent(
                 at_s=rng.uniform(0.1, horizon_s * 0.7),
                 kind="scale_down", worker=rng.randrange(n_workers)))
+    # seed-cache rider (drawn strictly AFTER every pre-existing draw, so
+    # the worker-fault/sigkill/sentinel/workerd/shipper/capacity
+    # schedule of a (seed, scenario) pair is byte-identical to the
+    # pre-seed-cache generator): scenarios already running workerd get
+    # their resident workspace-seed store dropped mid-run about a third
+    # of the time -- later digest-referencing creates must degrade to
+    # the per-create fallback walk, and no agent may ever see another
+    # agent's workspace content (the cross-agent-write invariant)
+    if plan.workerd and rng.random() < 0.35:
+        events.append(FaultEvent(
+            at_s=rng.uniform(0.05, horizon_s * 0.6),
+            kind="seed_cache_evict", worker=rng.randrange(n_workers)))
     plan.events = sorted(events, key=lambda e: e.at_s)
     _validate(plan)
     return plan

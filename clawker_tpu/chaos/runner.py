@@ -470,6 +470,18 @@ class ChaosRunner:
         _INJECTIONS.labels(ev.kind).inc()
         self.injected += 1
 
+    def _apply_seed_fault(self, ev: FaultEvent) -> None:
+        """Workspace-seed cache faults: drop the worker's resident seed
+        store mid-run (restart-equivalent cold cache).  Touches only
+        workerd's content-addressed store -- the engine stays unfaulted,
+        so spurious-quarantine also proves a cold seed cache can never
+        open a breaker; later creates referencing the digest degrade to
+        the per-create fallback walk (docs/loop-worktrees.md)."""
+        if 0 <= ev.worker < len(self.workerd_servers):
+            self.workerd_servers[ev.worker].drop_seeds()
+        _INJECTIONS.labels(ev.kind).inc()
+        self.injected += 1
+
     def _apply_capacity_fault(self, ev: FaultEvent) -> None:
         """Capacity-scenario faults: an open-loop traffic burst against
         one worker's admission queue, or a scale-down request.  Neither
@@ -591,6 +603,10 @@ class ChaosRunner:
                     # data-plane faults hit the workerd channel/daemon,
                     # never the engine: the worker stays unfaulted
                     self._apply_workerd_fault(ev)
+                elif ev.kind == "seed_cache_evict":
+                    # seed-store faults hit workerd's resident cache,
+                    # never the engine: the worker stays unfaulted
+                    self._apply_seed_fault(ev)
                 elif ev.kind == "index_down":
                     # monitor-stack faults hit the shipper's sink,
                     # never a worker: the fleet stays unfaulted
@@ -997,6 +1013,14 @@ class ChaosController:
                     self.sched.on_event(
                         "chaos", "skipped",
                         f"{ev.kind}: no capacity controller attached")
+                continue
+            if ev.kind == "seed_cache_evict":
+                # the seed store lives inside the worker's workerd
+                # daemon; a live CLI run does not own those processes
+                self.sched.on_event(
+                    "chaos", "skipped",
+                    f"{ev.kind}: seed stores are workerd-resident "
+                    "(use the soak runner / `clawker chaos run`)")
                 continue
             if not injectable:
                 self.sched.on_event(

@@ -112,11 +112,14 @@ class _ClientInterrupt:
                    "containers per worker that placements adopt (relabel/"
                    "env-fixup + start) instead of paying a full create "
                    "(default: settings loop.warm_pool; 0 = off; ignored "
-                   "with --worktrees).")
+                   "with bind-mode --worktrees).")
 @click.option("--image", default="@", help="Agent image ('@' = project default).")
 @click.option("--prompt", default="", help="Prompt handed to each harness loop.")
 @click.option("--worktrees/--no-worktrees", default=False,
-              help="One git worktree per agent loop.")
+              help="One git worktree + branch per agent loop, branched "
+                   "from one base (never N clones); agent branches land "
+                   "serially through the merge queue at iteration end "
+                   "(settings loop.worktrees.*; docs/loop-worktrees.md).")
 @click.option("--env", "env_kv", multiple=True, help="KEY=VAL extra agent env.")
 @click.option("--failover", type=click.Choice(["migrate", "wait", "fail"]),
               default=None,
@@ -241,11 +244,20 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
         line = f"[{agent}] {event}" + (f" {detail}" if detail else "")
         click.echo(line, err=True)
 
-    def discover_workerd(worktree_run: bool):
+    def discover_workerd(worktree_run: bool, workspace_mode: str = ""):
         """ExecutorSet for the in-process scheduler, or None (direct).
-        Worktree runs stay direct: the worktree mount is host-local."""
-        if use_workerd is False or worktree_run:
+        BIND-mode worktree runs stay direct (the worktree mount is
+        host-local); snapshot-mode worktree runs dispatch -- content
+        travels as a content-addressed workspace seed the worker-local
+        store resolves (docs/loop-worktrees.md)."""
+        if use_workerd is False:
             return None
+        if worktree_run:
+            mode = (workspace_mode
+                    or f.config.settings.loop.worktrees.workspace_mode
+                    or "bind")
+            if mode == "bind":
+                return None
         from ..workerd.executor import discover_executors
 
         execset = discover_executors(f.config, f.driver)
@@ -276,7 +288,8 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
                 f"{jpath}: no usable run header -- the journal is too "
                 "damaged to resume; start a fresh run")
         executors = discover_workerd(
-            bool(run_image.spec.get("worktrees")))
+            bool(run_image.spec.get("worktrees")),
+            str(run_image.spec.get("workspace_mode") or ""))
         sched = LoopScheduler.resume(
             f.config, f.driver, run_image, on_event=on_event,
             failover=failover,

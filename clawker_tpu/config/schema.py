@@ -457,6 +457,35 @@ class LoopWarmPoolSettings:
 
 
 @dataclass
+class LoopWorktreeSettings:
+    """The ``clawker loop --worktrees`` swarm scenario: N agents
+    collaborating on ONE repository, branch-per-agent
+    (docs/loop-worktrees.md).
+
+    Each agent loop gets its own branch forked from ``base`` and its own
+    linked git worktree (never a clone); ``workspace_mode`` picks how
+    that tree reaches the container -- ``bind`` mounts the worktree dir
+    live (local driver only), ``snapshot`` seeds the container from the
+    content-addressed seed cache (one tar per fan-out, workerd-capable,
+    warm-pool-capable).  With ``merge_queue``, agent branches land
+    serially into a run-scoped integration branch at iteration end;
+    conflict losers are resubmitted through admission after
+    ``merge_retry_s`` (or the admission controller's ``retry_after_s``
+    when it quotes one)."""
+
+    workspace_mode: str = "bind"    # bind | snapshot
+    branch_prefix: str = "loop"     # agent branches: <prefix>/<run>/<agent>
+    base: str = "HEAD"              # ref agent branches fork from
+    merge_queue: bool = True        # land agent branches at iteration end
+    merge_into: str = ""            # target branch; "" = run-scoped
+    #                                 integration branch <prefix>/<run>/merged
+    merge_retry_s: float = 0.5      # conflict-loser resubmit delay when
+    #                                 admission quotes no retry_after_s
+    merge_attempts: int = 3         # merge tries per branch before the
+    #                                 loser is reported failed
+
+
+@dataclass
 class LoopSettings:
     """Autonomous-loop scheduler defaults (net-new)."""
 
@@ -469,6 +498,8 @@ class LoopSettings:
     journal: LoopJournalSettings = field(default_factory=LoopJournalSettings)
     warm_pool: LoopWarmPoolSettings = field(
         default_factory=LoopWarmPoolSettings)
+    worktrees: LoopWorktreeSettings = field(
+        default_factory=LoopWorktreeSettings)
 
 
 @dataclass
@@ -539,6 +570,13 @@ class WorkerdSettings:
     intent_deadline_s: float = 60.0  # pending intent age before the loop
     #                                  fails over to the direct path
     start_deadline_s: float = 15.0  # workerd start: socket-answer deadline
+    seed_cache_bytes: int = 64 * 1024 * 1024  # worker-local seed store
+    #                                 cap: content-addressed workspace
+    #                                 seed tars kept resident (LRU by
+    #                                 bytes) so launch intents reference
+    #                                 a digest instead of re-shipping the
+    #                                 tree over the WAN per agent
+    #                                 (docs/loop-worktrees.md#seed-cache)
 
 
 @dataclass

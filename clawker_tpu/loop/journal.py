@@ -77,6 +77,17 @@ REC_POOL_READY = "pool_ready"   # pool member created; cid known
 REC_POOL_ADOPT = "pool_adopt"   # member consumed by a placement (pre-
 #                                 finalize WAL: `by` names the adopter)
 REC_POOL_REMOVE = "pool_remove"  # member recycled/swept/drained
+# workspace-seed / worktree provisioning (docs/loop-worktrees.md):
+# journaled write-ahead so --resume re-attaches agent worktrees and
+# re-serves cached seeds with zero duplicate branch creates, clones, or
+# seed transfers after a mid-provision SIGKILL
+REC_SEED_TAR = "seed_tar"       # seed tar built: digest + byte count
+#                                 (pre-transfer WAL for the fan-out)
+REC_SEED_SHIP = "seed_ship"     # seed shipped to a worker's seed store
+#                                 (pre-send WAL: at most one per
+#                                 (digest, worker) pair per generation)
+REC_SEED_WORKTREE = "seed_worktree"  # agent worktree provisioned:
+#                                 branch + path (pre-`worktree add` WAL)
 # elastic-capacity decisions (clawker_tpu/capacity,
 # docs/elastic-capacity.md): pool targets, token caps, queue-mode
 # flips, and fleet provision/drain -- journaled through the same WAL so
@@ -249,6 +260,21 @@ class RunImage:
     #                             order -- what --resume re-enqueues
     #                             FIRST so pending-queue order survives
     #                             a scheduler death
+    seeds: dict[str, int] = field(default_factory=dict)
+    #                             workspace seed digests built this run
+    #                             (digest -> tar byte count): resume can
+    #                             tell a re-build from a first build
+    seeded: dict[str, list[str]] = field(default_factory=dict)
+    #                             digest -> workers whose seed store
+    #                             holds (or was mid-receiving) that
+    #                             seed -- resume must not re-ship, just
+    #                             re-verify (docs/loop-worktrees.md)
+    worktrees: dict[str, dict] = field(default_factory=dict)
+    #                             agent -> {path, branch, base}: every
+    #                             worktree whose provision was journaled
+    #                             write-ahead; resume RE-ATTACHES these
+    #                             via the idempotent setup_worktree path
+    #                             instead of creating duplicates
 
 
 def replay(records: list[dict]) -> RunImage:
@@ -307,6 +333,30 @@ def replay(records: list[dict]) -> RunImage:
                         pending.remove(wid)
                     if phase == "done":
                         cap.setdefault("drained", []).append(wid)
+            continue
+        if kind == REC_SEED_TAR:
+            digest = str(rec.get("digest", ""))
+            if digest:
+                img.seeds[digest] = int(rec.get("bytes", 0))
+            continue
+        if kind == REC_SEED_SHIP:
+            digest = str(rec.get("digest", ""))
+            wid = str(rec.get("worker", ""))
+            if digest and wid:
+                shipped = img.seeded.setdefault(digest, [])
+                if wid not in shipped:
+                    shipped.append(wid)
+            continue
+        if kind == REC_SEED_WORKTREE:
+            # worktree provisioning is keyed by agent but must NOT
+            # materialize a LoopImage -- provisioning precedes placement
+            wa = str(rec.get("agent", ""))
+            if wa:
+                img.worktrees[wa] = {
+                    "path": str(rec.get("path", "")),
+                    "branch": str(rec.get("branch", "")),
+                    "base": str(rec.get("base", "")),
+                }
             continue
         if kind in (REC_POOL_ADD, REC_POOL_READY, REC_POOL_ADOPT,
                     REC_POOL_REMOVE):

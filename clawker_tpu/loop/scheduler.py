@@ -115,7 +115,11 @@ from ..placement import (
 )
 from ..monitor.ledger import FlightRecorder, flight_path
 from ..runtime.names import container_name
-from ..runtime.orchestrate import AgentRuntime, CreateOptions
+from ..runtime.orchestrate import (
+    AgentRuntime,
+    CreateOptions,
+    workspace_seed_tar,
+)
 from ..telemetry.spans import (
     SPAN_CREATE,
     SPAN_EXIT,
@@ -140,6 +144,9 @@ from .journal import (
     REC_POOL_REMOVE,
     REC_RESUME,
     REC_RUN,
+    REC_SEED_SHIP,
+    REC_SEED_TAR,
+    REC_SEED_WORKTREE,
     REC_SHUTDOWN,
     REC_STARTED,
     RunImage,
@@ -147,6 +154,7 @@ from .journal import (
     journal_path,
     replay,
 )
+from .mergeq import MergeQueue
 from .warmpool import WarmPool
 
 log = logsetup.get("loop.scheduler")
@@ -226,7 +234,10 @@ class LoopSpec:
     image: str = "@"
     prompt: str = ""                 # handed to the harness via env
     worktrees: bool = False          # one git worktree per agent loop
-    workspace_mode: str = ""         # default: snapshot (isolation per loop)
+    workspace_mode: str = ""         # default: snapshot (isolation per
+    #                                  loop); with --worktrees the default
+    #                                  comes from settings
+    #                                  loop.worktrees.workspace_mode (bind)
     agent_prefix: str = "loop"
     env: dict[str, str] = field(default_factory=dict)
     failover: str = "migrate"        # migrate | wait | fail
@@ -244,9 +255,13 @@ class LoopSpec:
     warm_pool_depth: int = 0         # per-worker warm pool of pre-created
     #                                  containers placements adopt; 0 =
     #                                  disabled (docs/loop-warmpool.md).
-    #                                  Ignored with --worktrees: a pool
-    #                                  member's mounts are staged before
-    #                                  the adopting agent's worktree exists
+    #                                  Ignored with bind-mode --worktrees
+    #                                  (a pool member's mounts are staged
+    #                                  before the adopting agent's worktree
+    #                                  exists); snapshot-mode worktree runs
+    #                                  pool normally -- content travels via
+    #                                  the workspace seed, not the mount
+    #                                  (docs/loop-worktrees.md#degrade-matrix)
 
 
 @dataclass
@@ -492,7 +507,7 @@ class LoopScheduler:
         # Refills bill a dedicated low-weight admission tenant so the
         # WFQ hands real placements the worker's tokens first.
         self.warmpool: WarmPool | None = None
-        if spec.warm_pool_depth > 0 and not spec.worktrees:
+        if spec.warm_pool_depth > 0 and not self._bind_worktrees():
             wps = cfg.settings.loop.warm_pool
             self.warmpool = WarmPool(
                 self.loop_id, depth=spec.warm_pool_depth,
@@ -512,9 +527,12 @@ class LoopScheduler:
         # the event stream drives the SAME journal records, spans, and
         # status transitions.  None (the default) or a worker with no
         # live channel = today's direct in-process path, unchanged.
-        # Worktree runs stay direct: a worktree is a host-local mount
-        # workerd cannot stage.  The set is caller-owned (CLI, bench,
-        # chaos runner) -- the scheduler never closes it.
+        # BIND-mode worktree runs stay direct: a bind worktree is a
+        # host-local mount workerd cannot stage.  Snapshot-mode worktree
+        # runs dispatch normally -- their content travels as a
+        # content-addressed workspace seed the worker-local store
+        # resolves (docs/loop-worktrees.md).  The set is caller-owned
+        # (CLI, bench, chaos runner) -- the scheduler never closes it.
         self.executors = executors
         if executors is not None:
             executors.bind(self)
@@ -538,6 +556,42 @@ class LoopScheduler:
         #                           stand-ins whose pre-opened breakers
         #                           route their loops into failover
         self._shutdown_journaled = False
+        # --- workspace-seed fan-out (docs/loop-worktrees.md): the tree
+        # walk is paid once per fan-out (content-addressed TTL cache in
+        # runtime/orchestrate), journaled write-ahead per DIGEST, and
+        # shipped once per (digest, worker) into the workerd-resident
+        # seed store so N creates on a worker fan out locally.
+        self._seed_lock = threading.Lock()
+        self._seeds_journaled: set[str] = set()     # REC_SEED_TAR dedup
+        self._worktrees_journaled: set[str] = set()  # REC_SEED_WORKTREE dedup
+        self._branches: dict[str, str] = {}   # agent -> its worktree branch
+        # --- merge queue (docs/loop-worktrees.md#merge-queue): agent
+        # branches land serially on the run's integration branch at
+        # iteration end; conflict losers resubmit with the admission
+        # controller's backoff hint.  Run-thread only, under _git_lock.
+        self.mergeq: MergeQueue | None = None
+        if spec.worktrees:
+            wts = cfg.settings.loop.worktrees
+            if wts.merge_queue:
+                self.mergeq = MergeQueue(retry_s=wts.merge_retry_s,
+                                         max_attempts=wts.merge_attempts)
+
+    def _bind_worktrees(self) -> bool:
+        """True when this run's worktrees are HOST-LOCAL bind mounts --
+        the shape that blocks workerd dispatch and warm pooling (the
+        daemon cannot stage a host path; a pool member's mounts predate
+        the adopting agent's worktree)."""
+        return self.spec.worktrees and self._effective_mode() == "bind"
+
+    def _effective_mode(self) -> str:
+        """The workspace mode this run's creates resolve to: the
+        explicit spec value, else the worktree settings default (bind)
+        for --worktrees runs, else snapshot."""
+        if self.spec.workspace_mode:
+            return self.spec.workspace_mode
+        if self.spec.worktrees:
+            return self.cfg.settings.loop.worktrees.workspace_mode or "bind"
+        return "snapshot"
 
     def _record_span(self, rec) -> None:
         if self.flight is not None:
@@ -604,7 +658,7 @@ class LoopScheduler:
         this run's WAL proves zero live placements (loops or pool
         members) on the victim.  A resumed run restores the journaled
         controller state before the first tick."""
-        if self.warmpool is None and not self.spec.worktrees:
+        if self.warmpool is None and not self._bind_worktrees():
             # adaptive sizing needs a pool to size, even when the run
             # was configured depth-0: targets start at zero and only
             # the controller raises them
@@ -846,11 +900,59 @@ class LoopScheduler:
 
     def _workerd_for(self, worker: Worker):
         """The worker's live executor, or None (= direct path).
-        Worktree runs are always direct: the worktree mount is a
-        host-local path the worker-resident daemon cannot stage."""
-        if self.executors is None or self.spec.worktrees:
+        BIND-mode worktree runs are always direct: the worktree mount
+        is a host-local path the worker-resident daemon cannot stage.
+        Snapshot-mode worktree runs dispatch -- their content rides the
+        content-addressed workspace seed instead of a mount."""
+        if self.executors is None or self._bind_worktrees():
             return None
         return self.executors.for_worker(worker.id)
+
+    # --- workspace-seed fan-out (docs/loop-worktrees.md): one tree
+    # walk per fan-out, one WAN transfer per (digest, worker).
+
+    def _seed_root(self, loop: AgentLoop | None = None) -> Path:
+        """The directory a snapshot create seeds from: the agent's
+        worktree once provisioned (its divergence is exactly what a
+        re-create must carry), else the project root.  While worktrees
+        have not diverged from base their digests COLLAPSE to the
+        project root's -- N agents cost one cache entry."""
+        if loop is not None and loop.worktree is not None:
+            return loop.worktree
+        return self.cfg.project_root or Path.cwd()
+
+    def _workspace_seed(self, root: Path) -> tuple[str, bytes | None]:
+        """(digest, tar) of the workspace seed for ``root`` via the
+        content-addressed cache; journals REC_SEED_TAR (durable) the
+        first time this run sees a digest, so a resume knows which
+        seeds were in flight without re-walking anything."""
+        root = Path(root)
+        if not root.exists():
+            return "", None
+        digest, tar = workspace_seed_tar(root)
+        if digest:
+            with self._seed_lock:
+                if digest not in self._seeds_journaled:
+                    self._seeds_journaled.add(digest)
+                    self._journal(REC_SEED_TAR, durable=True, digest=digest,
+                                  bytes=len(tar))
+        return digest, tar
+
+    def _ship_seed(self, ex, worker: Worker, root: Path) -> str:
+        """Stage the workspace seed in ``worker``'s workerd seed store
+        (once per (digest, worker): the executor tracks what it sent).
+        The WAL lands BEFORE the send -- a resume reads REC_SEED_SHIP to
+        know which workers may hold the digest; re-shipping after a
+        crash is harmless (a content-addressed put is idempotent).  A
+        transfer lost to a dying link only degrades that worker's
+        creates to the per-create fallback walk -- never correctness."""
+        digest, tar = self._workspace_seed(root)
+        if not digest or tar is None or ex is None or ex.seeded(digest):
+            return digest
+        self._journal(REC_SEED_SHIP, durable=True, digest=digest,
+                      worker=worker.id)
+        ex.submit_seed(digest, tar)
+        return digest
 
     def _launch_env(self, loop: AgentLoop) -> dict[str, str]:
         return {
@@ -865,15 +967,23 @@ class LoopScheduler:
                          epoch: int) -> dict:
         """The CreateOptions a launch intent carries -- the same fields
         _create builds in-process (workerd constructs the CreateOptions
-        from this doc and runs the full create path locally)."""
-        return {
+        from this doc and runs the full create path locally).  A
+        snapshot create references its workspace seed BY DIGEST: the
+        worker-local seed store resolves it without a WAN transfer or a
+        tree walk (a store miss degrades to the local fallback walk)."""
+        doc = {
             "agent": loop.agent, "image": self.spec.image,
             "env": self._launch_env(loop), "tty": False,
-            "workspace_mode": self.spec.workspace_mode or "snapshot",
+            "workspace_mode": self._effective_mode(),
             "worker": worker.id, "loop_id": self.loop_id,
             "extra_labels": {consts.LABEL_LOOP_EPOCH: str(epoch)},
             "replace": True,
         }
+        if doc["workspace_mode"] == "snapshot":
+            digest, _tar = self._workspace_seed(self._seed_root(loop))
+            if digest:
+                doc["seed_digest"] = digest
+        return doc
 
     def _state_doc(self, loop: AgentLoop) -> dict:
         """The per-iteration context file, shipped in the intent so
@@ -907,6 +1017,17 @@ class LoopScheduler:
         # scheduler-side (bookkeeping); the engine-side adoption runs
         # worker-resident, falling back to a cold create there.
         self.seams.fire("launch.pre_create")
+        if self.spec.worktrees and loop.worktree is None:
+            # snapshot-mode worktree dispatch: the branch + worktree
+            # identity lives HOST-side (the merge queue lands it); only
+            # the content travels, as the seed below
+            with self._git_lock:
+                workspace_root, _git_dir = self._maybe_worktree(loop.agent)
+            loop.worktree = workspace_root
+        if self._effective_mode() == "snapshot":
+            # one transfer per (digest, worker); every create on the
+            # worker then fans out from its local store
+            self._ship_seed(ex, worker, self._seed_root(loop))
         pool_cid = ""
         pool_entry = None
         if self.warmpool is not None and worker.engine is not None:
@@ -1143,6 +1264,12 @@ class LoopScheduler:
                            if not (self._stop.is_set() or wp.draining)
                            else None)
             if remote_fill is not None:
+                # pre-stage the workspace seed (docs/loop-worktrees.md):
+                # the fill's create resolves it from the worker-local
+                # store, so warm_pool_hit_p50 keeps its split even on
+                # WAN-remote workers
+                if self._effective_mode() == "snapshot":
+                    self._ship_seed(remote_fill, worker, self._seed_root())
                 fut = remote_fill.submit_pool_fill(
                     pool_agent, self._pool_opts_doc(worker, pool_agent))
             else:
@@ -1187,15 +1314,23 @@ class LoopScheduler:
                if self.spec.prompt else {}),
             **self.spec.env,
         }
-        return {
+        doc = {
             "agent": pool_agent, "image": self.spec.image, "env": env,
             "tty": False,
-            "workspace_mode": self.spec.workspace_mode or "snapshot",
+            "workspace_mode": self._effective_mode(),
             "worker": worker.id, "loop_id": self.loop_id,
             "extra_labels": {consts.LABEL_LOOP_EPOCH: consts.POOL_EPOCH,
                              consts.LABEL_WARMPOOL: pool_agent},
             "replace": True,
         }
+        if doc["workspace_mode"] == "snapshot":
+            # pool members seed from the project root: an adopting
+            # agent's worktree has not diverged at adoption time, so
+            # the digests are identical (docs/loop-worktrees.md)
+            digest, _tar = self._workspace_seed(self._seed_root())
+            if digest:
+                doc["seed_digest"] = digest
+        return doc
 
     def _pool_fill(self, worker: Worker, pool_agent: str) -> str | None:
         """Create one pool member (the expensive create-time stages) on
@@ -1214,6 +1349,14 @@ class LoopScheduler:
                if self.spec.prompt else {}),
             **self.spec.env,
         }
+        mode = self._effective_mode()
+        seed_digest = ""
+        if mode == "snapshot":
+            # pre-stage the workspace seed (docs/loop-worktrees.md):
+            # warms the content-addressed tar cache AND journals the
+            # digest, so this fill and every adoption-era create reuse
+            # one tree walk
+            seed_digest, _tar = self._workspace_seed(self._seed_root())
         # analyze: allow(wal-before-mutation): REC_POOL_ADD is journaled
         # durable in warmpool.begin_refill BEFORE this fill is submitted
         # to the lane -- the WAL lives one hop up the flow
@@ -1222,7 +1365,8 @@ class LoopScheduler:
             image=self.spec.image,
             env=env,
             tty=False,
-            workspace_mode=self.spec.workspace_mode or "snapshot",
+            workspace_mode=mode,
+            seed_digest=seed_digest,
             worker=worker.id,
             loop_id=self.loop_id,
             extra_labels={consts.LABEL_LOOP_EPOCH: consts.POOL_EPOCH,
@@ -1262,7 +1406,15 @@ class LoopScheduler:
         )
 
     def _maybe_worktree(self, agent: str) -> tuple[Path | None, Path | None]:
-        """(workspace_root, worktree_git_dir) for this loop agent."""
+        """(workspace_root, worktree_git_dir) for this loop agent:
+        branch-per-agent from one base, one linked worktree -- never a
+        clone.  Callers hold ``_git_lock`` (one shared repo).
+
+        Write-ahead: REC_SEED_WORKTREE lands (durable) BEFORE the git
+        mutation, so a crash anywhere inside ``worktree add`` resumes
+        straight back through the idempotent
+        :meth:`~clawker_tpu.gitx.git.GitManager.setup_worktree` with
+        zero duplicate branches or worktrees."""
         if not self.spec.worktrees:
             return None, None
         from ..gitx.git import GitManager
@@ -1271,9 +1423,85 @@ class LoopScheduler:
         gm = GitManager(root)
         if not gm.is_repo():
             raise ClawkerError("loop: --worktrees requires a git repository")
+        wts = self.cfg.settings.loop.worktrees
+        branch = f"{wts.branch_prefix}/{self.loop_id}/{agent}"
         dest = self.cfg.data_dir / "worktrees" / self.cfg.project_name() / agent
-        info = gm.setup_worktree(dest, f"loop/{self.loop_id}/{agent}")
+        if agent not in self._worktrees_journaled:
+            self._worktrees_journaled.add(agent)
+            self._journal(REC_SEED_WORKTREE, durable=True, agent=agent,
+                          path=str(dest), branch=branch, base=wts.base)
+        info = gm.setup_worktree(dest, branch, base=wts.base)
+        self._branches[agent] = branch
         return info.path, gm.git_dir()
+
+    # ------------------------------------------------------- merge queue
+
+    def _merge_target(self) -> str:
+        """Where agent branches land: an explicit settings override, or
+        a run-scoped integration branch (never a user checkout --
+        publishing is a guarded update-ref, docs/loop-worktrees.md)."""
+        wts = self.cfg.settings.loop.worktrees
+        return wts.merge_into or f"{wts.branch_prefix}/{self.loop_id}/merged"
+
+    def _merge_retry_hint(self) -> float:
+        """Conflict-loser backoff: the admission controller's shed hint
+        when the fleet is backpressured (merge retries must queue
+        behind real launches, not spin ahead of them), else the
+        configured merge_retry_s."""
+        wts = self.cfg.settings.loop.worktrees
+        hint = 0.0
+        try:
+            workers = self.admission.stats().get("workers", {})
+            hint = max((float(g.get("shed_retry_after_s", 0.0))
+                        for g in workers.values()), default=0.0)
+        except Exception:       # noqa: BLE001 -- a stats hiccup must not
+            pass                # stall the merge queue
+        return max(hint, float(wts.merge_retry_s))
+
+    def _merge_tick(self) -> None:
+        """Drain due merge-queue entries (run thread, under _git_lock --
+        the same lock worktree provisioning takes, so a landing never
+        races a ``worktree add``).  Git faults surface as events, never
+        as a run() crash."""
+        if self.mergeq is None or not self.mergeq.pending():
+            return
+        from ..gitx.git import GitManager
+
+        wts = self.cfg.settings.loop.worktrees
+        gm = GitManager(self.cfg.project_root or Path.cwd())
+        target = self._merge_target()
+        try:
+            with self._git_lock:
+                gm.ensure_branch(target, base=wts.base)
+                report = self.mergeq.drain(
+                    gm, target, retry_delay=self._merge_retry_hint,
+                    message_for=lambda a: (
+                        f"loop {self.loop_id}: land {a}"))
+        except ClawkerError as e:
+            self.on_event("scheduler", "merge_tick_failed", str(e))
+            log.error("loop %s: merge tick failed: %s", self.loop_id, e)
+            return
+        for agent, outcome in report.landed:
+            self.on_event(agent, "merged", f"{target}:{outcome}")
+        for agent in report.resubmitted:
+            self.on_event(agent, "merge_conflict",
+                          "resubmitted with backoff")
+        for agent in report.failed:
+            self.on_event(agent, "merge_failed",
+                          f"conflict after {wts.merge_attempts} attempts")
+
+    def _drain_merges(self, deadline_s: float = HALT_DEADLINE_S) -> None:
+        """Run the merge queue dry (bounded): the end-of-run landing
+        pass.  Entries inside a conflict backoff window are waited out
+        up to ``deadline_s``; whatever still cannot land is left on the
+        queue and reported failed at cleanup."""
+        if self.mergeq is None:
+            return
+        deadline = time.monotonic() + max(0.0, deadline_s)
+        self._merge_tick()
+        while self.mergeq.pending() and time.monotonic() < deadline:
+            time.sleep(0.02)
+            self._merge_tick()
 
     def start(self) -> None:
         """Place loops and fan create+first-start across worker lanes.
@@ -1400,6 +1628,16 @@ class LoopScheduler:
                     health_config=health_config, run_id=image.run_id,
                     admission=admission, seams=seams, executors=executors)
         sched._image = image
+        # seed provisioning replays as DEDUP state, not as work: a
+        # journaled digest is never re-journaled, and a journaled
+        # worktree re-attaches through the idempotent setup_worktree
+        # (zero duplicate seeds, branches, or worktree adds -- the
+        # REC_SEED_* records exist exactly for this)
+        sched._seeds_journaled.update(image.seeds)
+        sched._worktrees_journaled.update(image.worktrees)
+        for agent, wt in image.worktrees.items():
+            if wt.get("branch"):
+                sched._branches[agent] = str(wt["branch"])
         sched._build_resumed_loops(image)
         sched._journal(REC_RESUME, durable=True,
                        generation=image.generation + 1,
@@ -1808,10 +2046,16 @@ class LoopScheduler:
             **self.spec.env,
         }
         rt = self._runtime(worker)
-        # isolation default: snapshot copies; a worktree IS the isolation
-        # (and the linked .git file only resolves under a live bind)
-        mode = self.spec.workspace_mode or ("bind" if self.spec.worktrees
-                                            else "snapshot")
+        # isolation default: snapshot copies; a bind worktree IS the
+        # isolation (and the linked .git file only resolves under a
+        # live bind) -- settings loop.worktrees.workspace_mode governs
+        mode = self._effective_mode()
+        seed_digest = ""
+        if mode == "snapshot":
+            # journals REC_SEED_TAR + warms the content-addressed tar
+            # cache: the create below seeds from it without re-walking
+            seed_digest, _tar = self._workspace_seed(
+                self._seed_root(loop))
         with self._placement_lock:
             # epoch re-checked under the lock before opening the span: a
             # stale create racing its own orphaning must not re-open a
@@ -1835,6 +2079,7 @@ class LoopScheduler:
             replace=True,
             workspace_root=workspace_root,
             worktree_git_dir=git_dir,
+            seed_digest=seed_digest,
         )
         # warm-pool checkout (docs/loop-warmpool.md): an adoptable
         # pre-created container turns this create into a
@@ -2076,6 +2321,13 @@ class LoopScheduler:
         self._journal(REC_EXITED, agent=loop.agent, iteration=finished,
                       code=code)
         self.seams.fire("iteration.post_exit")
+        if (self.mergeq is not None and code == 0
+                and loop.agent in self._branches):
+            # iteration end: the agent's branch holds this iteration's
+            # work; the run-thread merge tick lands branches serially
+            # (docs/loop-worktrees.md#merge-queue).  Failed iterations
+            # never submit -- a branch only lands off a clean exit.
+            self.mergeq.submit(loop.agent, self._branches[loop.agent])
         if loop.consecutive_failures >= FAILURE_CEILING:
             loop.status = "failed"
             self.on_event(loop.agent, "failed",
@@ -2239,6 +2491,7 @@ class LoopScheduler:
                 # placements) and dispatch anything their removal unblocks
                 self.admission.sweep()
                 self._pool_tick()
+                self._merge_tick()
                 if self.capacity is not None:
                     # elastic capacity rides the run thread at its own
                     # interval (docs/elastic-capacity.md); in loopd the
@@ -2437,6 +2690,9 @@ class LoopScheduler:
             return self.loops
         if self._stop.is_set():
             self._halt_running()
+        # land whatever the last iterations submitted: the merge queue
+        # must drain before callers read branch state off run()
+        self._drain_merges()
         # iterations still open (stop(), a failed loop's in-flight span)
         # must land in the flight record before callers read it
         self.tracer.close_open(
@@ -2767,6 +3023,19 @@ class LoopScheduler:
         return out
 
     def cleanup(self, *, remove_containers: bool = False) -> None:
+        # merge-queue stragglers land first (a kill() skips this like
+        # everything else); stale worktree registrations are pruned so
+        # the NEXT run's setup_worktree starts clean
+        if not self._aborted:
+            self._drain_merges()
+            if self.spec.worktrees:
+                try:
+                    from ..gitx.git import GitManager
+                    with self._git_lock:
+                        GitManager(self.cfg.project_root
+                                   or Path.cwd()).prune_worktrees()
+                except ClawkerError:
+                    pass
         # the warm pool drains unconditionally (even under --keep): its
         # members are framework plumbing, not user containers, and
         # "zero leaked pool containers after drain" is the contract.

@@ -48,6 +48,70 @@ def clear_harness_seed_cache() -> None:
         _harness_tar_cache.clear()
 
 
+# --- workspace-seed digest cache (docs/loop-worktrees.md#seed-cache) ------
+# The same TTL-cache pattern, extended to the workspace snapshot itself:
+# SnapshotSeed used to re-walk and re-tar the ENTIRE project tree per
+# agent per create, so a 32-agent fan-out on one repo paid 32 identical
+# tree walks.  The tar is deterministic (workspace.strategy._tar_tree
+# normalizes every non-content field), so it digests to a stable sha256;
+# the cache maps project root -> (built_at, digest, tar) and a second
+# digest-keyed view serves the bytes back to whoever fans them out (the
+# scheduler shipping one copy per worker into workerd seed stores).
+_WORKSPACE_TAR_TTL_S = 30.0
+_workspace_tar_cache: dict[str, tuple[float, str, bytes]] = {}
+_workspace_tar_lock = threading.Lock()
+
+
+def clear_workspace_seed_cache() -> None:
+    """Drop cached workspace seed tars (tests; explicit invalidation)."""
+    with _workspace_tar_lock:
+        _workspace_tar_cache.clear()
+
+
+def workspace_seed_tar(root: Path) -> tuple[str, bytes]:
+    """``(digest, tar)`` for the project tree at ``root``: built once,
+    then served from the TTL-bounded cache -- the tree walk is paid per
+    *fan-out*, not per agent.  N git worktrees forked from one base have
+    identical content and therefore collapse to one digest, but each
+    worktree path keys its own entry (the walk is what discovers the
+    content, so a path-keyed probe is the only free lookup)."""
+    from ..workspace.strategy import (
+        _SEED_CACHE_HITS,
+        _SEED_CACHE_MISSES,
+        _tar_tree,
+        seed_digest,
+    )
+
+    key = str(root)
+    now = time.monotonic()
+    with _workspace_tar_lock:
+        hit = _workspace_tar_cache.get(key)
+        if hit is not None and now - hit[0] < _WORKSPACE_TAR_TTL_S:
+            phases.incr("workspace_seed.tar_cache_hit")
+            _SEED_CACHE_HITS.inc()
+            return hit[1], hit[2]
+    phases.incr("workspace_seed.tar_cache_miss")
+    _SEED_CACHE_MISSES.inc()
+    tar = _tar_tree(Path(root))
+    digest = seed_digest(tar)
+    with _workspace_tar_lock:
+        if len(_workspace_tar_cache) > 64:
+            _workspace_tar_cache.clear()
+        _workspace_tar_cache[key] = (now, digest, tar)
+    return digest, tar
+
+
+def workspace_seed_by_digest(digest: str) -> bytes | None:
+    """The cached tar for ``digest`` (any root), or None when the cache
+    no longer holds it -- the content-addressed view the seed fan-out
+    re-serves worker copies from."""
+    with _workspace_tar_lock:
+        for (_ts, d, tar) in _workspace_tar_cache.values():
+            if d == digest:
+                return tar
+    return None
+
+
 @dataclass
 class CreateOptions:
     agent: str = "dev"
@@ -67,6 +131,12 @@ class CreateOptions:
     worktree_git_dir: Path | None = None
     workspace_root: Path | None = None  # override project root (worktrees)
     workdir: str = ""                   # override container working dir
+    seed_digest: str = ""               # expected workspace-seed digest
+    #                                 (content-addressed; the workerd path
+    #                                 resolves it in the worker-local store)
+    seed_tar: bytes | None = None       # pre-resolved seed bytes: skip the
+    #                                 tree walk and seed with exactly these
+    #                                 (a worker-local seed-store hit)
 
 
 class AgentRuntime:
@@ -193,13 +263,26 @@ class AgentRuntime:
                 f"(container {name}); use --replace or `clawker start`"
             )
         with phases.phase("workspace_seed"):
-            mounts.seed(self.engine, cid)
+            mounts.seed(self.engine, cid, tar=opts.seed_tar,
+                        worker=opts.worker)
         with phases.phase("harness_seed"):
             self._seed_harness_config(cid, harness, root)
         if self.bootstrap:
             with phases.phase("identity_bootstrap"):
                 self.bootstrap(cid, project, opts.agent)
         return cid
+
+    def prefetch_seeds(self, harness: str, root: Path) -> str:
+        """Warm both create-time seed caches off the hot path (warm-pool
+        fills call this before their create, so a later adoption -- the
+        hit path -- never pays a tree walk or harness staging).  Returns
+        the workspace seed digest ("" when the root has nothing to
+        seed)."""
+        self.harness_seed_tar(harness, root)
+        if not Path(root).exists():
+            return ""
+        digest, _tar = workspace_seed_tar(Path(root))
+        return digest
 
     # ------------------------------------------------------- pool adoption
 

@@ -115,7 +115,10 @@ class WorkerdExecutor:
         self._dead = threading.Event()      # channel needs a redial
         self.reconnects = 0
         self.stats = {"intents": 0, "batches": 0, "events": 0,
-                      "failed_over": 0}
+                      "failed_over": 0, "seeds": 0}
+        self._seeded: set[str] = set()   # digests already shipped to the
+        #                                  worker's seed store (the
+        #                                  once-per-(digest,worker) gate)
         threading.Thread(target=self._sender, daemon=True,
                          name=f"workerd-send-{worker_id}").start()
         threading.Thread(target=self._monitor, daemon=True,
@@ -373,6 +376,28 @@ class WorkerdExecutor:
     def submit_halt(self, cid: str, timeout: int = 2) -> None:
         self._sendq.put({"kind": "halt", "seq": self._next_seq(),
                          "cid": cid, "timeout": timeout})
+
+    def seeded(self, digest: str) -> bool:
+        """Has this channel already shipped ``digest`` to the worker?"""
+        return digest in self._seeded
+
+    def submit_seed(self, digest: str, tar: bytes) -> bool:
+        """Ship a workspace seed to the worker's seed store, at most once
+        per digest per channel (docs/loop-worktrees.md#worker-resident-
+        seeds).  Fire-and-forget on the ordered intent queue: the
+        server's serial lane stores the seed before it executes any
+        launch queued after this call, so launches referencing the
+        digest hit the store.  A transfer lost to a dead link simply
+        degrades those launches to the per-create fallback -- seeding is
+        an optimization, never a correctness dependency.  Returns True
+        when a transfer was actually queued."""
+        if digest in self._seeded:
+            return False
+        self._seeded.add(digest)
+        self.stats["seeds"] += 1
+        self._sendq.put({"kind": "seed", "seq": self._next_seq(),
+                         "digest": digest, "tar": protocol.b64(tar)})
+        return True
 
     # ------------------------------------------------------------- events
 

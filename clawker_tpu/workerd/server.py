@@ -20,7 +20,10 @@ frame           meaning
                 first start), ``start`` (restart an existing container),
                 ``create`` (create only -- warm-pool fill), ``adopt``
                 (arm an exit waiter on a live container), ``halt``
-                (stop a container).
+                (stop a container), ``seed`` (stage a workspace seed
+                tar by content digest in the worker-local seed store so
+                later launches fan it out from the local socket --
+                docs/loop-worktrees.md#worker-resident-seeds).
 ``resync``      {running: [...]}: the reconnect handshake -- workerd
                 compares the scheduler's intent view against its LOCAL
                 container reality, re-arms waiters for still-running
@@ -76,13 +79,68 @@ _BUFFERED_DROPS = telemetry.counter(
     "workerd_events_dropped_total",
     "Events dropped off a full link-down buffer", labels=("worker",))
 
-INTENT_KINDS = ("launch", "start", "create", "adopt", "halt")
+INTENT_KINDS = ("launch", "start", "create", "adopt", "halt", "seed")
 EVENT_BUFFER = 4096             # events held while the link is down
 FLUSH_WINDOW_S = 0.002          # coalesce window per event batch
 DEDUP_KEYS_KEPT = 4096          # executed-intent keys retained; dedup
 #                                 only needs the client-retry window, and
 #                                 a daemon that outlives many runs must
 #                                 not grow a key per intent forever
+
+
+class SeedStore:
+    """Worker-local content-addressed seed cache: digest -> tar bytes.
+
+    Bounded by TOTAL bytes (``workerd.seed_cache_bytes``), evicting
+    least-recently-used digests -- a long-lived daemon hosting many runs
+    must not pin every seed it ever saw.  In-memory only: a killed
+    daemon loses the store, and the launch path degrades to the
+    per-create fallback (the scheduler's host-side cache still bounds
+    the cost to one tar build)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max(0, int(max_bytes))
+        self._entries: collections.OrderedDict[str, bytes] = \
+            collections.OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    def put(self, digest: str, tar: bytes) -> bool:
+        """Store one seed; returns False when the tar alone exceeds the
+        cap (stored nothing -- callers fall back per-create)."""
+        if len(tar) > self.max_bytes:
+            return False
+        with self._lock:
+            old = self._entries.pop(digest, None)
+            if old is not None:
+                self._bytes -= len(old)
+            while self._entries and self._bytes + len(tar) > self.max_bytes:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= len(evicted)
+            self._entries[digest] = tar
+            self._bytes += len(tar)
+            return True
+
+    def get(self, digest: str) -> bytes | None:
+        with self._lock:
+            tar = self._entries.get(digest)
+            if tar is not None:
+                self._entries.move_to_end(digest)
+            return tar
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    @property
+    def bytes_held(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
 
 
 class WorkerdServer:
@@ -109,8 +167,14 @@ class WorkerdServer:
         self.seams = seams if seams is not None else NULL_SEAMS
         self.flush_window_s = flush_window_s
         self.executed: dict[tuple, str] = {}    # dedup: intent key -> state
+        try:
+            seed_cap = int(cfg.settings.workerd.seed_cache_bytes)
+        except AttributeError:
+            seed_cap = 64 * 1024 * 1024
+        self.seeds = SeedStore(seed_cap)
         self.stats = {"intents": 0, "events": 0, "batches": 0,
-                      "dedup_hits": 0, "resyncs": 0}
+                      "dedup_hits": 0, "resyncs": 0,
+                      "seeds_stored": 0, "seed_hits": 0, "seed_misses": 0}
         self._q: queue.SimpleQueue = queue.SimpleQueue()   # the local lane
         self._events: collections.deque = collections.deque()
         self._ev_lock = threading.Lock()
@@ -219,6 +283,7 @@ class WorkerdServer:
         with self._ev_cond:
             self._events.clear()        # a killed process loses its buffer
             self._ev_cond.notify_all()
+        self.seeds.clear()              # ...and its in-memory seed store
 
     def drop_conns(self) -> None:
         """Hard-drop every client connection (the chaos
@@ -342,6 +407,8 @@ class WorkerdServer:
             "worker": self.worker_id, "socket": str(self.sock_path),
             "uptime_s": round(time.monotonic() - self._started_at, 1),
             "buffered_events": buffered,
+            "seed_store_bytes": self.seeds.bytes_held,
+            "seed_store_entries": len(self.seeds),
             **{k: v for k, v in self.stats.items()},
         }
 
@@ -425,6 +492,14 @@ class WorkerdServer:
                         "error": f"unknown intent kind {kind!r}",
                         "driverish": False})
             return
+        if kind == "seed":
+            # naturally idempotent (a content-addressed put): skips the
+            # positional dedup table, whose (agent, epoch, iteration) key
+            # is meaningless for a digest-keyed transfer
+            self.stats["intents"] += 1
+            _INTENTS.labels(self.worker_id, kind).inc()
+            self._do_seed(intent, seq)
+            return
         key = (kind, agent, epoch, iteration)
         if kind in ("launch", "start", "create") and key in self.executed:
             # idempotence: a re-sent intent (client retry across a
@@ -478,9 +553,43 @@ class WorkerdServer:
                 self.cfg, self.driver, ref),
             channels=channels)
 
+    def _do_seed(self, intent: dict, seq: int) -> None:
+        """Stage a workspace seed in the worker-local store.  The ONE
+        WAN transfer per (digest, worker): every launch that references
+        the digest afterwards fans out over the local engine socket."""
+        digest = str(intent.get("digest", ""))
+        try:
+            tar = protocol.unb64(str(intent.get("tar", "")))
+        except (ValueError, TypeError):
+            self._emit({"ev": "failed", "seq": seq, "phase": "seed",
+                        "error": "undecodable seed tar", "driverish": False})
+            return
+        if not digest or not tar:
+            self._emit({"ev": "failed", "seq": seq, "phase": "seed",
+                        "error": "seed intent missing digest or tar",
+                        "driverish": False})
+            return
+        stored = self.seeds.put(digest, tar)
+        if stored:
+            self.stats["seeds_stored"] += 1
+        self._emit({"ev": "seeded", "seq": seq, "digest": digest,
+                    "bytes": len(tar), "stored": stored})
+
+    def drop_seeds(self) -> None:
+        """Evict the whole seed store (the chaos ``seed_cache_evict``
+        fault): later launches referencing a digest degrade to the
+        per-create fallback path, never to an error."""
+        self.seeds.clear()
+
     def _opts(self, doc: dict):
         from ..runtime.orchestrate import CreateOptions
 
+        seed_digest = str(doc.get("seed_digest", ""))
+        seed_tar = None
+        if seed_digest:
+            seed_tar = self.seeds.get(seed_digest)
+            self.stats["seed_hits" if seed_tar is not None
+                       else "seed_misses"] += 1
         return CreateOptions(
             agent=str(doc.get("agent", "dev")),
             image=str(doc.get("image", "@")),
@@ -491,7 +600,9 @@ class WorkerdServer:
             loop_id=str(doc.get("loop_id", "")),
             extra_labels={str(k): str(v) for k, v in
                           (doc.get("extra_labels") or {}).items()},
-            replace=bool(doc.get("replace", True)))
+            replace=bool(doc.get("replace", True)),
+            seed_digest=seed_digest,
+            seed_tar=seed_tar)
 
     def _do_launch(self, intent: dict, seq: int, agent: str, epoch: int,
                    iteration: int) -> None:

@@ -179,6 +179,8 @@ def main() -> int:
         WARM_POOL_HIT_BUDGET_MS,
         ANOMALY_FLAG_LATENCY_BUDGET_S,
         ANOMALY_TICK_BUDGET_S,
+        SEED_AMORTIZATION_MIN,
+        SEED_CACHE_HIT_MIN,
         WORKERD_DIRECT_RTT_MIN_RATIO,
         WORKERD_EVENT_OVERHEAD_BUDGET_MS,
         WORKERD_RTT_RATIO_BUDGET,
@@ -204,6 +206,7 @@ def main() -> int:
         bench_warm_pool_refill_burst,
         bench_workerd_event_batch_overhead,
         bench_workerd_rtt_independence,
+        bench_workspace_seed_amortization,
     )
 
     fanout_s = bench_loop_fanout(iters=1)
@@ -257,6 +260,25 @@ def main() -> int:
                 or retry["workerd_ratio"] < wd_rtt["workerd_ratio"])):
             wd_rtt = retry
     wd_batch = bench_workerd_event_batch_overhead()
+
+    def _seed_green(r: dict) -> bool:
+        return (r["created"] == r["agents"]
+                and r["one_transfer_per_worker"]
+                and r["cache_hits"] >= SEED_CACHE_HIT_MIN
+                and r["store_misses"] == 0
+                and r["amortization"] >= SEED_AMORTIZATION_MIN)
+
+    seed_amort = bench_workspace_seed_amortization()
+    for _ in range(2):
+        # a wall-clock ratio on a busy shared box is noisy: a miss gets
+        # two re-measures and the best attempt is gated (the gate judges
+        # seed-fan-out amortization, not host load)
+        if _seed_green(seed_amort):
+            break
+        retry = bench_workspace_seed_amortization()
+        if _seed_green(retry) or retry["amortization"] > \
+                seed_amort["amortization"]:
+            seed_amort = retry
     console = bench_console_repaint()
     for _ in range(2):
         # a millisecond-scale p95 is tight against scheduler noise on a
@@ -439,6 +461,30 @@ def main() -> int:
             f"workerd_event_batch_overhead "
             f"{wd_batch['event_overhead_p50_ms']}ms > "
             f"{WORKERD_EVENT_OVERHEAD_BUDGET_MS}ms budget")
+    if seed_amort["created"] != seed_amort["agents"]:
+        failures.append(
+            f"workspace_seed_amortization: only {seed_amort['created']}/"
+            f"{seed_amort['agents']} workerd creates landed")
+    elif not seed_amort["one_transfer_per_worker"]:
+        failures.append(
+            f"workspace_seed_amortization: seed transfers per worker "
+            f"were {seed_amort['seed_transfers']}, expected exactly one "
+            "each (content-addressed dedup failed)")
+    elif seed_amort["cache_hits"] < SEED_CACHE_HIT_MIN:
+        failures.append(
+            f"workspace_seed_amortization: only {seed_amort['cache_hits']}"
+            f"/{seed_amort['agents']} agent lookups hit the digest cache "
+            f"(>= {SEED_CACHE_HIT_MIN} required)")
+    elif seed_amort["store_misses"] > 0:
+        failures.append(
+            f"workspace_seed_amortization: {seed_amort['store_misses']} "
+            "create(s) missed the worker-resident seed store and paid "
+            "the fallback walk")
+    elif seed_amort["amortization"] < SEED_AMORTIZATION_MIN:
+        failures.append(
+            f"workspace_seed_amortization {seed_amort['amortization']}x < "
+            f"{SEED_AMORTIZATION_MIN}x bar vs the per-agent baseline at "
+            f"{seed_amort['rtt_ms']}ms RTT")
     if not console["bounded"]:
         failures.append(
             f"console_repaint_p95: frame is {console['frame_lines']} "
@@ -523,6 +569,7 @@ def main() -> int:
         "cross_process_fairness": fairness,
         "workerd_rtt_independence": wd_rtt,
         "workerd_event_batch_overhead": wd_batch,
+        "workspace_seed_amortization": seed_amort,
         "console_repaint_p95": console,
         "ingest_docs_lag": ingest,
         "elastic_vs_static_p99": elastic,

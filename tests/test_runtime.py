@@ -73,12 +73,18 @@ def test_setup_mounts_snapshot_seeds(tmp_path):
     assert consts.WORKSPACE_DIR in api.containers[cid].archives
 
 
-def test_worktree_requires_bind(tmp_path):
+def test_worktree_git_dir_bind_vs_snapshot(tmp_path):
+    # bind worktrees mount the main repo's git dir read-only so the
+    # worktree's .git file resolves in-container; snapshot worktrees
+    # ship content via the seed instead -- no git-dir bind at all
+    git_dir = tmp_path / ".git"
     eng = Engine(FakeDockerAPI())
-    with pytest.raises(ValueError):
-        setup_mounts(
-            eng, "demo", "dev", tmp_path, mode="snapshot", worktree_git_dir=tmp_path / ".git"
-        )
+    m = setup_mounts(eng, "demo", "dev", tmp_path, mode="bind",
+                     worktree_git_dir=git_dir)
+    assert f"{git_dir}:{git_dir}:ro" in m.binds
+    m = setup_mounts(eng, "demo", "dev", tmp_path, mode="snapshot",
+                     worktree_git_dir=git_dir)
+    assert not any(str(git_dir) in b for b in m.binds)
 
 
 # -------------------------------------------------------------- orchestrate

@@ -389,16 +389,22 @@ def test_no_executors_is_the_direct_path_unchanged(env):
 
 
 def test_worktree_runs_stay_direct(env):
-    """--worktrees runs never route through workerd: the worktree
-    mount is host-local (degrade matrix)."""
+    """Bind-mode --worktrees runs never route through workerd (the
+    worktree mount is host-local, degrade matrix); snapshot-mode
+    worktree runs DO ride workerd -- content travels via the
+    content-addressed seed (docs/loop-worktrees.md)."""
     tenv, _proj, cfg = env
     drv = driver_with(1)
     servers, execset = wd_pod(tenv, cfg, drv)
     try:
         spec = LoopSpec(parallel=1, iterations=1, image=IMAGE,
-                        worktrees=True)
+                        worktrees=True)     # settings default: bind
         sched = LoopScheduler(cfg, drv, spec, executors=execset)
         assert sched._workerd_for(drv.workers()[0]) is None
+        snap = LoopSpec(parallel=1, iterations=1, image=IMAGE,
+                        worktrees=True, workspace_mode="snapshot")
+        sched2 = LoopScheduler(cfg, drv, snap, executors=execset)
+        assert sched2._workerd_for(drv.workers()[0]) is not None
     finally:
         teardown_pod(servers, execset, drv)
 

@@ -1,0 +1,252 @@
+"""Workspace-seed suite: the content-addressed seed fan-out (ISSUE 16).
+
+The acceptance shape: the deterministic tar ABI digests stably across
+metadata churn (and collapses undiverged worktrees to one digest), never
+descends into .git / symlinked dirs / foreign mounts; the host-side TTL
+cache pays the tree walk once per fan-out and serves the digest-keyed
+view back for worker shipping; the workerd-resident SeedStore is a
+bytes-bounded LRU whose eviction degrades launches to the per-create
+fallback rather than failing; snapshot creates referencing a digest
+resolve from the worker-local store with zero further WAN bytes; and a
+snapshot-mode scheduler run journals REC_SEED_TAR / REC_SEED_SHIP
+write-ahead with content-addressed dedup.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.config import load_config
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.loop import LoopScheduler, LoopSpec
+from clawker_tpu.loop.journal import (
+    REC_SEED_SHIP,
+    REC_SEED_TAR,
+    RunJournal,
+    journal_path,
+    replay,
+)
+from clawker_tpu.runtime.orchestrate import (
+    clear_workspace_seed_cache,
+    workspace_seed_by_digest,
+    workspace_seed_tar,
+)
+from clawker_tpu.testenv import TestEnv
+from clawker_tpu.workerd.executor import ExecutorSet, WorkerdExecutor
+from clawker_tpu.workerd.server import SeedStore, WorkerdServer
+from clawker_tpu.workspace.strategy import _tar_tree, seed_digest
+
+IMAGE = "clawker-seedproj:default"
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: seedproj\n")
+        cfg = load_config(proj)
+        clear_workspace_seed_cache()
+        yield tenv, proj, cfg
+        clear_workspace_seed_cache()
+
+
+def make_tree(root, salt="a"):
+    (root / "src").mkdir(parents=True, exist_ok=True)
+    (root / "src" / "main.py").write_text(f"print('{salt}')\n")
+    (root / "README.md").write_text("hello\n")
+
+
+def wait_for(pred, timeout=10.0, interval=0.01) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ------------------------------------------------------------- tar ABI
+
+
+def test_digest_stable_across_metadata_churn(tmp_path):
+    """mtime / mode-within-class churn never changes the digest; a
+    content change always does."""
+    make_tree(tmp_path)
+    d1 = seed_digest(_tar_tree(tmp_path))
+    os.utime(tmp_path / "README.md", (1, 1))
+    (tmp_path / "src" / "main.py").chmod(0o664)    # still non-exec: 0o644
+    d2 = seed_digest(_tar_tree(tmp_path))
+    assert d1 == d2
+    (tmp_path / "README.md").write_text("changed\n")
+    assert seed_digest(_tar_tree(tmp_path)) != d1
+
+
+def test_identical_trees_collapse_to_one_digest(tmp_path):
+    """N undiverged worktrees of one base share a single digest -- the
+    property that turns a 32-agent fan-out into one cached seed."""
+    a, b = tmp_path / "wt-a", tmp_path / "wt-b"
+    a.mkdir(), b.mkdir()
+    make_tree(a), make_tree(b)
+    assert seed_digest(_tar_tree(a)) == seed_digest(_tar_tree(b))
+    make_tree(b, salt="diverged")
+    assert seed_digest(_tar_tree(a)) != seed_digest(_tar_tree(b))
+
+
+def test_tar_skips_git_dir_and_symlinked_dirs(tmp_path):
+    import io
+    import tarfile
+
+    make_tree(tmp_path)
+    (tmp_path / ".git").mkdir()
+    (tmp_path / ".git" / "HEAD").write_text("ref: refs/heads/main\n")
+    (tmp_path / "loop").symlink_to(tmp_path, target_is_directory=True)
+    tar = _tar_tree(tmp_path)
+    names = tarfile.open(fileobj=io.BytesIO(tar)).getnames()
+    assert not any(n.startswith(".git") for n in names)
+    # the symlink entry itself survives; nothing UNDER it is walked
+    assert "loop" in names
+    assert not any(n.startswith("loop/") for n in names)
+
+
+# --------------------------------------------------------- host cache
+
+
+def test_workspace_seed_cache_hit_and_by_digest(tmp_path):
+    make_tree(tmp_path)
+    clear_workspace_seed_cache()
+    try:
+        d1, tar1 = workspace_seed_tar(tmp_path)
+        d2, tar2 = workspace_seed_tar(tmp_path)       # cache hit
+        assert (d1, tar1) == (d2, tar2)
+        assert workspace_seed_by_digest(d1) == tar1
+        assert workspace_seed_by_digest("0" * 64) is None
+    finally:
+        clear_workspace_seed_cache()
+
+
+# ---------------------------------------------------------- SeedStore
+
+
+def test_seed_store_lru_bounded_by_bytes():
+    store = SeedStore(max_bytes=100)
+    assert store.put("a", b"x" * 60)
+    assert store.put("b", b"y" * 60)       # evicts "a" (LRU)
+    assert store.get("a") is None
+    assert store.get("b") == b"y" * 60
+    assert not store.put("huge", b"z" * 101)   # over cap: stored nothing
+    assert store.get("huge") is None
+    # re-put of the same digest replaces, never double-counts
+    assert store.put("b", b"y" * 60)
+    assert store.bytes_held == 60
+    store.clear()
+    assert store.get("b") is None and store.bytes_held == 0
+
+
+def test_seed_store_get_refreshes_lru():
+    store = SeedStore(max_bytes=100)
+    store.put("a", b"x" * 40)
+    store.put("b", b"y" * 40)
+    store.get("a")                          # "a" becomes most-recent
+    store.put("c", b"z" * 40)               # evicts "b", not "a"
+    assert store.get("a") is not None
+    assert store.get("b") is None
+
+
+# ------------------------------------------------------ workerd seeds
+
+
+def test_seed_intent_then_create_resolves_from_local_store(env):
+    """submit_seed stores the tar worker-side; a later create intent
+    referencing the digest hits the store and fans out over the local
+    socket.  Dropping the store degrades the NEXT create to the
+    per-create fallback walk -- it still lands."""
+    tenv, proj, cfg = env
+    make_tree(proj)
+    drv = FakeDriver(n_workers=1)
+    drv.api.add_image(IMAGE)
+    sock = tenv.base / "wd.sock"
+    srv = WorkerdServer(cfg, drv.local_engine(0), worker_id="fake-0",
+                        sock_path=sock).start()
+    ex = WorkerdExecutor("fake-0", sock, intent_deadline_s=10.0)
+    try:
+        digest, tar = workspace_seed_tar(proj)
+        assert ex.submit_seed(digest, tar)
+        assert not ex.submit_seed(digest, tar)   # per-channel dedup
+        assert ex.stats["seeds"] == 1 and ex.seeded(digest)
+        assert wait_for(lambda: srv.stats["seeds_stored"] == 1)
+
+        def fill(agent):
+            return ex.submit_pool_fill(agent, {
+                "agent": agent, "image": IMAGE, "loop_id": "seedrun",
+                "worker": "fake-0", "workspace_mode": "snapshot",
+                "seed_digest": digest}).result(timeout=10.0)
+
+        cid = fill("wd-hit")
+        assert cid and srv.stats["seed_hits"] == 1
+        assert consts.WORKSPACE_DIR in drv.api.containers[cid].archives
+
+        srv.drop_seeds()                     # chaos: seed_cache_evict
+        cid2 = fill("wd-miss")
+        assert cid2 and srv.stats["seed_misses"] == 1
+        assert consts.WORKSPACE_DIR in drv.api.containers[cid2].archives
+    finally:
+        ex.close()
+        srv.stop()
+        drv.close()
+
+
+# ------------------------------------------------- scheduler seed WAL
+
+
+def test_snapshot_run_journals_seed_records_once(env):
+    """A snapshot-mode workerd fan-out journals ONE REC_SEED_TAR for the
+    digest and at most one REC_SEED_SHIP per (digest, worker) -- the
+    write-ahead dedup that makes --resume replay free -- and the run's
+    image folds them into .seeds / .seeded."""
+    tenv, proj, cfg = env
+    make_tree(proj)
+    drv = FakeDriver(n_workers=2)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, exit_behavior(b"", 0, delay=0.02))
+    servers, exs = [], {}
+    for i, w in enumerate(drv.workers()):
+        sock = tenv.base / f"wd-{i}.sock"
+        servers.append(WorkerdServer(cfg, drv.local_engine(i),
+                                     worker_id=w.id, sock_path=sock).start())
+        exs[w.id] = WorkerdExecutor(w.id, sock, intent_deadline_s=10.0)
+    execset = ExecutorSet(exs)
+    sched = LoopScheduler(
+        cfg, drv, LoopSpec(parallel=4, iterations=1, image=IMAGE,
+                           workspace_mode="snapshot"),
+        executors=execset)
+    try:
+        sched.start()
+        loops = sched.run(poll_s=0.05)
+        assert all(l.status == "done" for l in loops)
+        records = RunJournal.read(journal_path(cfg.logs_dir, sched.loop_id))
+        tars = [r for r in records if r.get("kind") == REC_SEED_TAR]
+        ships = [r for r in records if r.get("kind") == REC_SEED_SHIP]
+        assert len(tars) == 1                      # one digest, one WAL
+        digest = tars[0]["digest"]
+        assert len({(s["digest"], s["worker"]) for s in ships}) == len(ships)
+        image = replay(records)
+        assert image.seeds.get(digest) == tars[0]["bytes"]
+        assert set(image.seeded.get(digest, [])) == {s["worker"]
+                                                     for s in ships}
+        # every create on every daemon referenced content, not a walk:
+        # the per-channel transfer count stays at one
+        for ex in exs.values():
+            assert ex.stats["seeds"] <= 1
+    finally:
+        sched.cleanup(remove_containers=True)
+        execset.close_all()
+        for s in servers:
+            s.stop()
+        drv.close()

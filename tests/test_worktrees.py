@@ -1,0 +1,282 @@
+"""Worktree-swarm suite: branch-per-agent provisioning + merge queue
+(ISSUE 16).
+
+The acceptance shape: ``GitManager.setup_worktree`` is idempotent
+against every stale state a crashed run leaves (intact worktree reused,
+registered-but-gone pruned and re-added, branch-with-no-worktree
+re-attached); ``merge_into`` lands clean / ff / merged without ever
+touching a user checkout and raises :class:`MergeConflict` on
+conflicting hunks; the :class:`MergeQueue` resubmits conflict losers
+with backoff until ``max_attempts``; a ``--worktrees`` scheduler run
+provisions one branch + worktree per agent (never a clone), journals
+REC_SEED_WORKTREE write-ahead, and lands agent branches onto the
+run-scoped integration branch; resume re-attaches with zero duplicate
+worktree records.
+"""
+
+from __future__ import annotations
+
+import shutil
+import subprocess
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.config import load_config
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.gitx.git import GitError, GitManager, MergeConflict
+from clawker_tpu.loop import LoopScheduler, LoopSpec
+from clawker_tpu.loop.journal import (
+    REC_SEED_WORKTREE,
+    RunJournal,
+    journal_path,
+    replay,
+)
+from clawker_tpu.loop.mergeq import MergeQueue
+from clawker_tpu.testenv import TestEnv
+
+IMAGE = "clawker-wtproj:default"
+
+
+def git(repo, *args):
+    return subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=repo, check=True, capture_output=True, text=True).stdout
+
+
+def make_repo(root):
+    root.mkdir(parents=True, exist_ok=True)
+    git(root, "init", "-q", "-b", "main")
+    (root / "file.txt").write_text("base\n")
+    git(root, "add", ".")
+    git(root, "commit", "-q", "-m", "root")
+    return GitManager(root)
+
+
+def commit_on(gm, branch, fname, content, msg="wip"):
+    """Commit to ``branch`` through a throwaway worktree (no user
+    checkout is ever mutated -- same discipline as the merge queue)."""
+    wt = gm.root.parent / f"tmp-{branch.replace('/', '-')}"
+    gm.setup_worktree(wt, branch)
+    (wt / fname).write_text(content)
+    git(wt, "add", ".")
+    git(wt, "commit", "-q", "-m", msg)
+    gm.remove_worktree(wt, force=True)
+
+
+# ----------------------------------------------------------- lifecycle
+
+
+def test_worktree_lifecycle(tmp_path):
+    gm = make_repo(tmp_path / "repo")
+    dest = tmp_path / "wt" / "agent-0"
+    info = gm.setup_worktree(dest, "loop/run/agent-0")
+    assert info.path == dest and dest.exists()
+    assert gm.branch_exists("loop/run/agent-0")
+    assert (dest / "file.txt").read_text() == "base\n"
+    # idempotent: a second call reuses the intact worktree
+    again = gm.setup_worktree(dest, "loop/run/agent-0")
+    assert again.head == info.head
+    assert len([w for w in gm.list_worktrees()
+                if w.branch == "loop/run/agent-0"]) == 1
+    gm.remove_worktree(dest, force=True)
+    assert not any(w.path == dest for w in gm.list_worktrees())
+
+
+def test_worktree_reattach_after_dir_vanished(tmp_path):
+    """A registration whose directory is gone (crashed host, tmp wipe)
+    is pruned and re-added -- not an error."""
+    gm = make_repo(tmp_path / "repo")
+    dest = tmp_path / "wt" / "agent-0"
+    gm.setup_worktree(dest, "loop/run/agent-0")
+    shutil.rmtree(dest)
+    info = gm.setup_worktree(dest, "loop/run/agent-0")
+    assert dest.exists() and info.branch == "loop/run/agent-0"
+
+
+def test_worktree_branch_exists_without_worktree(tmp_path):
+    """A prior run that died between branch create and worktree add
+    leaves a bare branch: setup attaches to it instead of erroring."""
+    gm = make_repo(tmp_path / "repo")
+    git(gm.root, "branch", "loop/run/agent-0")
+    dest = tmp_path / "wt" / "agent-0"
+    info = gm.setup_worktree(dest, "loop/run/agent-0")
+    assert dest.exists() and info.branch == "loop/run/agent-0"
+
+
+def test_worktree_cross_claim_rejected(tmp_path):
+    """One branch, one worktree: attaching the same branch at a second
+    path (or a second branch at the same path) is refused -- the
+    cross-agent-write guarantee starts here."""
+    gm = make_repo(tmp_path / "repo")
+    gm.setup_worktree(tmp_path / "wt" / "a", "loop/run/a")
+    with pytest.raises(GitError):
+        gm.setup_worktree(tmp_path / "wt" / "elsewhere", "loop/run/a")
+    with pytest.raises(GitError):
+        gm.setup_worktree(tmp_path / "wt" / "a", "loop/run/b")
+
+
+# ---------------------------------------------------------- merge_into
+
+
+def test_merge_into_clean_ff_merged_conflict(tmp_path):
+    gm = make_repo(tmp_path / "repo")
+    gm.ensure_branch("target")
+    # clean: src already contained in target
+    gm.ensure_branch("noop")
+    assert gm.merge_into("target", "noop") == "clean"
+    # ff: src strictly ahead
+    commit_on(gm, "ahead", "a.txt", "a\n")
+    assert gm.merge_into("target", "ahead") == "ff"
+    # merged: diverged but disjoint files -> true merge commit
+    commit_on(gm, "left", "left.txt", "l\n")
+    commit_on(gm, "right", "right.txt", "r\n")
+    assert gm.merge_into("target", "left") in ("ff", "merged")
+    assert gm.merge_into("target", "right") == "merged"
+    # conflict: same hunk, different content
+    commit_on(gm, "c1", "hot.txt", "one\n")
+    commit_on(gm, "c2", "hot.txt", "two\n")
+    assert gm.merge_into("target", "c1") == "merged"
+    with pytest.raises(MergeConflict) as ei:
+        gm.merge_into("target", "c2")
+    assert ei.value.target == "target" and ei.value.src == "c2"
+    # no user checkout was touched, no temp worktree leaked
+    assert gm.current_branch() == "main"
+    assert {w.branch for w in gm.list_worktrees()} == {"main"}
+
+
+# ---------------------------------------------------------- MergeQueue
+
+
+def test_merge_queue_conflict_backoff_and_exhaustion(tmp_path):
+    gm = make_repo(tmp_path / "repo")
+    gm.ensure_branch("target")
+    commit_on(gm, "winner", "hot.txt", "one\n")
+    commit_on(gm, "loser", "hot.txt", "two\n")
+    clock = [0.0]
+    delays = []
+
+    def retry_delay():
+        delays.append(0.7)
+        return 0.7
+
+    q = MergeQueue(retry_s=0.5, max_attempts=2, clock=lambda: clock[0])
+    q.submit("w", "winner")
+    q.submit("l", "loser")
+    r1 = q.drain(gm, "target", retry_delay=retry_delay)
+    assert [a for a, _ in r1.landed] == ["w"]
+    assert r1.resubmitted == ["l"] and delays == [0.7]
+    # still inside the backoff window: deferred, not attempted
+    clock[0] = 0.5
+    r2 = q.drain(gm, "target", retry_delay=retry_delay)
+    assert r2.deferred == ["l"] and not r2.landed
+    # due again -> second conflict exhausts max_attempts
+    clock[0] = 1.0
+    r3 = q.drain(gm, "target", retry_delay=retry_delay)
+    assert r3.failed == ["l"] and not q.pending()
+
+
+def test_merge_queue_resubmit_replaces_stale_entry():
+    q = MergeQueue()
+    q.submit("a", "branch-v1")
+    q.submit("a", "branch-v2")
+    assert q.pending() == ["a"]
+    assert q._entries[0].branch == "branch-v2"
+
+
+# -------------------------------------------------------- swarm run
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: wtproj\n")
+        git(proj, "init", "-q", "-b", "main")
+        git(proj, "add", ".")
+        git(proj, "commit", "-q", "-m", "root")
+        cfg = load_config(proj)
+        yield tenv, proj, cfg
+
+
+def driver_with(n_workers):
+    drv = FakeDriver(n_workers=n_workers)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, exit_behavior(b"", 0, delay=0.02))
+    return drv
+
+
+def test_swarm_run_branch_per_agent_merge_queue_lands(env):
+    """--worktrees fan-out: one branch + worktree per agent from one
+    base (never a clone), REC_SEED_WORKTREE journaled write-ahead with
+    unique (path, branch) per agent, and the merge queue lands every
+    agent branch onto the run-scoped integration branch at run end."""
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    events = []
+    sched = LoopScheduler(
+        cfg, drv, LoopSpec(parallel=3, iterations=1, image=IMAGE,
+                           worktrees=True),
+        on_event=lambda a, e, d="": events.append((a, e, d)))
+    sched.start()
+    loops = sched.run(poll_s=0.05)
+    try:
+        assert all(l.status == "done" for l in loops)
+        gm = GitManager(proj)
+        records = RunJournal.read(journal_path(cfg.logs_dir, sched.loop_id))
+        wts = [r for r in records if r.get("kind") == REC_SEED_WORKTREE]
+        assert len(wts) == 3
+        assert len({r["agent"] for r in wts}) == 3
+        assert len({r["path"] for r in wts}) == 3        # no cross-claims
+        assert len({r["branch"] for r in wts}) == 3
+        for l in loops:
+            assert l.worktree is not None and l.worktree.exists()
+            assert gm.branch_exists(f"loop/{sched.loop_id}/{l.agent}")
+        # merge queue landed every agent (container writes don't reach
+        # a fake worktree, so undiverged tips land "clean")
+        target = f"loop/{sched.loop_id}/merged"
+        assert gm.branch_exists(target)
+        merged = {a for a, e, _ in events if e == "merged"}
+        assert merged == {l.agent for l in loops}
+    finally:
+        sched.cleanup(remove_containers=True)
+        drv.close()
+
+
+def test_swarm_resume_reattaches_zero_duplicate_worktrees(env):
+    """Resuming a worktree run replays REC_SEED_WORKTREE into the
+    scheduler's dedup state: provisioning again re-attaches the SAME
+    worktree with zero new journal records and zero new branches."""
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=2, iterations=1,
+                                             image=IMAGE, worktrees=True))
+    sched.start()
+    loops = sched.run(poll_s=0.05)
+    assert all(l.status == "done" for l in loops)
+    sched.cleanup(remove_containers=True)
+    records = RunJournal.read(journal_path(cfg.logs_dir, sched.loop_id))
+    image = replay(records)
+    assert len(image.worktrees) == 2
+
+    sched2 = LoopScheduler.resume(cfg, drv, image)
+    try:
+        # dedup state restored from the image, not re-journaled
+        assert sched2._worktrees_journaled == set(image.worktrees)
+        for agent, wt in image.worktrees.items():
+            assert sched2._branches[agent] == wt["branch"]
+            with sched2._git_lock:
+                path, _git_dir = sched2._maybe_worktree(agent)
+            assert str(path) == wt["path"]       # re-attached, not re-made
+        after = RunJournal.read(journal_path(cfg.logs_dir, sched2.loop_id))
+        wts = [r for r in after if r.get("kind") == REC_SEED_WORKTREE]
+        assert len(wts) == 2                     # zero duplicates
+        branches = git(proj, "branch", "--list", f"loop/{sched.loop_id}/*")
+        assert len([b for b in branches.splitlines()
+                    if "/merged" not in b]) == 2
+    finally:
+        sched2.cleanup(remove_containers=False)
+        drv.close()
