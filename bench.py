@@ -1451,6 +1451,199 @@ def bench_cross_process_fairness(loops_per_client: int = 6,
     }
 
 
+def bench_federation_fanout_n512(n_loops: int = 512, n_pods: int = 8,
+                                 per_run: int = 4, cap: int = 4,
+                                 rtt_s: float = 0.005) -> dict:
+    """federation_fanout_p50_n512: 512 loops routed across 8 fake pods
+    by the federation router, with a deterministic DCN round-trip
+    injected on every router->pod admission RPC (ISSUE 17 acceptance).
+
+    The evidence set: every loop reaches its budget; every pod's
+    daemon-side launch high-water mark holds its admission cap (the
+    router's leases are flow control, never a cap bypass); and the
+    capacity leases cost >= LEASE_AMORTIZATION_MIN x fewer admission
+    RPCs than the per-launch baseline protocol driven over the same
+    pods at the same RTT -- the zero-WAN-hop launch hot path."""
+    from clawker_tpu import consts
+    from clawker_tpu.config import load_config
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.engine.fake import exit_behavior
+    from clawker_tpu.federation import FederationRouter
+    from clawker_tpu.federation.lease import LeaseManager
+    from clawker_tpu.loopd.client import discover_all
+    from clawker_tpu.loopd.server import LoopdServer
+    from clawker_tpu.testenv import TestEnv
+
+    n_runs = n_loops // per_run
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: benchfed\n")
+        cfg = load_config(proj)
+        cfg.settings.loop.placement.max_inflight_per_worker = cap
+        drivers: list[FakeDriver] = []
+        servers: list[LoopdServer] = []
+        for i in range(n_pods):
+            drv = FakeDriver(n_workers=4, prefix=f"pod{i}")
+            for api in drv.apis:
+                api.add_image("clawker-benchfed:default")
+                api.set_behavior("clawker-benchfed:default",
+                                 exit_behavior(b"done\n", 0))
+            drivers.append(drv)
+            servers.append(LoopdServer(
+                cfg, drv,
+                sock_path=tenv.base / f"pod{i}" / "loopd.sock").start())
+        cfg.settings.federation.enable = True
+        cfg.settings.federation.pods = [str(s.sock_path) for s in servers]
+        router = FederationRouter(cfg, discover_all(cfg),
+                                  control_rtt_s=rtt_s)
+        reqs = [(f"tenant-{i % 4}",
+                 {"parallel": per_run, "iterations": 1,
+                  "tenant": f"tenant-{i % 4}"}) for i in range(n_runs)]
+        t0 = time.perf_counter()
+        results = router.submit_many(reqs)
+        submit_wall = time.perf_counter() - t0
+        lease_rpcs = router.lease.rpcs
+        # drain: stamp each run as it completes (per-run latency p50)
+        pending = {ack["run"] for _, ack in results}
+        done_at: dict[str, float] = {}
+        deadline = time.monotonic() + 120.0
+        while pending and time.monotonic() < deadline:
+            for srv in servers:
+                with srv._runs_lock:
+                    runs = list(srv.runs.items())
+                for rid, run in runs:
+                    if rid in pending and run.done.is_set():
+                        done_at[rid] = time.perf_counter() - t0
+                        pending.discard(rid)
+            if pending:
+                time.sleep(0.01)
+        wall = time.perf_counter() - t0
+        loops_done = 0
+        for srv in servers:
+            for run in srv.runs.values():
+                if run.done.is_set() and run.result and run.result["ok"]:
+                    loops_done += len(run.result["agents"])
+        launch_hwm = max(g.launch_hwm for drv in drivers
+                         for g in drv.gates)
+        # the per-launch baseline: the SAME admission traffic (one RPC
+        # per routed run, to the pod that actually hosted it) over the
+        # naive protocol at the same injected RTT
+        per_pod: dict[str, int] = {}
+        for pod, _ack in results:
+            per_pod[pod] = per_pod.get(pod, 0) + 1
+        baseline = LeaseManager(tokens=1, ttl_s=1.0, amortize=False,
+                                rtt_s=rtt_s)
+        tb = time.perf_counter()
+        for pod, count in per_pod.items():
+            client = router.registry.pods[pod].client
+            for _ in range(count):
+                baseline.spend(pod, client)
+        baseline_wall = time.perf_counter() - tb
+        router.close()
+        for srv in servers:
+            srv.stop()
+    lat = sorted(done_at.values())
+    p50 = lat[len(lat) // 2] if lat else 0.0
+    amortization = round(baseline.rpcs / max(lease_rpcs, 1), 1)
+    return {
+        "loops": n_loops, "pods": n_pods, "runs": n_runs,
+        "parallel_per_run": per_run, "rtt_ms": rtt_s * 1000.0,
+        "all_loops_done": loops_done == n_loops and not pending,
+        "loops_done": loops_done,
+        "cap": cap, "launch_hwm": launch_hwm,
+        "cap_respected": launch_hwm <= cap,
+        "submit_wall_s": round(submit_wall, 3),
+        "fanout_p50_s": round(p50, 3),
+        "fanout_wall_s": round(wall, 3),
+        "lease_rpcs": lease_rpcs,
+        "per_launch_rpcs": baseline.rpcs,
+        "per_launch_wall_s": round(baseline_wall, 3),
+        "lease_amortization": amortization,
+    }
+
+
+def bench_pod_failover_migrate() -> dict:
+    """pod_failover_migrate_s: kill the pod hosting a live run
+    mid-iteration; the router drains it onto the survivor via journal
+    adoption (`migrate_pod`).  Every loop must reach its budget on the
+    survivor within POD_FAILOVER_MIGRATE_BUDGET_S of the kill, with
+    the federation-wide exactly-once audit green -- a duplicate create
+    anywhere reads FAILED, never fast (ISSUE 17 acceptance)."""
+    import threading
+
+    from clawker_tpu import consts
+    from clawker_tpu.chaos.invariants import cross_pod_exactly_once
+    from clawker_tpu.config import load_config
+    from clawker_tpu.engine.drivers import FakeDriver
+    from clawker_tpu.federation import FederationRouter
+    from clawker_tpu.loopd.client import discover_all
+    from clawker_tpu.loopd.server import LoopdServer
+    from clawker_tpu.testenv import TestEnv
+
+    hold = threading.Event()
+
+    def hold_behavior(io) -> int:
+        if not hold.is_set():
+            hold.wait(20.0)
+        return 0
+
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: benchfed\n")
+        cfg = load_config(proj)
+        drivers: dict[str, FakeDriver] = {}
+        servers: list[LoopdServer] = []
+        for name in ("pod0", "pod1"):
+            drv = FakeDriver(n_workers=2, prefix=name)
+            for api in drv.apis:
+                api.add_image("clawker-benchfed:default")
+                api.set_behavior("clawker-benchfed:default", hold_behavior)
+            drivers[name] = drv
+            servers.append(LoopdServer(
+                cfg, drv,
+                sock_path=tenv.base / name / "loopd.sock").start())
+        cfg.settings.federation.enable = True
+        cfg.settings.federation.pods = [str(s.sock_path) for s in servers]
+        router = FederationRouter(cfg, discover_all(cfg))
+        pod, ack = router.submit(
+            {"parallel": 2, "iterations": 1, "tenant": "mig"})
+        run_id = ack["run"]
+        victim = next(s for s in servers
+                      if s.sock_path.parent.name == pod)
+        survivor = next(s for s in servers if s is not victim)
+        creates = lambda d: sum(  # noqa: E731
+            len(api.calls_named("container_create")) for api in d.apis)
+        deadline = time.monotonic() + 30.0
+        while creates(drivers[pod]) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        creates_before = creates(drivers[pod])
+        t0 = time.perf_counter()
+        victim.kill()
+        moved = router.migrate_pod(pod, orphan_grace_s=0.5)
+        hold.set()
+        run = survivor.runs.get(run_id)
+        run_ok = (run is not None and run.done.wait(30.0)
+                  and bool(run.result and run.result["ok"]))
+        wall = time.perf_counter() - t0
+        loops_done = (len(run.result["agents"])
+                      if run is not None and run.result else 0)
+        violations = cross_pod_exactly_once(drivers, cfg, run_id)
+        dead_created_after = creates(drivers[pod]) != creates_before
+        router.close()
+        survivor.stop()
+    return {
+        "migrate_wall_s": round(wall, 3),
+        "migrated_runs": len(moved),
+        "run_ok": run_ok,
+        "loops_done": loops_done, "parallel": 2,
+        "orphan_grace_s": 0.5,
+        "dead_pod_created_after_kill": dead_created_after,
+        "violations": violations,
+    }
+
+
 def bench_engine_dials(per_dial_delay: float = 0.01) -> dict:
     """Engine-API socket dials behind one `clawker run` orchestration.
 
@@ -2331,6 +2524,17 @@ SEED_AMORTIZATION_MIN = 10.0  # content-addressed seed fan-out (one walk,
 #                               50ms RTT (ISSUE 16 acceptance)
 SEED_CACHE_HIT_MIN = 31       # of 32 agent digest lookups in one
 #                               fan-out, at least 31 must hit the cache
+FEDERATION_FANOUT_BUDGET_S = 30.0  # 512 loops routed across 8 pods by
+#                               the federation router at 5ms injected
+#                               DCN RTT: submit -> p50 run completion
+#                               (ISSUE 17 acceptance)
+LEASE_AMORTIZATION_MIN = 5.0  # capacity leases vs per-launch admission
+#                               round-trips over the same routed traffic
+#                               at the same RTT: the zero-WAN-hop launch
+#                               hot path evidence
+POD_FAILOVER_MIGRATE_BUDGET_S = 10.0  # pod kill -> its run finished on
+#                               the survivor via journal adoption, with
+#                               the cross-pod exactly-once audit green
 
 
 def main() -> None:
@@ -2349,6 +2553,8 @@ def main() -> None:
     pool_burst = bench_warm_pool_refill_burst()
     loopd_rt = bench_loopd_submit_roundtrip()
     fairness = bench_cross_process_fairness()
+    fed = bench_federation_fanout_n512()
+    fed_mig = bench_pod_failover_migrate()
     wd_rtt = bench_workerd_rtt_independence()
     wd_batch = bench_workerd_event_batch_overhead()
     seed_amort = bench_workspace_seed_amortization()
@@ -2456,6 +2662,29 @@ def main() -> None:
                          and fairness["cap_respected"]
                          and fairness["interleaved"] else 0.0),
          "detail": fairness},
+        {"metric": "federation_fanout_p50_n512",
+         "value": round(fed["fanout_p50_s"], 3), "unit": "s",
+         # the gate IS the acceptance set: all 512 loops done across 8
+         # pods, no pod's admission cap breached, and leases amortizing
+         # admission RPCs >= 5x over per-launch round-trips at the same
+         # injected DCN RTT -- a cap breach or lost loop reads FAILED
+         "vs_baseline": (round(
+             FEDERATION_FANOUT_BUDGET_S / max(fed["fanout_p50_s"], 1e-9),
+             1) if fed["all_loops_done"] and fed["cap_respected"]
+             and fed["lease_amortization"] >= LEASE_AMORTIZATION_MIN
+             else 0.0),
+         "detail": fed},
+        {"metric": "pod_failover_migrate_s",
+         "value": fed_mig["migrate_wall_s"], "unit": "s",
+         # a migration that duplicated a create, left the run short, or
+         # launched on the dead pod must read FAILED, never fast
+         "vs_baseline": (round(
+             POD_FAILOVER_MIGRATE_BUDGET_S
+             / max(fed_mig["migrate_wall_s"], 1e-9), 1)
+             if fed_mig["run_ok"] and fed_mig["migrated_runs"] == 1
+             and not fed_mig["violations"]
+             and not fed_mig["dead_pod_created_after_kill"] else 0.0),
+         "detail": fed_mig},
         {"metric": "workerd_rtt_independence",
          "value": wd_rtt["workerd_ratio"], "unit": "x",
          # the gate IS the acceptance bar: all four legs drained, the

@@ -32,7 +32,7 @@ RANKS = {
     # rank 3: orchestration / long-lived daemons / analysis surfaces
     "loop": 3, "loopd": 3, "workerd": 3, "chaos": 3, "sentinel": 3,
     "ui": 3, "storeui": 3, "bundler": 3, "adversarial": 3, "parity": 3,
-    "nsd": 3, "analysis": 3,
+    "nsd": 3, "analysis": 3, "federation": 3,
     # rank 2: subsystems the orchestration layer composes
     "engine": 2, "controlplane": 2, "placement": 2, "health": 2,
     "monitor": 2, "telemetry": 2, "fleet": 2, "runtime": 2,

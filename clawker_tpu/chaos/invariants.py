@@ -389,6 +389,104 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
     return violations
 
 
+# ------------------------------------------------------ cross-pod audit
+
+
+def cross_pod_exactly_once(pods: dict, cfg, run_id: str) -> list[str]:
+    """The federation migration invariant (docs/federation.md#chaos):
+    a run that moved between pods is accounted EXACTLY ONCE across the
+    whole federation.
+
+    ``pods`` maps pod name -> that pod's FakeDriver (dead pods
+    included: their call recorders are the evidence the run really
+    left).  All pods share one journal (federation requires shared run
+    storage), so the union audit folds every pod's daemon-side creates
+    against the single write-ahead record:
+
+    - ``cross-pod-duplicate-create``: per (agent, worker) -- worker ids
+      are pod-prefixed, so the key is federation-global -- creates
+      never exceed journaled placements/pool-adds.  A run adopted twice
+      (or a zombie generation still launching on the dead pod) double-
+      creates and trips this.
+    - ``cross-pod-exit-once``: no (agent, iteration) exit journaled
+      twice across all generations/pods.
+    - ``cross-pod-single-home``: folding the record stream, each
+      agent's placements land on ONE pod at a time; after the run's
+      final record every agent's last placement names a worker that
+      belongs to exactly one registered pod.
+    """
+    from ..loop.journal import (
+        REC_EXITED,
+        REC_PLACEMENT,
+        REC_POOL_ADD,
+        RunJournal,
+        journal_path,
+    )
+    from ..runtime.names import container_name
+
+    violations: list[str] = []
+    records = RunJournal.read(journal_path(cfg.logs_dir, run_id))
+    project = cfg.project_name()
+
+    worker_pod: dict[str, str] = {}     # worker id -> owning pod
+    for pod_name, driver in pods.items():
+        for worker, _api in _daemon_view(driver):
+            if worker.id in worker_pod:
+                violations.append(
+                    f"cross-pod-single-home: worker id {worker.id} is "
+                    f"registered by both {worker_pod[worker.id]} and "
+                    f"{pod_name} -- pod worker namespaces must not alias")
+            worker_pod[worker.id] = pod_name
+
+    placements: dict[tuple[str, str], int] = {}
+    last_home: dict[str, str] = {}      # agent -> last placed worker
+    for rec in records:
+        if rec.get("kind") in (REC_PLACEMENT, REC_POOL_ADD):
+            agent = str(rec.get("agent", ""))
+            wid = str(rec.get("worker", ""))
+            placements[(agent, wid)] = placements.get((agent, wid), 0) + 1
+            if rec.get("kind") == REC_PLACEMENT:
+                last_home[agent] = wid
+    name_to_agent = {container_name(project, a): a
+                     for (a, _w) in placements}
+
+    for pod_name, driver in pods.items():
+        for worker, api in _daemon_view(driver):
+            creates: dict[str, int] = {}
+            for (args, _kw) in api.calls_named("container_create"):
+                cname = str(args[0]) if args else ""
+                creates[cname] = creates.get(cname, 0) + 1
+            for cname, n in sorted(creates.items()):
+                agent = name_to_agent.get(cname)
+                if agent is None:
+                    continue
+                allowed = placements.get((agent, worker.id), 0)
+                if n > allowed:
+                    violations.append(
+                        f"cross-pod-duplicate-create: pod {pod_name} "
+                        f"worker {worker.id} executed {n} creates for "
+                        f"{agent} but only {allowed} journaled "
+                        "placement(s) authorized one")
+
+    seen_exits: dict[tuple[str, int], int] = {}
+    for rec in records:
+        if rec.get("kind") == REC_EXITED:
+            key = (str(rec.get("agent", "")), int(rec.get("iteration", -1)))
+            seen_exits[key] = seen_exits.get(key, 0) + 1
+    for (agent, iteration), n in sorted(seen_exits.items()):
+        if n > 1:
+            violations.append(
+                f"cross-pod-exit-once: {agent} iteration {iteration} "
+                f"accounted {n} times across the federation")
+
+    for agent, wid in sorted(last_home.items()):
+        if wid and wid not in worker_pod:
+            violations.append(
+                f"cross-pod-single-home: {agent} last placed on "
+                f"{wid}, a worker no registered pod owns")
+    return violations
+
+
 # ------------------------------------------------------- observe-only twin
 
 

@@ -63,6 +63,16 @@ kind                    injection point
                         cold cache) -- later creates referencing the
                         digest must degrade to the per-create fallback
                         walk, never fail or cross-seed another agent
+``pod_down``            federation scenarios: EVERY worker's daemon dials
+                        ECONNREFUSED at once (the whole pod's control
+                        plane dies -- VM group preempted, loopd host
+                        gone); the federation router must migrate the
+                        pod's runs onto survivors exactly-once
+``pod_partition``       federation scenarios: every worker's probe
+                        channel drops while data paths stay up (DCN
+                        partition between front tier and pod): health
+                        must not condemn the whole pod without
+                        corroboration, lease renews lapse and recover
 ======================  ====================================================
 
 Plans with ``sentinel: true`` run with the fleet sentinel attached to
@@ -89,10 +99,12 @@ EVENT_KINDS = (
     "egress_silent", "egress_flood", "sentinel_kill",
     "workerd_partition", "workerd_kill", "index_down",
     "traffic_burst", "scale_down", "seed_cache_evict",
+    "pod_down", "pod_partition",
 )
 
 # event kinds that target no worker (worker index is ignored)
-_WORKERLESS_KINDS = ("cli_sigkill", "sentinel_kill", "index_down")
+_WORKERLESS_KINDS = ("cli_sigkill", "sentinel_kill", "index_down",
+                     "pod_down", "pod_partition")
 
 # fault gate modes the worker_* / engine_* / probe_* kinds map onto
 GATE_MODE = {
@@ -102,6 +114,14 @@ GATE_MODE = {
     "worker_slow": "slow",
     "engine_burst": "burst",
     "probe_drop": "probe_drop",
+}
+
+# fault gate modes the pod-scope kinds map onto, applied to EVERY
+# worker's gate at once (docs/federation.md#chaos): a dead pod refuses
+# all dials; a partitioned pod drops probes while data paths serve
+POD_GATE_MODE = {
+    "pod_down": "refuse",
+    "pod_partition": "probe_drop",
 }
 
 
@@ -372,6 +392,19 @@ def generate_plan(seed: int, scenario: int = 0, *, n_workers: int = 4,
         events.append(FaultEvent(
             at_s=rng.uniform(0.05, horizon_s * 0.6),
             kind="seed_cache_evict", worker=rng.randrange(n_workers)))
+    # pod rider (drawn strictly AFTER every pre-existing draw, so the
+    # worker-fault/sigkill/sentinel/workerd/shipper/capacity/seed-cache
+    # schedule of a (seed, scenario) pair is byte-identical to the
+    # pre-federation generator): about a fifth of scenarios lose the
+    # WHOLE pod at once -- every daemon refusing dials (pod_down) or
+    # every probe channel dropping while data paths serve
+    # (pod_partition).  Both revive at half-horizon + the usual bounded
+    # outage via the runner's end-of-schedule heal, and the standard
+    # invariant audit (exactly-once accounting included) must hold
+    if rng.random() < 0.20:
+        kind = "pod_down" if rng.random() < 0.5 else "pod_partition"
+        events.append(FaultEvent(
+            at_s=rng.uniform(0.1, horizon_s * 0.5), kind=kind, worker=-1))
     plan.events = sorted(events, key=lambda e: e.at_s)
     _validate(plan)
     return plan

@@ -35,7 +35,7 @@ from .invariants import (
     observe_only_violations,
     scheduling_outcome,
 )
-from .plan import GATE_MODE, FaultEvent, FaultPlan, generate_plan
+from .plan import GATE_MODE, POD_GATE_MODE, FaultEvent, FaultPlan, generate_plan
 from .seams import SeamAbort, SeamRegistry
 
 log = logsetup.get("chaos.runner")
@@ -57,6 +57,17 @@ def apply_fault(driver, ev: FaultEvent) -> None:
     live `loop --chaos-plan` controller."""
     if ev.kind == "worker_revive":
         driver.clear_fault(ev.worker)
+        return
+    if ev.kind in POD_GATE_MODE:
+        # pod-scope faults hit EVERY worker's gate at once (the whole
+        # pod's control plane dies / partitions; docs/federation.md).
+        # The all-workers view keeps fixed-seed schedules meaningful
+        # when an earlier scale_down shrank workers()
+        all_workers = getattr(driver, "all_workers", None)
+        n = len(all_workers() if all_workers is not None
+                else driver.workers())
+        for i in range(n):
+            driver.inject_fault(i, POD_GATE_MODE[ev.kind])
         return
     kw = {}
     if ev.kind == "worker_slow":
@@ -623,6 +634,13 @@ class ChaosRunner:
                     # the unfaulted set, so spurious-quarantine also
                     # proves stream chaos cannot open a breaker
                     self._apply_stream_fault(ev)
+                elif ev.kind in ("pod_down", "pod_partition"):
+                    # pod-scope faults gate EVERY worker at once: the
+                    # whole fleet is faulted (the unfaulted set empties,
+                    # so spurious-quarantine is vacuously satisfied) and
+                    # the end-of-schedule heal revives the pod
+                    faulted.update(range(self.plan.n_workers))
+                    self._apply_worker_fault(ev)
                 else:
                     if ev.kind != "worker_revive":
                         faulted.add(ev.worker)
@@ -1021,6 +1039,20 @@ class ChaosController:
                     "chaos", "skipped",
                     f"{ev.kind}: seed stores are workerd-resident "
                     "(use the soak runner / `clawker chaos run`)")
+                continue
+            if ev.kind in POD_GATE_MODE:
+                # pod-scope faults target every worker, no index check
+                if not injectable:
+                    self.sched.on_event(
+                        "chaos", "skipped",
+                        f"{ev.kind}: driver "
+                        f"{getattr(self.driver, 'name', '?')} is not "
+                        "fault-injectable")
+                    continue
+                apply_fault(self.driver, ev)
+                _INJECTIONS.labels(ev.kind).inc()
+                self.sched.on_event("chaos", "injected",
+                                    f"{ev.kind} (whole pod)")
                 continue
             if not injectable:
                 self.sched.on_event(

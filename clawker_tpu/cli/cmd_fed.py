@@ -1,0 +1,88 @@
+"""``clawker fed``: the multi-pod federation front tier.
+
+``status`` is the operator's one-glance view of the federation: every
+registered pod's liveness, load, breaker posture, lease pool, and
+measured control RTT, straight off each pod's loopd status RPC (see
+docs/federation.md).  With no ``federation.pods`` configured it shows
+the single canonical daemon -- a federation of one.
+"""
+
+from __future__ import annotations
+
+import json
+
+import click
+
+from .factory import Factory
+
+pass_factory = click.make_pass_decorator(Factory)
+
+
+@click.group("fed")
+def fed_group():
+    """Multi-pod federation: route runs across pods."""
+
+
+_POD_COLUMNS = ("POD", "ALIVE", "HEALTHY", "WORKERS", "RUNS", "LOAD",
+                "BRK-OPEN", "RTT-MS", "LEASES")
+
+
+@fed_group.command("status")
+@click.option("--format", "fmt", type=click.Choice(["table", "json"]),
+              default="table")
+@pass_factory
+def fed_status(f: Factory, fmt):
+    """Per-pod federation status over every pod's loopd status RPC.
+
+    Lists each registered pod (the canonical socket plus every
+    ``federation.pods`` entry) with liveness, worker count, live run
+    load, open breakers, outstanding capacity leases, and the measured
+    status round-trip.  Exits non-zero when NO pod answers --
+    scriptable as a federation liveness probe.
+    """
+    from ..federation.registry import PodRegistry
+    from ..loopd.client import discover_all
+
+    try:
+        project = f.config.project_name()
+    except LookupError:
+        project = None
+    clients = discover_all(f.config, require_project=project)
+    if not clients:
+        click.echo("fed: no pod's loopd answering (start one with "
+                   "`clawker loopd start`; register pods under "
+                   "settings federation.pods)", err=True)
+        raise SystemExit(1)
+    registry = PodRegistry(clients)
+    try:
+        registry.refresh()
+        pods = []
+        for p in sorted(registry.pods.values(), key=lambda x: x.index):
+            leases = (p.last_status.get("leases") or {})
+            pods.append({
+                "pod": p.name, "alive": p.alive, "healthy": p.healthy,
+                "workers": p.workers, "runs": list(p.runs),
+                "load": p.load, "breakers_open": p.breakers_open,
+                "rtt_ms": round(p.rtt_s * 1000.0, 2),
+                "leases": leases,
+            })
+    finally:
+        registry.close()
+    if fmt == "json":
+        click.echo(json.dumps({"pods": pods}, indent=2))
+        return
+    click.echo("\t".join(_POD_COLUMNS))
+    for p in pods:
+        leases = p["leases"] or {}
+        click.echo("\t".join(str(x) for x in (
+            p["pod"],
+            "yes" if p["alive"] else "NO",
+            "yes" if p["healthy"] else "NO",
+            p["workers"], len(p["runs"]), p["load"],
+            p["breakers_open"], p["rtt_ms"],
+            f"{leases.get('active', 0)}"
+            f"/{leases.get('outstanding_tokens', 0)}tok")))
+
+
+def register(cli: click.Group) -> None:
+    cli.add_command(fed_group)

@@ -880,22 +880,24 @@ def fleet_console(f: Factory, fps, once, fmt, no_spans):
     import time as _time
 
     from ..errors import ClawkerError
-    from ..loopd.client import discover
-    from ..loopd.feed import console_feed
+    from ..loopd.client import discover_all
+    from ..loopd.feed import console_feed, merge_feeds
     from ..ui.fleetconsole import FleetConsole
 
     try:
         project = f.config.project_name()
     except LookupError:
         project = None
-    client = discover(f.config, require_project=project)
-    if client is None:
+    # every federated pod's daemon (single-pod fleets get exactly the
+    # one canonical socket -- the pre-federation behavior)
+    clients = discover_all(f.config, require_project=project)
+    if not clients:
         click.echo("fleet console: no loopd daemon answering (start one "
                    "with `clawker loopd start`)", err=True)
         raise SystemExit(1)
 
     def feed_fn() -> dict:
-        return console_feed(client.status())
+        return merge_feeds([console_feed(c.status()) for c in clients])
 
     try:
         if fmt == "json":
@@ -918,7 +920,8 @@ def fleet_console(f: Factory, fps, once, fmt, no_spans):
         # BrokenPipe from the socket send, not a wrapped protocol error
         raise click.ClickException(f"fleet console: loopd went away ({e})")
     finally:
-        client.close()
+        for c in clients:
+            c.close()
 
 
 @fleet_group.command("status")

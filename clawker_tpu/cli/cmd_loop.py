@@ -182,6 +182,13 @@ class _ClientInterrupt:
               help="Daemon mode only: submit the run and exit "
                    "immediately -- it keeps executing under loopd; "
                    "re-attach with `clawker loop attach <run>`.")
+@click.option("--pods", "use_pods", is_flag=True,
+              help="Shard the run across every federated pod "
+                   "(docs/federation.md): the front-tier router splits "
+                   "--parallel N over the pods the pod policy picks "
+                   "(locality, load, health), acquires capacity leases, "
+                   "and submits one per-pod run per shard.  Shards run "
+                   "detached; re-attach each with `clawker loop attach`.")
 @pass_factory
 @click.pass_context
 def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
@@ -189,7 +196,7 @@ def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                warm_pool, image, prompt, worktrees, env_kv, failover,
                orphan_grace, resume_run, metrics_port, sentinel_flag,
                ship_telemetry, chaos_plan, as_json, keep, use_daemon,
-               use_workerd, detach):
+               use_workerd, detach, use_pods):
     """Fan autonomous agent loops across the runtime's workers."""
     if ctx.invoked_subcommand is not None:
         return
@@ -201,7 +208,7 @@ def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                warm_pool=warm_pool, sentinel_flag=sentinel_flag,
                ship_telemetry=ship_telemetry, chaos_plan=chaos_plan,
                use_daemon=use_daemon, use_workerd=use_workerd,
-               detach=detach)
+               detach=detach, use_pods=use_pods)
 
 
 def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
@@ -210,9 +217,15 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
                tenant_weight=None, max_inflight_per_worker=None,
                warm_pool=None, sentinel_flag=None, ship_telemetry=None,
                chaos_plan=None, use_daemon=None, use_workerd=None,
-               detach=False):
+               detach=False, use_pods=False):
     from .. import telemetry
 
+    if use_pods and (resume_run or chaos_plan):
+        raise click.ClickException(
+            "--pods cannot combine with "
+            + ("--resume" if resume_run else "--chaos-plan")
+            + ": these stay in-process by design (docs/federation.md "
+            "degrade matrix)")
     if use_daemon and (resume_run or chaos_plan):
         # an explicit --daemon must never silently degrade to a
         # CLI-owned run -- the exact ownership the user opted out of
@@ -320,6 +333,15 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             orphan_grace_s=orphan_grace,
             telemetry=tele.flight_recorder,
         )
+        # --- federated mode (docs/federation.md): the front-tier
+        # router shards the run across every federated pod's loopd.
+        # Shards are detached per-pod runs; a single-pod federation
+        # degrades to exactly the daemon path below.
+        if use_pods:
+            if _run_loops_federated(f, spec, as_json=as_json, keep=keep):
+                return
+            click.echo("--pods: one pod answering; submitting as a "
+                       "single daemon run", err=True)
         # --- daemon mode (docs/loopd.md): when a loopd answers on this
         # project's socket the CLI becomes a thin control client -- the
         # run executes inside the daemon (shared admission caps +
@@ -571,6 +593,50 @@ def _client_spec_doc(spec: LoopSpec) -> dict:
         "orphan_grace_s": spec.orphan_grace_s,
         "telemetry": spec.telemetry,
     }
+
+
+def _run_loops_federated(f: Factory, spec: LoopSpec, *, as_json: bool,
+                         keep: bool) -> bool:
+    """Shard the run across federated pods via the front-tier router
+    (docs/federation.md).  Returns False when fewer than two pods
+    answer -- the caller degrades to the single-daemon path."""
+    from ..errors import ClawkerError
+    from ..federation.router import FederationRouter
+    from ..loopd.client import discover_all
+
+    project = None
+    try:
+        project = f.config.project_name()
+    except LookupError:
+        pass
+    clients = discover_all(f.config, require_project=project)
+    if len(clients) < 2:
+        for c in clients:
+            c.close()
+        if not clients and not f.config.settings.federation.pods:
+            raise click.ClickException(
+                "--pods: no federation configured and no loopd "
+                "answering (register pods under settings "
+                "federation.pods; docs/federation.md)")
+        return False
+    router = FederationRouter(f.config, clients)
+    try:
+        shards = router.submit_sharded(_client_spec_doc(spec), keep=keep)
+    except ClawkerError as e:
+        router.close()
+        raise click.ClickException(f"federated submit failed: {e}")
+    router.close()
+    for pod, size, ack in shards:
+        click.echo(f"loop {ack.get('run')}: {size} agent(s) on pod {pod} "
+                   f"(tenant {ack.get('tenant')})", err=True)
+    click.echo(f"detached: {len(shards)} shard(s) across "
+               f"{len({p for p, _, _ in shards})} pod(s); re-attach "
+               "each with `clawker loop attach <run>`", err=True)
+    if as_json:
+        click.echo(json.dumps({"shards": [
+            {"pod": pod, "parallel": size, "loop_id": str(ack.get("run"))}
+            for pod, size, ack in shards], "detached": True}))
+    return True
 
 
 def _run_loops_client(f: Factory, client, spec: LoopSpec, *, detach: bool,

@@ -135,6 +135,7 @@ def register_commands() -> None:
         cmd_chaos,
         cmd_container,
         cmd_controlplane,
+        cmd_fed,
         cmd_firewall,
         cmd_fleet,
         cmd_harness,
@@ -157,6 +158,7 @@ def register_commands() -> None:
     cmd_chaos.register(cli)
     cmd_container.register(cli)
     cmd_controlplane.register(cli)
+    cmd_fed.register(cli)
     cmd_firewall.register(cli)
     cmd_fleet.register(cli)
     cmd_harness.register(cli)

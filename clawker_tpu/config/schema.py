@@ -551,6 +551,34 @@ class LoopdSettings:
 
 
 @dataclass
+class FederationSettings:
+    """Multi-pod federation: the front-tier run router (docs/federation.md).
+
+    One loopd daemon serves one pod; with ``pods`` listing several
+    daemons' sockets the ``FederationRouter`` places whole runs (or
+    shards of one large ``--parallel N`` run) ACROSS pods: a
+    ``PodPolicy`` picks pods by locality tier (ICI group < pod < DCN),
+    live load, and breaker state from each pod's status RPC, then the
+    pod's own per-worker policy places intra-pod, untouched.  Launch
+    admission is amortized through bounded, renewable capacity LEASES
+    (``lease_tokens`` launch tokens per pod, ``lease_ttl_s`` TTL), so
+    the launch hot path pays zero extra WAN hops.  No pods configured
+    = the single-pod loopd path, byte-identical (degrade matrix)."""
+
+    enable: bool = False            # `clawker loop --pods` / `clawker fed`
+    name: str = ""                  # THIS pod's name in the federation
+    #                                 ("" = the socket's directory name)
+    pods: list[str] = field(default_factory=list)  # per-pod loopd socket
+    #                                 paths the router addresses
+    shape: str = ""                 # pod grid "RxC" for locality tiers
+    #                                 ("" = flat: every pod equidistant)
+    lease_tokens: int = 8           # launch tokens per capacity lease
+    lease_ttl_s: float = 5.0        # lease TTL; a partitioned router's
+    #                                 tokens lapse back to the pod
+    status_interval_s: float = 1.0  # pod status/health poll cadence
+
+
+@dataclass
 class WorkerdSettings:
     """The worker-resident launch daemon (docs/workerd.md).
 
@@ -702,6 +730,7 @@ class Settings:
     runtime: RuntimeSettings = field(default_factory=RuntimeSettings)
     loop: LoopSettings = field(default_factory=LoopSettings)
     loopd: LoopdSettings = field(default_factory=LoopdSettings)
+    federation: FederationSettings = field(default_factory=FederationSettings)
     workerd: WorkerdSettings = field(default_factory=WorkerdSettings)
     telemetry: TelemetrySettings = field(default_factory=TelemetrySettings)
     credentials: CredentialSettings = field(default_factory=CredentialSettings)
@@ -714,4 +743,5 @@ class Settings:
         return {
             "firewall.dns_upstreams": "union",
             "runtime.tpu.workers": "union",
+            "federation.pods": "union",
         }

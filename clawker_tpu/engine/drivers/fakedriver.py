@@ -190,14 +190,20 @@ class FakeDriver(RuntimeDriver):
     name = "fake"
     real_cgroups = False
 
-    def __init__(self, n_workers: int = 1):
+    def __init__(self, n_workers: int = 1, *, prefix: str = "fake"):
+        # `prefix` namespaces worker ids/hostnames so several fake pods
+        # (one FakeDriver each) coexist in one journal without id
+        # collisions -- the federation migration path resumes a dead
+        # pod's run on a survivor and its stand-in workers must never
+        # alias the survivor's live ones (docs/federation.md)
+        self.prefix = prefix
         self.apis = [FakeDockerAPI() for _ in range(n_workers)]
         self.gates = [_FaultGate(api) for api in self.apis]
         self._workers = [
             Worker(
-                id=f"fake-{i}",
+                id=f"{prefix}-{i}",
                 index=i,
-                hostname=f"fake-worker-{i}",
+                hostname=f"{prefix}-worker-{i}",
                 engine=Engine(gate),
             )
             for i, gate in enumerate(self.gates)
@@ -258,8 +264,8 @@ class FakeDriver(RuntimeDriver):
         gate = _FaultGate(api)
         self.apis.append(api)
         self.gates.append(gate)
-        worker = Worker(id=f"fake-{index}", index=index,
-                        hostname=f"fake-worker-{index}",
+        worker = Worker(id=f"{self.prefix}-{index}", index=index,
+                        hostname=f"{self.prefix}-worker-{index}",
                         engine=Engine(gate))
         self._workers.append(worker)
         self._drained.discard(worker.id)

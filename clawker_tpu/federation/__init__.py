@@ -1,0 +1,40 @@
+"""Multi-pod federation: the front-tier run router (docs/federation.md).
+
+Every subsystem below this package tops out at ONE pod: the scheduler
+places onto one pod's workers, loopd admits onto one pod's daemons.
+Federation is the next scale multiplier -- a router that owns a
+registry of per-pod loopd endpoints and places whole runs (or shards
+of one large ``--parallel N`` run) across pods, without rewriting the
+scheduler:
+
+- :mod:`.registry` -- :class:`PodRegistry`: the live view of every
+  pod (status RPC polls: load, breaker counts, lease pool, measured
+  control RTT), the health input to pod-tier placement.
+- :mod:`.lease` -- :class:`LeaseManager`: the router side of the
+  capacity-lease protocol.  Bounded, renewable blocks of launch
+  credits are acquired from each pod's loopd ONCE per block, then
+  spent locally -- zero WAN admission round-trips on the launch hot
+  path (the lease amortizes admission the way workerd amortized
+  engine calls).
+- :mod:`.router` -- :class:`FederationRouter`: two-level placement
+  (:class:`~clawker_tpu.placement.PodPolicy` picks the pod, the pod's
+  own policy places within it), global WFQ tenant fairness layered on
+  top of per-pod tenant caps, and cross-pod migration of a dead pod's
+  runs via the journal/``adopt_run`` machinery.
+
+Degrade matrix: with no ``federation.pods`` configured the router is
+never built and the single-pod loopd path is byte-identical to before.
+"""
+
+from __future__ import annotations
+
+from .lease import LeaseManager
+from .registry import PodRegistry, PodState
+from .router import FederationRouter
+
+__all__ = [
+    "FederationRouter",
+    "LeaseManager",
+    "PodRegistry",
+    "PodState",
+]

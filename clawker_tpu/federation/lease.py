@@ -1,0 +1,200 @@
+"""LeaseManager: the router side of the capacity-lease protocol.
+
+The problem leases solve (docs/federation.md#leases): a naive router
+asks each pod's admission controller "may I launch?" once per launch --
+one WAN round-trip on the hot path of every loop, multiplied by the
+DCN RTT between front tier and pod.  A lease amortizes that: the
+router acquires a bounded, renewable block of N launch credits with a
+TTL from the pod's loopd (``lease_acquire``), spends them LOCALLY
+(zero RPCs), and only goes back to the wire when the block runs out or
+the TTL nears expiry.  The pod's admission token buckets still meter
+every real launch -- a lease is router-side flow control, not a bypass
+-- so the worst a stale lease can cause is a short queue at the pod,
+never an over-cap launch.
+
+Expiry discipline: a renew against a lapsed lease fails (the daemon
+swept it); the manager drops its state and re-acquires.  Partitions
+therefore cost exactly one failed RPC before recovery, and a pod that
+restarted mid-lease simply sees a fresh acquire.
+
+``amortize=False`` degrades every spend to a per-launch
+``lease_acquire(tokens=1)`` round-trip -- the naive protocol, kept as
+the measured baseline the federation bench compares against (the >=5x
+round-trip amortization gate).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from .. import logsetup, telemetry
+from ..errors import ClawkerError
+from ..loopd.client import LoopdClient
+
+log = logsetup.get("federation.lease")
+
+# router->pod admission control RPCs, by pod and verb
+# (acquire|renew|release); the amortization evidence the bench gates
+_LEASE_RPCS = telemetry.counter(
+    "federation_lease_rpcs_total",
+    "Router-to-pod lease RPCs by pod and verb",
+    labels=("pod", "verb"))
+
+# renew when this fraction of the TTL remains: early enough that one
+# slow RPC does not lapse the lease, late enough to amortize
+RENEW_AT_TTL_FRACTION = 0.25
+
+# bounded wait when a pod's credit pool is exhausted (grant 0):
+# attempts, not time -- a pod that never grants reads as an error
+EXHAUSTED_RETRIES = 50
+
+
+@dataclass
+class _PodLease:
+    lease_id: str
+    credits: int
+    granted: int
+    ttl_s: float
+    expires_at: float       # monotonic
+
+
+class LeaseManager:
+    """Per-pod capacity leases, spent locally on the launch hot path.
+
+    ``spend(pod, client)`` is the only call the router's submit path
+    makes: it consumes one local credit when the pod's lease block is
+    live, and pays a wire round-trip only to (re)fill the block.
+    ``rtt_s`` injects a deterministic sleep per wire RPC -- the DCN
+    round trip the federation bench models (fake pods answer over a
+    loopback socket; the injected RTT is what makes per-launch
+    admission measurably expensive, as it is on a real front tier).
+    """
+
+    def __init__(self, *, tokens: int = 0, ttl_s: float = 0.0,
+                 amortize: bool = True, rtt_s: float = 0.0):
+        self.tokens = int(tokens)
+        self.ttl_s = float(ttl_s)
+        self.amortize = amortize
+        self.rtt_s = max(0.0, float(rtt_s))
+        self.rpcs = 0                       # total wire round-trips
+        self.spends = 0                     # total credits consumed
+        self._leases: dict[str, _PodLease] = {}
+
+    # ------------------------------------------------------------- wire
+
+    def _rpc(self, pod: str, verb: str, fn, *args, **kw) -> dict:
+        if self.rtt_s > 0:
+            time.sleep(self.rtt_s)
+        self.rpcs += 1
+        _LEASE_RPCS.labels(pod, verb).inc()
+        return fn(*args, **kw)
+
+    def _acquire(self, pod: str, client: LoopdClient, *, tenant: str,
+                 tokens: int) -> _PodLease | None:
+        doc = self._rpc(pod, "acquire", client.lease_acquire,
+                        tenant=tenant, tokens=tokens, ttl_s=self.ttl_s)
+        granted = int(doc.get("tokens", 0))
+        if granted <= 0:
+            return None
+        lease = _PodLease(
+            lease_id=str(doc.get("lease", "")),
+            credits=granted,
+            granted=granted,
+            ttl_s=float(doc.get("ttl_s", self.ttl_s)),
+            expires_at=time.monotonic() + float(doc.get("ttl_s", 0.0)))
+        self._leases[pod] = lease
+        return lease
+
+    # -------------------------------------------------------- hot path
+
+    def spend(self, pod: str, client: LoopdClient, *,
+              tenant: str = "") -> None:
+        """Consume one launch credit against ``pod``; acquires/renews
+        over the wire only when the local block is out.  Raises
+        :class:`ClawkerError` when the pod refuses to grant credits
+        across the bounded retry budget (pool exhausted for too long).
+        """
+        self.spends += 1
+        if not self.amortize:
+            # the per-launch baseline: one admission round-trip per
+            # spend, credits never held locally
+            for _ in range(EXHAUSTED_RETRIES):
+                doc = self._rpc(pod, "acquire", client.lease_acquire,
+                                tenant=tenant, tokens=1, ttl_s=self.ttl_s)
+                if int(doc.get("tokens", 0)) > 0:
+                    return
+                time.sleep(float(doc.get("retry_after_s", 0.05)))
+            raise ClawkerError(
+                f"federation: pod {pod} granted no launch credit")
+        for _ in range(EXHAUSTED_RETRIES):
+            lease = self._leases.get(pod)
+            now = time.monotonic()
+            if lease is not None and now < lease.expires_at:
+                if lease.credits > 0:
+                    lease.credits -= 1
+                    # opportunistic renew near TTL expiry so the NEXT
+                    # spend never stalls on a lapsed lease
+                    if (lease.expires_at - now
+                            < lease.ttl_s * RENEW_AT_TTL_FRACTION):
+                        self._renew(pod, client)
+                    return
+                # block spent inside the TTL: refresh the credit block
+                if self._renew(pod, client):
+                    continue
+            else:
+                self._leases.pop(pod, None)
+            if self._acquire(pod, client, tenant=tenant,
+                             tokens=self.tokens) is not None:
+                continue
+            time.sleep(0.05)
+        raise ClawkerError(
+            f"federation: pod {pod} granted no launch credit")
+
+    def _renew(self, pod: str, client: LoopdClient) -> bool:
+        lease = self._leases.get(pod)
+        if lease is None:
+            return False
+        try:
+            doc = self._rpc(pod, "renew", client.lease_renew,
+                            lease.lease_id)
+        except (ClawkerError, OSError):
+            # swept by the daemon (TTL lapsed, daemon restarted): drop
+            # and let the caller re-acquire -- one failed RPC, no stall
+            self._leases.pop(pod, None)
+            return False
+        lease.credits = int(doc.get("tokens", lease.granted))
+        lease.granted = max(lease.granted, lease.credits)
+        lease.ttl_s = float(doc.get("ttl_s", lease.ttl_s))
+        lease.expires_at = time.monotonic() + lease.ttl_s
+        return True
+
+    # ------------------------------------------------------- lifecycle
+
+    def forget(self, pod: str) -> None:
+        """Drop local state for a dead pod (no wire traffic)."""
+        self._leases.pop(pod, None)
+
+    def release_all(self, clients: dict[str, LoopdClient]) -> None:
+        """Best-effort release of every held lease (router shutdown);
+        a pod that went away just keeps its lease until TTL sweep."""
+        for pod, lease in list(self._leases.items()):
+            client = clients.get(pod)
+            if client is None:
+                continue
+            try:
+                self._rpc(pod, "release", client.lease_release,
+                          lease.lease_id)
+            except (ClawkerError, OSError):
+                pass
+            self._leases.pop(pod, None)
+
+    def stats(self) -> dict:
+        return {
+            "rpcs": self.rpcs,
+            "spends": self.spends,
+            "leases": {
+                pod: {"credits": lease.credits, "granted": lease.granted}
+                for pod, lease in self._leases.items()
+            },
+        }

@@ -1,0 +1,146 @@
+"""PodRegistry: the router's live view of every federated pod.
+
+One :class:`PodState` per registered loopd endpoint, refreshed from the
+pod's status RPC (docs/federation.md#registry).  The refresh is the
+ONLY control-plane poll the router runs -- everything pod-tier
+placement consults (load, breaker counts, lease pool, measured RTT)
+rides the one status round-trip, so adding a pod costs one RPC per
+``federation.status_interval_s``, not one per decision.
+
+A pod whose status RPC fails is marked dead (``alive=False``) but kept
+in the registry: dead pods are what :meth:`FederationRouter.migrate_pod
+<clawker_tpu.federation.router.FederationRouter.migrate_pod>` drains,
+and a later successful refresh revives them.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from .. import logsetup
+from ..errors import ClawkerError
+from ..loopd.client import LoopdClient
+
+log = logsetup.get("federation.registry")
+
+
+@dataclass
+class PodState:
+    """One pod as the router sees it: identity, the control client,
+    and the last status snapshot's placement-relevant digest."""
+
+    name: str
+    client: LoopdClient
+    index: int
+    alive: bool = True
+    workers: int = 0            # live workers behind the pod's admission
+    load: int = 0               # live run slots (sum of parallel)
+    runs: list[str] = field(default_factory=list)   # live run ids
+    breakers_open: int = 0      # workers with a non-closed breaker
+    rtt_s: float = 0.0          # measured status round-trip
+    last_status: dict = field(default_factory=dict)
+    last_seen: float = 0.0      # monotonic stamp of last good refresh
+
+    # run states that still own capacity (mirror loopd's live set:
+    # anything not yet terminal)
+    _LIVE_STATES = ("starting", "running", "draining")
+
+    def digest(self, doc: dict, rtt_s: float) -> None:
+        """Fold one status reply into the placement-relevant fields."""
+        self.alive = True
+        self.last_status = doc
+        self.rtt_s = rtt_s
+        self.last_seen = time.monotonic()
+        admission = doc.get("admission") or {}
+        # admission only lists workers that have seen launches; an idle
+        # pod still reports its fleet via workerd/health rows
+        self.workers = (len(admission.get("workers") or {})
+                        or len(doc.get("workerd") or {})
+                        or len(doc.get("health") or []))
+        load = 0
+        runs: list[str] = []
+        for r in doc.get("runs") or []:
+            state = str(r.get("state", ""))
+            if state and state not in self._LIVE_STATES:
+                continue
+            runs.append(str(r.get("run", "")))
+            load += max(1, int(r.get("parallel", 0)))
+        self.load = load
+        self.runs = runs
+        open_count = 0
+        for h in doc.get("health") or []:
+            breaker = str(h.get("breaker", h.get("state", "closed")))
+            if breaker and breaker != "closed":
+                open_count += 1
+        self.breakers_open = open_count
+
+    @property
+    def healthy(self) -> bool:
+        """Placement-eligible: alive AND a majority of workers carry a
+        closed breaker.  A pod with most breakers open is effectively
+        down for new placements even though its daemon still answers --
+        the same stance worker-tier placement takes one level down."""
+        if not self.alive:
+            return False
+        if self.workers and self.breakers_open * 2 >= self.workers:
+            return False
+        return True
+
+
+class PodRegistry:
+    """Name -> :class:`PodState` over the federation's loopd endpoints.
+
+    Built from connected clients (normally ``discover_all``'s output);
+    pod names come from each daemon's hello (``federation.name``,
+    defaulting to the socket directory name), with positional
+    ``pod<i>`` fallbacks so an unnamed fleet still federates.
+    """
+
+    def __init__(self, clients: list[LoopdClient]):
+        self.pods: dict[str, PodState] = {}
+        for i, client in enumerate(clients):
+            name = ""
+            try:
+                name = client.daemon_pod()
+            except (ClawkerError, OSError):
+                pass
+            name = name or f"pod{i}"
+            if name in self.pods:        # two daemons claiming one name
+                name = f"{name}@{i}"
+            self.pods[name] = PodState(name=name, client=client, index=i)
+
+    def __len__(self) -> int:
+        return len(self.pods)
+
+    def names(self) -> list[str]:
+        """Pod names in index order (the federation's pod order)."""
+        return [p.name for p in sorted(self.pods.values(),
+                                       key=lambda p: p.index)]
+
+    def get(self, name: str) -> PodState | None:
+        return self.pods.get(name)
+
+    def refresh(self, name: str | None = None) -> None:
+        """Poll status on one pod (or all): fold the reply into its
+        :class:`PodState`, mark the pod dead on any RPC failure."""
+        targets = [self.pods[name]] if name else list(self.pods.values())
+        for pod in targets:
+            t0 = time.monotonic()
+            try:
+                doc = pod.client.status()
+            except (ClawkerError, OSError) as e:
+                if pod.alive:
+                    log.warning("pod %s status failed (%s): marking dead",
+                                pod.name, e)
+                pod.alive = False
+                continue
+            pod.digest(doc, time.monotonic() - t0)
+
+    def alive_pods(self) -> list[PodState]:
+        return [p for p in sorted(self.pods.values(), key=lambda p: p.index)
+                if p.alive]
+
+    def close(self) -> None:
+        for pod in self.pods.values():
+            pod.client.close()

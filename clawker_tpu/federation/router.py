@@ -1,0 +1,293 @@
+"""FederationRouter: two-level placement, global fairness, migration.
+
+The front tier (docs/federation.md#router).  One router owns N pods'
+loopd endpoints and answers three questions the single-pod stack
+cannot:
+
+- **Where does a run land?**  Two-level placement: a
+  :class:`~clawker_tpu.placement.PodPolicy` picks the pod -- locality
+  tier (DCN-adjacent pod groups via
+  :func:`~clawker_tpu.fleet.inventory.federation_topology`), live load
+  and measured status RTT from the :class:`PodRegistry`, pod-level
+  breaker state -- then the pod's OWN per-run policy places loops onto
+  workers, untouched.  The router never sees a worker.
+- **Who goes first?**  Global WFQ across tenants
+  (:meth:`FederationRouter.submit_many`): the same virtual-finish-time
+  discipline the per-pod admission controller runs, layered one level
+  up, so a tenant saturating pod A cannot starve pod B's queue.
+- **What happens when a pod dies?**  :meth:`migrate_pod` re-places a
+  dead pod's live runs onto survivors via ``adopt_run`` -- the journal
+  replay / resume machinery that already moves loops between workers,
+  generalized one level up.  Runs keep their ids, so the journal's
+  exactly-once accounting holds across the move.
+
+Launch hot path: ``submit`` spends a local lease credit
+(:class:`~clawker_tpu.federation.lease.LeaseManager`) and pays exactly
+one wire round-trip -- the submit itself.  Admission adds zero WAN
+hops.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import logsetup, telemetry
+from ..engine.drivers import Worker
+from ..errors import ClawkerError
+from ..fleet.inventory import federation_topology
+from ..health import BREAKER_CLOSED, BREAKER_OPEN
+from ..loopd.client import LoopdClient
+from ..placement import PlacementContext, PodPolicy
+from .lease import LeaseManager
+from .registry import PodRegistry, PodState
+
+log = logsetup.get("federation.router")
+
+# runs routed, by landing pod and tenant
+_SUBMITS = telemetry.counter(
+    "federation_submits_total", "Runs routed to a pod by the federation "
+    "router", labels=("pod", "tenant"))
+# cross-pod migrations, by ADOPTING pod
+_MIGRATIONS = telemetry.counter(
+    "federation_migrations_total", "Runs adopted cross-pod after a pod "
+    "died", labels=("pod",))
+
+
+@dataclass
+class _TenantShare:
+    """Router-tier WFQ state for one tenant: same virtual-finish-time
+    discipline as placement.admission, one level up."""
+
+    weight: float = 1.0
+    vfinish: float = 0.0
+    dispatched: int = 0
+
+
+class FederationRouter:
+    """Places runs across pods; see the module docstring.
+
+    ``clients`` is normally ``loopd.client.discover_all(cfg)``'s
+    output.  ``amortize=False`` selects the per-launch admission
+    baseline (bench comparison only); ``control_rtt_s`` injects a
+    deterministic DCN round trip on every admission RPC.
+    """
+
+    def __init__(self, cfg, clients: list[LoopdClient], *,
+                 amortize: bool = True, control_rtt_s: float = 0.0):
+        if not clients:
+            raise ClawkerError("federation: no pod endpoints "
+                               "(is loopd running on any pod?)")
+        self.cfg = cfg
+        fed = cfg.settings.federation
+        self.registry = PodRegistry(clients)
+        self.lease = LeaseManager(
+            tokens=fed.lease_tokens, ttl_s=fed.lease_ttl_s,
+            amortize=amortize, rtt_s=control_rtt_s)
+        self.policy = PodPolicy()
+        self.topology = federation_topology(fed.shape, len(self.registry))
+        self._placements: dict[str, str] = {}       # run id -> pod name
+        self._shares: dict[str, _TenantShare] = {}
+        self._vtime = 0.0
+        self.registry.refresh()
+
+    # ------------------------------------------------------ pod tier
+
+    def _context(self) -> PlacementContext:
+        """Pod stand-ins as placement Workers: id = pod name, index =
+        pod index, engine = the pod's control client (non-None = pod
+        addressable).  The worker-tier policy machinery then applies
+        verbatim, one level up."""
+        pods = sorted(self.registry.pods.values(), key=lambda p: p.index)
+        workers = [Worker(id=p.name, index=p.index, hostname=p.name,
+                          engine=p.client if p.alive else None)  # type: ignore[arg-type]
+                   for p in pods]
+        states = {p.name: (BREAKER_CLOSED if p.healthy else BREAKER_OPEN)
+                  for p in pods}
+        latency = {p.name: p.rtt_s for p in pods}
+        load = {p.name: p.load for p in pods}
+        return PlacementContext(
+            workers=workers,
+            breaker_state=lambda wid: states.get(wid, BREAKER_CLOSED),
+            latency_s=lambda wid: latency.get(wid, 0.0),
+            load=load,
+            topology=self.topology if self.topology.known else None)
+
+    def pick_pod(self, *, exclude: set[str] | None = None,
+                 near: str | None = None) -> PodState:
+        ctx = self._context()
+        near_w = None
+        if near is not None:
+            near_w = next((w for w in ctx.workers if w.id == near), None)
+        picked = self.policy.pick(ctx, exclude=exclude, near=near_w)
+        if picked is None:
+            raise ClawkerError("federation: no healthy pod eligible")
+        pod = self.registry.get(picked.id)
+        assert pod is not None
+        return pod
+
+    def plan_pods(self, n: int) -> list[PodState]:
+        """One pod per slot for ``n`` slots (sharding a --parallel N
+        run): locality-packed, load/latency-weighted, health-gated."""
+        ctx = self._context()
+        return [self.registry.pods[w.id]
+                for w in self.policy.plan(ctx, n)]
+
+    # ------------------------------------------------------ submit path
+
+    def submit(self, spec_doc: dict, *, keep: bool = False
+               ) -> tuple[str, dict]:
+        """Route one whole run: pick a pod, spend a lease credit,
+        submit.  Returns ``(pod_name, ack)``."""
+        tenant = str(spec_doc.get("tenant") or "")
+        pod = self.pick_pod()
+        self.lease.spend(pod.name, pod.client, tenant=tenant)
+        ack = pod.client.submit_run(dict(spec_doc), keep=keep, stream=False)
+        run_id = str(ack.get("run", ""))
+        if run_id:
+            self._placements[run_id] = pod.name
+        pod.load += max(1, int(spec_doc.get("parallel", 1)))
+        _SUBMITS.labels(pod.name, tenant or "-").inc()
+        return pod.name, ack
+
+    def submit_sharded(self, spec_doc: dict, *, keep: bool = False
+                       ) -> list[tuple[str, int, dict]]:
+        """Shard one large ``--parallel N`` run across pods: the pod
+        policy assigns each of the N slots a pod, contiguous slots on
+        one pod become one per-pod run of that shard's size.  Returns
+        ``[(pod_name, shard_parallel, ack), ...]``.  Each shard is an
+        ordinary run under its pod (own id, own agents); the caller
+        aggregates."""
+        n = max(1, int(spec_doc.get("parallel", 1)))
+        shards: dict[str, int] = {}
+        for pod in self.plan_pods(n):
+            shards[pod.name] = shards.get(pod.name, 0) + 1
+        tenant = str(spec_doc.get("tenant") or "")
+        out: list[tuple[str, int, dict]] = []
+        for pod_name, size in shards.items():
+            pod = self.registry.pods[pod_name]
+            self.lease.spend(pod.name, pod.client, tenant=tenant)
+            doc = dict(spec_doc)
+            doc["parallel"] = size
+            ack = pod.client.submit_run(doc, keep=keep, stream=False)
+            run_id = str(ack.get("run", ""))
+            if run_id:
+                self._placements[run_id] = pod.name
+            pod.load += size
+            _SUBMITS.labels(pod.name, tenant or "-").inc()
+            out.append((pod.name, size, ack))
+        return out
+
+    # --------------------------------------------------- global fairness
+
+    def _share(self, tenant: str, weight: float = 1.0) -> _TenantShare:
+        share = self._shares.get(tenant)
+        if share is None:
+            share = self._shares[tenant] = _TenantShare(weight=weight)
+        if weight != 1.0:
+            share.weight = weight
+        return share
+
+    def dispatch_order(self, requests: list[tuple[str, dict]]
+                       ) -> list[int]:
+        """WFQ order over ``(tenant, spec_doc)`` requests: each request
+        gets a virtual finish time ``start + 1/weight`` against its
+        tenant's share, dispatch goes in vfinish order -- so a tenant
+        that submitted 400 runs interleaves with one that submitted 4
+        instead of burying it (the admission controller's discipline,
+        at router scope, on top of per-pod tenant caps)."""
+        stamped: list[tuple[float, int]] = []
+        for i, (tenant, doc) in enumerate(requests):
+            weight = float(doc.get("tenant_weight") or 1.0)
+            share = self._share(tenant or "-", weight)
+            start = max(self._vtime, share.vfinish)
+            share.vfinish = start + 1.0 / max(share.weight, 1e-9)
+            stamped.append((share.vfinish, i))
+        stamped.sort()
+        return [i for _, i in stamped]
+
+    def submit_many(self, requests: list[tuple[str, dict]], *,
+                    keep: bool = False) -> list[tuple[str, dict]]:
+        """Submit a batch of ``(tenant, spec_doc)`` in global-WFQ
+        order; result list is index-aligned with ``requests``."""
+        out: list[tuple[str, dict] | None] = [None] * len(requests)
+        for i in self.dispatch_order(requests):
+            tenant, doc = requests[i]
+            self._vtime = max(self._vtime,
+                              self._shares[tenant or "-"].vfinish)
+            out[i] = self.submit(doc, keep=keep)
+            self._shares[tenant or "-"].dispatched += 1
+        return [r for r in out if r is not None]
+
+    # -------------------------------------------------------- migration
+
+    def migrate_pod(self, pod_name: str, *,
+                    orphan_grace_s: float | None = None) -> list[str]:
+        """Drain a dead pod: re-place every live run it hosted onto
+        surviving pods via ``adopt_run`` (journal replay + resume under
+        the survivor's admission).  Runs keep their ids -- loop
+        accounting stays exactly-once across the move.  Returns the
+        migrated run ids."""
+        dead = self.registry.get(pod_name)
+        if dead is None:
+            raise ClawkerError(f"federation: unknown pod {pod_name!r}")
+        dead.alive = False
+        self.lease.forget(pod_name)
+        runs = list(dead.runs)
+        runs += [r for r, p in self._placements.items()
+                 if p == pod_name and r not in runs]
+        moved: list[str] = []
+        for run_id in runs:
+            try:
+                target = self.pick_pod(exclude={pod_name}, near=pod_name)
+            except ClawkerError:
+                log.error("pod %s died with %d runs left and no healthy "
+                          "survivor", pod_name, len(runs) - len(moved))
+                break
+            try:
+                target.client.adopt_run(run_id,
+                                        orphan_grace_s=orphan_grace_s)
+            except (ClawkerError, OSError) as e:
+                log.warning("pod %s refused adoption of %s: %s",
+                            target.name, run_id, e)
+                continue
+            self._placements[run_id] = target.name
+            target.load += 1
+            _MIGRATIONS.labels(target.name).inc()
+            moved.append(run_id)
+            log.info("migrated run %s: %s -> %s", run_id, pod_name,
+                     target.name)
+        return moved
+
+    # -------------------------------------------------------- lifecycle
+
+    def placements(self) -> dict[str, str]:
+        """run id -> pod name, as routed (migrations folded in)."""
+        return dict(self._placements)
+
+    def status(self) -> dict:
+        """One doc over every pod: per-pod digests + router state
+        (what ``clawker fed status`` renders)."""
+        self.registry.refresh()
+        pods = []
+        for p in sorted(self.registry.pods.values(), key=lambda x: x.index):
+            pods.append({
+                "pod": p.name, "alive": p.alive, "healthy": p.healthy,
+                "workers": p.workers, "load": p.load,
+                "runs": list(p.runs), "breakers_open": p.breakers_open,
+                "rtt_ms": round(p.rtt_s * 1000.0, 2),
+                "leases": (p.last_status.get("leases") or {}),
+            })
+        return {
+            "pods": pods,
+            "placements": self.placements(),
+            "lease": self.lease.stats(),
+            "tenants": {t: {"weight": s.weight,
+                            "dispatched": s.dispatched}
+                        for t, s in self._shares.items()},
+        }
+
+    def close(self) -> None:
+        self.lease.release_all(
+            {p.name: p.client for p in self.registry.pods.values()
+             if p.alive})
+        self.registry.close()

@@ -170,6 +170,30 @@ def pod_topology(tpu: TPUSettings, n_workers: int) -> WorkerTopology:
     return WorkerTopology(known=True, rows=rows, cols=cols, coords=coords)
 
 
+def federation_topology(shape: str, n_pods: int) -> WorkerTopology:
+    """Pod-tier topology for the federation router (docs/federation.md):
+    the same 2-D grid model one level up -- grid cells are PODS, a row
+    is a DCN-adjacent pod group (co-located pods share the cheaper DCN
+    tier the way co-located workers share ICI).  ``shape`` is the
+    ``federation.shape`` setting ("RxC"); empty/unparseable/mismatched
+    shapes degrade to ``known=False`` exactly like :func:`pod_topology`
+    and pod placement falls back to spread."""
+    if n_pods <= 1:
+        return WorkerTopology()
+    parsed = _parse_shape(shape) if shape else None
+    if shape and parsed is None:
+        log.warning("federation.shape %r unparseable (want RxC); "
+                    "pod placement falls back to spread", shape)
+        return WorkerTopology()
+    if parsed is not None and parsed[0] * parsed[1] != n_pods:
+        log.warning("federation.shape %r does not cover %d pods; "
+                    "pod placement falls back to spread", shape, n_pods)
+        return WorkerTopology()
+    rows, cols = parsed if parsed is not None else _near_square(n_pods)
+    coords = {i: (i // cols, i % cols) for i in range(n_pods)}
+    return WorkerTopology(known=True, rows=rows, cols=cols, coords=coords)
+
+
 def discover_workers(tpu: TPUSettings) -> list[str]:
     if tpu.workers:
         return list(tpu.workers)

@@ -66,6 +66,44 @@ class LoopdClient:
         """The project the daemon serves ('' when it has none)."""
         return str(self.hello().get("project", ""))
 
+    def daemon_pod(self) -> str:
+        """The pod name the daemon carries in a federation."""
+        return str(self.hello().get("pod", ""))
+
+    # -------------------------------------------- federation verbs
+    # Capacity leases + run adoption (docs/federation.md): the router
+    # side of the lease protocol and cross-pod migration.
+
+    def lease_acquire(self, *, tenant: str = "", tokens: int = 0,
+                      ttl_s: float = 0.0) -> dict:
+        """Acquire a bounded block of launch credits from this pod's
+        admission controller (0 = the pod's configured defaults).
+        Returns the lease doc; ``tokens`` may come back clamped (or 0
+        with ``retry_after_s`` when the pod's credit pool is out)."""
+        return self._call({"type": "lease_acquire", "tenant": tenant,
+                           "tokens": tokens, "ttl_s": ttl_s})
+
+    def lease_renew(self, lease_id: str) -> dict:
+        """Refresh a lease's TTL and credit block.  Raises
+        :class:`LoopdError` when the lease already lapsed -- the
+        caller must re-acquire."""
+        return self._call({"type": "lease_renew", "lease": lease_id})
+
+    def lease_release(self, lease_id: str) -> dict:
+        return self._call({"type": "lease_release", "lease": lease_id})
+
+    def adopt_run(self, run_ref: str, *, orphan_grace_s: float | None = None,
+                  keep: bool = False, stream: bool = False) -> dict:
+        """Ask this pod to adopt a dead pod's journaled run (replay +
+        resume under its own admission; cross-pod migration).  With
+        ``stream`` the connection then carries the adopted run's event
+        frames via :meth:`events`."""
+        msg: dict = {"type": "adopt_run", "run": run_ref, "keep": keep,
+                     "stream": stream}
+        if orphan_grace_s is not None:
+            msg["orphan_grace_s"] = orphan_grace_s
+        return self._call(msg)
+
     def submit_run(self, spec_doc: dict, *, keep: bool = False,
                    stream: bool = True) -> dict:
         """Submit a loop run; returns the ack (``run`` id, tenant,
@@ -162,3 +200,29 @@ def discover(cfg, *, sock_path: Path | None = None,
             client.close()
             return None
     return client
+
+
+def discover_all(cfg, *, require_project: str | None = None
+                 ) -> list[LoopdClient]:
+    """EVERY project-matching daemon endpoint, one connected client per
+    pod: the canonical single-pod socket first, then each settings
+    ``federation.pods`` entry (docs/federation.md).  Duplicate paths
+    collapse; dead/foreign sockets are skipped exactly as
+    :func:`discover` skips them.  With no federation configured this is
+    ``[discover(cfg)]``-or-``[]`` -- the single-pod behavior unchanged."""
+    if not cfg.settings.loopd.enable:
+        return []
+    seen: set[str] = set()
+    clients: list[LoopdClient] = []
+    candidates = [socket_path(cfg)]
+    candidates += [Path(p) for p in cfg.settings.federation.pods]
+    for path in candidates:
+        key = str(path)
+        if key in seen:
+            continue
+        seen.add(key)
+        client = discover(cfg, sock_path=path,
+                          require_project=require_project)
+        if client is not None:
+            clients.append(client)
+    return clients

@@ -55,11 +55,15 @@ def console_feed(doc: dict) -> dict:
             continue
         if agent and (agent not in anom or z > anom[agent]):
             anom[agent] = z
+    pod = str(doc.get("pod") or "")
     runs = []
     for r in doc.get("runs") or []:
         runs.append({
             "run": str(r.get("run", "")),
             "state": str(r.get("state", "")),
+            # the hosting pod, stamped from the daemon's status doc so a
+            # multi-pod merge (merge_feeds) keeps rows attributable
+            "pod": str(r.get("pod") or pod),
             "tenant": str(r.get("tenant", "")),
             "client": str(r.get("client", "")),
             "parallel": int(r.get("parallel", 0)),
@@ -73,6 +77,7 @@ def console_feed(doc: dict) -> dict:
     admission = doc.get("admission") or {}
     return {
         "pid": doc.get("pid"),
+        "pod": pod,
         "project": str(doc.get("project") or ""),
         "uptime_s": float(doc.get("uptime_s") or 0.0),
         "runs": runs,
@@ -84,4 +89,80 @@ def console_feed(doc: dict) -> dict:
         "sentinel": sentinel,
         "shipper": doc.get("shipper") or {"enabled": False},
         "events_dropped_total": int(doc.get("events_dropped_total", 0)),
+    }
+
+
+def merge_feeds(feeds: list[dict]) -> dict:
+    """N pods' normalized console feeds -> ONE cross-pod feed
+    (docs/federation.md#console).
+
+    The console and ``--format json`` consumers keep reading the exact
+    single-pod schema; the merge adds only a top-level ``pods`` list
+    (pod names, feed order) that the TUI keys its POD column off.  Run
+    rows concatenate in feed order (each row already carries its
+    ``pod``); worker-keyed sections prefix keys with ``pod/`` so two
+    pods' ``fake-0`` never alias; tenant rows SUM across pods -- the
+    global view of a tenant the router's WFQ is balancing.  A
+    single-element list returns that feed unchanged (minus ``pods``):
+    the single-pod console is byte-identical to before."""
+    if not feeds:
+        return console_feed({})
+    if len(feeds) == 1:
+        return feeds[0]
+    pods = []
+    for i, f in enumerate(feeds):
+        pods.append(str(f.get("pod") or "") or f"pod{i}")
+    runs: list[dict] = []
+    workers: dict = {}
+    workerd: dict = {}
+    health: list[dict] = []
+    tenants: dict[str, dict] = {}
+    warm_pools: dict = {}
+    sentinel_rows: list[dict] = []
+    sentinel_on = False
+    shipper = {"enabled": False}
+    dropped = 0
+    for pod, f in zip(pods, feeds):
+        for r in f.get("runs") or []:
+            row = dict(r)
+            row["pod"] = str(row.get("pod") or "") or pod
+            runs.append(row)
+        for wid, w in (f.get("workers") or {}).items():
+            workers[f"{pod}/{wid}"] = w
+        for wid, w in (f.get("workerd") or {}).items():
+            workerd[f"{pod}/{wid}"] = w
+        for h in f.get("health") or []:
+            row = dict(h)
+            row["worker"] = f"{pod}/{h.get('worker', '')}"
+            health.append(row)
+        for name, t in (f.get("tenants") or {}).items():
+            agg = tenants.setdefault(name, {
+                "weight": t.get("weight", 1.0), "inflight": 0,
+                "queued": 0, "dispatched": 0})
+            for k in ("inflight", "queued", "dispatched"):
+                agg[k] += int(t.get(k, 0))
+        warm_pools.update(f.get("warm_pools") or {})
+        sent = f.get("sentinel") or {}
+        sentinel_on = sentinel_on or bool(sent.get("enabled"))
+        sentinel_rows += list(sent.get("rows") or [])
+        if not shipper.get("enabled") and (f.get("shipper") or {}).get(
+                "enabled"):
+            shipper = f["shipper"]
+        dropped += int(f.get("events_dropped_total", 0))
+    return {
+        "pid": feeds[0].get("pid"),
+        "pod": "",
+        "pods": pods,
+        "project": next((str(f.get("project") or "") for f in feeds
+                         if f.get("project")), ""),
+        "uptime_s": max(float(f.get("uptime_s") or 0.0) for f in feeds),
+        "runs": runs,
+        "workers": workers,
+        "tenants": tenants,
+        "health": health,
+        "workerd": workerd,
+        "warm_pools": warm_pools,
+        "sentinel": {"enabled": sentinel_on, "rows": sentinel_rows},
+        "shipper": shipper,
+        "events_dropped_total": dropped,
     }

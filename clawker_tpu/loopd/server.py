@@ -73,6 +73,14 @@ DONE_RUNS_KEPT = 64             # finished runs retained for attach/status;
 #                                 beyond this the oldest done runs are
 #                                 evicted (a resident daemon must not
 #                                 accumulate every run it ever hosted)
+LEASE_POOL_FACTOR = 4           # leasable launch credits per admission
+#                                 token: a lease bounds a ROUTER's burst
+#                                 (router-side flow control), while the
+#                                 daemon's own admission buckets still
+#                                 meter the actual inflight launches --
+#                                 so credits may safely exceed the
+#                                 instantaneous token count
+#                                 (docs/federation.md#leases)
 
 
 def spec_from_doc(doc: dict) -> LoopSpec:
@@ -101,6 +109,24 @@ def spec_from_doc(doc: dict) -> LoopSpec:
 
 
 @dataclass
+class _Lease:
+    """One federation capacity lease: a bounded, renewable block of
+    launch credits granted to a front-tier router so cross-pod
+    placement pays ZERO admission round-trips on the launch hot path
+    (the router spends credits locally; the daemon's admission buckets
+    still meter the real launches).  TTL-bounded: a partitioned
+    router's credits lapse back to the pod (docs/federation.md)."""
+
+    lease_id: str
+    tenant: str
+    granted: int                # credits in this block
+    remaining: int              # credits not yet spent (renew refreshes)
+    ttl_s: float
+    expires_at: float           # monotonic deadline
+    renewals: int = 0
+
+
+@dataclass
 class _DaemonRun:
     """One hosted run: its scheduler, drive thread, and subscribers.
 
@@ -114,6 +140,11 @@ class _DaemonRun:
     tenant: str
     client: str                         # submitting client identity
     keep: bool = False
+    resume_image: object | None = None  # adopt_run: the replayed journal
+    #                                     image a drive thread resumes
+    #                                     instead of starting fresh
+    #                                     (cross-pod migration)
+    adopt_orphan_grace_s: float | None = None
     sched: LoopScheduler | None = None
     thread: threading.Thread | None = None
     stop_requested: threading.Event = field(default_factory=threading.Event)
@@ -208,6 +239,11 @@ class LoopdServer:
         self.health: HealthMonitor | None = None
         self.runs: dict[str, _DaemonRun] = {}
         self._runs_lock = threading.Lock()
+        # federation capacity leases (docs/federation.md#leases)
+        self._leases: dict[str, _Lease] = {}
+        self._leases_lock = threading.Lock()
+        self._lease_grants = 0          # lease blocks ever granted
+        self._lease_expired = 0         # leases lapsed by TTL
         self._listener: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
         self._conns: set[socket.socket] = set()
@@ -613,6 +649,7 @@ class LoopdServer:
                         "type": "hello_ack", "pid": os.getpid(),
                         "version": __version__,
                         "project": self._project_name(),
+                        "pod": self.pod_name(),
                     })
                 elif kind == "ping":
                     with self._runs_lock:
@@ -624,12 +661,26 @@ class LoopdServer:
                     protocol.write_msg(conn, self._status_doc())
                 elif kind == "submit_run":
                     self._handle_submit(conn, msg, ident)
-                    return      # streaming connections are single-purpose
+                    if msg.get("stream", True):
+                        return  # streaming connections are single-purpose
+                    # stream=False is a unary verb: the federation router
+                    # reuses ONE control connection per pod for lease +
+                    # submit traffic (docs/federation.md#router)
                 elif kind == "attach":
                     self._handle_attach(conn, msg)
                     return
                 elif kind == "stop_run":
                     self._handle_stop_run(conn, msg)
+                elif kind == "lease_acquire":
+                    protocol.write_msg(conn, self._lease_acquire(msg, ident))
+                elif kind == "lease_renew":
+                    protocol.write_msg(conn, self._lease_renew(msg))
+                elif kind == "lease_release":
+                    protocol.write_msg(conn, self._lease_release(msg))
+                elif kind == "adopt_run":
+                    self._handle_adopt(conn, msg, ident)
+                    if msg.get("stream", False):
+                        return  # streaming: single-purpose like submit
                 elif kind == "shutdown":
                     protocol.write_msg(conn, {"type": "ok"})
                     threading.Thread(target=self.stop, daemon=True,
@@ -659,6 +710,102 @@ class LoopdServer:
             return self.cfg.project_name()
         except LookupError:
             return ""
+
+    def pod_name(self) -> str:
+        """This daemon's pod name in a federation: settings
+        ``federation.name``, else derived from the socket's directory
+        (every fake pod in tests binds a distinct dir).  Single-pod
+        deployments see the default ``loopd``."""
+        return (self.cfg.settings.federation.name
+                or self.sock_path.parent.name)
+
+    # -------------------------------------------------------- lease verbs
+    # Federation capacity leases: a front-tier router acquires a bounded,
+    # renewable block of launch credits per pod instead of a router->pod
+    # admission round-trip per launch -- the lease amortizes admission
+    # the way workerd amortized engine calls (docs/federation.md#leases).
+    # The daemon's own admission buckets still meter the real inflight
+    # launches, so a rogue router cannot widen any per-worker cap.
+
+    def _lease_pool(self) -> int:
+        """Total leasable launch credits for this pod."""
+        stats = self.admission.stats()
+        workers = [w for w in self.driver.workers() if w.engine is not None]
+        return max(1, len(workers)) * int(
+            stats["max_inflight_per_worker"]) * LEASE_POOL_FACTOR
+
+    def _lease_sweep_locked(self) -> None:
+        now = time.monotonic()
+        for lid in [lid for lid, le in self._leases.items()
+                    if le.expires_at <= now]:
+            del self._leases[lid]
+            self._lease_expired += 1
+
+    def _lease_acquire(self, msg: dict, ident: str) -> dict:
+        from ..util import ids
+
+        ttl = max(0.2, float(msg.get("ttl_s")
+                             or self.cfg.settings.federation.lease_ttl_s))
+        want = max(1, int(msg.get("tokens")
+                          or self.cfg.settings.federation.lease_tokens))
+        tenant = str(msg.get("tenant") or ident)
+        with self._leases_lock:
+            self._lease_sweep_locked()
+            outstanding = sum(le.remaining for le in self._leases.values())
+            grant = min(want, max(0, self._lease_pool() - outstanding))
+            if grant <= 0:
+                # every credit is out on unexpired leases: the router
+                # retries after the shortest-lived one can lapse
+                retry = min((le.expires_at for le in self._leases.values()),
+                            default=time.monotonic() + ttl)
+                return {"type": "lease", "lease": "", "tokens": 0,
+                        "ttl_s": ttl, "pod": self.pod_name(),
+                        "retry_after_s": round(
+                            max(0.05, retry - time.monotonic()), 3)}
+            lease = _Lease(lease_id=ids.short_id(), tenant=tenant,
+                           granted=grant, remaining=grant, ttl_s=ttl,
+                           expires_at=time.monotonic() + ttl)
+            self._leases[lease.lease_id] = lease
+            self._lease_grants += 1
+        log.info("lease %s granted to %s (%d credit(s), ttl %.1fs)",
+                 lease.lease_id, tenant, grant, ttl)
+        return {"type": "lease", "lease": lease.lease_id,
+                "tokens": grant, "ttl_s": ttl, "pod": self.pod_name()}
+
+    def _lease_renew(self, msg: dict) -> dict:
+        lid = str(msg.get("lease", ""))
+        with self._leases_lock:
+            self._lease_sweep_locked()
+            lease = self._leases.get(lid)
+            if lease is None:
+                # expired or never granted: the router must RE-ACQUIRE
+                # (a lapsed lease's credits are already back in the pool)
+                return {"type": "error",
+                        "error": f"lease {lid!r} unknown or expired"}
+            lease.remaining = lease.granted     # fresh credit block
+            lease.expires_at = time.monotonic() + lease.ttl_s
+            lease.renewals += 1
+            return {"type": "lease", "lease": lease.lease_id,
+                    "tokens": lease.granted, "ttl_s": lease.ttl_s,
+                    "pod": self.pod_name()}
+
+    def _lease_release(self, msg: dict) -> dict:
+        lid = str(msg.get("lease", ""))
+        with self._leases_lock:
+            released = self._leases.pop(lid, None) is not None
+        return {"type": "ok", "lease": lid, "released": released}
+
+    def _lease_stats(self) -> dict:
+        with self._leases_lock:
+            self._lease_sweep_locked()
+            return {
+                "active": len(self._leases),
+                "outstanding_tokens": sum(le.remaining
+                                          for le in self._leases.values()),
+                "pool": self._lease_pool(),
+                "granted_total": self._lease_grants,
+                "expired_total": self._lease_expired,
+            }
 
     # ----------------------------------------------------------- run verbs
 
@@ -730,6 +877,65 @@ class LoopdServer:
                  run.run_id, ident, run.tenant, spec.parallel)
         return run
 
+    def _handle_adopt(self, conn, msg: dict, ident: str) -> None:
+        """Adopt a dead pod's journaled run onto THIS pod (cross-pod
+        migration, docs/federation.md#migration): replay the run's WAL
+        from the shared logs dir and resume it under this daemon's
+        admission/lanes.  The dead pod's workers replay as engine-less
+        stand-ins, their breakers pre-open, and the run's own failover
+        policy re-places every orphaned loop onto this pod's workers --
+        journal appends continue under the SAME run id (generation+1),
+        so exit-accounted-once and duplicate-create audits hold across
+        the pod boundary."""
+        from ..loop.journal import RunJournal, journal_path, replay
+
+        run_ref = str(msg.get("run", ""))
+        jpath = journal_path(self.cfg.logs_dir, run_ref)
+        if not jpath.exists():
+            raise LoopdError(
+                f"adopt_run: no journal for run {run_ref!r} under "
+                f"{self.cfg.logs_dir} (federation pods must share "
+                "journal storage; docs/federation.md#migration)")
+        image = replay(RunJournal.read(jpath))
+        if not image.run_id:
+            raise LoopdError(
+                f"adopt_run: {jpath}: no usable run header -- the "
+                "journal is too damaged to adopt")
+        spec = spec_from_doc(image.spec)
+        if spec.tenant in ("", "default"):
+            spec.tenant = ident
+        run = _DaemonRun(run_id=image.run_id, spec=spec,
+                         tenant=spec.tenant, client=ident,
+                         keep=bool(msg.get("keep")),
+                         resume_image=image,
+                         adopt_orphan_grace_s=(
+                             float(msg["orphan_grace_s"])
+                             if msg.get("orphan_grace_s") is not None
+                             else None))
+        with self._runs_lock:
+            existing = self.runs.get(run.run_id)
+            if existing is not None and not existing.done.is_set():
+                raise LoopdError(
+                    f"adopt_run: run {run.run_id} is already hosted "
+                    "here and live")
+            self.runs[run.run_id] = run
+            active = sum(1 for r in self.runs.values()
+                         if not r.done.is_set())
+        _RUNS.labels(spec.tenant).inc()
+        _ACTIVE_RUNS.set(active)
+        log.info("run %s adopted by %s (tenant %s, %d loop(s))",
+                 run.run_id, ident, run.tenant, spec.parallel)
+        client_gone = False
+        try:
+            protocol.write_msg(conn, {
+                "type": "adopted", "run": run.run_id,
+                "tenant": run.tenant, "pod": self.pod_name()})
+        except (OSError, ClawkerError):
+            client_gone = True      # adoption proceeds regardless
+        self._start_run(run)
+        if not client_gone and msg.get("stream", True):
+            self._stream(conn, run)
+
     def _start_run(self, run: _DaemonRun) -> None:
         """Spawn the drive thread (idempotent)."""
         if run.thread is not None:
@@ -755,12 +961,23 @@ class LoopdServer:
                          "agent": agent, "event": event, "detail": detail})
 
         try:
-            sched = LoopScheduler(self.cfg, self.driver, run.spec,
-                                  on_event=on_event,
-                                  run_id=run.run_id,
-                                  admission=self.admission,
-                                  lanes=self.lanes,
-                                  seams=self.seams)
+            if run.resume_image is not None:
+                # cross-pod adoption: resume the replayed journal image
+                # under THIS daemon's shared admission (the run keeps
+                # its id; reconcile() below adopts/relaunches/migrates)
+                sched = LoopScheduler.resume(
+                    self.cfg, self.driver, run.resume_image,
+                    on_event=on_event,
+                    orphan_grace_s=run.adopt_orphan_grace_s,
+                    admission=self.admission,
+                    seams=self.seams)
+            else:
+                sched = LoopScheduler(self.cfg, self.driver, run.spec,
+                                      on_event=on_event,
+                                      run_id=run.run_id,
+                                      admission=self.admission,
+                                      lanes=self.lanes,
+                                      seams=self.seams)
             run.sched = sched
             if self.sentinel is not None:
                 # the hosted run's typed events feed the daemon
@@ -777,7 +994,10 @@ class LoopdServer:
                 return
             if run.stop_requested.is_set():
                 sched.request_shutdown("loopd stop_run")
-            sched.start()
+            if run.resume_image is not None:
+                sched.reconcile()
+            else:
+                sched.start()
             loops = sched.run(poll_s=DRIVE_POLL_S)
             if not (self._aborted or sched._aborted):
                 sched.cleanup(remove_containers=not run.keep)
@@ -940,10 +1160,12 @@ class LoopdServer:
             "pid": os.getpid(),
             "version": __version__,
             "project": self._project_name(),
+            "pod": self.pod_name(),
             "socket": str(self.sock_path),
             "uptime_s": round(time.monotonic() - self._started_at, 1),
             "runs": runs,
             "admission": self.admission.stats(),
+            "leases": self._lease_stats(),
             "health": self._health_stats(),
             "workerd": self._workerd_rows(),
             "warm_pools": pools,

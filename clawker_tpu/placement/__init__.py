@@ -36,6 +36,7 @@ from .policy import (
     PLACEMENT_POLICIES,
     PlacementContext,
     PlacementPolicy,
+    PodPolicy,
     get_policy,
     note_decision,
 )
@@ -44,5 +45,5 @@ __all__ = [
     "ADMISSION_DISPATCHED", "ADMISSION_QUEUED", "ADMISSION_REJECTED",
     "AdmissionController", "AdmissionOutcome", "AdmissionTicket",
     "PLACEMENT_POLICIES", "PlacementContext", "PlacementPolicy",
-    "get_policy", "note_decision",
+    "PodPolicy", "get_policy", "note_decision",
 ]

@@ -245,6 +245,26 @@ class TopologyPolicy(PlacementPolicy):
             w.index))
 
 
+class PodPolicy(TopologyPolicy):
+    """The POD tier of two-level placement (docs/federation.md).
+
+    The federation router builds a :class:`PlacementContext` whose
+    "workers" are pod stand-ins (one Worker per pod: id = pod name,
+    index = pod index, load = live run slots, latency = measured status
+    RTT, breaker = pod health from its status RPC) and whose topology
+    is :func:`~clawker_tpu.fleet.inventory.federation_topology` -- so
+    the exact locality machinery that packs loops onto ICI-adjacent
+    workers packs runs onto DCN-adjacent pods, one level up.  Intra-pod
+    placement stays with each pod's own policy, untouched.
+
+    Deliberately NOT in :data:`PLACEMENT_POLICIES`: loop specs name
+    intra-pod policies only; the pod tier is the router's, not a spec
+    field.
+    """
+
+    name = "pod"
+
+
 PLACEMENT_POLICIES: dict[str, type[PlacementPolicy]] = {
     "spread": SpreadPolicy,
     "pack": PackPolicy,

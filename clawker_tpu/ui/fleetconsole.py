@@ -210,6 +210,10 @@ class FleetConsole:
         cs = self.streams.colors()
         thr = _anomaly_threshold()
         lines: list[str] = []
+        # POD column only on a merged multi-pod feed (feed["pods"] set
+        # by loopd.feed.merge_feeds): the single-pod frame stays
+        # byte-identical (docs/federation.md#console)
+        has_pod = len(feed.get("pods") or []) > 1
         all_runs = feed.get("runs") or []
         runs, hidden_runs = self._select_runs(all_runs)
         self._prune_tails({r.get("run", "") for r in runs})
@@ -226,10 +230,13 @@ class FleetConsole:
             lines.append(head)
             rows = []
             has_anom = any(a.get("anomaly_z") is not None for a in agents)
+            pod = str(run.get("pod") or "-")
             for a in agents:
-                row = [a.get("agent", ""), a.get("worker", ""),
-                       cs.status(a.get("status", "")),
-                       str(a.get("iteration", 0)), a.get("exits", "-")]
+                row = [a.get("agent", ""), a.get("worker", "")]
+                if has_pod:
+                    row.append(pod)
+                row += [cs.status(a.get("status", "")),
+                        str(a.get("iteration", 0)), a.get("exits", "-")]
                 if has_anom:
                     z = a.get("anomaly_z")
                     cell = "-" if z is None else f"{z:.1f}"
@@ -237,6 +244,8 @@ class FleetConsole:
                                if z is not None and z >= thr else cell)
                 rows.append(row)
             headers = ["AGENT", "WORKER", "STATUS", "ITER", "EXITS"]
+            if has_pod:
+                headers.insert(2, "POD")
             if has_anom:
                 headers.append("ANOM-Z")
             lines += ["  " + l for l in
@@ -337,8 +346,11 @@ class FleetConsole:
     def frame_lines(self, feed: dict) -> list[str]:
         cs = self.streams.colors()
         width = self.streams.terminal_width()
+        pods = feed.get("pods") or []
+        who = (f"pods={','.join(pods)}" if len(pods) > 1
+               else f"loopd pid {feed.get('pid')}")
         head = (cs.bold("fleet console")
-                + cs.gray(f"  loopd pid {feed.get('pid')}"
+                + cs.gray(f"  {who}"
                           f"  project={feed.get('project') or '-'}"
                           f"  up {feed.get('uptime_s', 0):.0f}s"))
         lines = [head, ""]

@@ -87,6 +87,17 @@ Gates:
   container-second budget on p99 admission wait, while spending no
   more than the most expensive static config (ISSUE 14 acceptance
   bar; two noisy misses re-measured)
+- federation_fanout_p50_n512: 512 loops routed across 8 fake pods by
+  the federation router at 5ms injected DCN RTT complete within
+  bench.FEDERATION_FANOUT_BUDGET_S, no pod's admission cap breached,
+  and the capacity leases amortize router->pod admission RPCs >=
+  bench.LEASE_AMORTIZATION_MIN x over per-launch round-trips on the
+  same routed traffic (ISSUE 17 acceptance bar)
+- pod_failover_migrate_s: killing the pod hosting a live run, the
+  router drains it onto the survivor via journal adoption within
+  bench.POD_FAILOVER_MIGRATE_BUDGET_S, the run finishing under its
+  ORIGINAL id with the cross-pod exactly-once audit green and zero
+  creates on the dead pod after the kill (ISSUE 17)
 
 Prints one JSON line; exit 1 on any gate failure.
 """
@@ -167,6 +178,9 @@ def main() -> int:
         CONSOLE_REPAINT_BUDGET_MS,
         FAILOVER_BUDGET_S,
         FANOUT64_BUDGET_S,
+        FEDERATION_FANOUT_BUDGET_S,
+        LEASE_AMORTIZATION_MIN,
+        POD_FAILOVER_MIGRATE_BUDGET_S,
         INGEST_LAG_BUDGET_S,
         PARITY_WALL_BUDGET_S,
         POLL_COST_BUDGET,
@@ -192,6 +206,7 @@ def main() -> int:
         bench_elastic_vs_static_p99,
         bench_engine_dials,
         bench_failover,
+        bench_federation_fanout_n512,
         bench_fleet_provision,
         bench_ingest_lag,
         bench_loop_fanout,
@@ -200,6 +215,7 @@ def main() -> int:
         bench_loopd_submit_roundtrip,
         bench_parity,
         bench_placement_admission_stampede,
+        bench_pod_failover_migrate,
         bench_resume_reattach,
         bench_telemetry_overhead,
         bench_warm_pool_hit,
@@ -240,6 +256,9 @@ def main() -> int:
         if retry["submit_p50_ms"] < loopd_rt["submit_p50_ms"]:
             loopd_rt = retry
     fairness = bench_cross_process_fairness()
+    fed = bench_federation_fanout_n512()
+    fed_mig = bench_pod_failover_migrate()
+
     def _wd_rtt_green(r: dict) -> bool:
         return (r["all_done"]
                 and r["workerd_ratio"] <= WORKERD_RTT_RATIO_BUDGET
@@ -438,6 +457,43 @@ def main() -> int:
     elif not fairness["interleaved"]:
         failures.append("cross_process_fairness: tenants did not "
                         "interleave (first-burst-wins starvation)")
+    if not fed["all_loops_done"]:
+        failures.append(
+            f"federation_fanout_p50_n512: only {fed['loops_done']}/"
+            f"{fed['loops']} loops reached their budget across "
+            f"{fed['pods']} pods")
+    elif not fed["cap_respected"]:
+        failures.append(
+            f"federation_fanout_p50_n512: a pod exceeded its admission "
+            f"cap (launch hwm {fed['launch_hwm']}, cap {fed['cap']}) -- "
+            "leases must be flow control, never a cap bypass")
+    elif fed["lease_amortization"] < LEASE_AMORTIZATION_MIN:
+        failures.append(
+            f"federation_fanout_p50_n512: lease amortization "
+            f"{fed['lease_amortization']}x < {LEASE_AMORTIZATION_MIN}x "
+            f"vs per-launch admission at {fed['rtt_ms']}ms RTT "
+            f"({fed['lease_rpcs']} vs {fed['per_launch_rpcs']} RPCs)")
+    elif fed["fanout_p50_s"] > FEDERATION_FANOUT_BUDGET_S:
+        failures.append(
+            f"federation_fanout_p50_n512 {fed['fanout_p50_s']}s > "
+            f"{FEDERATION_FANOUT_BUDGET_S}s budget")
+    if fed_mig["violations"]:
+        failures.append(
+            "pod_failover_migrate_s: cross-pod exactly-once audit "
+            f"violated: {'; '.join(fed_mig['violations'][:3])}")
+    elif fed_mig["dead_pod_created_after_kill"]:
+        failures.append(
+            "pod_failover_migrate_s: the dead pod created containers "
+            "AFTER the kill (migration raced the corpse)")
+    elif fed_mig["migrated_runs"] != 1 or not fed_mig["run_ok"]:
+        failures.append(
+            f"pod_failover_migrate_s: migrated {fed_mig['migrated_runs']} "
+            f"run(s), survivor finished ok={fed_mig['run_ok']} "
+            f"({fed_mig['loops_done']}/{fed_mig['parallel']} loops)")
+    elif fed_mig["migrate_wall_s"] > POD_FAILOVER_MIGRATE_BUDGET_S:
+        failures.append(
+            f"pod_failover_migrate_s {fed_mig['migrate_wall_s']}s > "
+            f"{POD_FAILOVER_MIGRATE_BUDGET_S}s budget")
     if not wd_rtt["all_done"]:
         failures.append("workerd_rtt_independence: a leg's loops missed "
                         "their budget")
@@ -567,6 +623,8 @@ def main() -> int:
         "warm_pool_refill_burst": pool_burst,
         "loopd_submit_roundtrip_p50": loopd_rt,
         "cross_process_fairness": fairness,
+        "federation_fanout_p50_n512": fed,
+        "pod_failover_migrate_s": fed_mig,
         "workerd_rtt_independence": wd_rtt,
         "workerd_event_batch_overhead": wd_batch,
         "workspace_seed_amortization": seed_amort,

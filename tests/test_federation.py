@@ -1,0 +1,489 @@
+"""Federation suite (ISSUE 17): the multi-pod front-tier router.
+
+The acceptance shape: capacity leases amortize router->pod admission
+RPCs (acquire/renew/expiry under partition, the >=5x evidence the
+bench gates); the pod tier of two-level placement orders pods by
+locality/load/health; global WFQ interleaves two tenants' batches
+across two fake pods; killing a pod mid-run migrates its run onto a
+survivor via journal adoption with ZERO duplicate creates
+(cross_pod_exactly_once green); `clawker fed status` renders every
+pod; and discover_all stays byte-identical to discover() on a
+single-pod deployment.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.config import load_config
+from clawker_tpu.engine.drivers import FakeDriver, Worker
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.errors import ClawkerError
+from clawker_tpu.federation import FederationRouter, LeaseManager, PodRegistry
+from clawker_tpu.fleet.inventory import federation_topology
+from clawker_tpu.health import BREAKER_CLOSED, BREAKER_OPEN
+from clawker_tpu.loopd import LoopdError, socket_path
+from clawker_tpu.loopd.client import LoopdClient, discover, discover_all
+from clawker_tpu.loopd.server import LoopdServer
+from clawker_tpu.placement import PlacementContext, PodPolicy
+from clawker_tpu.testenv import TestEnv
+
+IMAGE = "clawker-fedproj:default"
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: fedproj\n")
+        cfg = load_config(proj)
+        yield tenv, proj, cfg
+
+
+def driver_with(n_workers: int, *, prefix: str = "fake", behavior=None):
+    drv = FakeDriver(n_workers=n_workers, prefix=prefix)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, behavior or exit_behavior(b"done\n", 0))
+    return drv
+
+
+def hold_behavior(hold: threading.Event):
+    def run(io) -> int:
+        if not hold.is_set():
+            hold.wait(20.0)
+        return 0
+
+    return run
+
+
+def wait_for(pred, timeout=10.0, interval=0.01) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def total_creates(drv) -> int:
+    return sum(len(api.calls_named("container_create")) for api in drv.apis)
+
+
+def pod_server(tenv, cfg, name: str, drv) -> LoopdServer:
+    """One fake pod: a loopd on its own socket dir (the dir name IS the
+    pod name -- the federation.name default) over a shared cfg, so all
+    pods see ONE journal store, as cross-pod adoption requires."""
+    sock = tenv.base / name / "loopd.sock"
+    return LoopdServer(cfg, drv, sock_path=sock).start()
+
+
+@pytest.fixture
+def server(env):
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    srv = LoopdServer(cfg, drv).start()
+    yield cfg, drv, srv
+    srv.stop()
+
+
+@pytest.fixture
+def two_pods(env):
+    tenv, proj, cfg = env
+    drivers: dict[str, FakeDriver] = {}
+    servers: list[LoopdServer] = []
+    for name in ("podA", "podB"):
+        drv = driver_with(2, prefix=name)
+        drivers[name] = drv
+        servers.append(pod_server(tenv, cfg, name, drv))
+    cfg.settings.federation.enable = True
+    cfg.settings.federation.pods = [str(s.sock_path) for s in servers]
+    yield cfg, drivers, servers
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:  # noqa: BLE001 - a test may have killed it
+            pass
+
+
+# ----------------------------------------------------------------- leases
+
+
+def test_lease_acquire_clamps_to_pool_and_reports_exhaustion(server):
+    """The daemon grants at most its pool (live workers x per-worker
+    cap x LEASE_POOL_FACTOR); an exhausted pool answers 0 tokens with a
+    retry hint instead of blocking the control connection."""
+    cfg, drv, srv = server
+    client = LoopdClient(srv.sock_path)
+    client.hello()
+    pool = srv._lease_pool()
+    doc = client.lease_acquire(tokens=10**6, ttl_s=5.0)
+    assert doc["tokens"] == pool and doc["lease"]
+    assert doc["pod"] == srv.pod_name()
+    starved = client.lease_acquire(tokens=1, ttl_s=5.0)
+    assert starved["tokens"] == 0 and starved["lease"] == ""
+    assert starved["retry_after_s"] > 0
+    # releasing returns the credits to the pool
+    client.lease_release(doc["lease"])
+    again = client.lease_acquire(tokens=1, ttl_s=5.0)
+    assert again["tokens"] == 1
+    stats = client.status()["leases"]
+    assert stats["active"] == 1 and stats["pool"] == pool
+    client.close()
+
+
+def test_lease_renew_refreshes_and_expired_lease_must_reacquire(server):
+    cfg, drv, srv = server
+    client = LoopdClient(srv.sock_path)
+    client.hello()
+    doc = client.lease_acquire(tokens=2, ttl_s=0.3)
+    assert doc["tokens"] == 2
+    renewed = client.lease_renew(doc["lease"])
+    assert renewed["tokens"] == 2           # fresh credit block
+    time.sleep(0.6)                          # TTL lapses; the daemon sweeps
+    with pytest.raises(LoopdError, match="unknown or expired"):
+        client.lease_renew(doc["lease"])
+    # the control connection survived the inline error: re-acquire works
+    fresh = client.lease_acquire(tokens=2, ttl_s=0.3)
+    assert fresh["tokens"] == 2 and fresh["lease"] != doc["lease"]
+    client.close()
+
+
+def test_lease_manager_amortizes_admission_rpcs(server):
+    """The perf tentpole's unit twin: 40 launches on an amortized lease
+    cost ~spends/tokens wire RPCs; the per-launch baseline pays one RPC
+    per launch -- the >=5x gap the federation bench gates."""
+    cfg, drv, srv = server
+    client = LoopdClient(srv.sock_path)
+    client.hello()
+    am = LeaseManager(tokens=8, ttl_s=5.0)
+    for _ in range(40):
+        am.spend("p", client)
+    assert am.spends == 40
+    assert am.rpcs <= 40 // 5, am.rpcs      # 1 acquire + 4 renews
+    base = LeaseManager(tokens=8, ttl_s=5.0, amortize=False)
+    for _ in range(20):
+        base.spend("p", client)
+    assert base.rpcs == base.spends == 20
+    # per-spend wire cost: amortized <= baseline / 5 (the bench gate)
+    assert (am.rpcs / am.spends) * 5 <= base.rpcs / base.spends
+    am.release_all({"p": client})
+    client.close()
+
+
+def test_lease_partition_costs_one_failed_rpc_then_reacquires(server):
+    """A swept lease (daemon restart / partition past TTL) fails ONE
+    renew; the manager drops state and re-acquires -- no stall, no
+    crash on the spend path."""
+    cfg, drv, srv = server
+    client = LoopdClient(srv.sock_path)
+    client.hello()
+    mgr = LeaseManager(tokens=2, ttl_s=5.0)
+    mgr.spend("p", client)
+    first = mgr._leases["p"].lease_id
+    # the pod forgets the lease mid-TTL (restart during a partition)
+    client.lease_release(first)
+    mgr.spend("p", client)                  # spends the last local credit
+    rpcs_before = mgr.rpcs
+    mgr.spend("p", client)                  # renew fails -> re-acquire
+    assert mgr._leases["p"].lease_id != first
+    assert mgr.rpcs - rpcs_before == 2      # exactly: failed renew + acquire
+    # full TTL expiry on BOTH sides: silent local drop, fresh acquire
+    expired = LeaseManager(tokens=2, ttl_s=0.3)
+    expired.spend("p", client)
+    time.sleep(0.6)
+    expired.spend("p", client)
+    assert expired.rpcs == 2                # two acquires, zero failures
+    client.close()
+
+
+# --------------------------------------------------------------- pod tier
+
+
+def _pod_ctx(n=4, shape="2x2", broken=(), loads=None):
+    topo = federation_topology(shape, n)
+    workers = [Worker(id=f"p{i}", index=i, hostname=f"p{i}",
+                      engine=object()) for i in range(n)]
+    states = {f"p{i}": (BREAKER_OPEN if i in broken else BREAKER_CLOSED)
+              for i in range(n)}
+    return PlacementContext(
+        workers=workers,
+        breaker_state=lambda wid: states[wid],
+        latency_s=lambda wid: 0.0,
+        load=dict(loads or {}),
+        topology=topo if topo.known else None), workers
+
+
+def test_pod_policy_prefers_dcn_adjacent_pods():
+    """Two-level placement's pod tier: with a 2x2 pod grid, re-placing
+    near p0 picks its row-mate p1 over the p2/p3 row -- the exact
+    locality machinery of worker placement, one level up."""
+    ctx, workers = _pod_ctx()
+    pick = PodPolicy().pick(ctx, exclude={"p0"}, near=workers[0])
+    assert pick is not None and pick.id == "p1"
+    # row-mate unhealthy: the next-cheapest pod across the DCN boundary
+    ctx2, workers2 = _pod_ctx(broken=(1,))
+    pick2 = PodPolicy().pick(ctx2, exclude={"p0"}, near=workers2[0])
+    assert pick2 is not None and pick2.id == "p2"
+
+
+def test_pod_policy_plan_packs_a_pod_group():
+    """A 2-slot plan lands inside ONE DCN-adjacent pod row instead of
+    straddling the expensive boundary."""
+    ctx, _ = _pod_ctx()
+    planned = [w.id for w in PodPolicy().plan(ctx, 2)]
+    assert set(planned) == {"p0", "p1"}
+    # load breaks ties one level up too: an empty pod beats a loaded one
+    ctx3, _ = _pod_ctx(shape="", loads={"p0": 5, "p1": 5, "p2": 0, "p3": 0})
+    pick = PodPolicy().pick(ctx3)
+    assert pick is not None and pick.id == "p2"
+
+
+def test_registry_digests_status_and_marks_dead_pods(two_pods):
+    cfg, drivers, servers = two_pods
+    registry = PodRegistry(discover_all(cfg))
+    try:
+        assert registry.names() == ["podA", "podB"]
+        registry.refresh()
+        for pod in registry.pods.values():
+            assert pod.alive and pod.healthy and pod.workers == 2
+            assert pod.load == 0 and pod.runs == []
+        servers[1].kill()
+        registry.refresh()
+        assert registry.get("podA").alive
+        dead = registry.get("podB")
+        assert not dead.alive and not dead.healthy
+        assert [p.name for p in registry.alive_pods()] == ["podA"]
+    finally:
+        registry.close()
+
+
+# --------------------------------------------------- router / global WFQ
+
+
+def _bare_router() -> FederationRouter:
+    """Router with WFQ state only -- the discipline needs no pods."""
+    r = FederationRouter.__new__(FederationRouter)
+    r._shares = {}
+    r._vtime = 0.0
+    return r
+
+
+def test_router_wfq_interleaves_two_tenants():
+    """Pure WFQ discipline: 4 alpha requests + 2 beta requests at equal
+    weight dispatch interleaved (a,b,a,b,a,a) -- the burst tenant never
+    buries the small one (serial would be aaaabb)."""
+    reqs = ([("alpha", {"parallel": 1})] * 4
+            + [("beta", {"parallel": 1})] * 2)
+    assert _bare_router().dispatch_order(reqs) == [0, 4, 1, 5, 2, 3]
+    # weight tips the interleave: a weight-2 tenant drains 2:1
+    reqs2 = ([("heavy", {"parallel": 1, "tenant_weight": 2.0})] * 4
+             + [("light", {"parallel": 1})] * 2)
+    order2 = _bare_router().dispatch_order(reqs2)
+    heavy_first_two = [i for i in order2[:3] if i < 4]
+    assert len(heavy_first_two) == 2
+
+
+def test_router_submits_across_pods_with_global_wfq(two_pods):
+    cfg, drivers, servers = two_pods
+    router = FederationRouter(cfg, discover_all(cfg))
+    try:
+        reqs = ([("alpha", {"parallel": 1, "iterations": 1,
+                            "tenant": "alpha"})] * 4
+                + [("beta", {"parallel": 1, "iterations": 1,
+                             "tenant": "beta"})] * 2)
+        results = router.submit_many(reqs)
+        assert len(results) == 6
+        by_pod: dict[str, int] = {}
+        for pod, ack in results:
+            assert ack["run"]
+            by_pod[pod] = by_pod.get(pod, 0) + 1
+        # load-balanced across BOTH pods (least-loaded pod tier)
+        assert by_pod == {"podA": 3, "podB": 3}, by_pod
+        # the hot path amortized: 6 submits cost at most one lease
+        # acquire per pod, not one admission RPC per launch
+        assert router.lease.rpcs <= 2, router.lease.stats()
+        doc = router.status()
+        assert doc["tenants"]["alpha"]["dispatched"] == 4
+        assert doc["tenants"]["beta"]["dispatched"] == 2
+        for srv in servers:
+            assert wait_for(lambda: all(
+                r.done.is_set() for r in srv.runs.values()))
+    finally:
+        router.close()
+
+
+def test_router_shards_one_large_run_across_pods(two_pods):
+    cfg, drivers, servers = two_pods
+    router = FederationRouter(cfg, discover_all(cfg))
+    try:
+        shards = router.submit_sharded(
+            {"parallel": 4, "iterations": 1, "tenant": "big"})
+        assert sum(size for _, size, _ in shards) == 4
+        assert {pod for pod, _, _ in shards} == {"podA", "podB"}
+        for pod, size, ack in shards:
+            assert len(ack["agents"]) == size
+            assert router.placements()[ack["run"]] == pod
+        for srv in servers:
+            assert wait_for(lambda: all(
+                r.done.is_set() for r in srv.runs.values()))
+            assert all(r.result["ok"] for r in srv.runs.values())
+    finally:
+        router.close()
+
+
+# -------------------------------------------------------------- migration
+
+
+def test_pod_kill_migrates_runs_with_zero_duplicate_creates(env):
+    """The tentpole's failure story: kill the pod hosting a live run;
+    migrate_pod re-places it onto the survivor via journal adoption --
+    the run keeps its id, finishes on the survivor, and the federation-
+    wide exactly-once audit is green."""
+    from clawker_tpu.chaos.invariants import cross_pod_exactly_once
+
+    tenv, proj, cfg = env
+    hold = threading.Event()
+    drivers = {
+        "podA": driver_with(2, prefix="podA",
+                            behavior=hold_behavior(hold)),
+        "podB": driver_with(2, prefix="podB",
+                            behavior=hold_behavior(hold)),
+    }
+    srv_a = pod_server(tenv, cfg, "podA", drivers["podA"])
+    srv_b = pod_server(tenv, cfg, "podB", drivers["podB"])
+    cfg.settings.federation.enable = True
+    cfg.settings.federation.pods = [str(srv_a.sock_path),
+                                    str(srv_b.sock_path)]
+    router = FederationRouter(cfg, discover_all(cfg))
+    try:
+        pod, ack = router.submit(
+            {"parallel": 2, "iterations": 1, "tenant": "mig"})
+        run_id = ack["run"]
+        assert pod == "podA"            # both empty: index order wins
+        # both loops genuinely executing on pod A before the kill
+        assert wait_for(lambda: total_creates(drivers["podA"]) == 2)
+        srv_a.kill()
+        moved = router.migrate_pod("podA", orphan_grace_s=0.2)
+        assert moved == [run_id]
+        assert router.placements()[run_id] == "podB"
+        hold.set()
+        run = srv_b.runs[run_id]        # adopted under the SAME id
+        assert run.done.wait(20.0)
+        assert run.result["ok"], run.result
+        # the dead pod never created again; the survivor created only
+        # what the journal authorized -- exactly once, federation-wide
+        assert total_creates(drivers["podA"]) == 2
+        violations = cross_pod_exactly_once(drivers, cfg, run_id)
+        assert violations == [], violations
+        assert router.status()["placements"][run_id] == "podB"
+    finally:
+        router.close()
+        srv_b.stop()
+
+
+def test_migrate_unknown_pod_and_no_survivor(two_pods):
+    cfg, drivers, servers = two_pods
+    router = FederationRouter(cfg, discover_all(cfg))
+    try:
+        with pytest.raises(ClawkerError, match="unknown pod"):
+            router.migrate_pod("podZ")
+        # no healthy survivor: the drain reports zero moves, no crash
+        router.registry.get("podB").alive = False
+        assert router.migrate_pod("podA") == []
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------------ CLI surface
+
+
+def test_fed_status_cli_table_and_json(env):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(2)
+    # no pod answering: non-zero (federation liveness probe contract)
+    res = CliRunner().invoke(cli, ["fed", "status"],
+                             obj=Factory(cwd=proj, driver=drv))
+    assert res.exit_code == 1
+    srv = LoopdServer(cfg, drv).start()
+    try:
+        res = CliRunner().invoke(cli, ["fed", "status"],
+                                 obj=Factory(cwd=proj, driver=drv),
+                                 catch_exceptions=False)
+        assert res.exit_code == 0, res.output
+        assert "POD" in res.output and srv.pod_name() in res.output
+        res2 = CliRunner().invoke(
+            cli, ["fed", "status", "--format", "json"],
+            obj=Factory(cwd=proj, driver=drv), catch_exceptions=False)
+        assert res2.exit_code == 0, res2.output
+        doc = json.loads(res2.output[res2.output.index("{"):])
+        (pod,) = doc["pods"]
+        assert pod["alive"] and pod["healthy"] and pod["workers"] == 2
+    finally:
+        srv.stop()
+
+
+def test_cli_loop_pods_rejects_in_process_modes(env, tmp_path):
+    from click.testing import CliRunner
+
+    from clawker_tpu.cli.factory import Factory
+    from clawker_tpu.cli.root import cli
+
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    res = CliRunner().invoke(
+        cli, ["loop", "--pods", "--resume", "whatever"],
+        obj=Factory(cwd=proj, driver=drv))
+    assert res.exit_code != 0 and "--pods" in res.output
+    plan = tmp_path / "plan.json"
+    plan.write_text('{"seed": 1, "events": []}')
+    res = CliRunner().invoke(
+        cli, ["loop", "--pods", "--chaos-plan", str(plan)],
+        obj=Factory(cwd=proj, driver=drv))
+    assert res.exit_code != 0 and "--pods" in res.output
+
+
+# ------------------------------------------------------- discover_all
+
+
+def test_discover_all_single_pod_matches_discover(server):
+    """The degrade regression: with no federation configured,
+    discover_all is exactly [discover()] -- same socket, same daemon."""
+    cfg, drv, srv = server
+    single = discover(cfg)
+    many = discover_all(cfg)
+    assert single is not None and len(many) == 1
+    assert many[0].path == single.path == socket_path(cfg)
+    single.close()
+    for c in many:
+        c.close()
+
+
+def test_discover_all_dedups_and_skips_dead_sockets(env):
+    tenv, proj, cfg = env
+    drv = driver_with(1)
+    srv = LoopdServer(cfg, drv).start()
+    try:
+        cfg.settings.federation.pods = [
+            str(socket_path(cfg)),              # duplicate of canonical
+            str(tenv.base / "nowhere" / "loopd.sock"),  # never existed
+        ]
+        many = discover_all(cfg)
+        assert len(many) == 1 and many[0].path == socket_path(cfg)
+        for c in many:
+            c.close()
+        cfg.settings.loopd.enable = False       # master switch still wins
+        assert discover_all(cfg) == []
+    finally:
+        srv.stop()

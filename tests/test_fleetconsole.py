@@ -324,6 +324,82 @@ def test_console_renders_all_sections(env):
     assert "ship:0p/0d" in text                 # shipper state in the bar
 
 
+# ------------------------------------------------------- multi-pod merge
+
+
+def _pod_status_doc(pod: str, n_agents: int = 16) -> dict:
+    return {
+        "pid": 1, "pod": pod, "project": "p", "uptime_s": 1.0,
+        "runs": [{
+            "run": f"r-{pod}", "state": "running", "tenant": "shared",
+            "client": "c", "parallel": n_agents, "iterations": 2,
+            "placement": "spread", "subscribers": 0, "events_dropped": 1,
+            "agents": [
+                {"agent": f"{pod}-a{i:03d}", "worker": f"{pod}-0",
+                 "status": "running", "iteration": 1, "exit_codes": [0]}
+                for i in range(n_agents)]}],
+        "admission": {
+            "workers": {"fake-0": {"inflight": 1, "capacity": 4,
+                                   "pending": 0, "rejected": 0}},
+            "tenants": {"shared": {"weight": 1.0, "inflight": 1,
+                                   "queued": 0, "dispatched": 2}}},
+        "health": [{"worker": "fake-0", "state": "closed",
+                    "breaker_state_gauge": 0, "probe_p50_ms": 1.0}],
+        "workerd": {"fake-0": "ok"}, "warm_pools": {},
+        "sentinel": {"enabled": False}, "shipper": {"enabled": False},
+        "events_dropped_total": 1,
+    }
+
+
+def test_merge_feeds_concatenates_and_disambiguates():
+    from clawker_tpu.loopd.feed import merge_feeds
+
+    feeds = [console_feed(_pod_status_doc(f"pod{i}")) for i in range(8)]
+    merged = merge_feeds(feeds)
+    assert merged["pods"] == [f"pod{i}" for i in range(8)]
+    assert len(merged["runs"]) == 8
+    assert {r["pod"] for r in merged["runs"]} == set(merged["pods"])
+    # worker-keyed sections pod-prefixed: two pods' fake-0 never alias
+    assert "pod0/fake-0" in merged["workers"]
+    assert "pod7/fake-0" in merged["workerd"]
+    assert all(h["worker"].split("/")[0] in merged["pods"]
+               for h in merged["health"])
+    # tenant rows SUM federation-wide (the view the router's WFQ
+    # balances); drop counters sum too
+    assert merged["tenants"]["shared"]["dispatched"] == 16
+    assert merged["events_dropped_total"] == 8
+    # the single-pod degenerate case is the feed itself, untouched
+    assert merge_feeds([feeds[0]]) is feeds[0]
+
+
+def test_console_multi_pod_feed_pod_column_and_budget():
+    """The federation console satellite: 8 pods' feeds concatenated --
+    the POD column appears, virtualization still bounds the frame at
+    128 agents, and the damage painter holds a clean repaint."""
+    from clawker_tpu.loopd.feed import merge_feeds
+
+    feeds = [console_feed(_pod_status_doc(f"pod{i}")) for i in range(8)]
+    merged = merge_feeds(feeds)
+    streams, _, _, _ = IOStreams.test()
+    console = FleetConsole(streams, lambda: merged)
+    frame = console.frame_lines(merged)
+    text = "\n".join(frame)
+    assert "POD" in text                        # the multi-pod column
+    assert "pods=pod0" in text                  # head names the pods
+    agent_rows = sum(1 for l in frame if "-a0" in l)
+    assert agent_rows <= MAX_AGENT_ROWS         # virtualized @128 agents
+    assert len(frame) <= 140                    # whole frame bounded
+    console.render_once()
+    base = console.painter.stats()["rows_painted"]
+    console.render_once()                       # unchanged merged feed:
+    stats = console.painter.stats()             # zero repainted rows
+    assert stats["rows_painted"] == base
+    # single-pod feed renders WITHOUT the POD column: byte-identical
+    # to the pre-federation console
+    single = console.frame_lines(feeds[0])
+    assert "POD" not in "\n".join(single)
+
+
 # ------------------------------------------------ dashboard reuses painter
 
 
