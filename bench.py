@@ -1349,6 +1349,88 @@ def bench_loopd_submit_roundtrip(iters: int = 14) -> dict:
     }
 
 
+GITGUARD_PUSH_OVERHEAD_BUDGET_MS = 5.0  # p50 ms the git firewall proxy
+#                               may add to a push round-trip on top of
+#                               the upstream apply (ISSUE 18
+#                               acceptance: protocol-aware enforcement
+#                               must be invisible next to a real
+#                               network push)
+
+
+def bench_gitguard_push_overhead(iters: int = 60) -> dict:
+    """gitguard_push_overhead: p50 milliseconds the git firewall proxy
+    (docs/git-policy.md) adds to a push round-trip -- one receive-pack
+    POST through the proxy's HTTP path (identity check, pkt-line
+    parse, policy verdict, forward, report-status relay) versus the
+    same command list applied to the upstream directly.  Gate:
+    overhead p50 <= 5ms, with EVERY guarded push acknowledged (an
+    overhead measured on refused pushes would be flattering and
+    wrong)."""
+    import http.client
+
+    from clawker_tpu.gitguard import (
+        FakeGitUpstream,
+        GitguardServer,
+        RefPolicy,
+    )
+    from clawker_tpu.gitguard.pktline import FLUSH_PKT, encode_pkt
+    from clawker_tpu.gitguard.refpolicy import IDENTITY_HEADER
+
+    def push_body(i: int) -> bytes:
+        sha = format(i + 1, "040x")
+        ref = "refs/heads/loop/bench/agent-0/work"
+        return encode_pkt(
+            f"{'0' * 40} {sha} {ref}".encode() + b"\x00report-status\n"
+        ) + FLUSH_PKT
+
+    guarded: list[float] = []
+    direct: list[float] = []
+    upstream = FakeGitUpstream(refs={"refs/heads/main": "a" * 40})
+    srv = GitguardServer(upstream, RefPolicy(run="bench"),
+                         tcp_addr=("127.0.0.1", 0))
+    srv.start()
+    try:
+        for i in range(iters + 3):      # warmups eat lazy imports
+            body = push_body(i)
+            t0 = time.perf_counter()
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", srv.port, timeout=5.0)
+            conn.request(
+                "POST", "/bench/git-receive-pack", body=body,
+                headers={IDENTITY_HEADER: "bench/agent-0",
+                         "Content-Type":
+                         "application/x-git-receive-pack-request"})
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            g_ms = (time.perf_counter() - t0) * 1000
+            # the baseline: the SAME command list applied straight to
+            # the upstream (what the push costs with no guard in path)
+            t1 = time.perf_counter()
+            upstream.caller = "bench/agent-0"
+            upstream.call("git-receive-pack", body)
+            d_ms = (time.perf_counter() - t1) * 1000
+            if i >= 3 and resp.status == 200:
+                guarded.append(g_ms)
+                direct.append(d_ms)
+    finally:
+        srv.close()
+    acked = sum(1 for _, ident, _r in upstream.acknowledged
+                if ident == "bench/agent-0")
+    g50 = statistics.median(guarded) if guarded else 0.0
+    d50 = statistics.median(direct) if direct else 0.0
+    return {
+        "guarded_p50_ms": round(g50, 3),
+        "direct_p50_ms": round(d50, 3),
+        "overhead_p50_ms": round(g50 - d50, 3),
+        "iters": iters,
+        "pushes_measured": len(guarded),
+        # each loop pushes twice (guarded + baseline), so all-acked
+        # means every guarded push actually landed
+        "all_acked": acked >= 2 * len(guarded),
+    }
+
+
 def bench_cross_process_fairness(loops_per_client: int = 6,
                                  cap: int = 2) -> dict:
     """cross_process_fairness: TWO real client processes submit
@@ -2552,6 +2634,7 @@ def main() -> None:
     pool_hit = bench_warm_pool_hit()
     pool_burst = bench_warm_pool_refill_burst()
     loopd_rt = bench_loopd_submit_roundtrip()
+    gitguard_rt = bench_gitguard_push_overhead()
     fairness = bench_cross_process_fairness()
     fed = bench_federation_fanout_n512()
     fed_mig = bench_pod_failover_migrate()
@@ -2654,6 +2737,17 @@ def main() -> None:
              LOOPD_SUBMIT_BUDGET_MS / max(loopd_rt["submit_p50_ms"], 1e-9),
              1) if loopd_rt["runs_ok"] == loopd_rt["iters"] else 0.0),
          "detail": loopd_rt},
+        {"metric": "gitguard_push_overhead",
+         "value": gitguard_rt["overhead_p50_ms"], "unit": "ms",
+         # headroom under the 5ms per-push budget; a leg whose pushes
+         # were refused (or never landed) must read FAILED, never fast
+         "vs_baseline": (round(
+             GITGUARD_PUSH_OVERHEAD_BUDGET_MS
+             / max(gitguard_rt["overhead_p50_ms"], 1e-9), 1)
+             if gitguard_rt["all_acked"]
+             and gitguard_rt["pushes_measured"] == gitguard_rt["iters"]
+             else 0.0),
+         "detail": gitguard_rt},
         {"metric": "cross_process_fairness", "value": fairness["wall_s"],
          "unit": "s",
          # the gate IS the invariant set: two client processes, one

@@ -37,6 +37,10 @@ SCOPED_FILES = (
     "clawker_tpu/capacity/controller.py",
     "clawker_tpu/workspace/strategy.py",
     "clawker_tpu/gitx/git.py",
+    # gitguard rule install/teardown mutates the shared rules store and
+    # must be dominated by a REC_GITGUARD_RULES journal write
+    # (docs/git-policy.md); the proxy itself is I/O-only and exempt
+    "clawker_tpu/gitguard/server.py",
 )
 
 # attribute names that are unambiguous engine mutations anywhere

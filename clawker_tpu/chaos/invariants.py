@@ -59,6 +59,14 @@ with; docs/chaos.md#invariants):
   watches).  Intent dedup hits are legitimate (a re-sent intent across
   a partition); an intent executed with no placement to authorize it
   is not, and surfaces as duplicate-create.
+- ``ref-isolation-at-proxy``: branch-per-agent ref isolation holds AT
+  THE GIT PROXY (docs/git-policy.md).  Ground truth is the upstream's
+  acknowledged-update log: no acknowledged update may ever name a ref
+  outside its pusher's branch namespace (the sole exception being the
+  merge-queue identity landing the integration branch), no allow
+  verdict in the proxy's decision stream may name an out-of-namespace
+  ref, and after a ``gitguard_down`` kill NOTHING may be acknowledged
+  at all -- a dead guard fails closed, it never falls open.
 """
 
 from __future__ import annotations
@@ -84,7 +92,7 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
                      cap: int = 0, unfaulted: set[str] | None = None,
                      health=None, kills: int = 0,
                      sentinel=None, workerd=None,
-                     shipper=None) -> list[str]:
+                     shipper=None, gitguard=None) -> list[str]:
     """Audit one finished scenario; returns human-readable violations
     (empty list = all invariants hold).
 
@@ -361,6 +369,66 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
                 "shipper-backpressure: the index went down but the "
                 "shipper recorded neither a failed flush nor a drop -- "
                 "the fault never reached the sink path")
+
+    # --- ref-isolation-at-proxy: branch-per-agent isolation, audited
+    # against the UPSTREAM's acknowledged log (docs/git-policy.md).
+    # ``gitguard`` is the runner's audit dict: run/branch_prefix name
+    # the namespace scheme, ``acknowledged`` is (ts, identity_header,
+    # ref) per update the upstream actually applied, ``decisions`` is
+    # (ts, decision_doc) off the proxy, ``downed_at`` when (if ever)
+    # the proxy was killed.  Three falsifiable halves: nothing landed
+    # out of namespace, the proxy never SAID allow out of namespace
+    # (catches a verdict/forward mismatch the first half would miss
+    # when the upstream also refuses), and nothing at all landed after
+    # the kill (fail-closed, never fail-open).
+    if gitguard is not None:
+        from ..gitguard.refpolicy import AgentIdentity, RefPolicy
+
+        policy = RefPolicy(
+            run=str(gitguard.get("run", "")),
+            branch_prefix=str(gitguard.get("branch_prefix", "loop")))
+        integration = policy.integration_ref()
+
+        def in_namespace(ident_header: str, ref: str) -> bool:
+            ident = AgentIdentity.from_header(ident_header)
+            if ident is None:
+                return False
+            if ref == integration:
+                return ident.merge_queue
+            ns = policy.namespace(ident)
+            return ref == ns or ref.startswith(ns + "/")
+
+        downed_at = gitguard.get("downed_at")
+        for ts, ident_header, ref in gitguard.get("acknowledged") or []:
+            if not in_namespace(str(ident_header), str(ref)):
+                violations.append(
+                    f"ref-isolation-at-proxy: upstream acknowledged "
+                    f"{ref} pushed by {ident_header!r} -- an "
+                    "out-of-namespace update landed")
+            if downed_at is not None and ts > downed_at:
+                violations.append(
+                    f"ref-isolation-at-proxy: upstream acknowledged "
+                    f"{ref} AFTER the guard was killed -- a dead guard "
+                    "must fail closed, not open")
+        # decision docs carry (run, agent) but not role, so the
+        # integration ref is checked by the acknowledged-log half
+        # above (only the merge-queue role may land it); here an allow
+        # verdict must name the integration ref or the agent's own
+        # namespace -- anything else is a verdict the policy can never
+        # legitimately produce
+        for _ts, doc in gitguard.get("decisions") or []:
+            if doc.get("verdict") != "allow":
+                continue
+            ident_header = "/".join(
+                p for p in (doc.get("run", ""), doc.get("agent", ""))
+                if p)
+            ref = str(doc.get("ref", ""))
+            if ref and ref != integration \
+                    and not in_namespace(ident_header, ref):
+                violations.append(
+                    f"ref-isolation-at-proxy: proxy journaled an allow "
+                    f"verdict for out-of-namespace ref {ref} "
+                    f"(identity {ident_header!r})")
 
     # --- span-tree: flight record parses; kill-free runs close every root
     fpath = Path(flight_path(cfg.logs_dir, run_id))

@@ -73,6 +73,11 @@ kind                    injection point
                         partition between front tier and pod): health
                         must not condemn the whole pod without
                         corroboration, lease renews lapse and recover
+``gitguard_down``       gitguard scenarios: kill the run's git firewall
+                        proxy mid-run -- every later push attempt must
+                        fail CLOSED (connection refused, journaled
+                        ``down_refused``), never fall through to an
+                        unguarded path (``ref-isolation-at-proxy``)
 ======================  ====================================================
 
 Plans with ``sentinel: true`` run with the fleet sentinel attached to
@@ -99,12 +104,12 @@ EVENT_KINDS = (
     "egress_silent", "egress_flood", "sentinel_kill",
     "workerd_partition", "workerd_kill", "index_down",
     "traffic_burst", "scale_down", "seed_cache_evict",
-    "pod_down", "pod_partition",
+    "pod_down", "pod_partition", "gitguard_down",
 )
 
 # event kinds that target no worker (worker index is ignored)
 _WORKERLESS_KINDS = ("cli_sigkill", "sentinel_kill", "index_down",
-                     "pod_down", "pod_partition")
+                     "pod_down", "pod_partition", "gitguard_down")
 
 # fault gate modes the worker_* / engine_* / probe_* kinds map onto
 GATE_MODE = {
@@ -179,6 +184,9 @@ class FaultPlan:
     shipper: bool = False           # run with the telemetry shipper attached
     capacity: bool = False          # run with the elastic-capacity
     #                                 controller attached
+    gitguard: bool = False          # run with a git firewall proxy + a
+    #                                 deterministic push probe schedule
+    #                                 (docs/git-policy.md)
     events: list[FaultEvent] = field(default_factory=list)
 
     @property
@@ -196,6 +204,7 @@ class FaultPlan:
             "workerd": self.workerd,
             "shipper": self.shipper,
             "capacity": self.capacity,
+            "gitguard": self.gitguard,
             "events": [e.to_doc() for e in sorted(self.events,
                                                   key=lambda e: e.at_s)],
         }
@@ -219,6 +228,7 @@ class FaultPlan:
             workerd=bool(doc.get("workerd", False)),
             shipper=bool(doc.get("shipper", False)),
             capacity=bool(doc.get("capacity", False)),
+            gitguard=bool(doc.get("gitguard", False)),
             events=[FaultEvent.from_doc(e) for e in doc.get("events") or []],
         )
         _validate(plan)
@@ -405,6 +415,22 @@ def generate_plan(seed: int, scenario: int = 0, *, n_workers: int = 4,
         kind = "pod_down" if rng.random() < 0.5 else "pod_partition"
         events.append(FaultEvent(
             at_s=rng.uniform(0.1, horizon_s * 0.5), kind=kind, worker=-1))
+    # gitguard rider (drawn strictly AFTER every pre-existing draw, so
+    # the worker-fault/sigkill/sentinel/workerd/shipper/capacity/
+    # seed-cache/pod schedule of a (seed, scenario) pair is
+    # byte-identical to the pre-gitguard generator): about a third of
+    # scenarios run a git firewall proxy with a deterministic push-probe
+    # schedule riding the run (own-namespace allow, sibling deny,
+    # integration-branch deny, an occasional merge-queue landing), and
+    # roughly 40% of those kill the proxy mid-run -- every later probe
+    # must fail CLOSED, never land an out-of-namespace update
+    # (docs/git-policy.md; the ref-isolation-at-proxy invariant)
+    if rng.random() < 0.35:
+        plan.gitguard = True
+        if rng.random() < 0.4:
+            events.append(FaultEvent(
+                at_s=rng.uniform(0.1, horizon_s * 0.6),
+                kind="gitguard_down", worker=-1))
     plan.events = sorted(events, key=lambda e: e.at_s)
     _validate(plan)
     return plan

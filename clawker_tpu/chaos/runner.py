@@ -82,6 +82,48 @@ IMAGE = "clawker-chaos:default"
 # drain within this is itself an invariant violation (stuck-run)
 SCENARIO_DEADLINE_S = 60.0
 MAX_GENERATIONS = 4             # sigkill/resume cycles per scenario bound
+
+# gitguard scenarios: the run name + agent pool the deterministic
+# push-probe schedule draws identities/refs from (docs/git-policy.md)
+GITGUARD_RUN = "chaosrun"
+GITGUARD_AGENTS = 3
+GITGUARD_PROBES = 8
+
+
+def gitguard_probe_script(seed: int,
+                          scenario: int) -> list[tuple[str, str, str, str]]:
+    """Deterministic push-probe schedule for a gitguard scenario:
+    ``(kind, identity_header, ref, new_sha)`` per probe, drawn from the
+    (seed, scenario) pair alone -- same plan, same probes, every
+    machine.  Kinds: own-namespace push (must land), sibling-namespace
+    and integration-branch pushes (must be refused at the proxy), and
+    an occasional merge-queue landing (the ONE identity allowed onto
+    the integration branch)."""
+    import random
+
+    rng = random.Random(
+        (int(seed) & 0xFFFFFFFF) * 7_919 + int(scenario) + 1)
+    probes: list[tuple[str, str, str, str]] = []
+    for _ in range(GITGUARD_PROBES):
+        kind = rng.choice(("own", "own", "own", "sibling", "sibling",
+                           "integration", "mergeq"))
+        a = rng.randrange(GITGUARD_AGENTS)
+        sha = format(rng.getrandbits(160), "040x")
+        if kind == "own":
+            ident = f"{GITGUARD_RUN}/agent-{a}"
+            ref = f"refs/heads/loop/{GITGUARD_RUN}/agent-{a}/work"
+        elif kind == "sibling":
+            other = (a + 1) % GITGUARD_AGENTS
+            ident = f"{GITGUARD_RUN}/agent-{a}"
+            ref = f"refs/heads/loop/{GITGUARD_RUN}/agent-{other}/work"
+        elif kind == "integration":
+            ident = f"{GITGUARD_RUN}/agent-{a}"
+            ref = f"refs/heads/loop/{GITGUARD_RUN}/merged"
+        else:
+            ident = f"{GITGUARD_RUN}/queue/mergeq"
+            ref = f"refs/heads/loop/{GITGUARD_RUN}/merged"
+        probes.append((kind, ident, ref, sha))
+    return probes
 SENTINEL_TRAIN_STEPS = 20       # one shape for every chaos sentinel fit:
 #                                 the soak and the observe-only twin share
 #                                 a single jit compilation per process
@@ -292,6 +334,34 @@ class ChaosRunner:
                     queue_high=10_000,      # growth off: event-driven only
                     idle_low=0.0,           # idle drains off: ditto
                     sustain_s=3600.0))
+        # gitguard scenarios (plan.gitguard): the run's git firewall
+        # proxy rides the scenario over an in-memory upstream,
+        # exercised by a deterministic protocol-level push-probe
+        # schedule (own-namespace allow, sibling deny, integration
+        # deny, an occasional merge-queue landing).  gitguard_down
+        # kills the proxy mid-run; every later probe must fail CLOSED
+        # (connection refused, recorded as such) -- the invariant
+        # audits the upstream's acknowledged log as ground truth
+        # (docs/git-policy.md; ref-isolation-at-proxy)
+        self.gitguard_srv = None
+        self.gitguard_upstream = None
+        self._gitguard_decisions: list[tuple[float, dict]] = []
+        self._gitguard_probes: list[dict] = []
+        self._gitguard_script: list[tuple[str, str, str, str]] = []
+        self._gitguard_downed_at: float | None = None
+        if plan.gitguard:
+            from ..gitguard import FakeGitUpstream, GitguardServer, RefPolicy
+
+            self.gitguard_upstream = FakeGitUpstream(
+                refs={"refs/heads/main": "a" * 40})
+            self.gitguard_srv = GitguardServer(
+                self.gitguard_upstream, RefPolicy(run=GITGUARD_RUN),
+                tcp_addr=("127.0.0.1", 0),
+                on_decision=lambda d: self._gitguard_decisions.append(
+                    (time.monotonic(), d.to_doc())))
+            self.gitguard_srv.start()
+            self._gitguard_script = gitguard_probe_script(
+                plan.seed, plan.scenario)
 
     @staticmethod
     def _sentinel_available() -> bool:
@@ -522,6 +592,71 @@ class ChaosRunner:
         _INJECTIONS.labels(ev.kind).inc()
         self.injected += 1
 
+    def _gitguard_probe(self) -> None:
+        """Fire the next scheduled push probe at the gitguard proxy:
+        one receive-pack POST carrying one ref update, identity in the
+        header (the shape Envoy stamps in production).  A probe against
+        a killed proxy must dial ECONNREFUSED -- recorded as
+        ``refused`` so the invariant can prove nothing landed after
+        the down (fail-closed, docs/git-policy.md)."""
+        if self.gitguard_srv is None or not self._gitguard_script:
+            return
+        import http.client
+
+        from ..gitguard.pktline import FLUSH_PKT, encode_pkt
+        from ..gitguard.refpolicy import IDENTITY_HEADER
+
+        kind, ident, ref, sha = self._gitguard_script.pop(0)
+        body = encode_pkt(
+            f"{'0' * 40} {sha} {ref}".encode() + b"\x00report-status\n"
+        ) + FLUSH_PKT
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", self.gitguard_srv.port, timeout=2.0)
+            conn.request(
+                "POST", "/chaos/git-receive-pack", body=body,
+                headers={IDENTITY_HEADER: ident, "Content-Type":
+                         "application/x-git-receive-pack-request"})
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            outcome = f"http_{resp.status}"
+        except OSError:
+            outcome = "refused"
+        self._gitguard_probes.append({
+            "kind": kind, "identity": ident, "ref": ref,
+            "t": time.monotonic(), "outcome": outcome})
+
+    def _apply_gitguard_fault(self, ev: FaultEvent) -> None:
+        """Kill the git firewall proxy mid-run.  The guard is the ONLY
+        git path (the co-installed egress rules pin ssh/22 + git/9418
+        shut), so a dead guard means pushes fail CLOSED -- later
+        probes must dial ECONNREFUSED and the invariant proves nothing
+        was acknowledged after this moment.  Never touches a worker's
+        engine: spurious-quarantine also proves a dead git proxy
+        cannot open a breaker."""
+        if self.gitguard_srv is not None:
+            self.gitguard_srv.close()
+            self._gitguard_downed_at = time.monotonic()
+        _INJECTIONS.labels(ev.kind).inc()
+        self.injected += 1
+
+    def _gitguard_audit(self) -> dict | None:
+        """Gitguard evidence for the invariant checker: the upstream's
+        acknowledged-update log (ground truth), the proxy's decision
+        stream, the probe outcomes, and when (if ever) the proxy was
+        killed.  None when the scenario ran without gitguard."""
+        if self.gitguard_upstream is None:
+            return None
+        return {
+            "run": GITGUARD_RUN,
+            "branch_prefix": "loop",
+            "downed_at": self._gitguard_downed_at,
+            "acknowledged": list(self.gitguard_upstream.acknowledged),
+            "decisions": list(self._gitguard_decisions),
+            "probes": list(self._gitguard_probes),
+        }
+
     def _arm_sigkill(self, ev: FaultEvent, sched=None) -> None:
         """Arm a crash seam on the current (or given) generation.
         Several seams may be armed at once -- whichever fires first
@@ -608,8 +743,18 @@ class ChaosRunner:
                     if now >= t0 + ev.at_s:
                         break
                     time.sleep(min(0.01, t0 + ev.at_s - now))
+                # gitguard scenarios interleave the deterministic push
+                # probes with the schedule: one probe per event slot,
+                # the remainder flushed after the heal -- probes before
+                # a gitguard_down exercise enforcement, probes after it
+                # prove fail-closed
+                self._gitguard_probe()
                 if ev.kind == "cli_sigkill":
                     self._arm_sigkill(ev)
+                elif ev.kind == "gitguard_down":
+                    # git-proxy faults hit the guard, never an engine:
+                    # the worker stays unfaulted
+                    self._apply_gitguard_fault(ev)
                 elif ev.kind in ("workerd_partition", "workerd_kill"):
                     # data-plane faults hit the workerd channel/daemon,
                     # never the engine: the worker stays unfaulted
@@ -649,6 +794,12 @@ class ChaosRunner:
             # servicing seams fired late (and the resumes they trigger)
             for i in range(self.plan.n_workers):
                 self.driver.clear_fault(i)
+            # flush the rest of the push-probe script (a gitguard_down
+            # in the schedule leaves these proving fail-closed); the
+            # guard itself is NOT healed -- a dead guard stays dead for
+            # the scenario, exactly the degrade the docs promise
+            while self._gitguard_script:
+                self._gitguard_probe()
             while time.monotonic() < deadline:
                 self._service_kill()
                 if self._run_done.is_set():
@@ -700,7 +851,8 @@ class ChaosRunner:
                 unfaulted=unfaulted, health=final.health,
                 kills=self.kills, sentinel=self.sentinel,
                 workerd=self._workerd_audit(),
-                shipper=self._shipper_audit()))
+                shipper=self._shipper_audit(),
+                gitguard=self._gitguard_audit()))
         except ClawkerError as e:
             runner_error = True
             result.violations.append(f"runner-error: {e}")
@@ -713,6 +865,8 @@ class ChaosRunner:
                 self.shipper.kill()
             if self.index is not None:
                 self.index.unstall()    # release any wedged sink thread
+            if self.gitguard_srv is not None:
+                self.gitguard_srv.close()
             if self.executors is not None:
                 self.executors.close_all()
             for srv in self.workerd_servers:
@@ -1039,6 +1193,21 @@ class ChaosController:
                     "chaos", "skipped",
                     f"{ev.kind}: seed stores are workerd-resident "
                     "(use the soak runner / `clawker chaos run`)")
+                continue
+            if ev.kind == "gitguard_down":
+                # kill the live run's git firewall proxy: every later
+                # agent push must fail CLOSED (the egress lane pins
+                # leave no other git path; docs/git-policy.md)
+                guard = getattr(self.sched, "gitguard", None)
+                if guard is not None:
+                    guard.close()
+                    _INJECTIONS.labels(ev.kind).inc()
+                    self.sched.on_event("chaos", "injected",
+                                        "gitguard_down (fail-closed)")
+                else:
+                    self.sched.on_event(
+                        "chaos", "skipped",
+                        f"{ev.kind}: no gitguard attached to this run")
                 continue
             if ev.kind in POD_GATE_MODE:
                 # pod-scope faults target every worker, no index check

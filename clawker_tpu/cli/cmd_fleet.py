@@ -417,6 +417,16 @@ def fleet_placement(f: Factory, policy, slots, probes, metrics_url, fmt,
         }
         if cstats.get("enabled"):
             doc["capacity"] = cstats
+        # per-run git firewall summary (docs/git-policy.md): which runs
+        # have a gitguard up, the egress rule set it installed (the
+        # ssh/git lane pins + guarded https hosts), and its decision
+        # tallies -- the placement view doubles as the "is the only git
+        # path the guarded one" check
+        grows = [{"run": r.get("run"), **(r.get("gitguard") or {})}
+                 for r in daemon_doc.get("runs", [])
+                 if (r.get("gitguard") or {}).get("enabled")]
+        if grows:
+            doc["gitguard"] = grows
         if fmt == "table":
             click.echo(f"source: loopd (pid {daemon_doc.get('pid')}, "
                        f"{len(daemon_doc.get('runs', []))} hosted "
@@ -524,6 +534,15 @@ def _render_placement(doc: dict, topo, fmt: str) -> None:
         for t, info in sorted((cstats.get("tenants") or {}).items()):
             click.echo(f"  slo {t}: {info.get('slo_s')}s "
                        f"headroom={info.get('headroom_s')}s")
+    for g in doc.get("gitguard") or []:
+        dec = g.get("decisions") or {}
+        tallies = " ".join(f"{k}={v}" for k, v in sorted(dec.items()))
+        click.echo(f"gitguard {g.get('run')}: "
+                   f"{'up' if g.get('running') else 'DOWN (fail-closed)'}"
+                   f" hosts={','.join(g.get('hosts') or []) or '-'}"
+                   + (f" {tallies}" if tallies else ""))
+        for key in g.get("rules") or []:
+            click.echo(f"  rule {key}")
     if unhealthy:
         raise SystemExit(1)
 

@@ -120,6 +120,15 @@ class _ClientInterrupt:
                    "from one base (never N clones); agent branches land "
                    "serially through the merge queue at iteration end "
                    "(settings loop.worktrees.*; docs/loop-worktrees.md).")
+@click.option("--gitguard/--no-gitguard", "gitguard", default=None,
+              help="Worktree runs only: route agent git traffic through "
+                   "the run's gitguard proxy -- advertisements hide "
+                   "out-of-namespace refs, pushes outside the agent's "
+                   "branch namespace are refused with a git-readable "
+                   "error, and run-scoped egress rules pin ssh/22 + "
+                   "git/9418 shut so guarded smart-HTTP is the only git "
+                   "path (default: settings gitguard.enable; "
+                   "docs/git-policy.md).")
 @click.option("--env", "env_kv", multiple=True, help="KEY=VAL extra agent env.")
 @click.option("--failover", type=click.Choice(["migrate", "wait", "fail"]),
               default=None,
@@ -193,16 +202,16 @@ class _ClientInterrupt:
 @click.pass_context
 def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
                placement, tenant, tenant_weight, max_inflight_per_worker,
-               warm_pool, image, prompt, worktrees, env_kv, failover,
-               orphan_grace, resume_run, metrics_port, sentinel_flag,
-               ship_telemetry, chaos_plan, as_json, keep, use_daemon,
-               use_workerd, detach, use_pods):
+               warm_pool, image, prompt, worktrees, gitguard, env_kv,
+               failover, orphan_grace, resume_run, metrics_port,
+               sentinel_flag, ship_telemetry, chaos_plan, as_json, keep,
+               use_daemon, use_workerd, detach, use_pods):
     """Fan autonomous agent loops across the runtime's workers."""
     if ctx.invoked_subcommand is not None:
         return
     _run_loops(f, parallel, iterations, placement, image, prompt, worktrees,
                env_kv, failover, orphan_grace, metrics_port, as_json, keep,
-               resume_run=resume_run, tenant=tenant,
+               gitguard=gitguard, resume_run=resume_run, tenant=tenant,
                tenant_weight=tenant_weight,
                max_inflight_per_worker=max_inflight_per_worker,
                warm_pool=warm_pool, sentinel_flag=sentinel_flag,
@@ -213,7 +222,7 @@ def loop_group(ctx: click.Context, f: Factory, parallel, iterations,
 
 def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
                worktrees, env_kv, failover, orphan_grace, metrics_port,
-               as_json, keep, resume_run=None, tenant=None,
+               as_json, keep, gitguard=None, resume_run=None, tenant=None,
                tenant_weight=None, max_inflight_per_worker=None,
                warm_pool=None, sentinel_flag=None, ship_telemetry=None,
                chaos_plan=None, use_daemon=None, use_workerd=None,
@@ -328,6 +337,7 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             image=image,
             prompt=prompt,
             worktrees=worktrees,
+            gitguard=gitguard,
             env=env,
             failover=failover or defaults.failover,
             orphan_grace_s=orphan_grace,
@@ -583,6 +593,7 @@ def _client_spec_doc(spec: LoopSpec) -> dict:
         "parallel": spec.parallel, "iterations": spec.iterations,
         "placement": spec.placement, "image": spec.image,
         "prompt": spec.prompt, "worktrees": spec.worktrees,
+        "gitguard": spec.gitguard,
         "workspace_mode": spec.workspace_mode,
         "agent_prefix": spec.agent_prefix, "env": dict(spec.env),
         "failover": spec.failover, "tenant": spec.tenant,

@@ -706,6 +706,29 @@ class CapacitySettings:
 
 
 @dataclass
+class GitguardSettings:
+    """The git-protocol firewall proxy for worktree swarms
+    (docs/git-policy.md).
+
+    With ``enable``, a ``clawker loop --worktrees`` run starts a
+    gitguard proxy on a hardened unix socket, installs run-scoped
+    egress rules (each host in ``hosts`` gets an https lane forced
+    through the guard plus ssh/22 and git/9418 deny pins), and tears
+    both down at cleanup.  The guard filters ref advertisements and
+    refuses out-of-namespace pushes per agent identity -- fail-closed:
+    with the guard down, every git path is a connection error."""
+
+    enable: bool = True             # guard worktree swarm runs
+    hosts: list[str] = field(default_factory=list)
+    #                                 git hosts to route through the guard
+    #                                 (empty = the run's own seed repo only)
+    socket: str = ""                # unix socket path override
+    #                                 ("" = <state>/gitguard/<run>.sock)
+    merge_identity: str = "mergeq"  # privileged role that may land the
+    #                                 integration branch
+
+
+@dataclass
 class CredentialSettings:
     """Host-credential staging policy (off by default).
 
@@ -737,6 +760,7 @@ class Settings:
     chaos: ChaosSettings = field(default_factory=ChaosSettings)
     sentinel: SentinelSettings = field(default_factory=SentinelSettings)
     capacity: CapacitySettings = field(default_factory=CapacitySettings)
+    gitguard: GitguardSettings = field(default_factory=GitguardSettings)
 
     @staticmethod
     def merge_strategies() -> dict[str, str]:
@@ -744,4 +768,5 @@ class Settings:
             "firewall.dns_upstreams": "union",
             "runtime.tpu.workers": "union",
             "federation.pods": "union",
+            "gitguard.hosts": "union",
         }

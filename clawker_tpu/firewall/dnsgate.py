@@ -228,6 +228,13 @@ class ZonePolicy:
             wild = dst.startswith("*.")
             apex = dst[2:] if wild else dst
             deny = getattr(rule, "action", "allow") == "deny"
+            if deny and (getattr(rule, "port", 0)
+                         or getattr(rule, "proto", "") in ("ssh", "git")):
+                # Port-scoped deny (gitguard's ssh/22 + git/9418 pins,
+                # docs/git-policy.md): the kernel denies exactly that
+                # port lane; the zone must keep RESOLVING so the host's
+                # other lanes (the guarded https path) stay reachable.
+                continue
             z = Zone(apex=apex, wildcard=wild, deny=deny)
             prev = zones.get((z.apex, z.wildcard, False))
             if prev is not None and prev.deny:

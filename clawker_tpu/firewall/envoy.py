@@ -44,6 +44,9 @@ class EnvoyBundle:
     config_yaml: str
     tcp_ports: dict[str, int] = field(default_factory=dict)  # rule.key() -> port
     mitm_domains: list[str] = field(default_factory=list)    # need CA-signed certs
+    gitguard_domains: list[str] = field(default_factory=list)
+    #                               MITM'd hosts routed via the gitguard
+    #                               pipe cluster (docs/git-policy.md)
 
 
 def _cluster_name(domain: str, port: int, *, tls: bool) -> str:
@@ -90,6 +93,51 @@ def _cluster(domain: str, port: int, *, tls: bool) -> dict:
 # for MITM/HTTP), so it cannot be pinned at config time.  Parity:
 # envoy_config.go:269-297 (httpsWildcardUpstreamLayer / httpWildcardUpstream
 # use DFP; exact rules keep pinned clusters).
+# The gitguard lane (docs/git-policy.md): MITM chains for git hosts
+# route allowed paths to the run's gitguard proxy over its hardened
+# unix socket instead of the real upstream.  The vhost strips any
+# client-supplied identity header and pins the mTLS-verified peer
+# subject -- the guard trusts the header precisely because only Envoy
+# can reach the 0600 socket.
+GITGUARD_CLUSTER = "gitguard"
+GITGUARD_IDENTITY_HEADER = "X-Clawker-Identity"
+
+
+def _gitguard_cluster(socket_path: str) -> dict:
+    return {
+        "name": GITGUARD_CLUSTER,
+        "type": "STATIC",
+        "connect_timeout": "5s",
+        "load_assignment": {
+            "cluster_name": GITGUARD_CLUSTER,
+            "endpoints": [{
+                "lb_endpoints": [{
+                    "endpoint": {
+                        "address": {"pipe": {"path": socket_path}}
+                    }
+                }]
+            }],
+        },
+    }
+
+
+def _pin_gitguard_identity(chain: dict) -> dict:
+    """Strip client identity headers and stamp the verified peer subject
+    on every vhost of a gitguard-routed MITM chain."""
+    for f in chain.get("filters", []):
+        rc = (f.get("typed_config") or {}).get("route_config")
+        for vh in (rc or {}).get("virtual_hosts", []):
+            vh["request_headers_to_remove"] = [GITGUARD_IDENTITY_HEADER]
+            vh["request_headers_to_add"] = [{
+                "header": {
+                    "key": GITGUARD_IDENTITY_HEADER,
+                    "value": "%DOWNSTREAM_PEER_SUBJECT%",
+                },
+                "append": False,
+            }]
+    return chain
+
+
 DFP_CACHE_PLAIN = "dfp_cache_plain"
 DFP_CACHE_TLS = "dfp_cache_tls"
 DFP_CLUSTER_PLAIN = "dfp_plain"
@@ -253,19 +301,22 @@ def _path_routes(rule: EgressRule, cluster: str) -> list[dict]:
     return routes
 
 
-def _mitm_chain(rule: EgressRule, cert_dir: str) -> dict:
+def _mitm_chain(rule: EgressRule, cert_dir: str,
+                cluster_override: str = "") -> dict:
     wildcard = rule.dst.startswith("*.")
     apex = rule.dst[2:] if wildcard else rule.dst
     # Wildcard: upstream host is the request authority (any subdomain), so
     # route through the TLS dynamic-forward-proxy cluster; exact: pinned.
-    cluster = (
+    # A cluster_override (the gitguard pipe cluster) wins over both:
+    # allowed paths land on the guard's unix socket, not the real host.
+    cluster = cluster_override or (
         DFP_CLUSTER_TLS
         if wildcard
         else _cluster_name(apex, rule.effective_port(), tls=True)
     )
     routes = _path_routes(rule, cluster)
     http_filters = []
-    if wildcard:
+    if wildcard and not cluster_override:
         http_filters.append(_dfp_http_filter(DFP_CACHE_TLS))
     http_filters.append({
         "name": "envoy.filters.http.router",
@@ -458,8 +509,16 @@ def generate_envoy_config(
     tls_port: int = consts.ENVOY_TLS_PORT,
     tcp_port_base: int = consts.ENVOY_TCP_PORT_BASE,
     admin_port: int = consts.ENVOY_HEALTH_PORT,
+    gitguard_hosts: tuple[str, ...] = (),
+    gitguard_socket: str = "",
 ) -> EnvoyBundle:
-    """Rule set -> (bootstrap YAML, sequential-listener allocation)."""
+    """Rule set -> (bootstrap YAML, sequential-listener allocation).
+
+    ``gitguard_hosts`` + ``gitguard_socket`` (both required together)
+    reroute those hosts' MITM chains to the gitguard proxy's unix
+    socket: the allowed smart-HTTP paths land on the guard, which
+    filters advertisements and judges pushes before anything reaches
+    the real upstream (docs/git-policy.md)."""
     ordered = sorted(
         {r.key(): r for r in rules}.values(), key=lambda r: r.key()
     )
@@ -497,6 +556,7 @@ def generate_envoy_config(
     tcp_ports: dict[str, int] = {}
     http_rules: list[EgressRule] = []
     mitm_domains: list[str] = []
+    gitguard_domains: list[str] = []
     next_port = tcp_port_base
 
     for rule in ordered:
@@ -510,16 +570,26 @@ def generate_envoy_config(
             # (firewall_test.go:653 DenySubdomainUnderWildcard).
             continue
         port = rule.effective_port()
+        guarded = bool(gitguard_socket) and apex in set(gitguard_hosts)
         if rule.proto == "https":
             if rule.needs_inspection():
-                tls_chains.append(cede_apex_to_exact(
-                    _mitm_chain(rule, cert_dir), rule))
+                if guarded:
+                    tls_chains.append(cede_apex_to_exact(
+                        _pin_gitguard_identity(_mitm_chain(
+                            rule, cert_dir,
+                            cluster_override=GITGUARD_CLUSTER)), rule))
+                    gitguard_domains.append(apex)
+                    clusters.setdefault(GITGUARD_CLUSTER,
+                                        _gitguard_cluster(gitguard_socket))
+                else:
+                    tls_chains.append(cede_apex_to_exact(
+                        _mitm_chain(rule, cert_dir), rule))
                 mitm_domains.append(apex)
-                if wildcard:
+                if wildcard and not guarded:
                     clusters.setdefault(
                         DFP_CLUSTER_TLS,
                         _dfp_cluster(DFP_CLUSTER_TLS, DFP_CACHE_TLS, tls=True))
-                else:
+                elif not guarded:
                     clusters.setdefault(_cluster_name(apex, port, tls=True),
                                         _cluster(apex, port, tls=True))
             else:
@@ -610,6 +680,7 @@ def generate_envoy_config(
         config_yaml=yaml.safe_dump(bootstrap, sort_keys=True),
         tcp_ports=tcp_ports,
         mitm_domains=sorted(set(mitm_domains)),
+        gitguard_domains=sorted(set(gitguard_domains)),
     )
 
 

@@ -270,6 +270,17 @@ def build_routes(rules, *, envoy_ip: str, tls_port: int,
         zh = zone_hash(apex)
         port = rule.effective_port()
         if getattr(rule, "action", "allow") == "deny":
+            if rule.port or rule.proto in ("ssh", "git"):
+                # Port-scoped deny (gitguard's ssh/22 + git/9418 pins,
+                # docs/git-policy.md): deny exactly this port lane --
+                # written AFTER allows, so it beats a same-key allow --
+                # while the zone's other lanes (the guarded https path)
+                # stay live and the DNS gate keeps resolving the host.
+                table[RouteKey(zh, port, PROTO_TCP)] = RouteVal(Action.DENY)
+                if rule.proto == "udp":
+                    table[RouteKey(zh, port, PROTO_UDP)] = RouteVal(
+                        Action.DENY)
+                continue
             # Defense in depth behind the DNS-gate NXDOMAIN: even a stale
             # dns_cache entry for the denied zone denies on every port.
             table[RouteKey(zh, 0, PROTO_TCP)] = RouteVal(Action.DENY)

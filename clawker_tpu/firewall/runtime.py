@@ -121,6 +121,9 @@ def build_handler(
         dns_host=dns_host,
         dns_port=dns_port,
         upstreams=tuple(cfg.settings.firewall.dns_upstreams) or consts.UPSTREAM_DNS,
+        gitguard_hosts=(tuple(cfg.settings.gitguard.hosts)
+                        if cfg.settings.gitguard.enable else ()),
+        gitguard_socket=cfg.settings.gitguard.socket,
     )
     return FirewallHandler(
         stack=stack,

@@ -55,6 +55,8 @@ class FirewallStack:
         dns_host: str = "",
         dns_port: int = consts.DNS_PORT,
         upstreams: tuple[str, ...] = consts.UPSTREAM_DNS,
+        gitguard_hosts: tuple[str, ...] = (),
+        gitguard_socket: str = "",
     ):
         self.engine = engine
         self.maps = maps
@@ -63,6 +65,12 @@ class FirewallStack:
         self.dns_host = dns_host
         self.dns_port = dns_port
         self.upstreams = upstreams
+        # git hosts whose MITM chain routes through the gitguard proxy
+        # socket instead of dynamic-forward-proxy (docs/git-policy.md);
+        # only armed when settings name a STABLE socket -- per-run
+        # sockets are enforced at the proxy itself
+        self.gitguard_hosts = tuple(gitguard_hosts)
+        self.gitguard_socket = gitguard_socket
         self.gate: DnsGate | None = None
         self.bundle: EnvoyBundle | None = None
 
@@ -99,7 +107,10 @@ class FirewallStack:
         while the previous config keeps serving (envoy_validate.go)."""
         from .envoy import validate_bundle
 
-        bundle = generate_envoy_config(rules, cert_dir=ENVOY_CONF_MOUNT + "/certs")
+        bundle = generate_envoy_config(
+            rules, cert_dir=ENVOY_CONF_MOUNT + "/certs",
+            gitguard_hosts=self.gitguard_hosts,
+            gitguard_socket=self.gitguard_socket)
         errs = validate_bundle(bundle)
         if errs:
             raise ClawkerError(

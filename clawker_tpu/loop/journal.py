@@ -88,6 +88,16 @@ REC_SEED_SHIP = "seed_ship"     # seed shipped to a worker's seed store
 #                                 (digest, worker) pair per generation)
 REC_SEED_WORKTREE = "seed_worktree"  # agent worktree provisioned:
 #                                 branch + path (pre-`worktree add` WAL)
+# gitguard (clawker_tpu/gitguard, docs/git-policy.md): the git-protocol
+# firewall for worktree swarms.  Rule installs are journaled
+# write-ahead so a --resume (or post-SIGKILL cleanup) tears down
+# exactly the run-scoped egress rules this run added -- never a user's
+# standing rules; every proxy verdict lands as a decision record, the
+# evidence stream the chaos ref-isolation-at-proxy invariant audits.
+REC_GITGUARD_RULES = "gitguard_rules"      # run-scoped git egress rules
+#                                 installed (pre-add WAL: rule keys)
+REC_GITGUARD_DECISION = "gitguard_decision"  # one proxy verdict
+#                                 (allow/deny/down_refused + ref/agent)
 # elastic-capacity decisions (clawker_tpu/capacity,
 # docs/elastic-capacity.md): pool targets, token caps, queue-mode
 # flips, and fleet provision/drain -- journaled through the same WAL so
@@ -275,6 +285,13 @@ class RunImage:
     #                             write-ahead; resume RE-ATTACHES these
     #                             via the idempotent setup_worktree path
     #                             instead of creating duplicates
+    gitguard_rules: list[str] = field(default_factory=list)
+    #                             egress rule keys this run installed for
+    #                             the gitguard lane (pre-add WAL): resume
+    #                             re-arms teardown for exactly these keys
+    gitguard_decisions: dict[str, int] = field(default_factory=dict)
+    #                             verdict -> count folded from decision
+    #                             records (status/summary surfaces)
 
 
 def replay(records: list[dict]) -> RunImage:
@@ -357,6 +374,16 @@ def replay(records: list[dict]) -> RunImage:
                     "branch": str(rec.get("branch", "")),
                     "base": str(rec.get("base", "")),
                 }
+            continue
+        if kind == REC_GITGUARD_RULES:
+            for key in rec.get("keys") or []:
+                if str(key) not in img.gitguard_rules:
+                    img.gitguard_rules.append(str(key))
+            continue
+        if kind == REC_GITGUARD_DECISION:
+            verdict = str(rec.get("verdict", "")) or "unknown"
+            img.gitguard_decisions[verdict] = (
+                img.gitguard_decisions.get(verdict, 0) + 1)
             continue
         if kind in (REC_POOL_ADD, REC_POOL_READY, REC_POOL_ADOPT,
                     REC_POOL_REMOVE):

@@ -101,9 +101,11 @@ from ..fleet.inventory import pod_topology
 from ..health import BREAKER_CLOSED, BREAKER_OPEN, HealthConfig, HealthMonitor
 from ..monitor.events import (
     CAPACITY_DECISION,
+    GITGUARD_DECISION,
     PLACEMENT_DECISION,
     TRACE_SPAN,
     EventBus,
+    GitguardDecisionEvent,
     PlacementEvent,
 )
 from ..placement import (
@@ -141,6 +143,8 @@ from .journal import (
     REC_MIGRATED,
     REC_ORPHANED,
     REC_PLACEMENT,
+    REC_GITGUARD_DECISION,
+    REC_GITGUARD_RULES,
     REC_POOL_REMOVE,
     REC_RESUME,
     REC_RUN,
@@ -234,6 +238,10 @@ class LoopSpec:
     image: str = "@"
     prompt: str = ""                 # handed to the harness via env
     worktrees: bool = False          # one git worktree per agent loop
+    gitguard: bool | None = None     # git-protocol firewall for worktree
+    #                                  runs (docs/git-policy.md): None =
+    #                                  settings gitguard.enable; only
+    #                                  meaningful with worktrees
     workspace_mode: str = ""         # default: snapshot (isolation per
     #                                  loop); with --worktrees the default
     #                                  comes from settings
@@ -575,6 +583,14 @@ class LoopScheduler:
             if wts.merge_queue:
                 self.mergeq = MergeQueue(retry_s=wts.merge_retry_s,
                                          max_attempts=wts.merge_attempts)
+        # --- gitguard (docs/git-policy.md): the git-protocol firewall
+        # for worktree swarms.  start() journals + installs run-scoped
+        # egress rules (git hosts via the guard, ssh/git-protocol
+        # denied) and brings the proxy up on a hardened unix socket;
+        # cleanup()/--resume tear down exactly the journaled rule keys.
+        self.gitguard = None                    # GitguardServer when armed
+        self._gitguard_rule_keys: list[str] = []
+        self._gitguard_decisions: dict[str, int] = {}
 
     def _bind_worktrees(self) -> bool:
         """True when this run's worktrees are HOST-LOCAL bind mounts --
@@ -1434,6 +1450,139 @@ class LoopScheduler:
         self._branches[agent] = branch
         return info.path, gm.git_dir()
 
+    # ---------------------------------------------------------- gitguard
+
+    def _gitguard_armed(self) -> bool:
+        """--worktrees runs guard their git lane unless explicitly
+        opted out (spec.gitguard False) or disabled in settings."""
+        if not self.spec.worktrees:
+            return False
+        if self.spec.gitguard is not None:
+            return self.spec.gitguard
+        return bool(self.cfg.settings.gitguard.enable)
+
+    def _gitguard_socket_path(self) -> Path:
+        gs = self.cfg.settings.gitguard
+        if gs.socket:
+            return Path(gs.socket)
+        return self.cfg.data_dir / "gitguard" / f"{self.loop_id}.sock"
+
+    def _gitguard_setup(self) -> None:
+        """Arm the git firewall for this run (docs/git-policy.md).
+
+        Two mutations, both fail-closed on error: (1) run-scoped egress
+        rules -- each configured git host's https lane forced through
+        the guard plus ssh/22 + git/9418 deny pins -- journaled
+        write-ahead (REC_GITGUARD_RULES, durable, NEW keys only, so
+        teardown after any crash/resume removes exactly what this run
+        added and never a user's standing rule); (2) the proxy itself
+        on a hardened unix socket over the run's seed repository.
+        Every proxy verdict journals, rides the bus typed, and counts
+        in the gitguard_* metrics."""
+        if not self._gitguard_armed() or self.gitguard is not None:
+            return
+        from ..firewall.rules import RulesStore
+        from ..gitguard import (
+            GitguardServer,
+            LocalRepoUpstream,
+            RefPolicy,
+            git_egress_rules,
+        )
+        from ..gitx.git import GitManager
+
+        gs = self.cfg.settings.gitguard
+        wts = self.cfg.settings.loop.worktrees
+        root = self.cfg.project_root or Path.cwd()
+        gm = GitManager(root)
+        base = wts.base
+        if base == "HEAD":
+            try:
+                base = gm.current_branch() or "main"
+            except ClawkerError:
+                base = "main"
+        merge_ref = (f"refs/heads/{wts.merge_into}" if wts.merge_into
+                     else "")
+        policy = RefPolicy(
+            run=self.loop_id, branch_prefix=wts.branch_prefix,
+            base_refs=(f"refs/heads/{base}",), merge_ref=merge_ref)
+        # rule install: WAL the keys this run is about to ADD (a key
+        # already in the store belongs to the user and is never listed,
+        # so teardown cannot eat it), then mutate the store
+        try:
+            store = RulesStore(self.cfg.egress_rules_path)
+            have = {r.key() for r in store.load()}
+            rules = git_egress_rules(list(gs.hosts))
+            fresh = [r for r in rules if r.key() not in have]
+            keys = [r.key() for r in fresh]
+            if keys:
+                self._journal(REC_GITGUARD_RULES, durable=True, keys=keys,
+                              hosts=list(gs.hosts))
+                if self.journal is not None:
+                    self.journal.sync()
+                store.add(fresh)
+                for k in keys:
+                    if k not in self._gitguard_rule_keys:
+                        self._gitguard_rule_keys.append(k)
+        except ClawkerError as e:
+            self.on_event("scheduler", "gitguard_rules_failed", str(e))
+            log.error("loop %s: gitguard rule install failed: %s",
+                      self.loop_id, e)
+        try:
+            self.gitguard = GitguardServer(
+                LocalRepoUpstream(root), policy,
+                socket_path=self._gitguard_socket_path(),
+                on_decision=self._on_gitguard_decision).start()
+            self.on_event("scheduler", "gitguard_up",
+                          str(self._gitguard_socket_path()))
+        except (ClawkerError, OSError) as e:
+            # fail-closed either way: with the deny pins installed and
+            # no guard socket, every git path is a connection error --
+            # but say so loudly, the run's pushes will all refuse
+            self.gitguard = None
+            self.on_event("scheduler", "gitguard_start_failed", str(e))
+            log.error("loop %s: gitguard start failed (git lane is "
+                      "fail-closed): %s", self.loop_id, e)
+
+    def _on_gitguard_decision(self, d) -> None:
+        self._gitguard_decisions[d.verdict] = (
+            self._gitguard_decisions.get(d.verdict, 0) + 1)
+        self._journal(REC_GITGUARD_DECISION, **d.to_doc())
+        self.on_event("scheduler", GITGUARD_DECISION, GitguardDecisionEvent(
+            d.verdict, d.service, d.ref, d.reason).detail())
+
+    def _gitguard_teardown(self) -> None:
+        """Stop the proxy and remove exactly the journaled rule keys."""
+        guard, self.gitguard = self.gitguard, None
+        if guard is not None:
+            try:
+                guard.close()
+            except Exception:   # noqa: BLE001 -- teardown is best-effort
+                pass
+        keys, self._gitguard_rule_keys = self._gitguard_rule_keys, []
+        if keys:
+            try:
+                from ..firewall.rules import RulesStore
+                store = RulesStore(self.cfg.egress_rules_path)
+                for key in keys:
+                    store.remove(key)
+            except ClawkerError as e:
+                log.warning("loop %s: gitguard rule teardown failed: %s",
+                            self.loop_id, e)
+
+    def gitguard_summary(self) -> dict:
+        """Status surface: what the git firewall is enforcing for this
+        run (loopd status, `clawker fleet placement` run summary)."""
+        gs = self.cfg.settings.gitguard
+        return {
+            "enabled": self._gitguard_armed(),
+            "running": self.gitguard is not None,
+            "socket": str(self._gitguard_socket_path())
+            if self._gitguard_armed() else "",
+            "hosts": list(gs.hosts),
+            "rules": list(self._gitguard_rule_keys),
+            "decisions": dict(self._gitguard_decisions),
+        }
+
     # ------------------------------------------------------- merge queue
 
     def _merge_target(self) -> str:
@@ -1541,6 +1690,10 @@ class LoopScheduler:
                           tenant=self.spec.tenant)
         if self.journal is not None:
             self.journal.sync()
+        # the git firewall arms BEFORE any launch is submitted: an
+        # agent's very first iteration must already find ssh/git-proto
+        # denied and the guarded smart-HTTP lane the only git path
+        self._gitguard_setup()
         self.seams.fire("run.post_placement")
         for loop in self.loops:
             note_decision(self.policy.name, loop.worker.id)
@@ -1559,7 +1712,8 @@ class LoopScheduler:
         return {
             "parallel": s.parallel, "iterations": s.iterations,
             "placement": s.placement, "image": s.image, "prompt": s.prompt,
-            "worktrees": s.worktrees, "workspace_mode": s.workspace_mode,
+            "worktrees": s.worktrees, "gitguard": s.gitguard,
+            "workspace_mode": s.workspace_mode,
             "agent_prefix": s.agent_prefix, "env": dict(s.env),
             "failover": s.failover,
             "tenant": s.tenant, "tenant_weight": s.tenant_weight,
@@ -1611,6 +1765,7 @@ class LoopScheduler:
             image=str(sd.get("image") or "@"),
             prompt=str(sd.get("prompt") or ""),
             worktrees=bool(sd.get("worktrees") or False),
+            gitguard=sd.get("gitguard"),
             workspace_mode=str(sd.get("workspace_mode") or ""),
             agent_prefix=str(sd.get("agent_prefix") or "loop"),
             env={str(k): str(v) for k, v in (sd.get("env") or {}).items()},
@@ -1638,6 +1793,15 @@ class LoopScheduler:
         for agent, wt in image.worktrees.items():
             if wt.get("branch"):
                 sched._branches[agent] = str(wt["branch"])
+        # gitguard rules the dead generation installed: re-arm teardown
+        # for exactly these keys (the WAL's pre-add record), and fold
+        # the journaled verdict counts back into the status surface;
+        # the proxy itself re-arms through the ordinary setup when the
+        # resumed generation starts its first launch batch
+        sched._gitguard_rule_keys = list(image.gitguard_rules)
+        sched._gitguard_decisions = dict(image.gitguard_decisions)
+        if sched._gitguard_armed():
+            sched._gitguard_setup()
         sched._build_resumed_loops(image)
         sched._journal(REC_RESUME, durable=True,
                        generation=image.generation + 1,
@@ -3036,6 +3200,11 @@ class LoopScheduler:
                                    or Path.cwd()).prune_worktrees()
                 except ClawkerError:
                     pass
+            # git firewall: stop the proxy, remove the run-scoped rules
+            # (exactly the journaled keys).  A kill() skips this like
+            # everything else -- the next --resume replays the WAL and
+            # tears down then.
+            self._gitguard_teardown()
         # the warm pool drains unconditionally (even under --keep): its
         # members are framework plumbing, not user containers, and
         # "zero leaked pool containers after drain" is the contract.

@@ -97,6 +97,8 @@ def spec_from_doc(doc: dict) -> LoopSpec:
         image=str(doc.get("image") or "@"),
         prompt=str(doc.get("prompt") or ""),
         worktrees=bool(doc.get("worktrees") or False),
+        gitguard=(bool(doc["gitguard"])
+                  if doc.get("gitguard") is not None else None),
         workspace_mode=str(doc.get("workspace_mode") or ""),
         agent_prefix=str(doc.get("agent_prefix") or "loop"),
         env={str(k): str(v) for k, v in (doc.get("env") or {}).items()},
@@ -214,6 +216,8 @@ class _DaemonRun:
             "iterations": self.spec.iterations,
             "placement": self.spec.placement,
             "agents": sched.status() if sched is not None else [],
+            "gitguard": (sched.gitguard_summary()
+                         if sched is not None else {"enabled": False}),
             "subscribers": len(self.subs),
             "events_dropped": self.dropped,
             **({"ok": self.result.get("ok")} if self.done.is_set() else {}),

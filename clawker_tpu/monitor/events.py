@@ -92,6 +92,43 @@ class CapacityDecisionEvent:
         return cls(kind, "" if worker == "-" else worker, value, reason)
 
 
+# Event name gitguard proxy verdicts ride the bus under
+# (clawker_tpu/gitguard + docs/git-policy.md): every advertisement
+# filter / push refusal / allow the git firewall made for this run,
+# typed so status surfaces and tests can replay what was enforced.
+GITGUARD_DECISION = "gitguard.decision"
+
+
+@dataclass(frozen=True)
+class GitguardDecisionEvent:
+    """Typed payload of a ``gitguard.decision`` event.
+
+    ``verdict`` is ``allow`` / ``deny`` / ``down_refused``; ``service``
+    the smart-HTTP service judged (``git-receive-pack`` for pushes,
+    ``git-upload-pack`` for fetch wants); ``ref`` the ref the verdict
+    is about; ``reason`` the git-readable refusal text ("" on allow).
+    Rides as the detail string like the other typed events; structured
+    consumers round-trip with :meth:`parse`.
+    """
+
+    verdict: str
+    service: str
+    ref: str
+    reason: str = ""
+
+    def detail(self) -> str:
+        base = f"{self.verdict} {self.service or '-'} {self.ref or '-'}"
+        return f"{base}: {self.reason}" if self.reason else base
+
+    @classmethod
+    def parse(cls, detail: str) -> "GitguardDecisionEvent":
+        head, _, reason = detail.partition(": ")
+        verdict, _, rest = head.partition(" ")
+        service, _, ref = rest.partition(" ")
+        return cls(verdict, "" if service == "-" else service,
+                   "" if ref == "-" else ref, reason)
+
+
 @dataclass(frozen=True)
 class AnomalyFlagEvent:
     """Typed payload of an ``anomaly.flag`` event.

@@ -39,6 +39,11 @@ Gates:
   client's submit_run frame to the loopd daemon's ack over the unix
   socket, every daemon-hosted run completing ok (ISSUE 9 acceptance
   bar; two noisy misses re-measured, best attempt gated)
+- gitguard_push_overhead_p50 <= bench.GITGUARD_PUSH_OVERHEAD_BUDGET_MS
+  ms added per push round-trip by the git-protocol-aware firewall
+  proxy (identity check + pkt-line parse + policy verdict + relay) on
+  top of the raw upstream apply, every guarded push acknowledged
+  (ISSUE 18 acceptance bar; two noisy misses re-measured)
 - cross_process_fairness: TWO client processes submitting to one loopd
   -- the daemon-side launch high-water mark holds the shared admission
   cap and the WFQ interleaves the tenants (neither starved); the
@@ -188,6 +193,7 @@ def main() -> int:
         STAMPEDE_BUDGET_S,
         TELEMETRY_BUDGET_NS,
         TELEMETRY_DISABLED_BUDGET_NS,
+        GITGUARD_PUSH_OVERHEAD_BUDGET_MS,
         LOOPD_SUBMIT_BUDGET_MS,
         WARM_POOL_BURST_BUDGET_S,
         WARM_POOL_HIT_BUDGET_MS,
@@ -208,6 +214,7 @@ def main() -> int:
         bench_failover,
         bench_federation_fanout_n512,
         bench_fleet_provision,
+        bench_gitguard_push_overhead,
         bench_ingest_lag,
         bench_loop_fanout,
         bench_loop_fanout_n64,
@@ -255,6 +262,25 @@ def main() -> int:
         retry = bench_loopd_submit_roundtrip()
         if retry["submit_p50_ms"] < loopd_rt["submit_p50_ms"]:
             loopd_rt = retry
+    def _gitguard_green(r: dict) -> bool:
+        return (r["all_acked"] and r["pushes_measured"] == r["iters"]
+                and r["overhead_p50_ms"] <= GITGUARD_PUSH_OVERHEAD_BUDGET_MS)
+
+    gitguard_rt = bench_gitguard_push_overhead()
+    for _ in range(2):
+        # a millisecond-scale overhead delta is tight against scheduler
+        # noise on a shared box: a miss gets two re-measures and the
+        # best attempt is gated (the gate judges the proxy's cost, not
+        # how busy the CI host was)
+        if _gitguard_green(gitguard_rt):
+            break
+        retry = bench_gitguard_push_overhead()
+        if _gitguard_green(retry) or (
+                retry["all_acked"]
+                and retry["pushes_measured"] == retry["iters"]
+                and retry["overhead_p50_ms"]
+                < gitguard_rt["overhead_p50_ms"]):
+            gitguard_rt = retry
     fairness = bench_cross_process_fairness()
     fed = bench_federation_fanout_n512()
     fed_mig = bench_pod_failover_migrate()
@@ -444,6 +470,19 @@ def main() -> int:
         failures.append(
             f"loopd_submit_roundtrip_p50 {loopd_rt['submit_p50_ms']}ms > "
             f"{LOOPD_SUBMIT_BUDGET_MS}ms budget")
+    if not gitguard_rt["all_acked"] \
+            or gitguard_rt["pushes_measured"] != gitguard_rt["iters"]:
+        failures.append(
+            f"gitguard_push_overhead_p50: only "
+            f"{gitguard_rt['pushes_measured']}/{gitguard_rt['iters']} "
+            "guarded pushes landed and were acknowledged -- an overhead "
+            "measured on refused pushes proves nothing")
+    elif gitguard_rt["overhead_p50_ms"] > GITGUARD_PUSH_OVERHEAD_BUDGET_MS:
+        failures.append(
+            f"gitguard_push_overhead_p50 {gitguard_rt['overhead_p50_ms']}ms"
+            f" > {GITGUARD_PUSH_OVERHEAD_BUDGET_MS}ms budget (guarded "
+            f"{gitguard_rt['guarded_p50_ms']}ms vs direct "
+            f"{gitguard_rt['direct_p50_ms']}ms)")
     if not fairness["both_ok"]:
         failures.append("cross_process_fairness: a client process's run "
                         "failed" + (": " + fairness.get("error", "")
@@ -622,6 +661,7 @@ def main() -> int:
         "warm_pool_hit_p50": pool_hit,
         "warm_pool_refill_burst": pool_burst,
         "loopd_submit_roundtrip_p50": loopd_rt,
+        "gitguard_push_overhead_p50": gitguard_rt,
         "cross_process_fairness": fairness,
         "federation_fanout_p50_n512": fed,
         "pod_failover_migrate_s": fed_mig,

@@ -1,0 +1,676 @@
+"""gitguard suite: the git-protocol-aware firewall proxy (ISSUE 18).
+
+The acceptance shape: the pkt-line codec survives an adversarial
+corpus (torn frames, oversized lengths, the reserved ``0003``) by
+raising instead of buffering attacker-chosen lengths; ``RefPolicy``
+enforces branch-per-agent namespacing with the integration branch
+merge-queue-only; the protocol filter hides sibling refs from
+advertisements (re-homing the capability suffix) and refuses
+out-of-namespace pushes *atomically* and in-protocol; the proxy
+end-to-end refuses what policy refuses -- against the fake upstream
+*and* against a real ``git push`` -- and fails CLOSED when killed;
+the chaos rider keeps plan schedules deterministic and the
+``ref-isolation-at-proxy`` invariant actually fires on a poisoned
+acknowledged log; and a ``--worktrees`` scheduler run arms the guard,
+journals its egress rule keys write-ahead, and tears both down.
+"""
+
+from __future__ import annotations
+
+import http.client
+import subprocess
+
+import pytest
+
+from clawker_tpu import consts
+from clawker_tpu.chaos import FaultEvent, FaultPlan, generate_plan
+from clawker_tpu.chaos.invariants import check_invariants
+from clawker_tpu.chaos.runner import ChaosRunner, gitguard_probe_script
+from clawker_tpu.config import load_config
+from clawker_tpu.engine.drivers import FakeDriver
+from clawker_tpu.engine.fake import exit_behavior
+from clawker_tpu.firewall.rules import RulesStore
+from clawker_tpu.gitguard import (
+    FakeGitUpstream,
+    GitguardServer,
+    LocalRepoUpstream,
+    RefPolicy,
+    git_egress_rules,
+)
+from clawker_tpu.gitguard.pktline import (
+    FLUSH_PKT,
+    MAX_PKT_PAYLOAD,
+    PktError,
+    TruncatedPkt,
+    decode_sideband,
+    encode_pkt,
+    encode_sideband,
+    iter_pkts,
+)
+from clawker_tpu.gitguard.protocol import (
+    filter_advertisement,
+    filter_ls_refs,
+    parse_receive_commands,
+    refusal_response,
+)
+from clawker_tpu.gitguard.refpolicy import (
+    IDENTITY_HEADER,
+    AgentIdentity,
+    RefPolicy as Policy,
+)
+from clawker_tpu.loop import LoopScheduler, LoopSpec
+from clawker_tpu.loop.journal import (
+    REC_GITGUARD_RULES,
+    RunJournal,
+    journal_path,
+    replay,
+)
+from clawker_tpu.testenv import TestEnv
+
+SHA_A = "a" * 40
+SHA_B = "b" * 40
+ZERO = "0" * 40
+
+IMAGE = "clawker-ggproj:default"
+
+
+# ------------------------------------------------------------- pkt-line
+
+
+def test_pktline_roundtrip_golden():
+    body = (encode_pkt("hello\n") + FLUSH_PKT + b"0001" +
+            encode_pkt(b"raw-bytes") + b"0002")
+    kinds = [(p.kind, p.payload) for p in iter_pkts(body)]
+    assert kinds == [("data", b"hello\n"), ("flush", b""),
+                     ("delim", b""), ("data", b"raw-bytes"),
+                     ("response-end", b"")]
+    # the canonical git example: "0006a\n"
+    assert encode_pkt("a\n") == b"0006a\n"
+
+
+def test_pktline_adversarial_corpus():
+    # bad hex in the length header
+    with pytest.raises(PktError):
+        list(iter_pkts(b"zzzzoops"))
+    # reserved 0003 (git treats it as an error, never a 0-byte line)
+    with pytest.raises(PktError, match="reserved"):
+        list(iter_pkts(b"0003"))
+    # oversized length header: fail closed, never buffer it
+    with pytest.raises(PktError, match="oversized"):
+        list(iter_pkts(b"fff5" + b"x" * 100))
+    # torn frame: header promises more bytes than the buffer holds
+    torn = encode_pkt("ok\n") + b"0040only-ten"
+    with pytest.raises(TruncatedPkt) as ei:
+        list(iter_pkts(torn))
+    assert ei.value.consumed == len(encode_pkt("ok\n"))
+    # ... but a streaming proxy may tolerate exactly that
+    assert [p.payload for p in iter_pkts(torn, tolerate_truncated=True)
+            ] == [b"ok\n"]
+    # torn length header itself (< 4 bytes left)
+    with pytest.raises(TruncatedPkt):
+        list(iter_pkts(encode_pkt("x") + b"00"))
+
+
+def test_encode_pkt_rejects_oversized_payload():
+    assert len(encode_pkt(b"x" * MAX_PKT_PAYLOAD)) == MAX_PKT_PAYLOAD + 4
+    with pytest.raises(PktError):
+        encode_pkt(b"x" * (MAX_PKT_PAYLOAD + 1))
+
+
+def test_sideband_roundtrip_and_split():
+    payload = b"status " * 20_000          # > one 64k frame
+    framed = encode_sideband(1, payload) + encode_sideband(3, b"oops")
+    data, _progress, error = decode_sideband(framed)
+    assert data == payload and error == b"oops"
+    # every frame stays within the pkt-line cap
+    assert all(len(p.payload) <= MAX_PKT_PAYLOAD
+               for p in iter_pkts(framed))
+
+
+# ------------------------------------------------------------ refpolicy
+
+
+def test_identity_from_header_shapes():
+    assert AgentIdentity.from_header("r1/a0") == AgentIdentity("r1", "a0")
+    mq = AgentIdentity.from_header("r1/a0/mergeq")
+    assert mq is not None and mq.merge_queue
+    assert mq.header_value() == "r1/a0/mergeq"
+    for bad in ("", "one-part", "a/b/c/d", "//", None):
+        assert AgentIdentity.from_header(bad or "") is None
+
+
+def test_may_read_visibility():
+    pol = Policy(run="r1")
+    a0 = AgentIdentity("r1", "a0")
+    mq = AgentIdentity("r1", "q", role="mergeq")
+    own = "refs/heads/loop/r1/a0"
+    sibling = "refs/heads/loop/r1/a1"
+    # anonymous: HEAD + base refs only
+    assert pol.may_read(None, "HEAD")
+    assert pol.may_read(None, "refs/heads/main")
+    assert not pol.may_read(None, own)
+    # an agent: base refs + its own namespace, never a sibling's
+    assert pol.may_read(a0, own) and pol.may_read(a0, own + "/wip")
+    assert not pol.may_read(a0, sibling)
+    assert not pol.may_read(a0, own + "-suffix")    # prefix, not ns
+    # the merge queue must see everything to land it
+    assert pol.may_read(mq, sibling)
+
+
+def test_may_update_matrix():
+    pol = Policy(run="r1")
+    a0 = AgentIdentity("r1", "a0")
+    mq = AgentIdentity("r1", "q", role="mergeq")
+    own = "refs/heads/loop/r1/a0"
+    integration = pol.integration_ref()
+    assert integration == "refs/heads/loop/r1/merged"
+    assert pol.may_update(a0, own).allowed
+    assert pol.may_update(a0, own + "/topic").allowed
+    d = pol.may_update(a0, "refs/heads/loop/r1/a1")
+    assert not d.allowed and "namespace" in d.reason
+    d = pol.may_update(a0, integration)
+    assert not d.allowed and "merge-queue" in d.reason
+    assert pol.may_update(mq, integration).allowed
+    d = pol.may_update(None, own)
+    assert not d.allowed and "unauthenticated" in d.reason
+    d = pol.may_update(AgentIdentity("other-run", "a0"), own)
+    assert not d.allowed and "match" in d.reason
+
+
+def test_hostile_ref_names_refused():
+    pol = Policy(run="r1")
+    a0 = AgentIdentity("r1", "a0")
+    ns = "refs/heads/loop/r1/a0"
+    for ref in ("", ns + "/\x00evil", ns + "/b\x07ell", ns + "/../../x",
+                "no-refs-prefix", ns + "/", ns + "/x.lock", ns + "//y"):
+        assert not pol.may_update(a0, ref).allowed, ref
+
+
+def test_git_egress_rules_shape():
+    rules = git_egress_rules(["github.com"])
+    by_key = {r.key(): r for r in rules}
+    assert set(by_key) == {"github.com:https:443", "github.com:ssh:22",
+                           "github.com:git:9418"}
+    assert by_key["github.com:https:443"].action == "allow"
+    # the pins that make the guarded lane the ONLY git path
+    assert by_key["github.com:ssh:22"].action == "deny"
+    assert by_key["github.com:git:9418"].action == "deny"
+
+
+# ------------------------------------------------------------- protocol
+
+
+def _advertise(refs: dict[str, str]) -> bytes:
+    return FakeGitUpstream(refs=dict(refs)).advertise("git-receive-pack")
+
+
+def test_filter_advertisement_hides_and_rehomes_caps():
+    refs = {"refs/heads/main": SHA_A,
+            "refs/heads/loop/r1/a0": SHA_B,
+            "refs/heads/loop/r1/a1": SHA_B}
+    pol = Policy(run="r1")
+    body, hidden = filter_advertisement(
+        _advertise(refs), "git-receive-pack", pol,
+        AgentIdentity("r1", "a1"))
+    assert hidden == 1
+    lines = [p.text for p in iter_pkts(body)
+             if p.kind == "data" and not p.text.startswith("# service=")]
+    assert not any("loop/r1/a0" in ln for ln in lines)
+    assert any("loop/r1/a1" in ln for ln in lines)
+    # caps re-homed onto the first surviving line, exactly once
+    assert body.count(b"\x00") == 1
+    first = next(ln for ln in lines)
+    assert "\x00report-status" in first or "report-status" in first
+
+
+def test_filter_advertisement_all_hidden_placeholder():
+    refs = {"refs/heads/loop/r1/a0": SHA_B}
+    body, hidden = filter_advertisement(
+        _advertise(refs), "git-receive-pack", Policy(run="r1"), None)
+    assert hidden == 1
+    # the standard empty-repo placeholder, so clients see "no refs"
+    assert b"capabilities^{}" in body
+
+
+def test_filter_ls_refs_drops_hidden():
+    body = (encode_pkt(f"{SHA_A} refs/heads/main\n") +
+            encode_pkt(f"{SHA_B} refs/heads/loop/r1/a0\n") + FLUSH_PKT)
+    out, hidden = filter_ls_refs(body, Policy(run="r1"),
+                                 AgentIdentity("r1", "a1"))
+    assert hidden == 1 and b"a0" not in out and b"main" in out
+
+
+def _push_body(ref: str, caps: str = "report-status",
+               new: str = SHA_B) -> bytes:
+    return encode_pkt(f"{ZERO} {new} {ref}".encode() + b"\x00" +
+                      caps.encode() + b"\n") + FLUSH_PKT
+
+
+def test_parse_receive_commands_golden():
+    body = (encode_pkt(f"{ZERO} {SHA_B} refs/heads/x".encode() +
+                       b"\x00report-status side-band-64k\n") +
+            encode_pkt(f"{SHA_A} {ZERO} refs/heads/gone\n") + FLUSH_PKT +
+            b"PACKxxxx")
+    push = parse_receive_commands(body)
+    assert [c.ref for c in push.commands] == ["refs/heads/x",
+                                              "refs/heads/gone"]
+    assert push.commands[1].is_delete
+    assert push.wants_sideband and push.wants_report_status
+    assert push.pack == b"PACKxxxx"
+
+
+def test_parse_receive_smuggled_second_command_list():
+    body = _push_body("refs/heads/loop/r1/a0") + \
+        encode_pkt(f"{ZERO} {SHA_B} refs/heads/loop/r1/merged\n") + \
+        FLUSH_PKT
+    with pytest.raises(PktError, match="smuggled"):
+        parse_receive_commands(body)
+
+
+def test_refusal_response_is_atomic():
+    """One denied ref refuses the innocent riders in the same push."""
+    pol = Policy(run="r1")
+    a0 = AgentIdentity("r1", "a0")
+    body = (encode_pkt(f"{ZERO} {SHA_B} refs/heads/loop/r1/a0".encode() +
+                       b"\x00report-status\n") +
+            encode_pkt(f"{ZERO} {SHA_B} refs/heads/loop/r1/a1\n") +
+            FLUSH_PKT)
+    push = parse_receive_commands(body)
+    verdicts = [pol.may_update(a0, c.ref) for c in push.commands]
+    out = refusal_response(push, verdicts)
+    text = b"".join(p.payload for p in iter_pkts(out)).decode()
+    assert "unpack ok" in text
+    assert "ng refs/heads/loop/r1/a1" in text        # the denied ref
+    assert "ng refs/heads/loop/r1/a0" in text        # the innocent rider
+    assert "ok refs/" not in text
+
+
+def test_refusal_response_sideband_wrapped():
+    pol = Policy(run="r1")
+    push = parse_receive_commands(
+        _push_body("refs/heads/loop/r1/a1",
+                   caps="report-status side-band-64k"))
+    out = refusal_response(
+        push, [pol.may_update(AgentIdentity("r1", "a0"), c.ref)
+               for c in push.commands])
+    data, _p, _e = decode_sideband(out)
+    assert b"ng refs/heads/loop/r1/a1" in data
+
+
+# ------------------------------------------- proxy e2e (fake upstream)
+
+
+@pytest.fixture
+def guard():
+    upstream = FakeGitUpstream(refs={"refs/heads/main": SHA_A})
+    decisions = []
+    srv = GitguardServer(upstream, Policy(run="r1"),
+                         tcp_addr=("127.0.0.1", 0),
+                         on_decision=decisions.append).start()
+    try:
+        yield srv, upstream, decisions
+    finally:
+        srv.close()
+
+
+def _post(port: int, body: bytes, headers: dict) -> tuple[int, bytes]:
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5.0)
+    conn.request("POST", "/repo/git-receive-pack", body=body,
+                 headers={"Content-Type":
+                          "application/x-git-receive-pack-request",
+                          **headers})
+    resp = conn.getresponse()
+    out = resp.read()
+    conn.close()
+    return resp.status, out
+
+
+def test_proxy_push_own_ref_lands(guard):
+    srv, upstream, decisions = guard
+    status, out = _post(srv.port, _push_body("refs/heads/loop/r1/a0"),
+                        {IDENTITY_HEADER: "r1/a0"})
+    assert status == 200 and b"ok refs/heads/loop/r1/a0" in out
+    assert [(i, r) for _t, i, r in upstream.acknowledged] == \
+        [("r1/a0", "refs/heads/loop/r1/a0")]
+    assert [d.verdict for d in decisions] == ["allow"]
+
+
+def test_proxy_push_sibling_refused_not_acknowledged(guard):
+    srv, upstream, decisions = guard
+    status, out = _post(srv.port, _push_body("refs/heads/loop/r1/a1"),
+                        {IDENTITY_HEADER: "r1/a0"})
+    assert status == 200 and b"ng refs/heads/loop/r1/a1" in out
+    assert upstream.acknowledged == []
+    assert [d.verdict for d in decisions] == ["deny"]
+
+
+def test_proxy_duplicate_identity_header_fail_closed(guard):
+    """Two conflicting identity headers (a client-supplied one riding
+    beside Envoy's) resolve to NO identity -- the push refuses."""
+    srv, upstream, _decisions = guard
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5.0)
+    conn.putrequest("POST", "/repo/git-receive-pack")
+    body = _push_body("refs/heads/loop/r1/a0")
+    conn.putheader("Content-Type",
+                   "application/x-git-receive-pack-request")
+    conn.putheader("Content-Length", str(len(body)))
+    conn.putheader(IDENTITY_HEADER, "r1/a0")
+    conn.putheader(IDENTITY_HEADER, "r1/a1")
+    conn.endheaders()
+    conn.send(body)
+    resp = conn.getresponse()
+    out = resp.read()
+    conn.close()
+    assert b"ng refs/heads/loop/r1/a0" in out
+    assert upstream.acknowledged == []
+
+
+def test_proxy_malformed_body_reports_unpack_error(guard):
+    srv, upstream, decisions = guard
+    status, out = _post(srv.port, b"0003garbage",
+                        {IDENTITY_HEADER: "r1/a0"})
+    assert status == 200 and b"unpack error" in out
+    assert upstream.acknowledged == []
+    assert decisions and "malformed" in decisions[0].reason
+
+
+def test_proxy_advertisement_filtered_per_identity(guard):
+    srv, upstream, _decisions = guard
+    upstream.refs["refs/heads/loop/r1/a0"] = SHA_B
+    upstream.refs["refs/heads/loop/r1/a1"] = SHA_B
+
+    def advertise(headers: dict) -> bytes:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=5.0)
+        conn.request("GET", "/repo/info/refs?service=git-receive-pack",
+                     headers=headers)
+        resp = conn.getresponse()
+        out = resp.read()
+        conn.close()
+        assert resp.status == 200
+        return out
+
+    mine = advertise({IDENTITY_HEADER: "r1/a0"})
+    assert b"loop/r1/a0" in mine and b"loop/r1/a1" not in mine
+    anon = advertise({})
+    assert b"refs/heads/main" in anon and b"loop/r1/" not in anon
+
+
+def test_proxy_refuses_dumb_protocol_fallback(guard):
+    srv, _upstream, _decisions = guard
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=5.0)
+    conn.request("GET", "/repo/info/refs")     # no ?service= -> dumb
+    resp = conn.getresponse()
+    resp.read()
+    conn.close()
+    assert resp.status == 403   # an unfiltered lane is refused outright
+
+
+def test_proxy_fail_closed_after_close(guard):
+    srv, _upstream, _decisions = guard
+    port = srv.port
+    srv.close()
+    assert not srv.running
+    with pytest.raises(OSError):
+        _post(port, _push_body("refs/heads/loop/r1/a0"),
+              {IDENTITY_HEADER: "r1/a0"})
+    srv.close()                 # idempotent (chaos calls it twice)
+
+
+# ------------------------------------------------- real-git end-to-end
+
+
+def _git(cwd, *args, header: str = "", check: bool = True):
+    cmd = ["git"]
+    if header:
+        cmd += ["-c", f"http.extraHeader={IDENTITY_HEADER}: {header}"]
+    cmd += ["-c", "user.email=t@t", "-c", "user.name=t", *args]
+    return subprocess.run(cmd, cwd=cwd, check=check,
+                          capture_output=True, text=True)
+
+
+def test_real_git_push_through_guard(tmp_path):
+    """A real git client against the proxy over LocalRepoUpstream:
+    anonymous clone sees only the base branch, an identified push to
+    the agent's own branch lands, a sibling-branch push is refused
+    in-protocol (``[remote rejected]``), and sibling branches never
+    appear in ls-remote."""
+    upstream_repo = tmp_path / "seed"
+    upstream_repo.mkdir()
+    _git(upstream_repo, "init", "-q", "-b", "main")
+    (upstream_repo / "f.txt").write_text("base\n")
+    _git(upstream_repo, "add", ".")
+    _git(upstream_repo, "commit", "-q", "-m", "root")
+    _git(upstream_repo, "branch", "loop/r1/a1")     # the sibling to hide
+
+    srv = GitguardServer(LocalRepoUpstream(upstream_repo),
+                         Policy(run="r1"),
+                         tcp_addr=("127.0.0.1", 0)).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/seed"
+        clone = tmp_path / "agent0"
+        _git(tmp_path, "clone", "-q", url, str(clone))
+        (clone / "work.txt").write_text("agent-0 was here\n")
+        _git(clone, "add", ".")
+        _git(clone, "commit", "-q", "-m", "work")
+
+        # own branch: lands
+        r = _git(clone, "push", "-q", "origin",
+                 "HEAD:refs/heads/loop/r1/a0", header="r1/a0")
+        assert r.returncode == 0, r.stderr
+        heads = _git(upstream_repo, "branch", "--list",
+                     "loop/r1/a0").stdout
+        assert "loop/r1/a0" in heads
+
+        # sibling branch: refused in-protocol with the policy reason
+        r = _git(clone, "push", "origin", "HEAD:refs/heads/loop/r1/a1",
+                 header="r1/a0", check=False)
+        assert r.returncode != 0
+        assert "remote rejected" in r.stderr
+        assert "namespace" in r.stderr
+
+        # integration branch: merge-queue only
+        r = _git(clone, "push", "origin",
+                 "HEAD:refs/heads/loop/r1/merged", header="r1/a0",
+                 check=False)
+        assert r.returncode != 0 and "merge-queue" in r.stderr
+
+        # the merge-queue identity alone lands the integration branch
+        r = _git(clone, "push", "-q", "origin",
+                 "HEAD:refs/heads/loop/r1/merged", header="r1/q/mergeq")
+        assert r.returncode == 0, r.stderr
+        assert "loop/r1/merged" in _git(
+            upstream_repo, "branch", "--list", "loop/r1/merged").stdout
+
+        # the sibling branch is invisible, not just unpushable
+        ls = _git(clone, "ls-remote", "origin", header="r1/a0").stdout
+        assert "loop/r1/a0" in ls and "loop/r1/a1" not in ls
+
+        # fail-closed: a dead guard is a connection error, never a
+        # pass-through
+        srv.close()
+        r = _git(clone, "push", "origin",
+                 "HEAD:refs/heads/loop/r1/a0", header="r1/a0",
+                 check=False)
+        assert r.returncode != 0
+    finally:
+        srv.close()
+
+
+# ----------------------------------------------------------- chaos rider
+
+
+@pytest.fixture
+def env():
+    with TestEnv() as tenv:
+        proj = tenv.base / "proj"
+        proj.mkdir()
+        (proj / consts.PROJECT_FLAT_FORM).write_text("project: ggproj\n")
+        subprocess.run(["git", "init", "-q", "-b", "main"], cwd=proj,
+                       check=True)
+        subprocess.run(["git", "-c", "user.email=t@t",
+                        "-c", "user.name=t", "add", "."], cwd=proj,
+                       check=True)
+        subprocess.run(["git", "-c", "user.email=t@t",
+                        "-c", "user.name=t", "commit", "-q", "-m", "root"],
+                       cwd=proj, check=True)
+        cfg = load_config(proj)
+        yield tenv, proj, cfg
+
+
+def test_plan_gitguard_roundtrip(tmp_path):
+    plan = FaultPlan(seed=7, scenario=3, gitguard=True, events=[
+        FaultEvent(at_s=0.3, kind="gitguard_down", worker=-1)])
+    p = tmp_path / "plan.json"
+    p.write_text(plan.to_json())
+    loaded = FaultPlan.load(p)
+    assert loaded.gitguard is True
+    assert [e.kind for e in loaded.events] == ["gitguard_down"]
+
+
+def test_gitguard_rider_is_schedule_deterministic():
+    """The rider draws AFTER every pre-existing draw, and the probe
+    script derives from (seed, scenario) alone -- two generations are
+    byte-identical, and gitguard_down only appears on gitguard plans."""
+    for i in range(20):
+        a, b = generate_plan(99, i), generate_plan(99, i)
+        assert a.to_doc() == b.to_doc()
+        for ev in a.events:
+            if ev.kind == "gitguard_down":
+                assert a.gitguard
+    assert gitguard_probe_script(99, 4) == gitguard_probe_script(99, 4)
+    kinds = {k for k, _i, _r, _s in gitguard_probe_script(99, 4)}
+    assert kinds <= {"own", "sibling", "integration", "mergeq"}
+
+
+def test_chaos_scenario_with_gitguard_down_holds_invariants(env):
+    tenv, proj, cfg = env
+    plan = FaultPlan(seed=5, scenario=0, n_workers=2, n_loops=2,
+                     iterations=1, gitguard=True, events=[
+                         FaultEvent(at_s=0.05, kind="worker_kill",
+                                    worker=1),
+                         FaultEvent(at_s=0.2, kind="gitguard_down",
+                                    worker=-1),
+                         FaultEvent(at_s=0.35, kind="worker_revive",
+                                    worker=1),
+                     ])
+    runner = ChaosRunner(cfg, plan)
+    result = runner.run_scenario()
+    assert result.ok, result.violations
+    probes = runner._gitguard_probes
+    assert probes, "gitguard plan fired no push probes"
+    # probes after the kill observed the fail-closed refusal
+    assert any(p["outcome"] == "refused" for p in probes)
+    # the dead guard acknowledged nothing after its down timestamp
+    downed = runner._gitguard_downed_at
+    assert downed is not None
+    assert all(ts <= downed
+               for ts, _i, _r in runner.gitguard_upstream.acknowledged)
+
+
+def test_invariant_flags_poisoned_gitguard_evidence(env):
+    """ref-isolation-at-proxy must actually fire: an out-of-namespace
+    acknowledged update, a post-down landing, and an impossible allow
+    verdict are each violations."""
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=1)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, exit_behavior(b"", 0))
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=1,
+                                             image=IMAGE))
+    sched.start()
+    sched.run(poll_s=0.05)
+    sched.cleanup(remove_containers=True)
+
+    def audit(**kw):
+        base = {"run": "r1", "branch_prefix": "loop", "downed_at": None,
+                "acknowledged": [], "decisions": [], "probes": []}
+        base.update(kw)
+        return check_invariants(drv, cfg, sched.loop_id,
+                                loops=sched.loops, gitguard=base)
+
+    assert audit() == []
+    # out-of-namespace landing
+    out = audit(acknowledged=[(1.0, "r1/a0", "refs/heads/loop/r1/a1")])
+    assert any(v.startswith("ref-isolation-at-proxy") and
+               "out-of-namespace" in v for v in out)
+    # in-namespace but AFTER the guard died: fail-open evidence
+    out = audit(downed_at=10.0,
+                acknowledged=[(11.0, "r1/a0", "refs/heads/loop/r1/a0")])
+    assert any("AFTER the guard was killed" in v for v in out)
+    # a verdict the policy can never legitimately produce
+    out = audit(decisions=[(1.0, {"verdict": "allow", "run": "r1",
+                                  "agent": "a0",
+                                  "ref": "refs/heads/loop/r1/a1"})])
+    assert any("allow" in v and "out-of-namespace" in v for v in out)
+    # the merge queue landing integration is NOT a violation
+    assert audit(acknowledged=[
+        (1.0, "r1/q/mergeq", "refs/heads/loop/r1/merged")]) == []
+    drv.close()
+
+
+# ----------------------------------------------------- scheduler wiring
+
+
+def test_scheduler_arms_guard_journals_rules_and_tears_down(env):
+    """--worktrees arms gitguard: run-scoped egress rules (https lane +
+    ssh/git deny pins) journaled write-ahead then installed, the proxy
+    up on its per-run socket, the summary surfaced, and cleanup
+    removing exactly the journaled keys."""
+    tenv, proj, cfg = env
+    cfg.settings.gitguard.hosts = ["git.example.com"]
+    drv = FakeDriver(n_workers=1)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, exit_behavior(b"", 0, delay=0.02))
+    sched = LoopScheduler(cfg, drv, LoopSpec(parallel=1, iterations=1,
+                                             image=IMAGE, worktrees=True))
+    sched.start()
+    try:
+        assert sched.gitguard is not None and sched.gitguard.running
+        summary = sched.gitguard_summary()
+        assert summary["enabled"] and summary["running"]
+        assert set(summary["rules"]) == {"git.example.com:https:443",
+                                         "git.example.com:ssh:22",
+                                         "git.example.com:git:9418"}
+        installed = {r.key() for r in
+                     RulesStore(cfg.egress_rules_path).load()}
+        assert set(summary["rules"]) <= installed
+        sched.run(poll_s=0.05)
+    finally:
+        sched.cleanup(remove_containers=True)
+        drv.close()
+    # teardown: proxy down, rule keys removed, nothing else touched
+    assert sched.gitguard is None
+    left = {r.key() for r in RulesStore(cfg.egress_rules_path).load()}
+    assert not left & {"git.example.com:https:443",
+                       "git.example.com:ssh:22",
+                       "git.example.com:git:9418"}
+    records = RunJournal.read(journal_path(cfg.logs_dir, sched.loop_id))
+    rules = [r for r in records if r.get("kind") == REC_GITGUARD_RULES]
+    assert len(rules) == 1 and len(rules[0]["keys"]) == 3
+    # and the image replays them (resume knows what to tear down)
+    image = replay(records)
+    assert set(image.gitguard_rules) == set(rules[0]["keys"])
+
+
+def test_no_gitguard_opt_out_disarms(env):
+    tenv, proj, cfg = env
+    drv = FakeDriver(n_workers=1)
+    for api in drv.apis:
+        api.add_image(IMAGE)
+        api.set_behavior(IMAGE, exit_behavior(b"", 0))
+    sched = LoopScheduler(
+        cfg, drv, LoopSpec(parallel=1, iterations=1, image=IMAGE,
+                           worktrees=True, gitguard=False))
+    sched.start()
+    try:
+        assert sched.gitguard is None
+        assert sched.gitguard_summary() == {
+            "enabled": False, "running": False, "socket": "",
+            "hosts": [], "rules": [], "decisions": {}}
+        sched.run(poll_s=0.05)
+    finally:
+        sched.cleanup(remove_containers=True)
+        drv.close()
