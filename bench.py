@@ -51,6 +51,15 @@ Added (telemetry PR):
   path pays; the smoke gate keeps it bounded so instrumentation can
   never silently regress the cold-start headline.
 
+Added (distributed tracing PR):
+- tracing_overhead_ns -- per-span propagate+record cost: parse the
+  inbound traceparent, mint a child, serialize the outbound header,
+  and record one SpanRecord through the sink into a real flight
+  recorder (append + flush).  Gated alongside telemetry_overhead_ns.
+- trace_merge_wall_n256 -- wall to merge 256 agents x 4 recorder
+  processes (router/loopd/scheduler/workerd) into the causal forest
+  `clawker trace` renders, skew adjustment and gap audit included.
+
 Added (run journal / resume PR):
 - resume_reattach_wall_n8 -- kill the scheduler of a running
   8-loop/4-worker fake pod mid-wait, then measure the `--resume`
@@ -1028,10 +1037,10 @@ def bench_workerd_event_batch_overhead(iters: int = 40) -> dict:
             self.engine_ms = 0.0
 
         def _workerd_created(self, loop, epoch, worker, cid, pool_hit,
-                             pool_error, pool_entry, ms):
+                             pool_error, pool_entry, ms, **kw):
             self.engine_ms += ms
 
-        def _workerd_started(self, loop, epoch, worker, ms):
+        def _workerd_started(self, loop, epoch, worker, ms, **kw):
             self.engine_ms += ms
             self.started.set()
 
@@ -1843,6 +1852,136 @@ def bench_telemetry_overhead(n: int = 50_000) -> dict:
     }
 
 
+def bench_tracing_overhead(n: int = 5_000) -> dict:
+    """Per-span distributed-tracing cost in nanoseconds, split into the
+    two quantities the tracing design budgets separately
+    (docs/tracing.md#overhead):
+
+    - ``propagate_ns``: the pure context plumbing every traced RPC hop
+      pays -- parse the inbound traceparent, mint a child context,
+      serialize the outbound header.  Rides frames already being sent,
+      so this IS the whole propagation cost.
+    - ``record_ns``: propagate plus recording one SpanRecord through
+      the context sink into a real flight recorder (json + append +
+      flush per record -- the durability the recorder exists for).
+    """
+    import tempfile
+
+    from clawker_tpu.monitor.ledger import FlightRecorder
+    from clawker_tpu.tracing.context import TraceContext
+
+    header = TraceContext("benchrun0123", "a1b2c3d4e5f60718").to_header()
+
+    def propagate_once() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ctx = TraceContext.from_header(header)
+            ctx.child().to_header()
+        return (time.perf_counter() - t0) / n * 1e9
+
+    with tempfile.TemporaryDirectory() as td:
+        flight = FlightRecorder(Path(td) / "bench-trace.jsonl")
+        # child() inherits the parent's sink, matching the real hop
+        # shape: the daemon holds one sink-bearing context per run and
+        # mints a child per recorded span
+        parent = TraceContext(
+            "benchrun0123", "a1b2c3d4e5f60718", agent="bench",
+            worker="w0", sink=lambda rec: flight.append(rec.to_json()))
+
+        def record_loop() -> float:
+            t0 = time.perf_counter()
+            for _ in range(n):
+                ctx = TraceContext.from_header(header)
+                ctx.child().to_header()
+                parent.child().record(
+                    "engine.request", t0, t0 + 0.001, verb="GET",
+                    path="/ping")
+            return (time.perf_counter() - t0) / n * 1e9
+
+        propagate_once()            # warmup
+        propagate_ns = propagate_once()
+        record_loop()               # warmup (file + page cache)
+        record_ns = record_loop()
+        flight.close()
+    return {
+        "propagate_ns": round(propagate_ns, 1),
+        "record_ns": round(record_ns, 1),
+        "spans": n,
+    }
+
+
+def _trace_merge_fixture(agents: int = 256, iterations: int = 2) -> dict:
+    """Synthetic 4-process recorder set for one run: router + loopd
+    submit hops, the scheduler's iteration trees (ctx_parent-linked),
+    and workerd's remote segments (skewed, parentless -- the launch
+    path), shaped exactly like the real recorder files."""
+    from clawker_tpu.telemetry.spans import SpanRecord
+
+    run = "benchmergerun"
+    t = 1_722_700_000.0
+    router = [SpanRecord(
+        trace_id=run, span_id="rtr0", parent_id="", name="router.submit",
+        agent="", worker="front", t_start=t, t_end=t + 0.05,
+        attrs={"pod": "pod-a", "wan_ms": 50.0})]
+    loopd = [SpanRecord(
+        trace_id=run, span_id="lpd0", parent_id="", name="loopd.submit",
+        agent="", worker="pod-a", t_start=t + 0.02, t_end=t + 0.04,
+        attrs={"ctx_parent": "rtr0", "skew_s": 0.002})]
+    sched: list = []
+    workerd: list = []
+    for a in range(agents):
+        agent = f"loop-bench-{a:03d}"
+        for it in range(iterations):
+            base = t + 0.1 + it * 0.5 + (a % 7) * 0.01
+            root_id = f"it{a:03d}x{it}"
+            sched.append(SpanRecord(
+                trace_id=run, span_id=root_id, parent_id="",
+                name="iteration", agent=agent, worker=f"w{a % 4}",
+                t_start=base, t_end=base + 0.4,
+                attrs={"iteration": it, "ctx_parent": "lpd0"}))
+            for j, phase in enumerate(("create", "start", "wait")):
+                sched.append(SpanRecord(
+                    trace_id=run, span_id=f"{root_id}p{j}",
+                    parent_id=root_id, name=phase, agent=agent,
+                    worker=f"w{a % 4}", t_start=base + j * 0.1,
+                    t_end=base + (j + 1) * 0.1,
+                    attrs={"iteration": it, "workerd": True}))
+            for j, phase in enumerate(("workerd.create", "workerd.start")):
+                workerd.append(SpanRecord(
+                    trace_id=run, span_id=f"{root_id}w{j}", parent_id="",
+                    name=phase, agent=agent, worker=f"w{a % 4}",
+                    t_start=base + 0.003 + j * 0.1,
+                    t_end=base + 0.003 + (j + 1) * 0.1,
+                    attrs={"iteration": it, "skew_s": 0.003}))
+    return {"run": run, "sources": {
+        "router:router-front": router, "loopd:loopd-pod-a": loopd,
+        "scheduler": sched, "workerd:workerd-w0": workerd}}
+
+
+def bench_trace_merge(agents: int = 256) -> dict:
+    """Wall time to merge one run's 4-process recorder set at fleet
+    scale (256 agents x 2 iterations: ~2.5k spans) into the causal
+    forest `clawker trace` renders -- skew adjustment, cross-recorder
+    linking, gap synthesis, monotonicity audit included."""
+    from clawker_tpu.tracing.merge import merge_records
+
+    fx = _trace_merge_fixture(agents=agents)
+    merge_records(fx["sources"], fx["run"])     # warmup
+    t0 = time.perf_counter()
+    res = merge_records(fx["sources"], fx["run"])
+    wall = time.perf_counter() - t0
+    rooted = len(res.roots)
+    return {
+        "agents": agents,
+        "spans": res.spans,
+        "roots": rooted,
+        "gaps": res.gaps,
+        "skew_suspects": res.skew_suspects,
+        "one_rooted_tree": rooted == 1,     # everything under the router
+        "merge_wall_s": round(wall, 4),
+    }
+
+
 CONSOLE_REPAINT_BUDGET_MS = 50.0    # p95 frame build+paint at 256 agents
 #                                     across 4 hosted runs (fleet console,
 #                                     docs/fleet-console.md#repaint-budget)
@@ -2585,6 +2724,15 @@ TELEMETRY_BUDGET_NS = 20_000  # per-record registry cost, enabled (a
 #                               1% of the 8.95ms cold-start headline)
 TELEMETRY_DISABLED_BUDGET_NS = 4_000   # disabled = one attr check; it
 #                               must stay near-free or opting out is a lie
+TRACING_BUDGET_NS = 50_000    # per-span propagate+record, flight append
+#                               and flush included: a traced hop fires a
+#                               handful of spans per iteration, so 50us
+#                               keeps tracing under 1% of even a warm
+#                               ~40ms create/start pair
+TRACE_MERGE_BUDGET_S = 2.0    # merge 256 agents x 4 recorder processes
+#                               (~2.5k spans) into one causal forest --
+#                               `clawker trace` is interactive, so the
+#                               offline merge must stay prompt-speed
 ANOMALY_FLAG_LATENCY_BUDGET_S = 2.0   # egress append -> anomaly.flag on
 #                               the bus, sentinel live on the fake pod
 #                               (ISSUE 10 acceptance)
@@ -2643,6 +2791,8 @@ def main() -> None:
     seed_amort = bench_workspace_seed_amortization()
     dials = bench_engine_dials()
     tele = bench_telemetry_overhead()
+    tracing = bench_tracing_overhead()
+    tmerge = bench_trace_merge()
     console = bench_console_repaint()
     ingest = bench_ingest_lag()
     elastic = bench_elastic_vs_static_p99()
@@ -2854,6 +3004,18 @@ def main() -> None:
          "vs_baseline": round(
              TELEMETRY_BUDGET_NS / max(tele["enabled_ns"], 1e-9), 1),
          "detail": tele},
+        {"metric": "tracing_overhead_ns", "value": tracing["record_ns"],
+         "unit": "ns",
+         # headroom under the per-span budget (propagate + record +
+         # flight append/flush): >= 1 means a traced hop stays invisible
+         "vs_baseline": round(
+             TRACING_BUDGET_NS / max(tracing["record_ns"], 1e-9), 1),
+         "detail": tracing},
+        {"metric": "trace_merge_wall_n256", "value": tmerge["merge_wall_s"],
+         "unit": "s",
+         "vs_baseline": round(
+             TRACE_MERGE_BUDGET_S / max(tmerge["merge_wall_s"], 1e-9), 1),
+         "detail": tmerge},
         {"metric": "anomaly_score_step", "value": anom["score_step_us"],
          "unit": "us",
          # a dead lane (score_step 0 / device unavailable) must read as

@@ -38,7 +38,7 @@ RANKS = {
     "monitor": 2, "telemetry": 2, "fleet": 2, "runtime": 2,
     "firewall": 2, "agentd": 2, "analytics": 2, "hostproxy": 2,
     "socketbridge": 2, "workspace": 2, "project": 2, "bundle": 2,
-    "gitx": 2, "capacity": 2, "gitguard": 2,
+    "gitx": 2, "capacity": 2, "gitguard": 2, "tracing": 2,
     # rank 1: leaves -- importable from anywhere, import nothing above
     "util": 1, "config": 1, "consts": 1, "errors": 1, "logsetup": 1,
     "state": 1, "storage": 1, "containerfs": 1,

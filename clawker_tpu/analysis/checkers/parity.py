@@ -13,6 +13,12 @@ Two registries keep string-keyed surfaces honest:
   ``| `name` |`` row, and every documented name must still be
   registered somewhere (documented-but-never-emitted names rot the
   operator docs the monitor stack dashboards are built from).
+
+- tracing/names.py ``SPAN_CATALOGUE``: every ``SPAN_*`` string
+  constant anywhere in the tree must appear in the catalogue, the
+  catalogue must match docs/telemetry.md's span-catalogue table both
+  ways, and the metric scan above EXCLUDES that table's section (span
+  names like ``iteration`` would otherwise read as phantom metrics).
 """
 
 from __future__ import annotations
@@ -25,21 +31,42 @@ from ._util import call_tail, first_str_arg, receiver
 
 SEAMS_FILE = "clawker_tpu/chaos/seams.py"
 TELEMETRY_DOC = "docs/telemetry.md"
+SPAN_NAMES_FILE = "clawker_tpu/tracing/names.py"
 
 _DOC_ROW_RE = re.compile(r"^\|\s*`([a-z][a-z0-9_]+)`\s*\|", re.MULTILINE)
 _METRIC_FACTORIES = {"counter", "gauge", "histogram"}
 _METRIC_RECEIVERS = {"telemetry", "REGISTRY"}
 
+# span names may carry dots (router.submit); rows only count inside the
+# span-catalogue section, which the metric scan symmetrically excludes
+_SPAN_ROW_RE = re.compile(r"^\|\s*`([a-z][a-z0-9_.]+)`\s*\|", re.MULTILINE)
+_SPAN_HEADING_RE = re.compile(r"^(#{2,6})\s+.*span catalogue",
+                              re.IGNORECASE | re.MULTILINE)
 
-def _seam_names(ctx: RepoContext) -> tuple[set[str], int] | None:
-    """SEAM_NAMES parsed from the registry module's AST, with the
-    tuple's line; None when the fixture repo has no seam registry."""
-    src = ctx.source(SEAMS_FILE)
+
+def _split_span_section(doc: str) -> tuple[str, str]:
+    """(doc without the span-catalogue section, that section) -- the
+    section runs from its heading to the next same-or-higher heading."""
+    m = _SPAN_HEADING_RE.search(doc)
+    if m is None:
+        return doc, ""
+    level = len(m.group(1))
+    rest = doc[m.end():]
+    nxt = re.search(rf"^#{{2,{level}}}\s", rest, re.MULTILINE)
+    end = m.end() + (nxt.start() if nxt else len(rest))
+    return doc[:m.start()] + doc[end:], doc[m.start():end]
+
+
+def _literal_tuple(ctx: RepoContext, rel: str, var: str
+                   ) -> tuple[set[str], int] | None:
+    """``var`` parsed as a tuple/list of string literals from ``rel``'s
+    AST, with its line; None when the fixture repo lacks the registry."""
+    src = ctx.source(rel)
     if src is None or src.tree is None:
         return None
     for n in ast.walk(src.tree):
         if isinstance(n, ast.Assign) and any(
-                isinstance(t, ast.Name) and t.id == "SEAM_NAMES"
+                isinstance(t, ast.Name) and t.id == var
                 for t in n.targets):
             if isinstance(n.value, (ast.Tuple, ast.List)):
                 names = {e.value for e in n.value.elts
@@ -49,16 +76,23 @@ def _seam_names(ctx: RepoContext) -> tuple[set[str], int] | None:
     return None
 
 
+def _seam_names(ctx: RepoContext) -> tuple[set[str], int] | None:
+    return _literal_tuple(ctx, SEAMS_FILE, "SEAM_NAMES")
+
+
 @register_checker
 class RegistryParityChecker(Checker):
     id = "registry-parity"
     doc = ("every fired seam name must be registered in chaos/seams.py "
            "(and every seam fired somewhere); every registered metric "
-           "must have a docs/telemetry.md row (and vice versa)")
+           "must have a docs/telemetry.md row (and vice versa); every "
+           "SPAN_* constant must be in tracing/names.py SPAN_CATALOGUE, "
+           "which must match the doc's span-catalogue table both ways")
 
     def __init__(self):
         self._fired: dict[str, tuple[str, int]] = {}
         self._metrics: dict[str, tuple[str, int]] = {}
+        self._span_consts: dict[str, tuple[str, int]] = {}
 
     def interested(self, rel: str) -> bool:
         return True
@@ -68,6 +102,15 @@ class RegistryParityChecker(Checker):
         if src.rel == SEAMS_FILE:
             return []
         for c in ast.walk(src.tree):
+            if isinstance(c, ast.Assign) \
+                    and isinstance(c.value, ast.Constant) \
+                    and isinstance(c.value.value, str) \
+                    and re.fullmatch(r"[a-z][a-z0-9_.]*", c.value.value):
+                for t in c.targets:
+                    if isinstance(t, ast.Name) and t.id.startswith("SPAN_"):
+                        self._span_consts.setdefault(
+                            c.value.value, (src.rel, c.lineno))
+                continue
             if not isinstance(c, ast.Call):
                 continue
             tail = call_tail(c)
@@ -87,6 +130,7 @@ class RegistryParityChecker(Checker):
         findings: list[Finding] = []
         fired, self._fired = self._fired, {}
         metrics, self._metrics = self._metrics, {}
+        span_consts, self._span_consts = self._span_consts, {}
 
         seams = _seam_names(ctx)
         if seams is not None:
@@ -107,8 +151,43 @@ class RegistryParityChecker(Checker):
                              f"generator still draws it as dead coverage")))
 
         doc = ctx.read_text(TELEMETRY_DOC)
+        metric_doc, span_section = _split_span_section(doc or "")
+
+        catalogue = _literal_tuple(ctx, SPAN_NAMES_FILE, "SPAN_CATALOGUE")
+        if catalogue is not None:
+            registered, reg_line = catalogue
+            for name, (rel, line) in sorted(span_consts.items()):
+                if name not in registered:
+                    findings.append(Finding(
+                        checker=self.id, path=rel, line=line,
+                        message=(f"span `{name}` has a SPAN_* constant but "
+                                 f"is missing from tracing/names.py "
+                                 f"SPAN_CATALOGUE")))
+            if doc is not None and span_section:
+                span_doc = set(_SPAN_ROW_RE.findall(span_section))
+                for name in sorted(registered - span_doc):
+                    findings.append(Finding(
+                        checker=self.id, path=SPAN_NAMES_FILE, line=reg_line,
+                        message=(f"span `{name}` is in SPAN_CATALOGUE but "
+                                 f"has no row in docs/telemetry.md's "
+                                 f"span-catalogue table")))
+                for name in sorted(span_doc - registered):
+                    findings.append(Finding(
+                        checker=self.id, path=TELEMETRY_DOC, line=1,
+                        message=(f"span `{name}` is documented in the "
+                                 f"span-catalogue table but absent from "
+                                 f"tracing/names.py SPAN_CATALOGUE -- "
+                                 f"documented-but-never-emitted")))
+            elif doc is not None:
+                findings.append(Finding(
+                    checker=self.id, path=TELEMETRY_DOC, line=1,
+                    message=("docs/telemetry.md has no span-catalogue "
+                             "section (heading containing 'span "
+                             "catalogue') to cross-check SPAN_CATALOGUE "
+                             "against")))
+
         if doc is not None and metrics:
-            documented = set(_DOC_ROW_RE.findall(doc))
+            documented = set(_DOC_ROW_RE.findall(metric_doc))
             for name, (rel, line) in sorted(metrics.items()):
                 if name not in documented:
                     findings.append(Finding(

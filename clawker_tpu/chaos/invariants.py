@@ -31,6 +31,13 @@ with; docs/chaos.md#invariants):
 - ``span-tree``: the flight record parses, and (for scenarios without
   CLI kills) every span tree is rooted at a terminally-statused
   iteration root.
+- ``trace-completeness``: the cross-process trace merge
+  (docs/tracing.md) resolves the run to rooted trees.  Kill-free
+  scenarios may not leave any bare root below the real top of the
+  submit chain; under kills the bare-root audit loosens, but an
+  iteration whose children prove a workerd launch must STILL hold
+  either its remote segment or an explicit gap span -- a dead workerd
+  degrades to a gap, never to a broken tree.
 - ``sentinel-observe-only``: the fleet sentinel changes NO scheduling
   outcome.  Two halves: scenarios that ran with a sentinel attached
   audit its mutation counters (zero engine/breaker/placement calls --
@@ -431,11 +438,12 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
                     f"(identity {ident_header!r})")
 
     # --- span-tree: flight record parses; kill-free runs close every root
+    from ..monitor.ledger import read_rotated_lines
+
     fpath = Path(flight_path(cfg.logs_dir, run_id))
     if fpath.exists():
         try:
-            spans = load_spans(
-                fpath.read_text(encoding="utf-8").splitlines())
+            spans = load_spans(read_rotated_lines(fpath))
         except Exception as e:      # noqa: BLE001 -- corruption IS a finding
             violations.append(f"span-tree: flight record unreadable: {e}")
             spans = []
@@ -454,6 +462,59 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
                     violations.append(
                         f"span-tree: {rec.agent} iteration root ended "
                         f"with status {rec.status!r}")
+
+    # --- trace-completeness: the cross-process merge resolves every
+    # iteration to a ROOTED tree whose remote segments are complete or
+    # explicitly gap-marked (docs/tracing.md#chaos).  Kills loosen the
+    # bare-root audit exactly as span-tree loosens (a SIGKILLed writer
+    # legitimately loses its unflushed tail), but never the shape rule:
+    # a remote segment that DID survive must merge gap-marked or
+    # hosted, never as a broken tree.
+    try:
+        from ..tracing.merge import merge_run
+        from ..tracing.names import SPAN_LOOPD_SUBMIT, SPAN_ROUTER_SUBMIT
+
+        merged = merge_run(Path(cfg.logs_dir), run_id)
+    except Exception as e:      # noqa: BLE001 -- a merge crash IS a finding
+        violations.append(f"trace-completeness: merge failed: {e}")
+        merged = None
+    if merged is not None and merged.spans:
+        # legitimate top-of-chain roots: the hop a submit REALLY started
+        # at (router when federated, loopd when daemon-direct, iteration
+        # when in-process) plus standalone run-level spans and the
+        # merge's own gap placeholders
+        root_ok = {SPAN_ITERATION, SPAN_ROUTER_SUBMIT, SPAN_LOOPD_SUBMIT}
+
+        def _walk(nodes):
+            for n in nodes:
+                yield n
+                yield from _walk(n.children)
+
+        for root in merged.roots:
+            rec = root.record
+            if (rec.name in root_ok or rec.name in STANDALONE_SPANS
+                    or rec.attrs.get("gap")):
+                continue
+            if kills == 0:
+                violations.append(
+                    f"trace-completeness: span {rec.name!r} "
+                    f"({rec.agent or rec.worker}) merges as a bare root "
+                    "-- its upstream segment is missing and not "
+                    "gap-marked")
+        for node in _walk(merged.roots):
+            rec = node.record
+            if rec.name != SPAN_ITERATION:
+                continue
+            via = any(c.record.attrs.get("workerd") for c in node.children)
+            resolved = any(c.record.name.startswith("workerd.")
+                           or c.record.attrs.get("gap")
+                           for c in node.children)
+            if via and not resolved:
+                violations.append(
+                    f"trace-completeness: {rec.agent} iteration "
+                    f"{rec.attrs.get('iteration')} launched via workerd "
+                    "but its remote segment is neither present nor "
+                    "gap-marked")
     return violations
 
 

@@ -317,7 +317,7 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             failover=failover,
             iterations=iterations if iterations >= 0 else None,
             orphan_grace_s=orphan_grace,
-            telemetry=tele.flight_recorder,
+            telemetry=tele.flight_recorder.enable,
             executors=executors)
         spec = sched.spec
     else:
@@ -341,7 +341,7 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             env=env,
             failover=failover or defaults.failover,
             orphan_grace_s=orphan_grace,
-            telemetry=tele.flight_recorder,
+            telemetry=tele.flight_recorder.enable,
         )
         # --- federated mode (docs/federation.md): the front-tier
         # router shards the run across every federated pod's loopd.
@@ -861,10 +861,13 @@ def loop_trace(f: Factory, run, as_json):
     migration hops -- the post-mortem view of what every iteration paid
     and where it travelled (docs/telemetry.md).
     """
+    from ..monitor.ledger import read_rotated_lines
     from ..telemetry import build_trees, load_spans, tree_to_dict
 
     path = _resolve_flight(f, run)
-    spans = load_spans(path.read_text(encoding="utf-8").splitlines())
+    # read across the rotation boundary: a size-capped recorder keeps the
+    # previous generation at <path>.1 (docs/telemetry.md)
+    spans = load_spans(read_rotated_lines(path))
     if not spans:
         raise click.ClickException(f"{path}: no span records")
     trees = build_trees(spans)

@@ -148,6 +148,7 @@ def register_commands() -> None:
         cmd_plugin,
         cmd_project,
         cmd_settings,
+        cmd_trace,
         cmd_volume,
         cmd_workerd,
     )
@@ -171,6 +172,7 @@ def register_commands() -> None:
     cmd_project.register(cli)
     cmd_plugin.register(cli)
     cmd_settings.register(cli)
+    cmd_trace.register(cli)
     cmd_volume.register(cli)
     cmd_workerd.register(cli)
 

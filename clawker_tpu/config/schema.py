@@ -510,6 +510,44 @@ class RuntimeSettings:
 
 
 @dataclass
+class FlightRecorderSettings:
+    """Per-run / per-daemon span JSONL under logs/flight
+    (docs/telemetry.md#flight-recorder).
+
+    Back-compat: ``telemetry.flight_recorder`` used to be a bare bool;
+    that form still parses as ``{enable: <bool>}`` (see ``from_raw``).
+    ``max_bytes`` size-caps each recorder file: at the cap the current
+    file rotates to ``<file>.1`` and a fresh generation starts, so a
+    long daemon-hosted run cannot grow logs/flight unboundedly while
+    the newest records stay readable (readers cross the boundary)."""
+
+    enable: bool = True
+    max_bytes: int = 0              # per-file rotation cap; 0 = unbounded
+
+    @classmethod
+    def from_raw(cls, raw) -> "FlightRecorderSettings":
+        if isinstance(raw, bool):
+            return cls(enable=raw)
+        return from_dict(cls, raw)
+
+    def __bool__(self) -> bool:     # legacy truthiness: `if
+        return self.enable          # settings.telemetry.flight_recorder:`
+
+
+@dataclass
+class TracingSettings:
+    """Cross-process distributed tracing (docs/tracing.md).
+
+    Context propagation rides frame fields on RPCs that already exist,
+    so ``enable`` gates only the *recording* side: daemon-side remote
+    spans and the per-channel clock-skew estimation.  ``skew_alpha`` is
+    the EWMA weight for new midpoint-offset samples."""
+
+    enable: bool = True
+    skew_alpha: float = 0.25        # EWMA weight per offset sample
+
+
+@dataclass
 class TelemetrySettings:
     """Fleet telemetry (net-new; docs/telemetry.md).
 
@@ -520,7 +558,9 @@ class TelemetrySettings:
     metrics_port: int = 0           # 127.0.0.1 scrape port; 0 = off
     otlp: bool = False              # ship registry snapshots over the
     #                                 CP's OTLP lanes during loop runs
-    flight_recorder: bool = True    # per-run span JSONL under logs/flight
+    flight_recorder: FlightRecorderSettings = field(
+        default_factory=FlightRecorderSettings)
+    tracing: TracingSettings = field(default_factory=TracingSettings)
 
 
 @dataclass

@@ -40,6 +40,8 @@ from typing import Any, Callable, Iterator
 
 from .. import telemetry
 from ..errors import ClawkerError, DriverError
+from ..tracing.context import current as trace_current
+from ..tracing.context import record_engine_request
 from .errors_map import raise_for
 from .pool import ConnectionPool, _SockConnection  # noqa: F401 (re-export)
 
@@ -215,6 +217,14 @@ class HTTPDockerAPI:
         """
         t_req = time.perf_counter()
         hdrs = {"Host": "docker", "Connection": "keep-alive"}
+        # Distributed tracing rides ambient context (docs/tracing.md):
+        # when a scheduler/workerd wrapped this call in ``use(ctx)``, the
+        # daemon sees a W3C traceparent header and the call is recorded
+        # as an ``engine.request`` span -- zero cost when no context is
+        # active (the common untraced path).
+        t_trace = time.time() if trace_current() is not None else 0.0
+        if t_trace:
+            hdrs["traceparent"] = trace_current().to_header()
         data: bytes | None = None
         if raw_body is not None:
             data = raw_body
@@ -257,6 +267,8 @@ class HTTPDockerAPI:
                         retried = True
                         continue
                     self._pool.note_suppressed_retry()
+                if t_trace:
+                    record_engine_request(method, path, t_trace, ok=False)
                 raise DriverError(f"daemon unreachable ({method} {path}): {e}") from e
             try:
                 payload = resp.read()
@@ -267,6 +279,8 @@ class HTTPDockerAPI:
                 # twice.  Stale-socket reaping manifests before the status
                 # line, which the block above already handles.
                 conn.close()
+                if t_trace:
+                    record_engine_request(method, path, t_trace, ok=False)
                 raise DriverError(f"daemon unreachable ({method} {path}): {e}") from e
             break
         if not dedicated:
@@ -278,6 +292,9 @@ class HTTPDockerAPI:
             conn.close()
         else:
             self._pool.checkin(conn)
+        if t_trace:
+            record_engine_request(method, path, t_trace,
+                                  ok=resp.status < 400)
         self._check(resp.status, payload, path)
         if not payload:
             return None

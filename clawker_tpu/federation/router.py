@@ -29,7 +29,9 @@ hops.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
+from pathlib import Path
 
 from .. import logsetup, telemetry
 from ..engine.drivers import Worker
@@ -37,9 +39,20 @@ from ..errors import ClawkerError
 from ..fleet.inventory import federation_topology
 from ..health import BREAKER_CLOSED, BREAKER_OPEN
 from ..loopd.client import LoopdClient
+from ..monitor.ledger import FLIGHT_DIR, FlightRecorder
 from ..placement import PlacementContext, PodPolicy
+from ..telemetry.spans import SpanRecord
+from ..tracing.context import TraceContext
+from ..tracing.names import SPAN_ROUTER_SUBMIT
+from ..tracing.skew import ChannelClock
+from ..util import ids
 from .lease import LeaseManager
 from .registry import PodRegistry, PodState
+
+# placeholder trace id on submit-frame traceparents: the run id (= the
+# real trace id) does not exist until the pod's ack names it, and the
+# receiving pod only reads the SPAN id (its upstream parent) anyway
+PENDING_TRACE = "pending"
 
 log = logsetup.get("federation.router")
 
@@ -88,6 +101,22 @@ class FederationRouter:
         self._placements: dict[str, str] = {}       # run id -> pod name
         self._shares: dict[str, _TenantShare] = {}
         self._vtime = 0.0
+        # distributed tracing (docs/tracing.md): the router IS the root
+        # clock.  One skew estimator per pod, fed by the ``ts`` replies
+        # on RPCs the router already pays (submit acks); ``router.submit``
+        # hop spans land in a router-lifetime flight recorder.
+        self.name = fed.name or "front"
+        self._clocks: dict[str, ChannelClock] = {}
+        self.flight: FlightRecorder | None = None
+        try:
+            tele = cfg.settings.telemetry
+            if tele.tracing.enable and tele.flight_recorder.enable:
+                self.flight = FlightRecorder(
+                    Path(cfg.logs_dir) / FLIGHT_DIR
+                    / f"router-{self.name}.jsonl",
+                    max_bytes=tele.flight_recorder.max_bytes)
+        except AttributeError:
+            self.flight = None
         self.registry.refresh()
 
     # ------------------------------------------------------ pod tier
@@ -134,6 +163,38 @@ class FederationRouter:
 
     # ------------------------------------------------------ submit path
 
+    def _clock(self, pod_name: str) -> ChannelClock:
+        clock = self._clocks.get(pod_name)
+        if clock is None:
+            clock = self._clocks[pod_name] = ChannelClock()
+        return clock
+
+    def _submit_to(self, pod: PodState, doc: dict, *, keep: bool,
+                   tenant: str) -> dict:
+        """One traced submit RPC: the router's traceparent and its
+        cumulative clock-offset estimate for this pod ride the frame,
+        the round-trip itself feeds the pod's skew estimator, and the
+        ``router.submit`` hop span is recorded once the ack names the
+        run (= trace) id.  Zero new round-trips."""
+        clock = self._clock(pod.name)
+        span_id = ids.short_id(16) if self.flight is not None else ""
+        tp = (TraceContext(PENDING_TRACE, span_id).to_header()
+              if span_id else "")
+        t0 = time.time()
+        ack = pod.client.submit_run(doc, keep=keep, stream=False, tp=tp,
+                                    clock_offset_s=clock.cumulative())
+        t1 = time.time()
+        clock.observe(t0, float(ack.get("ts") or 0.0), t1)
+        run_id = str(ack.get("run", ""))
+        if run_id and self.flight is not None:
+            self.flight.append(SpanRecord(
+                trace_id=run_id, span_id=span_id, parent_id="",
+                name=SPAN_ROUTER_SUBMIT, agent="", worker=self.name,
+                t_start=t0, t_end=t1,
+                attrs={"pod": pod.name, "tenant": tenant or "-",
+                       "wan_ms": round((t1 - t0) * 1000.0, 3)}).to_json())
+        return ack
+
     def submit(self, spec_doc: dict, *, keep: bool = False
                ) -> tuple[str, dict]:
         """Route one whole run: pick a pod, spend a lease credit,
@@ -141,7 +202,8 @@ class FederationRouter:
         tenant = str(spec_doc.get("tenant") or "")
         pod = self.pick_pod()
         self.lease.spend(pod.name, pod.client, tenant=tenant)
-        ack = pod.client.submit_run(dict(spec_doc), keep=keep, stream=False)
+        ack = self._submit_to(pod, dict(spec_doc), keep=keep,
+                              tenant=tenant)
         run_id = str(ack.get("run", ""))
         if run_id:
             self._placements[run_id] = pod.name
@@ -168,7 +230,7 @@ class FederationRouter:
             self.lease.spend(pod.name, pod.client, tenant=tenant)
             doc = dict(spec_doc)
             doc["parallel"] = size
-            ack = pod.client.submit_run(doc, keep=keep, stream=False)
+            ack = self._submit_to(pod, doc, keep=keep, tenant=tenant)
             run_id = str(ack.get("run", ""))
             if run_id:
                 self._placements[run_id] = pod.name
@@ -291,3 +353,5 @@ class FederationRouter:
             {p.name: p.client for p in self.registry.pods.values()
              if p.alive})
         self.registry.close()
+        if self.flight is not None:
+            self.flight.close()

@@ -80,6 +80,7 @@ reconcile re-derives from engine state.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import io
 import queue
@@ -132,6 +133,7 @@ from ..telemetry.spans import (
     SPAN_WAIT,
     Tracer,
 )
+from ..tracing.context import TraceContext, use
 from ..util import ids
 from .journal import (
     REC_ADMIT_QUEUED,
@@ -270,6 +272,15 @@ class LoopSpec:
     #                                  pool normally -- content travels via
     #                                  the workspace seed, not the mount
     #                                  (docs/loop-worktrees.md#degrade-matrix)
+    trace_parent: str = ""           # upstream traceparent (loopd's submit
+    #                                  span): iteration roots carry its
+    #                                  span id as attr ctx_parent so the
+    #                                  cross-process merge can join the
+    #                                  segments (docs/tracing.md)
+    clock_offset_s: float = 0.0      # this scheduler's cumulative clock
+    #                                  offset to the ROOT clock (the
+    #                                  router's), estimated hop by hop;
+    #                                  0 when this process is the root
 
 
 @dataclass
@@ -487,11 +498,20 @@ class LoopScheduler:
         # debug.  See docs/telemetry.md.
         self.flight: FlightRecorder | None = None
         if spec.telemetry:
+            try:
+                fr_max = int(cfg.settings.telemetry.flight_recorder.max_bytes)
+            except AttributeError:      # bare test cfgs without settings
+                fr_max = 0
             self.flight = FlightRecorder(
-                flight_path(cfg.logs_dir, self.loop_id))
+                flight_path(cfg.logs_dir, self.loop_id), max_bytes=fr_max)
         self.tracer = Tracer(
             self.loop_id,
             on_span=self._record_span if spec.telemetry else None)
+        # cumulative clock offset to the root clock (docs/tracing.md):
+        # loopd stamps it on the spec for federated runs; executors chain
+        # their per-channel estimates onto it before handing workerd its
+        # own.  0 = this process IS the root clock.
+        self._trace_offset_s = float(spec.clock_offset_s or 0.0)
         self._span_sinks: list = []     # extra structured-span consumers
         #                                 (the monitor shipper); tee'd in
         #                                 _record_span, never load-bearing
@@ -1064,7 +1084,8 @@ class LoopScheduler:
 
     def _workerd_created(self, loop: AgentLoop, epoch: int, worker: Worker,
                          cid: str, pool_hit: bool, pool_error: str,
-                         pool_entry, ms: float) -> None:
+                         pool_entry, ms: float, *,
+                         wan_ms: float = 0.0) -> None:
         if pool_entry is not None and not pool_hit:
             # remote adoption failed and workerd cold-created instead:
             # account the recycled member and discard its container
@@ -1094,11 +1115,12 @@ class LoopScheduler:
         now = self.tracer.now()
         self.tracer.child(loop.agent, loop.iteration, SPAN_CREATE,
                           now - ms / 1000.0, now, worker=worker.id,
-                          pool=pool_hit, workerd=True)
+                          pool=pool_hit, workerd=True,
+                          wan_ms=round(wan_ms, 3))
         self.on_event(loop.agent, "created", worker.id)
 
     def _workerd_started(self, loop: AgentLoop, epoch: int, worker: Worker,
-                         ms: float) -> None:
+                         ms: float, *, wan_ms: float = 0.0) -> None:
         with self._placement_lock:
             if loop.epoch != epoch or self._stop.is_set():
                 return
@@ -1116,7 +1138,7 @@ class LoopScheduler:
         now = self.tracer.now()
         self.tracer.child(loop.agent, loop.iteration, SPAN_START,
                           now - ms / 1000.0, now, worker=worker.id,
-                          workerd=True)
+                          workerd=True, wan_ms=round(wan_ms, 3))
         self._iter_started[(loop.agent, loop.iteration)] = now
         self.on_event(loop.agent, "iteration_start", str(loop.iteration))
 
@@ -1720,6 +1742,8 @@ class LoopScheduler:
             "tenant_max_inflight": s.tenant_max_inflight,
             "max_inflight_per_worker": s.max_inflight_per_worker,
             "warm_pool_depth": s.warm_pool_depth,
+            "trace_parent": s.trace_parent,
+            "clock_offset_s": s.clock_offset_s,
         }
 
     def wait_launched(self, timeout: float | None = None) -> bool:
@@ -1778,6 +1802,8 @@ class LoopScheduler:
             max_inflight_per_worker=int(
                 sd.get("max_inflight_per_worker") or 0),
             warm_pool_depth=int(sd.get("warm_pool_depth") or 0),
+            trace_parent=str(sd.get("trace_parent") or ""),
+            clock_offset_s=float(sd.get("clock_offset_s") or 0.0),
         )
         sched = cls(cfg, driver, spec, on_event=on_event,
                     health_config=health_config, run_id=image.run_id,
@@ -2187,8 +2213,40 @@ class LoopScheduler:
         qw = self._queue_wait.pop(loop.agent, None)
         if qw is not None:
             attrs["queue_ms"] = round(qw * 1000, 2)
+        # federated runs: link this root to loopd's submit span and carry
+        # the cumulative clock offset so the cross-process merge can both
+        # JOIN the segments and re-base their clocks (docs/tracing.md)
+        tp = TraceContext.from_header(self.spec.trace_parent)
+        if tp is not None and tp.span_id:
+            attrs["ctx_parent"] = tp.span_id
+        if self._trace_offset_s:
+            attrs["skew_s"] = round(self._trace_offset_s, 6)
         self.tracer.begin_iteration(loop.agent, loop.iteration, worker.id,
                                     **attrs)
+
+    def _trace_tp(self, loop: AgentLoop) -> str:
+        """Traceparent for one loop's workerd intents: the run id plus
+        the open iteration-root span id when one is open (adopt/start
+        after the root exists), else a root-less header the merge joins
+        by (agent, iteration) -- the launch path, where the root only
+        opens when the created event lands."""
+        if self.flight is None:
+            return ""       # tracing rides telemetry: off together
+        span_id = self.tracer.open_root(loop.agent, loop.iteration)
+        return TraceContext(self.loop_id, span_id).to_header()
+
+    def _engine_ctx(self, loop: AgentLoop):
+        """Activate this iteration's trace context around direct-path
+        engine work: httpapi stamps ``engine.request`` spans under the
+        open iteration root, with zero new round-trips (the traceparent
+        rides requests the path already makes)."""
+        if self.flight is None:
+            return contextlib.nullcontext()
+        return use(TraceContext(
+            self.loop_id,
+            self.tracer.open_root(loop.agent, loop.iteration),
+            agent=loop.agent, worker=loop.worker.id,
+            sink=self._record_span))
 
     def _create(self, loop: AgentLoop, epoch: int, worker: Worker) -> None:
         # worktree setup mutates ONE shared git repo (refs, worktree
@@ -2253,26 +2311,27 @@ class LoopScheduler:
         cid = ""
         pool_hit = False
         self.seams.fire("launch.pre_create")
-        if self.warmpool is not None and worker.engine is not None:
-            entry = self.warmpool.checkout(worker.id, by=loop.agent,
-                                           epoch=epoch)
-            if entry is not None:
-                aopts = dataclasses.replace(
-                    opts, extra_labels=dict(opts.extra_labels))
-                # pool-origin marker survives adoption so volume sweeps
-                # can trace the placeholder's volumes back to it
-                aopts.extra_labels[consts.LABEL_WARMPOOL] = entry.agent
-                try:
-                    rt.adopt_pooled(entry.cid, aopts)
-                    cid = entry.cid
-                    pool_hit = True
-                except ClawkerError as e:
-                    self.warmpool.adoption_failed(entry, str(e))
-                    self._remove_cid(worker, entry.cid)
-                    log.info("loop %s: pool adoption on %s failed (%s); "
-                             "cold create", loop.agent, worker.id, e)
-        if not pool_hit:
-            cid = rt.create(opts)
+        with self._engine_ctx(loop):
+            if self.warmpool is not None and worker.engine is not None:
+                entry = self.warmpool.checkout(worker.id, by=loop.agent,
+                                               epoch=epoch)
+                if entry is not None:
+                    aopts = dataclasses.replace(
+                        opts, extra_labels=dict(opts.extra_labels))
+                    # pool-origin marker survives adoption so volume sweeps
+                    # can trace the placeholder's volumes back to it
+                    aopts.extra_labels[consts.LABEL_WARMPOOL] = entry.agent
+                    try:
+                        rt.adopt_pooled(entry.cid, aopts)
+                        cid = entry.cid
+                        pool_hit = True
+                    except ClawkerError as e:
+                        self.warmpool.adoption_failed(entry, str(e))
+                        self._remove_cid(worker, entry.cid)
+                        log.info("loop %s: pool adoption on %s failed (%s); "
+                                 "cold create", loop.agent, worker.id, e)
+            if not pool_hit:
+                cid = rt.create(opts)
         # durable before anything acts on the cid: a crash here must find
         # the container again by (deterministic name, journaled cid)
         self._journal(REC_CREATED, durable=True, agent=loop.agent,
@@ -2338,18 +2397,19 @@ class LoopScheduler:
         except ClawkerError:
             pass  # state file is advisory; the loop itself is not
         self.seams.fire("launch.pre_start")
-        if fresh:
-            # first start of THIS container (iteration 0, or the first
-            # iteration after a migration re-created it elsewhere): the
-            # full pre/post bootstrap must run
-            rt.start(cid)
-        else:
-            engine.start_container(cid)
-            # a restarted container gets a fresh cgroup: enforcement must
-            # re-enroll every iteration (the handler's drift guard keys
-            # on exactly this)
-            if rt.post_start:
-                rt.post_start(cid)
+        with self._engine_ctx(loop):
+            if fresh:
+                # first start of THIS container (iteration 0, or the first
+                # iteration after a migration re-created it elsewhere): the
+                # full pre/post bootstrap must run
+                rt.start(cid)
+            else:
+                engine.start_container(cid)
+                # a restarted container gets a fresh cgroup: enforcement
+                # must re-enroll every iteration (the handler's drift
+                # guard keys on exactly this)
+                if rt.post_start:
+                    rt.post_start(cid)
         with self._placement_lock:
             if loop.epoch != epoch:
                 # orphaned mid-start: the orphan already moved this

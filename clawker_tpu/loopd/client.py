@@ -105,12 +105,23 @@ class LoopdClient:
         return self._call(msg)
 
     def submit_run(self, spec_doc: dict, *, keep: bool = False,
-                   stream: bool = True) -> dict:
+                   stream: bool = True, tp: str = "",
+                   clock_offset_s: float = 0.0) -> dict:
         """Submit a loop run; returns the ack (``run`` id, tenant,
         agent names).  With ``stream`` the connection then carries the
-        run's event frames -- consume them via :meth:`events`."""
-        return self._call({"type": "submit_run", "spec": spec_doc,
-                           "keep": keep, "stream": stream})
+        run's event frames -- consume them via :meth:`events`.
+
+        ``tp`` / ``clock_offset_s`` are the federation router's trace
+        propagation fields (docs/tracing.md): its submit span's
+        traceparent and its cumulative clock-offset estimate for this
+        pod, riding the frame the submit already pays for."""
+        msg: dict = {"type": "submit_run", "spec": spec_doc,
+                     "keep": keep, "stream": stream}
+        if tp:
+            msg["tp"] = tp
+        if clock_offset_s:
+            msg["clock_offset_s"] = round(clock_offset_s, 6)
+        return self._call(msg)
 
     def attach(self, run_ref: str) -> dict:
         """Attach to a hosted run (id or unambiguous prefix); returns

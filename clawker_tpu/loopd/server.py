@@ -41,6 +41,7 @@ import socket
 import threading
 import time
 from dataclasses import dataclass, field
+from pathlib import Path
 
 from .. import __version__, logsetup, telemetry
 from ..agentd import protocol
@@ -107,6 +108,8 @@ def spec_from_doc(doc: dict) -> LoopSpec:
                         if doc.get("orphan_grace_s") is not None else None),
         warm_pool_depth=int(doc.get("warm_pool_depth") or 0),
         telemetry=bool(doc.get("telemetry", True)),
+        trace_parent=str(doc.get("trace_parent") or ""),
+        clock_offset_s=float(doc.get("clock_offset_s") or 0.0),
     )
 
 
@@ -228,9 +231,14 @@ class LoopdServer:
     """Accept loop, per-connection handlers, hosted-run supervision."""
 
     def __init__(self, cfg: Config, driver: RuntimeDriver, *,
-                 sock_path=None, seams=None, metrics_port: int | None = None):
+                 sock_path=None, seams=None, metrics_port: int | None = None,
+                 executors=None):
         self.cfg = cfg
         self.driver = driver
+        # worker-resident launch data plane for hosted runs (an
+        # ExecutorSet; docs/workerd.md) -- every hosted scheduler
+        # dispatches through it when a worker's channel is live
+        self.executors = executors
         self.sock_path = sock_path if sock_path is not None else (
             socket_path(cfg))
         self.seams = seams if seams is not None else NULL_SEAMS
@@ -269,6 +277,20 @@ class LoopdServer:
         self._capacity_journal = None   # the daemon's own capacity WAL:
         #                             durable scale intents land here even
         #                             with zero hosted runs to fan out to
+        # distributed tracing (docs/tracing.md): daemon-lifetime recorder
+        # for ``loopd.submit`` hop spans -- one file per pod, every hosted
+        # run's hop in it (the merge filters by trace id)
+        self.flight = None
+        try:
+            tele = cfg.settings.telemetry
+            if tele.tracing.enable and tele.flight_recorder.enable:
+                from ..monitor.ledger import FLIGHT_DIR, FlightRecorder
+                self.flight = FlightRecorder(
+                    Path(cfg.logs_dir) / FLIGHT_DIR
+                    / f"loopd-{self.pod_name()}.jsonl",
+                    max_bytes=tele.flight_recorder.max_bytes)
+        except AttributeError:
+            self.flight = None
 
     # ----------------------------------------------------------- lifecycle
 
@@ -540,6 +562,8 @@ class LoopdServer:
             self._metrics_server.stop()
         if self._capacity_journal is not None:
             self._capacity_journal.close()
+        if self.flight is not None:
+            self.flight.close()
         self.lanes.close_all()
         self._drop_conns()
         pidfile_path(self.cfg).unlink(missing_ok=True)
@@ -570,6 +594,11 @@ class LoopdServer:
             self.shipper.kill()
         if self._metrics_server is not None:
             self._metrics_server.stop()
+        if self.flight is not None:
+            # the recorder FILE stays behind: a killed pod's submit
+            # spans are exactly the surviving trace evidence the merge
+            # renders around (docs/tracing.md#gaps)
+            self.flight.close()
         self._stopped.set()
 
     def _drop_conns(self) -> None:
@@ -654,13 +683,18 @@ class LoopdServer:
                         "version": __version__,
                         "project": self._project_name(),
                         "pod": self.pod_name(),
+                        # server wall clock: the client side of this
+                        # round-trip feeds its per-pod skew estimator
+                        # (docs/tracing.md#clock-skew)
+                        "ts": time.time(),
                     })
                 elif kind == "ping":
                     with self._runs_lock:
                         n = sum(1 for r in self.runs.values()
                                 if not r.done.is_set())
                     protocol.write_msg(conn, {
-                        "type": "pong", "pid": os.getpid(), "runs": n})
+                        "type": "pong", "pid": os.getpid(), "runs": n,
+                        "ts": time.time()})
                 elif kind == "status":
                     protocol.write_msg(conn, self._status_doc())
                 elif kind == "submit_run":
@@ -774,7 +808,8 @@ class LoopdServer:
         log.info("lease %s granted to %s (%d credit(s), ttl %.1fs)",
                  lease.lease_id, tenant, grant, ttl)
         return {"type": "lease", "lease": lease.lease_id,
-                "tokens": grant, "ttl_s": ttl, "pod": self.pod_name()}
+                "tokens": grant, "ttl_s": ttl, "pod": self.pod_name(),
+                "ts": time.time()}
 
     def _lease_renew(self, msg: dict) -> dict:
         lid = str(msg.get("lease", ""))
@@ -791,7 +826,7 @@ class LoopdServer:
             lease.renewals += 1
             return {"type": "lease", "lease": lease.lease_id,
                     "tokens": lease.granted, "ttl_s": lease.ttl_s,
-                    "pod": self.pod_name()}
+                    "pod": self.pod_name(), "ts": time.time()}
 
     def _lease_release(self, msg: dict) -> dict:
         lid = str(msg.get("lease", ""))
@@ -814,6 +849,7 @@ class LoopdServer:
     # ----------------------------------------------------------- run verbs
 
     def _handle_submit(self, conn, msg: dict, ident: str) -> None:
+        t_submit = time.time()
         doc = msg.get("spec") or {}
         spec = spec_from_doc(doc)
         # per-tenant accounting keyed by CLIENT IDENTITY: a run that
@@ -828,6 +864,7 @@ class LoopdServer:
         # thread AFTER the ack, so submit latency is the socket hop
         # plus registration, not a journal fsync + fan-out
         run = self._create_run(spec, ident, keep=bool(msg.get("keep")))
+        self._trace_submit(run, msg, t_submit)
         self.seams.fire("loopd.post_submit")
         client_gone = False
         try:
@@ -837,7 +874,9 @@ class LoopdServer:
                 # deterministic per (run, slot) -- the same names the
                 # scheduler will place (and the journal will record)
                 "agents": [f"{spec.agent_prefix}-{run.run_id[:6]}-{i}"
-                           for i in range(spec.parallel)]})
+                           for i in range(spec.parallel)],
+                # skew sample for the submitting router's estimator
+                "ts": time.time()})
         except (OSError, ClawkerError):
             client_gone = True      # ownership already transferred: the
             #                         run executes regardless
@@ -845,6 +884,38 @@ class LoopdServer:
         self._start_run(run)
         if not client_gone and msg.get("stream", True):
             self._stream(conn, run)
+
+    def _trace_submit(self, run: _DaemonRun, msg: dict,
+                      t_submit: float) -> None:
+        """Record this pod's ``loopd.submit`` hop span and hand the spec
+        its downstream trace linkage: the run id IS the trace id from
+        here on (it did not exist before _create_run), the submit span
+        is the scheduler's upstream parent, and the router's cumulative
+        clock offset rides along so the hosted scheduler -- and every
+        workerd below it -- stamps auditable ``skew_s`` values."""
+        spec = run.spec
+        offset = float(msg.get("clock_offset_s") or 0.0)
+        spec.clock_offset_s = offset
+        if self.flight is None or self._aborted:
+            return
+        from ..telemetry.spans import SpanRecord
+        from ..tracing.context import TraceContext
+        from ..tracing.names import SPAN_LOOPD_SUBMIT
+        from ..util import ids
+
+        up = TraceContext.from_header(str(msg.get("tp", "")))
+        span_id = ids.short_id(16)
+        spec.trace_parent = TraceContext(run.run_id, span_id).to_header()
+        attrs = {"pod": self.pod_name(), "tenant": run.tenant}
+        if up is not None and up.span_id:
+            attrs["ctx_parent"] = up.span_id
+        if offset:
+            attrs["skew_s"] = round(offset, 6)
+        self.flight.append(SpanRecord(
+            trace_id=run.run_id, span_id=span_id, parent_id="",
+            name=SPAN_LOOPD_SUBMIT, agent="", worker=self.pod_name(),
+            t_start=t_submit, t_end=time.time(),
+            attrs=attrs).to_json())
 
     def _create_run(self, spec: LoopSpec, ident: str, *,
                     keep: bool) -> _DaemonRun:
@@ -964,6 +1035,11 @@ class LoopdServer:
             run.publish({"type": "event", "run": run.run_id,
                          "agent": agent, "event": event, "detail": detail})
 
+        # an executor set binds to ONE scheduler, so hosted runs get a
+        # fresh set each when a factory was supplied (a plain set is
+        # the single-run convenience: tests, one-shot daemons)
+        execset = (self.executors() if callable(self.executors)
+                   else self.executors)
         try:
             if run.resume_image is not None:
                 # cross-pod adoption: resume the replayed journal image
@@ -974,14 +1050,16 @@ class LoopdServer:
                     on_event=on_event,
                     orphan_grace_s=run.adopt_orphan_grace_s,
                     admission=self.admission,
-                    seams=self.seams)
+                    seams=self.seams,
+                    executors=execset)
             else:
                 sched = LoopScheduler(self.cfg, self.driver, run.spec,
                                       on_event=on_event,
                                       run_id=run.run_id,
                                       admission=self.admission,
                                       lanes=self.lanes,
-                                      seams=self.seams)
+                                      seams=self.seams,
+                                      executors=execset)
             run.sched = sched
             if self.sentinel is not None:
                 # the hosted run's typed events feed the daemon
@@ -1013,6 +1091,11 @@ class LoopdServer:
             agents = run.sched.status() if run.sched is not None else []
             ok = False
             run.result["error"] = repr(e)
+        if callable(self.executors) and execset is not None:
+            try:
+                execset.close_all()     # factory-made: this run owned it
+            except Exception:  # noqa: BLE001 -- teardown must not mask
+                pass           #                the run's own result
         if self._aborted:
             return      # killed daemons publish nothing
         run.result.update({"agents": agents, "ok": ok})

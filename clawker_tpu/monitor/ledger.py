@@ -21,6 +21,7 @@ SeedCollisionError, LoadLedger) -- semantics re-derived.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -123,6 +124,60 @@ def flight_path(logs_dir: Path, run_id: str) -> Path:
     return Path(logs_dir) / FLIGHT_DIR / f"loop-{run_id}.jsonl"
 
 
+def rotated_path(path: Path) -> Path:
+    """The previous generation a size-capped recorder rotated out."""
+    return Path(str(path) + ".1")
+
+
+def read_rotated_lines(path: Path) -> list[str]:
+    """Raw lines across the rotation boundary: the ``.1`` generation
+    first (older records), then the current file.  Missing files read
+    as empty, so the helper serves unrotated recorders unchanged."""
+    lines: list[str] = []
+    for p in (rotated_path(path), Path(path)):
+        try:
+            lines.extend(p.read_text(encoding="utf-8").splitlines())
+        except OSError:
+            continue
+    return lines
+
+
+def read_rotated(path: Path) -> list[dict]:
+    """:func:`read_jsonl` across the rotation boundary."""
+    return parse_jsonl(read_rotated_lines(path))
+
+
+def tail_rotated(path: Path, state: TailState) -> list[dict]:
+    """Rotation-aware incremental tail: like :func:`tail_jsonl`, but
+    when the file shrank because the recorder ROTATED (current ->
+    ``.1``), the old generation's remaining records are drained from
+    the prior offset before the cursor restarts on the new file -- a
+    console tailing a capped recorder loses nothing at the boundary.
+    ``state.resets`` still bumps, but only genuinely (a truncation, or
+    a second rotation between polls) loses records."""
+    path = Path(path)
+    try:
+        size = path.stat().st_size
+    except OSError:
+        size = -1
+    out: list[dict] = []
+    if 0 <= size < state.offset:
+        try:
+            with open(rotated_path(path), "rb") as f:
+                f.seek(state.offset - len(state.carry))
+                data = f.read()
+            lines = data.split(b"\n")
+            out.extend(parse_jsonl(
+                line.decode("utf-8", "replace") for line in lines))
+        except OSError:
+            pass        # double rotation / no .1: the remainder is gone
+        state.offset = 0
+        state.carry = b""
+        state.resets += 1
+    out.extend(tail_jsonl(path, state))
+    return out
+
+
 class FlightRecorder:
     """Append-only JSONL record sink for one run.
 
@@ -131,16 +186,43 @@ class FlightRecorder:
     buffering records in memory would lose the most interesting tail.
     A recorder whose directory cannot be created degrades to a no-op --
     telemetry must never fail the run it is recording.
+
+    ``max_bytes`` bounds the file for daemon-lifetime recorders (and
+    long daemon-hosted runs): when an append would pass the cap, the
+    current file rotates to ``<path>.1`` (replacing any prior ``.1``)
+    and a fresh generation starts, so the newest records are always in
+    a readable, bounded pair of files.  Readers cross the boundary via
+    :func:`read_rotated` / :func:`tail_rotated`.  0 = unbounded.
     """
 
-    def __init__(self, path: Path):
+    def __init__(self, path: Path, *, max_bytes: int = 0):
         self.path = Path(path)
+        self.max_bytes = max(0, int(max_bytes))
         self._lock = threading.Lock()
         self._fh = None
+        self._size = 0
         self.dropped = 0
+        self.rotations = 0
         try:
             self.path.parent.mkdir(parents=True, exist_ok=True)
             self._fh = open(self.path, "a", encoding="utf-8")
+            self._size = self.path.stat().st_size
+        except OSError:
+            self._fh = None
+
+    def _rotate_locked(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+        try:
+            os.replace(self.path, rotated_path(self.path))
+        except OSError:
+            pass        # rotation is best-effort; keep appending
+        try:
+            self._fh = open(self.path, "a", encoding="utf-8")
+            self._size = self.path.stat().st_size
+            self.rotations += 1
         except OSError:
             self._fh = None
 
@@ -153,9 +235,16 @@ class FlightRecorder:
             if self._fh is None:
                 self.dropped += 1
                 return
+            if (self.max_bytes and self._size
+                    and self._size + len(line) + 1 > self.max_bytes):
+                self._rotate_locked()
+                if self._fh is None:
+                    self.dropped += 1
+                    return
             try:
                 self._fh.write(line + "\n")
                 self._fh.flush()
+                self._size += len(line) + 1
             except OSError:
                 self.dropped += 1
 

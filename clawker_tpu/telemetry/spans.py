@@ -209,6 +209,15 @@ class Tracer:
                                               dict(attrs)]
             return span_id
 
+    def open_root(self, agent: str, iteration: int) -> str:
+        """The open root's span id, or "" -- a PEEK (never opens): the
+        workerd dispatch path asks for a traceparent to stamp on adopt
+        intents, and must not conjure roots for iterations that have
+        not begun."""
+        with self._lock:
+            entry = self._open.get((agent, iteration))
+            return entry[0] if entry is not None else ""
+
     def child(self, agent: str, iteration: int, name: str,
               t_start: float, t_end: float, *, worker: str = "",
               status: str = "ok", **attrs) -> SpanRecord | None:

@@ -1,0 +1,38 @@
+"""Cross-process distributed tracing (docs/tracing.md).
+
+The flight-recorder span trees (telemetry/spans.py) stop at the
+process boundary: loopd, workerd, and the federation router each added
+a WAN/daemon hop where causality was lost.  This package closes those
+seams with three small, dependency-light pieces:
+
+- :mod:`~clawker_tpu.tracing.context` -- a W3C-traceparent-style
+  :class:`TraceContext` carried as *frame fields* on every existing
+  RPC (federation submit, the loopd wire protocol, workerd
+  intent/event frames, engine HTTP headers).  Propagation never adds a
+  round-trip: the ids ride messages that were already being sent.
+- :mod:`~clawker_tpu.tracing.skew` -- per-channel clock-skew
+  estimation from the round-trips each channel already performs
+  (hello/ping midpoint offset, EWMA-smoothed), chained cumulatively so
+  every daemon can stamp its spans with an auditable ``skew_s``
+  offset back to the root clock.
+- :mod:`~clawker_tpu.tracing.merge` -- joins the router / loopd /
+  workerd / scheduler flight recorders into one causal tree, tolerant
+  of torn tails and missing segments: a dead daemon's segment renders
+  as an explicit *gap span*, never a broken tree.
+
+:mod:`~clawker_tpu.tracing.names` is the span-name catalogue the
+``registry-parity`` analyze checker enforces against the table in
+docs/telemetry.md (the same diff-time contract metric names have).
+"""
+
+from __future__ import annotations
+
+from .context import TraceContext, current, use
+from .merge import MergeResult, merge_records, merge_run
+from .skew import ChannelClock
+
+__all__ = [
+    "TraceContext", "current", "use",
+    "ChannelClock",
+    "MergeResult", "merge_records", "merge_run",
+]

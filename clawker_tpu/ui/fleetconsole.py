@@ -27,7 +27,8 @@ import collections
 import time
 from pathlib import Path
 
-from ..monitor.ledger import TailState, flight_path, tail_jsonl
+from ..monitor.ledger import (FLIGHT_DIR, TailState, flight_path,
+                              tail_rotated)
 from ..telemetry.spans import SPAN_ITERATION, SpanRecord, build_trees
 from .colors import visible_len
 from .damage import DamagePainter
@@ -66,28 +67,59 @@ def _anomaly_threshold() -> float:
 class SpanTail:
     """Bounded incremental tail of one run's flight recorder.
 
-    ``poll`` is O(new bytes) (monitor.ledger.tail_jsonl cursor); only
+    ``poll`` is O(new bytes) (monitor.ledger.tail_rotated cursor); only
     the newest :data:`SPAN_TAIL_LIMIT` span records are retained, so a
     long-lived console never re-reads or re-holds a multi-hour flight
-    file.  A rotated/truncated file resets the window."""
+    file.  A size-capped recorder's rotation is drained losslessly at
+    the boundary; only a genuine truncation loses records.
 
-    def __init__(self, path: Path, *, limit: int = SPAN_TAIL_LIMIT):
+    With ``remote_dir`` + ``run_id`` the tail ALSO follows the daemon
+    recorders that may hold this run's remote trace segments
+    (docs/tracing.md): workerd's create/start/wait spans and the
+    router/loopd submit hops, filtered by trace id, rendered as hop
+    rows under the waterfall."""
+
+    def __init__(self, path: Path, *, limit: int = SPAN_TAIL_LIMIT,
+                 remote_dir: Path | None = None, run_id: str = ""):
         self.path = Path(path)
         self.state = TailState()
         self.records: collections.deque[SpanRecord] = collections.deque(
             maxlen=limit)
+        self.remote_dir = Path(remote_dir) if remote_dir is not None else None
+        self.run_id = run_id
+        self._remote_states: dict[Path, TailState] = {}
+        self.remote: collections.deque[SpanRecord] = collections.deque(
+            maxlen=limit)
 
     def poll(self) -> int:
-        before = self.state.resets
-        docs = tail_jsonl(self.path, self.state)
-        if self.state.resets != before:
-            self.records.clear()
         n = 0
-        for doc in docs:
+        for doc in tail_rotated(self.path, self.state):
             if doc.get("kind") == "span":
                 self.records.append(SpanRecord.from_json(doc))
                 n += 1
+        if self.remote_dir is not None and self.run_id:
+            for pattern in ("workerd-*.jsonl", "router-*.jsonl",
+                            "loopd-*.jsonl"):
+                for p in sorted(self.remote_dir.glob(pattern)):
+                    st = self._remote_states.setdefault(p, TailState())
+                    for doc in tail_rotated(p, st):
+                        if (doc.get("kind") == "span"
+                                and doc.get("trace_id") == self.run_id):
+                            self.remote.append(SpanRecord.from_json(doc))
+                            n += 1
         return n
+
+    def _hop_line(self, cs, rec: SpanRecord, t0: float) -> str:
+        """One remote segment as a hop row, offset skew-adjusted onto
+        the scheduler's clock (attr ``skew_s`` is the segment's
+        cumulative offset estimate -- docs/tracing.md#skew)."""
+        skew = float(rec.attrs.get("skew_s") or 0.0)
+        off = (rec.t_start - skew - t0) * 1000.0
+        wan = rec.attrs.get("wan_ms")
+        extra = f" wan={float(wan):.1f}ms" if wan is not None else ""
+        return cs.gray(
+            f"    ↳ {rec.name:<16.16} {rec.worker:<12.12} "
+            f"+{off:7.1f}ms {rec.wall_s * 1000:6.1f}ms{extra}")
 
     def waterfall_lines(self, cs, *, rows: int = WATERFALL_ROWS,
                         width: int = WATERFALL_WIDTH) -> list[str]:
@@ -99,6 +131,11 @@ class SpanTail:
         roots = [t for t in trees if t.record.name == SPAN_ITERATION]
         roots.sort(key=lambda t: t.record.t_end)
         out = []
+        # run-level submit hops (router/loopd: agent-less) lead the
+        # waterfall -- the WAN cost the whole run paid to get here
+        for hop in [r for r in self.remote if not r.agent][-2:]:
+            t0 = roots[0].record.t_start if roots else hop.t_start
+            out.append(self._hop_line(cs, hop, t0))
         for tree in roots[-rows:]:
             rec = tree.record
             span = max(rec.wall_s, 1e-9)
@@ -117,6 +154,13 @@ class SpanTail:
                       else cs.red(rec.status))
             out.append(f"  {label:<20.20} |{''.join(bar)}| "
                        f"{rec.wall_s * 1000:6.1f}ms {status}")
+            # this iteration's remote workerd segment, as hop rows
+            # offset onto the scheduler's clock (newest 3)
+            it = rec.attrs.get("iteration")
+            hops = [r for r in self.remote
+                    if r.agent == rec.agent and r.attrs.get("iteration") == it]
+            for hop in hops[-3:]:
+                out.append(self._hop_line(cs, hop, rec.t_start))
         return out
 
 
@@ -180,7 +224,8 @@ class FleetConsole:
         tail = self._tails.get(run_id)
         if tail is None:
             tail = self._tails[run_id] = SpanTail(
-                flight_path(self.logs_dir, run_id))
+                flight_path(self.logs_dir, run_id),
+                remote_dir=self.logs_dir / FLIGHT_DIR, run_id=run_id)
             # bound the tail map to the runs the feed still reports
             # (done-run eviction on the daemon side drops them here too)
         return tail

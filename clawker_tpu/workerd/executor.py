@@ -40,6 +40,7 @@ from .. import logsetup, telemetry
 from ..agentd import protocol
 from ..chaos.seams import SeamAbort
 from ..errors import ClawkerError
+from ..tracing.skew import ChannelClock
 from . import WorkerdError
 
 log = logsetup.get("workerd.executor")
@@ -111,6 +112,10 @@ class WorkerdExecutor:
         self._wlock = threading.Lock()
         self._live = False
         self._ever_connected = False
+        # per-channel clock-skew estimator (docs/tracing.md#clock-skew):
+        # fed by the ``ts`` field on hello_ack/resync_ack round-trips
+        # this channel already pays -- never a new RPC
+        self.clock = ChannelClock()
         self._closed = threading.Event()
         self._dead = threading.Event()      # channel needs a redial
         self.reconnects = 0
@@ -174,17 +179,29 @@ class WorkerdExecutor:
             s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             s.settimeout(CONNECT_TIMEOUT_S)
             s.connect(str(self.sock_path))
+            t0 = time.time()
             protocol.write_msg(s, {"type": "hello"})
-            if protocol.read_msg(s).get("type") != "hello_ack":
+            ack = protocol.read_msg(s)
+            if ack.get("type") != "hello_ack":
                 s.close()
                 return False
+            # the handshake round-trip doubles as a clock-skew sample;
+            # the resync frame hands the daemon its CUMULATIVE offset to
+            # the root clock so its spans carry an auditable ``skew_s``
+            self.clock.observe(t0, float(ack.get("ts") or 0.0), time.time())
             view = self._running_view()
-            protocol.write_msg(s, {"type": "resync", "running": view})
+            t0 = time.time()
+            protocol.write_msg(s, {
+                "type": "resync", "running": view,
+                "clock_offset_s": round(
+                    self.clock.cumulative(self._upstream_offset()), 6)})
             # the resync_ack may be preceded by event frames the server
             # flushes the moment the sink opens: dispatch them in order
             while True:
                 msg = protocol.read_msg(s)
                 if msg.get("type") == "resync_ack":
+                    self.clock.observe(t0, float(msg.get("ts") or 0.0),
+                                       time.time())
                     break
                 if msg.get("type") == "events":
                     self._dispatch_events(msg)
@@ -222,6 +239,24 @@ class WorkerdExecutor:
         if sched is None:
             return []
         return sched._workerd_running_view(self.worker_id)
+
+    def _upstream_offset(self) -> float:
+        """The scheduler's own cumulative offset to the root clock (0
+        when the scheduler IS the root viewer; the loopd-supplied value
+        on a federated run) -- chained into this channel's estimate."""
+        return float(getattr(self.sched, "_trace_offset_s", 0.0) or 0.0)
+
+    def _tp(self, loop) -> str:
+        """The traceparent for one loop's intents: run trace id plus the
+        open iteration-root span id when the scheduler has opened it
+        (adopt intents); "" when tracing is off or no scheduler bound."""
+        fn = getattr(self.sched, "_trace_tp", None)
+        if fn is None:
+            return ""
+        try:
+            return fn(loop)
+        except Exception:   # noqa: BLE001 -- tracing never fails a launch
+            return ""
 
     def _fire_seam(self, name: str) -> None:
         sched = self.sched
@@ -338,7 +373,8 @@ class WorkerdExecutor:
         seq = self._next_seq()
         doc = {"kind": "launch", "seq": seq, "agent": loop.agent,
                "epoch": epoch, "iteration": loop.iteration,
-               "opts": opts_doc, "pool_cid": pool_cid, "state": state}
+               "opts": opts_doc, "pool_cid": pool_cid, "state": state,
+               "tp": self._tp(loop)}
         return self._submit(doc, _Pending(
             seq=seq, kind="launch", doc=doc, handle=Future(),
             t_submit=time.monotonic(), loop=loop, epoch=epoch,
@@ -349,7 +385,7 @@ class WorkerdExecutor:
         seq = self._next_seq()
         doc = {"kind": "start", "seq": seq, "agent": loop.agent,
                "epoch": epoch, "iteration": loop.iteration, "cid": cid,
-               "fresh": fresh, "state": state}
+               "fresh": fresh, "state": state, "tp": self._tp(loop)}
         return self._submit(doc, _Pending(
             seq=seq, kind="start", doc=doc, handle=Future(),
             t_submit=time.monotonic(), loop=loop, epoch=epoch,
@@ -371,7 +407,8 @@ class WorkerdExecutor:
         self._sendq.put({"kind": "adopt", "seq": self._next_seq(),
                          "agent": loop.agent, "epoch": epoch,
                          "iteration": loop.iteration,
-                         "cid": loop.container_id})
+                         "cid": loop.container_id,
+                         "tp": self._tp(loop)})
 
     def submit_halt(self, cid: str, timeout: int = 2) -> None:
         self._sendq.put({"kind": "halt", "seq": self._next_seq(),
@@ -425,6 +462,14 @@ class WorkerdExecutor:
             except Exception:   # noqa: BLE001 -- one bad event must not
                 log.exception("workerd event dispatch failed: %r", ev)
 
+    @staticmethod
+    def _wan_ms(p: _Pending, ev: dict) -> float:
+        """Per-hop WAN wait: client wall elapsed since submit minus the
+        server-side ms the event reports -- queueing + propagation +
+        batching for this intent, attributed on the scheduler's span."""
+        elapsed_ms = (time.monotonic() - p.t_submit) * 1000.0
+        return max(0.0, round(elapsed_ms - float(ev.get("ms", 0.0)), 3))
+
     def _dispatch_one(self, ev: dict) -> None:
         kind = str(ev.get("ev", ""))
         sched = self.sched
@@ -451,13 +496,15 @@ class WorkerdExecutor:
                 sched._workerd_created(
                     p.loop, p.epoch, p.worker, p.cid,
                     bool(ev.get("pool")), str(ev.get("pool_error", "")),
-                    entry, float(ev.get("ms", 0.0)))
+                    entry, float(ev.get("ms", 0.0)),
+                    wan_ms=self._wan_ms(p, ev))
         elif kind == "started":
             with self._plock:
                 self._pending.pop(seq, None)
             if sched is not None:
                 sched._workerd_started(p.loop, p.epoch, p.worker,
-                                       float(ev.get("ms", 0.0)))
+                                       float(ev.get("ms", 0.0)),
+                                       wan_ms=self._wan_ms(p, ev))
             if not p.handle.done():
                 p.handle.set_result(None)
         elif kind == "pool_ready":

@@ -51,6 +51,7 @@ ever re-sends cannot double-create.
 from __future__ import annotations
 
 import collections
+import contextlib
 import os
 import queue
 import socket
@@ -61,6 +62,8 @@ from .. import __version__, logsetup, telemetry
 from ..agentd import protocol
 from ..chaos.seams import NULL_SEAMS
 from ..errors import ClawkerError, DriverError, NotFoundError
+from ..tracing.names import (SPAN_WORKERD_CREATE, SPAN_WORKERD_START,
+                             SPAN_WORKERD_WAIT)
 from . import WorkerdError
 
 log = logsetup.get("workerd.server")
@@ -172,6 +175,25 @@ class WorkerdServer:
         except AttributeError:
             seed_cap = 64 * 1024 * 1024
         self.seeds = SeedStore(seed_cap)
+        # distributed tracing (docs/tracing.md): worker-side phase
+        # timings become real remote SpanRecords in a per-daemon flight
+        # recorder; the cumulative clock offset to the root clock
+        # arrives on resync frames and is stamped on every span as
+        # ``skew_s`` so the merge's adjustment is auditable
+        self.trace_skew_s = 0.0
+        self.flight = None
+        try:
+            tele = cfg.settings.telemetry
+            if tele.tracing.enable and tele.flight_recorder.enable:
+                from pathlib import Path as _P
+
+                from ..monitor.ledger import FLIGHT_DIR, FlightRecorder
+                self.flight = FlightRecorder(
+                    _P(cfg.logs_dir) / FLIGHT_DIR
+                    / f"workerd-{worker_id}.jsonl",
+                    max_bytes=tele.flight_recorder.max_bytes)
+        except AttributeError:
+            self.flight = None
         self.stats = {"intents": 0, "events": 0, "batches": 0,
                       "dedup_hits": 0, "resyncs": 0,
                       "seeds_stored": 0, "seed_hits": 0, "seed_misses": 0}
@@ -268,6 +290,8 @@ class WorkerdServer:
                 pidfile_path(self.cfg).unlink(missing_ok=True)
             except OSError:
                 pass
+        if self.flight is not None:
+            self.flight.close()
         log.info("workerd for %s stopped", self.worker_id)
 
     def kill(self) -> None:
@@ -284,6 +308,11 @@ class WorkerdServer:
             self._events.clear()        # a killed process loses its buffer
             self._ev_cond.notify_all()
         self.seeds.clear()              # ...and its in-memory seed store
+        if self.flight is not None:
+            # the recorder FILE stays behind (a real SIGKILL leaves it);
+            # spans already flushed are the surviving trace segment, and
+            # anything in flight is the gap the merge marks
+            self.flight.close()
 
     def drop_conns(self) -> None:
         """Hard-drop every client connection (the chaos
@@ -357,13 +386,17 @@ class WorkerdServer:
                 if kind == "hello":
                     # NOTE: the event sink opens at resync, not hello --
                     # the client's handshake reads deterministically
-                    # (hello_ack, then events*, then resync_ack)
+                    # (hello_ack, then events*, then resync_ack).  ``ts``
+                    # turns the round-trip the client already pays into
+                    # one clock-skew sample (docs/tracing.md#clock-skew)
                     self._reply(conn, {
                         "type": "hello_ack", "pid": os.getpid(),
-                        "version": __version__, "worker": self.worker_id})
+                        "version": __version__, "worker": self.worker_id,
+                        "ts": time.time()})
                 elif kind == "ping":
                     self._reply(conn, {"type": "pong", "pid": os.getpid(),
-                                       "worker": self.worker_id})
+                                       "worker": self.worker_id,
+                                       "ts": time.time()})
                 elif kind == "status":
                     self._reply(conn, self._status_doc())
                 elif kind == "intents":
@@ -428,6 +461,14 @@ class WorkerdServer:
         flush so the client can fire ``workerd.post_reconnect`` at the
         boundary the events replay across."""
         self.stats["resyncs"] += 1
+        if msg.get("clock_offset_s") is not None:
+            # the client's cumulative estimate of THIS daemon's clock
+            # offset to the root clock (upstream offsets chained in) --
+            # stamped on every span this daemon records from here on
+            try:
+                self.trace_skew_s = float(msg["clock_offset_s"])
+            except (TypeError, ValueError):
+                pass
         with self._sink_lock:
             self._sink = conn
         healed = 0
@@ -461,7 +502,8 @@ class WorkerdServer:
                                        else "stopped without exit code")})
                 healed += 1
         self._reply(conn, {"type": "resync_ack", "healed": healed,
-                           "buffered": self.undelivered()})
+                           "buffered": self.undelivered(),
+                           "ts": time.time()})
         with self._ev_cond:
             self._ev_cond.notify_all()      # flush the link-down backlog
 
@@ -522,7 +564,8 @@ class WorkerdServer:
                 self._do_create_only(intent, seq)
             elif kind == "adopt":
                 self._arm_waiter(agent, epoch, iteration,
-                                 str(intent.get("cid", "")))
+                                 str(intent.get("cid", "")),
+                                 tp=str(intent.get("tp", "")))
             elif kind == "halt":
                 self._do_halt(intent)
         finally:
@@ -611,28 +654,32 @@ class WorkerdServer:
         executed against the local socket."""
         opts = self._opts(intent.get("opts") or {})
         rt = self._runtime()
+        tp = str(intent.get("tp", ""))
         t0 = time.monotonic()
+        t0_wall = time.time()
         pool_cid = str(intent.get("pool_cid", ""))
         cid = ""
         pool_hit = False
         pool_error = ""
+        sid = self._span_id(tp)
         try:
-            if pool_cid:
-                try:
-                    # analyze: allow(wal-before-mutation): workerd executes
-                    # intents the scheduler journaled write-ahead
-                    # (REC_PLACEMENT durable before dispatch, the
-                    # workerd.pre_dispatch seam) -- the WAL lives on the
-                    # control-plane side of the channel
-                    rt.adopt_pooled(pool_cid, opts)
-                    cid = pool_cid
-                    pool_hit = True
-                except ClawkerError as e:
-                    pool_error = str(e)     # cold-create fallback below
-            if not cid:
-                # analyze: allow(wal-before-mutation): intent WAL'd by the
-                # dispatching scheduler (see above)
-                cid = rt.create(opts)
+            with self._engine_ctx(tp, agent, sid):
+                if pool_cid:
+                    try:
+                        # analyze: allow(wal-before-mutation): workerd
+                        # executes intents the scheduler journaled
+                        # write-ahead (REC_PLACEMENT durable before
+                        # dispatch, the workerd.pre_dispatch seam) -- the
+                        # WAL lives on the control-plane side of the channel
+                        rt.adopt_pooled(pool_cid, opts)
+                        cid = pool_cid
+                        pool_hit = True
+                    except ClawkerError as e:
+                        pool_error = str(e)  # cold-create fallback below
+                if not cid:
+                    # analyze: allow(wal-before-mutation): intent WAL'd by
+                    # the dispatching scheduler (see above)
+                    cid = rt.create(opts)
         except ClawkerError as e:
             self._emit({"ev": "failed", "seq": seq, "phase": "create",
                         "error": str(e),
@@ -641,8 +688,11 @@ class WorkerdServer:
         self._emit({"ev": "created", "seq": seq, "cid": cid,
                     "pool": pool_hit, "pool_error": pool_error,
                     "ms": round((time.monotonic() - t0) * 1000, 3)})
+        self._record_span(tp, SPAN_WORKERD_CREATE, agent, iteration,
+                          t0_wall, time.time(), span_id=sid,
+                          cid=cid, pool=pool_hit)
         self._start_cid(rt, seq, agent, epoch, iteration, cid, fresh=True,
-                        state_doc=intent.get("state"))
+                        state_doc=intent.get("state"), tp=tp)
 
     def _do_start(self, intent: dict, seq: int, agent: str, epoch: int,
                   iteration: int) -> None:
@@ -650,35 +700,40 @@ class WorkerdServer:
         rt = self._runtime()
         self._start_cid(rt, seq, agent, epoch, iteration, cid,
                         fresh=bool(intent.get("fresh", False)),
-                        state_doc=intent.get("state"))
+                        state_doc=intent.get("state"),
+                        tp=str(intent.get("tp", "")))
 
     def _start_cid(self, rt, seq: int, agent: str, epoch: int,
                    iteration: int, cid: str, *, fresh: bool,
-                   state_doc=None) -> None:
+                   state_doc=None, tp: str = "") -> None:
         t0 = time.monotonic()
+        t0_wall = time.time()
+        sid = self._span_id(tp)
         try:
-            if state_doc:
-                # the per-iteration context file (scheduler's
-                # _write_iteration): advisory, never fatal
-                try:
-                    # analyze: allow(wal-before-mutation): advisory write
-                    # into a cid whose REC_CREATED the scheduler already
-                    # journaled
-                    self.engine.put_archive(
-                        cid, str(state_doc.get("dir", "/run/clawker")),
-                        protocol.unb64(str(state_doc.get("tar", ""))))
-                except ClawkerError:
-                    pass
-            if fresh:
-                # analyze: allow(wal-before-mutation): start intents are
-                # WAL'd scheduler-side before dispatch (docs/workerd.md)
-                rt.start(cid)
-            else:
-                # analyze: allow(wal-before-mutation): same contract as
-                # the fresh branch above
-                self.engine.start_container(cid)
-                if rt.post_start:
-                    rt.post_start(cid)
+            with self._engine_ctx(tp, agent, sid):
+                if state_doc:
+                    # the per-iteration context file (scheduler's
+                    # _write_iteration): advisory, never fatal
+                    try:
+                        # analyze: allow(wal-before-mutation): advisory
+                        # write into a cid whose REC_CREATED the scheduler
+                        # already journaled
+                        self.engine.put_archive(
+                            cid, str(state_doc.get("dir", "/run/clawker")),
+                            protocol.unb64(str(state_doc.get("tar", ""))))
+                    except ClawkerError:
+                        pass
+                if fresh:
+                    # analyze: allow(wal-before-mutation): start intents
+                    # are WAL'd scheduler-side before dispatch
+                    # (docs/workerd.md)
+                    rt.start(cid)
+                else:
+                    # analyze: allow(wal-before-mutation): same contract
+                    # as the fresh branch above
+                    self.engine.start_container(cid)
+                    if rt.post_start:
+                        rt.post_start(cid)
         except ClawkerError as e:
             self._emit({"ev": "failed", "seq": seq, "phase": "start",
                         "error": str(e),
@@ -686,7 +741,9 @@ class WorkerdServer:
             return
         self._emit({"ev": "started", "seq": seq, "cid": cid,
                     "ms": round((time.monotonic() - t0) * 1000, 3)})
-        self._arm_waiter(agent, epoch, iteration, cid)
+        self._record_span(tp, SPAN_WORKERD_START, agent, iteration,
+                          t0_wall, time.time(), span_id=sid, cid=cid)
+        self._arm_waiter(agent, epoch, iteration, cid, tp=tp)
 
     def _do_create_only(self, intent: dict, seq: int) -> None:
         """Warm-pool fill: the expensive create-time stages, no start."""
@@ -715,7 +772,7 @@ class WorkerdServer:
             pass        # best effort, like the scheduler's own halts
 
     def _arm_waiter(self, agent: str, epoch: int, iteration: int,
-                    cid: str) -> None:
+                    cid: str, *, tp: str = "") -> None:
         """Local blocking wait -> unsolicited ``exited`` event.  The
         waiter is worker-resident, so an iteration's whole execute
         window costs the WAN nothing."""
@@ -726,6 +783,7 @@ class WorkerdServer:
 
         def wait() -> None:
             t0 = time.monotonic()
+            t0_wall = time.time()
             code: int | None
             detail = ""
             try:
@@ -748,11 +806,76 @@ class WorkerdServer:
                         "iteration": iteration, "code": code,
                         "detail": detail,
                         "wait_ms": round((time.monotonic() - t0) * 1000, 1)})
+            self._record_span(
+                tp, SPAN_WORKERD_WAIT, agent, iteration, t0_wall,
+                time.time(), cid=cid,
+                status="ok" if code == 0 else "failed")
 
         threading.Thread(target=wait, daemon=True,
                          name=f"workerd-wait-{cid[:12]}").start()
 
     # ------------------------------------------------------------ events
+
+    def _record_span(self, tp: str, name: str, agent: str, iteration: int,
+                     t_start: float, t_end: float, *, status: str = "ok",
+                     span_id: str = "", **attrs) -> None:
+        """One remote SpanRecord into the per-daemon flight recorder.
+        ``tp`` is the intent's propagated traceparent (trace id = the
+        run id; span id = the upstream parent, often "" because the
+        scheduler opens the iteration root only when the created event
+        lands -- the merge then attaches by (agent, iteration)).  An
+        explicit ``span_id`` lets the engine-context path pre-announce
+        this span's id to its own children.  No recorder / no context =
+        no work."""
+        if self.flight is None or self._aborted or not tp:
+            return
+        from ..telemetry.spans import SpanRecord
+        from ..tracing.context import TraceContext
+        from ..util import ids
+
+        ctx = TraceContext.from_header(tp)
+        if ctx is None:
+            return
+        self.flight.append(SpanRecord(
+            trace_id=ctx.trace_id, span_id=span_id or ids.short_id(16),
+            parent_id=ctx.span_id, name=name, agent=agent,
+            worker=self.worker_id, t_start=t_start, t_end=t_end,
+            status=status,
+            attrs={"iteration": iteration,
+                   "skew_s": round(self.trace_skew_s, 6),
+                   **attrs}).to_json())
+
+    def _span_id(self, tp: str) -> str:
+        """Pre-generated span id for a phase about to run, or "" when
+        its span would not record anyway."""
+        if self.flight is None or self._aborted or not tp:
+            return ""
+        from ..util import ids
+        return ids.short_id(16)
+
+    def _engine_ctx(self, tp: str, agent: str, span_id: str):
+        """Ambient trace context around one phase's LOCAL engine work:
+        httpapi records ``engine.request`` children into this daemon's
+        recorder, parented to the phase span whose id was pre-generated
+        via :meth:`_span_id` and recorded when the phase ends."""
+        if not span_id:
+            return contextlib.nullcontext()
+        from ..tracing.context import TraceContext, use
+
+        ctx = TraceContext.from_header(tp)
+        if ctx is None:
+            return contextlib.nullcontext()
+        return use(TraceContext(ctx.trace_id, span_id, agent=agent,
+                                worker=self.worker_id,
+                                sink=self._engine_sink))
+
+    def _engine_sink(self, rec) -> None:
+        if self.flight is None or self._aborted:
+            return
+        doc = rec.to_json()
+        doc["attrs"] = {"skew_s": round(self.trace_skew_s, 6),
+                        **doc["attrs"]}
+        self.flight.append(doc)
 
     def _emit(self, ev: dict) -> None:
         if self._aborted:

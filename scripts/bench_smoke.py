@@ -22,6 +22,11 @@ Gates:
 - telemetry_overhead_ns: enabled <= bench.TELEMETRY_BUDGET_NS and
   disabled <= bench.TELEMETRY_DISABLED_BUDGET_NS  (ISSUE 4 acceptance
   bar -- instrumentation must never silently regress the cold start)
+- tracing_overhead_ns <= bench.TRACING_BUDGET_NS per span (propagate +
+  record through a real flight recorder)  (ISSUE 19 acceptance bar)
+- trace_merge_wall_n256 <= bench.TRACE_MERGE_BUDGET_S merging 256
+  agents x 4 recorder processes into ONE rooted tree, zero gaps or
+  skew suspects on a clean set  (ISSUE 19 acceptance bar)
 - loop_fanout_p50_n64 <= bench.FANOUT64_BUDGET_S with every admission
   cap respected and all 64 loops at budget  (ISSUE 6 acceptance bar)
 - placement_admission_stampede: a 64-loop burst against one slow
@@ -193,6 +198,8 @@ def main() -> int:
         STAMPEDE_BUDGET_S,
         TELEMETRY_BUDGET_NS,
         TELEMETRY_DISABLED_BUDGET_NS,
+        TRACE_MERGE_BUDGET_S,
+        TRACING_BUDGET_NS,
         GITGUARD_PUSH_OVERHEAD_BUDGET_MS,
         LOOPD_SUBMIT_BUDGET_MS,
         WARM_POOL_BURST_BUDGET_S,
@@ -225,6 +232,8 @@ def main() -> int:
         bench_pod_failover_migrate,
         bench_resume_reattach,
         bench_telemetry_overhead,
+        bench_trace_merge,
+        bench_tracing_overhead,
         bench_warm_pool_hit,
         bench_warm_pool_refill_burst,
         bench_workerd_event_batch_overhead,
@@ -241,6 +250,17 @@ def main() -> int:
     resume = bench_resume_reattach()
     dials = bench_engine_dials()
     tele = bench_telemetry_overhead()
+    tracing = bench_tracing_overhead()
+    for _ in range(2):
+        # like the telemetry gate, a microsecond-scale per-span cost is
+        # tight against scheduler noise on a shared box: a miss gets two
+        # re-measures and the best attempt is gated
+        if tracing["record_ns"] <= TRACING_BUDGET_NS:
+            break
+        retry = bench_tracing_overhead()
+        if retry["record_ns"] < tracing["record_ns"]:
+            tracing = retry
+    tmerge = bench_trace_merge()
     pool_hit = bench_warm_pool_hit()
     for _ in range(2):
         # the 1ms budget is tight against scheduler noise on a shared
@@ -433,6 +453,20 @@ def main() -> int:
         failures.append(
             f"telemetry_overhead_ns disabled {tele['disabled_ns']}ns "
             f"> {TELEMETRY_DISABLED_BUDGET_NS}ns budget")
+    if tracing["record_ns"] > TRACING_BUDGET_NS:
+        failures.append(
+            f"tracing_overhead_ns {tracing['record_ns']}ns "
+            f"> {TRACING_BUDGET_NS}ns budget")
+    if not tmerge["one_rooted_tree"]:
+        failures.append(
+            f"trace_merge_wall_n256: {tmerge['roots']} roots / "
+            f"{tmerge['gaps']} gaps / {tmerge['skew_suspects']} skew "
+            "suspects -- a clean 4-process recorder set must merge into "
+            "ONE rooted tree")
+    elif tmerge["merge_wall_s"] > TRACE_MERGE_BUDGET_S:
+        failures.append(
+            f"trace_merge_wall_n256 {tmerge['merge_wall_s']}s > "
+            f"{TRACE_MERGE_BUDGET_S}s budget")
     if pool_hit["misses"] or pool_hit["hits"] != pool_hit["iters"]:
         failures.append(
             f"warm_pool_hit_p50: hit rate {pool_hit['hits']}/"
@@ -658,6 +692,8 @@ def main() -> int:
         "resume_reattach_wall_n8": resume,
         "engine_dials_per_run": dials,
         "telemetry_overhead_ns": tele,
+        "tracing_overhead_ns": tracing,
+        "trace_merge_wall_n256": tmerge,
         "warm_pool_hit_p50": pool_hit,
         "warm_pool_refill_burst": pool_burst,
         "loopd_submit_roundtrip_p50": loopd_rt,

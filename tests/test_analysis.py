@@ -332,6 +332,69 @@ def test_parity_metrics_both_directions(tmp_path):
     assert "undocumented_total" in msgs and "ghost_metric_total" in msgs
 
 
+def _span_repo(tmp_path, *, names: str, doc: str):
+    return make_repo(tmp_path, {
+        "clawker_tpu/chaos/seams.py": _seams_module(()),
+        "clawker_tpu/tracing/names.py": names,
+        "docs/telemetry.md": doc,
+    })
+
+
+SPAN_DOC = """
+## Span catalogue
+
+| span | emitted by |
+|---|---|
+| `iteration` | scheduler |
+| `gap` | merge |
+
+## Other
+"""
+
+
+def test_parity_spans_both_directions(tmp_path):
+    """A SPAN_* constant missing from SPAN_CATALOGUE, a catalogued span
+    missing from the doc table, and a documented-but-never-emitted row
+    each fire; the metric scan must NOT see the span table's rows."""
+    repo = _span_repo(
+        tmp_path,
+        names="""
+        SPAN_ITERATION = "iteration"
+        SPAN_ROGUE = "rogue.span"
+        SPAN_CATALOGUE = (
+            "iteration",
+            "undocumented.span",
+        )
+        """,
+        doc=SPAN_DOC)
+    found = findings_of(repo, "registry-parity")
+    msgs = " / ".join(f.message for f in found)
+    assert len(found) == 3, msgs
+    assert "rogue.span" in msgs          # const outside the catalogue
+    assert "undocumented.span" in msgs   # catalogued, no doc row
+    assert "`gap`" in msgs               # documented, never emitted
+    assert "iteration" not in {          # span rows are not metrics
+        f.message.split("`")[1] for f in found if "metric" in f.message}
+
+
+def test_parity_spans_silent_when_in_sync_and_fires_without_section(
+        tmp_path):
+    names = """
+    SPAN_ITERATION = "iteration"
+    SPAN_GAP = "gap"
+    SPAN_CATALOGUE = (
+        "iteration",
+        "gap",
+    )
+    """
+    repo = _span_repo(tmp_path, names=names, doc=SPAN_DOC)
+    assert findings_of(repo, "registry-parity") == []
+    repo2 = _span_repo(tmp_path / "bare", names=names,
+                       doc="| `documented_total` | counter |\n")
+    found = findings_of(repo2, "registry-parity")
+    assert len(found) == 1 and "span-catalogue" in found[0].message
+
+
 def test_parity_silent_when_in_sync(tmp_path):
     repo = make_repo(tmp_path, {
         "clawker_tpu/chaos/seams.py": _seams_module(("launch.pre_create",)),
