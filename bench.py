@@ -1288,6 +1288,96 @@ def bench_chaos_soak(scenarios: int = CHAOS_SOAK_SCENARIOS,
     }
 
 
+JOURNAL_CHECKSUM_BUDGET_NS = 20_000   # per-record CRC32 trailer cost the
+#                                       checksummed WAL may add over a
+#                                       plain json.dumps encode -- the
+#                                       integrity tax on every journal
+#                                       and flight append must stay
+#                                       microscopic next to the fsync it
+#                                       rides with (docs/durability.md)
+
+
+def bench_journal_checksum_overhead(n: int = 20_000) -> dict:
+    """journal_checksum_overhead: per-record cost of the CRC32 trailer.
+
+    Measures ``encode_record`` (serialize + crc + splice) against the
+    bare ``json.dumps`` it wraps, on a realistic placement-record
+    shape, and the end-to-end non-durable ``RunJournal.append`` p50 on
+    tmpfs for scale.  The gate is the DELTA -- the checksum must cost
+    nanoseconds-per-record, because it rides every journal and flight
+    append (docs/durability.md#verify)."""
+    from clawker_tpu.loop.journal import RunJournal
+    from clawker_tpu.monitor.ledger import encode_record
+
+    rec = {"kind": "placement", "seq": 12345, "ts": 1723.456789,
+           "agent": "bench-agent-07", "worker": "w3", "epoch": 2}
+
+    def _encode_ns() -> tuple[float, float]:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            json.dumps(rec, separators=(",", ":"), default=str)
+        plain = (time.perf_counter() - t0) / n * 1e9
+        t0 = time.perf_counter()
+        for _ in range(n):
+            encode_record(rec)
+        full = (time.perf_counter() - t0) / n * 1e9
+        return plain, full
+
+    _encode_ns()                        # warmup
+    plain_ns, full_ns = _encode_ns()
+    with tempfile.TemporaryDirectory() as td:
+        j = RunJournal(Path(td) / "bench.journal")
+        samples = []
+        for i in range(2_000):
+            t0 = time.perf_counter()
+            j.append("placement", agent="bench", worker="w0", epoch=i)
+            samples.append(time.perf_counter() - t0)
+        j.close()
+    samples.sort()
+    return {
+        "plain_encode_ns": round(plain_ns, 1),
+        "checksum_encode_ns": round(full_ns, 1),
+        "overhead_ns": round(full_ns - plain_ns, 1),
+        "append_p50_us": round(samples[len(samples) // 2] * 1e6, 1),
+        "records": n,
+    }
+
+
+DISK_FULL_CHAOS_BUDGET_S = 30.0   # wall ceiling for the one-scenario
+#                                   disk-fault gate: a full disk must
+#                                   degrade the run, never wedge it
+
+
+def bench_disk_full_chaos() -> dict:
+    """disk_full_chaos: one seeded disk-fault scenario, end to end.
+
+    A ``disk_full`` rider (docs/chaos.md#disk-faults) arms ENOSPC on
+    the live journal's writes mid-run; the fleet must drain clean and
+    the no-silent-drop / replay-integrity invariants must hold -- the
+    degraded-durability path exercised as a perf-suite gate, not just
+    in the soak's draw luck."""
+    from clawker_tpu.chaos.plan import FaultEvent, FaultPlan
+    from clawker_tpu.chaos.runner import ChaosRunner, _fresh_cfg
+
+    plan = FaultPlan(seed=CHAOS_SOAK_SEED, scenario=0, n_workers=2,
+                     n_loops=4, iterations=2, events=[
+                         FaultEvent(at_s=0.05, kind="disk_full",
+                                    worker=-1, arg=3),
+                     ])
+    env, cfg = _fresh_cfg()
+    t0 = time.perf_counter()
+    try:
+        result = ChaosRunner(cfg, plan).run_scenario()
+    finally:
+        env.__exit__(None, None, None)
+    return {
+        "ok": result.ok,
+        "violations": result.violations,
+        "injected": result.injected,
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+
 LOOPD_SUBMIT_BUDGET_MS = 5.0  # submit frame -> submitted ack over the
 #                               loopd unix socket: the per-run cost the
 #                               daemon split adds on top of scheduling

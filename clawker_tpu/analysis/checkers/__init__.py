@@ -8,6 +8,7 @@ it in the catalogue.
 
 from . import (  # noqa: F401 -- imported for their registration side effect
     determinism,
+    durable,
     layering,
     locks,
     parity,

@@ -117,6 +117,14 @@ def tokens_for(queued: int, inflight: int, launch_s: float, slo_s: float,
     return cap, work / cap
 
 
+def _synced(rcpt) -> bool:
+    """Durability verdict of a ``hooks.journal(...)`` result (duck-typed
+    so this layer never imports loop.journal: a real AppendReceipt
+    answers with its ``synced`` bit, ``None`` means no WAL is wired and
+    there is no contract to break)."""
+    return rcpt is None or bool(getattr(rcpt, "synced", True))
+
+
 @dataclass
 class CapacityHooks:
     """The scheduler/loopd seam: every surface the controller may act
@@ -133,7 +141,9 @@ class CapacityHooks:
     # on a worker according to the run journal(s) -- the wiring layer
     # implements it by replaying, so a drain can never outrun the WAL
     live_placements: Callable[[str], int] | None = None
-    journal: Callable[..., None] = field(
+    # returns the wiring layer's AppendReceipt (or None when no WAL is
+    # wired) -- durable call sites consume it via _synced() below
+    journal: Callable[..., object] = field(
         default=lambda kind, **fields: None)
     emit: Callable[[CapacityDecisionEvent], None] = field(
         default=lambda ev: None)
@@ -428,9 +438,16 @@ class CapacityController:
     def _scale_up(self, pending: int) -> None:
         # WAL before the provisioner call: a crash in between replays
         # as a durable intent the next generation can audit
-        self.hooks.journal(REC_CAPACITY_SCALE, durable=True,
-                           action="provision", worker="", phase="intent",
-                           pending=pending)
+        rcpt = self.hooks.journal(REC_CAPACITY_SCALE, durable=True,
+                                  action="provision", worker="",
+                                  phase="intent", pending=pending)
+        if not _synced(rcpt):
+            # storage fault: without a durable intent a crash mid-
+            # provision leaks an unauditable worker -- skip the scale;
+            # the sustain window re-triggers once storage recovers
+            log.warning("capacity provision skipped: intent not durable "
+                        "(storage fault)")
+            return
         try:
             new = self.scaler.provision(1)
         except Exception as e:  # noqa: BLE001 -- a failed provision is a
@@ -496,9 +513,15 @@ class CapacityController:
             # WAL-before-mutation: the drain intent is durable before
             # the scaler acts, so a crash mid-drain replays as an
             # auditable intent against a victim PROVEN empty
-            self.hooks.journal(REC_CAPACITY_SCALE, durable=True,
-                               action="drain", worker=victim,
-                               phase="intent")
+            rcpt = self.hooks.journal(REC_CAPACITY_SCALE, durable=True,
+                                      action="drain", worker=victim,
+                                      phase="intent")
+            if not _synced(rcpt):
+                # storage fault: leave the victim queued in
+                # _pending_drain so the drain retries next tick
+                log.warning("capacity drain of %s deferred: intent not "
+                            "durable (storage fault)", victim)
+                continue
             try:
                 ok = self.scaler.drain(victim)
             except Exception as e:      # noqa: BLE001

@@ -74,6 +74,18 @@ with; docs/chaos.md#invariants):
   verdict in the proxy's decision stream may name an out-of-namespace
   ref, and after a ``gitguard_down`` kill NOTHING may be acknowledged
   at all -- a dead guard fails closed, it never falls open.
+- ``no-silent-drop``: a storage fault that actually fired (FaultFS
+  shim counters) must surface -- as a counted scheduler fault AND a
+  ``storage.fault`` bus event; a journal that dropped records must
+  show degraded durability.  A poisoned or dropped write that
+  surfaces nowhere is exactly the silent data loss the fail-loud WAL
+  contract forbids (docs/durability.md).
+- ``replay-integrity``: the checksummed journal fold reproduces the
+  daemon's view of the run up to the declared fault point.  A verify
+  pass reporting corruption is legitimate ONLY when the plan injected
+  a torn record (bit-flip/power-cut), and the verified prefix must
+  still fold to the run's own header -- a fold that lost the run id
+  lost the WAL itself.
 """
 
 from __future__ import annotations
@@ -99,7 +111,8 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
                      cap: int = 0, unfaulted: set[str] | None = None,
                      health=None, kills: int = 0,
                      sentinel=None, workerd=None,
-                     shipper=None, gitguard=None) -> list[str]:
+                     shipper=None, gitguard=None,
+                     storage=None) -> list[str]:
     """Audit one finished scenario; returns human-readable violations
     (empty list = all invariants hold).
 
@@ -436,6 +449,43 @@ def check_invariants(driver, cfg, run_id: str, *, loops=None,
                     f"ref-isolation-at-proxy: proxy journaled an allow "
                     f"verdict for out-of-namespace ref {ref} "
                     f"(identity {ident_header!r})")
+
+    # --- no-silent-drop / replay-integrity: the storage-fault contract
+    # (docs/durability.md).  ``storage`` is the runner's audit dict:
+    # ``fired`` counts faults the FaultFS shims actually raised,
+    # ``faults``/``events`` what the scheduler surfaced (its counter
+    # and storage.fault bus frames across generations), ``dropped``/
+    # ``durability`` the journal's own accounting, ``verify`` the
+    # checksum scan of the final journal, ``torn_injected`` whether
+    # the plan corrupted bytes on purpose, and ``folded_run_id`` what
+    # the verified-prefix fold thinks the run is.
+    if storage is not None:
+        fired = int(storage.get("fired", 0))
+        if fired and not int(storage.get("faults", 0)):
+            violations.append(
+                f"no-silent-drop: {fired} injected storage fault(s) "
+                "fired but the scheduler counted none")
+        if fired and not int(storage.get("events", 0)):
+            violations.append(
+                f"no-silent-drop: {fired} injected storage fault(s) "
+                "fired but no storage.fault event reached the bus")
+        if int(storage.get("dropped", 0)) \
+                and storage.get("durability") == "ok":
+            violations.append(
+                f"no-silent-drop: {storage.get('dropped')} journal "
+                "record(s) dropped but durability still reads ok")
+        verify = storage.get("verify") or {}
+        if int(verify.get("corrupt", 0)) \
+                and not storage.get("torn_injected"):
+            violations.append(
+                f"replay-integrity: journal verify found "
+                f"{verify.get('corrupt')} corrupt record(s) without a "
+                "torn-record injection")
+        folded = storage.get("folded_run_id")
+        if folded is not None and folded != run_id:
+            violations.append(
+                "replay-integrity: the checksummed fold lost the run "
+                f"header (folded {folded!r}, expected {run_id!r})")
 
     # --- span-tree: flight record parses; kill-free runs close every root
     from ..monitor.ledger import read_rotated_lines

@@ -78,6 +78,23 @@ kind                    injection point
                         fail CLOSED (connection refused, journaled
                         ``down_refused``), never fall through to an
                         unguarded path (``ref-isolation-at-proxy``)
+``disk_full``           storage scenarios: the run journal's fd starts
+                        returning ENOSPC for ``arg`` writes
+                        (testenv.FaultFS) -- durable appends must fail
+                        LOUDLY (storage.fault event, degraded
+                        durability, strand-without-penalty on
+                        placement WAL), never silently succeed
+``io_error``            storage scenarios: like ``disk_full`` but EIO
+                        -- the generic dying-disk write error
+``fsync_fail``          storage scenarios: the next ``arg`` fsyncs on
+                        the journal fd raise EIO; the writer must
+                        reopen + re-append the unsynced ring, NEVER
+                        retry fsync on the poisoned fd
+``torn_record``         storage scenarios: flip one bit mid-journal
+                        (``arg: "flip"``) or truncate at the last
+                        synced offset (power cut) -- ``journal
+                        verify`` must flag it and a resume must fold
+                        only the verified prefix
 ======================  ====================================================
 
 Plans with ``sentinel: true`` run with the fleet sentinel attached to
@@ -105,11 +122,13 @@ EVENT_KINDS = (
     "workerd_partition", "workerd_kill", "index_down",
     "traffic_burst", "scale_down", "seed_cache_evict",
     "pod_down", "pod_partition", "gitguard_down",
+    "disk_full", "io_error", "fsync_fail", "torn_record",
 )
 
 # event kinds that target no worker (worker index is ignored)
 _WORKERLESS_KINDS = ("cli_sigkill", "sentinel_kill", "index_down",
-                     "pod_down", "pod_partition", "gitguard_down")
+                     "pod_down", "pod_partition", "gitguard_down",
+                     "disk_full", "io_error", "fsync_fail", "torn_record")
 
 # fault gate modes the worker_* / engine_* / probe_* kinds map onto
 GATE_MODE = {
@@ -431,6 +450,26 @@ def generate_plan(seed: int, scenario: int = 0, *, n_workers: int = 4,
             events.append(FaultEvent(
                 at_s=rng.uniform(0.1, horizon_s * 0.6),
                 kind="gitguard_down", worker=-1))
+    # storage rider (drawn strictly AFTER every pre-existing draw, so
+    # the worker-fault/sigkill/sentinel/workerd/shipper/capacity/
+    # seed-cache/pod/gitguard schedule of a (seed, scenario) pair is
+    # byte-identical to the pre-storage generator): about a third of
+    # scenarios hit the run journal's own disk -- write errors
+    # (ENOSPC/EIO), an fsync-fail burst (the reopen-not-retry proof),
+    # or a torn record (bit-flip/power-cut, audited by the
+    # replay-integrity invariant).  Every fault must surface as a
+    # storage.fault event + metric (the no-silent-drop invariant)
+    if rng.random() < 0.35:
+        kind = rng.choice(("disk_full", "io_error", "fsync_fail",
+                           "fsync_fail", "torn_record"))
+        arg = None
+        if kind in ("disk_full", "io_error", "fsync_fail"):
+            arg = rng.randint(1, 4)
+        elif kind == "torn_record":
+            arg = "flip" if rng.random() < 0.5 else "cut"
+        events.append(FaultEvent(
+            at_s=rng.uniform(0.05, horizon_s * 0.6), kind=kind,
+            worker=-1, arg=arg))
     plan.events = sorted(events, key=lambda e: e.at_s)
     _validate(plan)
     return plan

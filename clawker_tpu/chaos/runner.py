@@ -248,6 +248,19 @@ class ChaosRunner:
         self._run_done = threading.Event()
         self._run_exc: list[BaseException] = []
         self._armed: list[tuple] = []   # (sched, seam, event) pending arms
+        # storage scenarios (disk_full/io_error/fsync_fail/torn_record):
+        # faults hit the run journal's OWN fd via testenv.FaultFS, never
+        # an engine -- the workers stay unfaulted.  The audit compares
+        # the shims' fired counts against the scheduler's fault
+        # accounting and storage.fault bus events (no-silent-drop), and
+        # the checksum verify verdict against the injections
+        # (replay-integrity); both counters accumulate across
+        # kill/resume generations
+        self._storage_injected: list[str] = []
+        self._storage_shims: list = []
+        self._torn_injected = False
+        self._storage_events = 0        # storage.fault frames, all gens
+        self._storage_faults_base = 0   # dead generations' fault counts
         # sentinel scenarios (plan.sentinel): the fleet sentinel rides
         # the run, fed by synthetic per-worker egress streams; the
         # standard invariants must hold WITH it attached, its audit
@@ -396,6 +409,11 @@ class ChaosRunner:
         from ..loop.journal import RunJournal, journal_path, replay
 
         self.generations += 1
+        if self._sched is not None:
+            # the dead generation's storage-fault count survives into
+            # the audit (its bus history does not)
+            self._storage_faults_base += getattr(
+                self._sched, "storage_faults", 0)
         seams = SeamRegistry()
         if resume_of is None:
             sched = LoopScheduler(self.cfg, self.driver, self._spec(),
@@ -414,6 +432,7 @@ class ChaosRunner:
                 health_config=self.health_config, seams=seams,
                 executors=self.executors)
         self._sched = sched
+        sched.events.add_tap(self._storage_tap)
         if self.sentinel is not None:
             # re-attached per generation: each generation owns a fresh
             # bus/flight recorder, while the sentinel's baselines and
@@ -591,6 +610,124 @@ class ChaosRunner:
                 self.capacity_ctrl.request_drain(wid)
         _INJECTIONS.labels(ev.kind).inc()
         self.injected += 1
+
+    def _storage_tap(self, rec) -> None:
+        """Bus tap counting storage.fault frames across generations --
+        the no-silent-drop audit's event half."""
+        from ..monitor.events import STORAGE_FAULT
+
+        if rec.event == STORAGE_FAULT:
+            self._storage_events += 1
+
+    def _apply_storage_fault(self, ev: FaultEvent) -> None:
+        """Storage faults hit the run journal's own fd
+        (testenv.FaultFS) or its bytes on disk, never an engine: the
+        workers stay unfaulted, so spurious-quarantine also proves a
+        dying disk cannot open a breaker."""
+        import errno
+
+        from ..testenv import FaultFS
+
+        self._storage_injected.append(ev.kind)
+        if ev.kind == "torn_record":
+            self._inject_torn(ev)
+        else:
+            journal = getattr(self._sched, "journal", None)
+            shim = FaultFS.install(journal) if journal is not None else None
+            if shim is None:
+                return      # journal disabled/unhealthy: nothing to arm
+            self._storage_shims.append(shim)
+            n = max(1, int(ev.arg or 1))
+            if ev.kind == "disk_full":
+                shim.fail_writes(n, errno_=errno.ENOSPC)
+            elif ev.kind == "io_error":
+                shim.fail_writes(n, errno_=errno.EIO)
+            elif ev.kind == "fsync_fail":
+                shim.fail_fsyncs(n)
+        _INJECTIONS.labels(ev.kind).inc()
+        self.injected += 1
+
+    def _inject_torn(self, ev: FaultEvent) -> None:
+        """torn_record: corrupt journal bytes in place -- a bit-flip
+        (``arg: "flip"``) or a crash-torn cut truncating into the last
+        record (``arg: "cut"``).  A sacrificial probe record takes the
+        damage: the corruption is real (verify must flag it, the
+        durable fold must stop at it) without destroying a record the
+        OTHER invariants cross-audit -- the mid-run process stays
+        alive, so a damaged placement/exit record would never be
+        re-journaled the way a kill/resume cycle heals a torn tail.
+        The replay-integrity invariant tolerates the corruption ONLY
+        because ``torn_injected`` declares it."""
+        from pathlib import Path
+
+        from ..testenv import FaultFS
+
+        journal = getattr(self._sched, "journal", None)
+        if journal is None or not journal.healthy:
+            return
+        rcpt = journal.append("chaos_torn_probe", durable=True,
+                              mode=str(ev.arg))
+        if not rcpt.synced:
+            return      # the disk is already faulted: nothing settled
+        jp = Path(journal.path)
+        try:
+            size = jp.stat().st_size
+        except OSError:
+            return
+        self._torn_injected = True
+        if ev.arg == "cut":
+            # power cut: the probe's unsynced-looking tail vanishes;
+            # terminating the torn fragment keeps later appends on a
+            # fresh line, so the fragment reads as one garbled
+            # mid-file line the fold must stop before
+            try:
+                os.truncate(jp, size - 4)
+                with open(jp, "a", encoding="utf-8") as fh:
+                    fh.write("\n")
+            except OSError:
+                self._torn_injected = False
+        else:
+            # flip one bit inside the probe line (clear of its newline):
+            # the record still parses but its CRC lies, or stops
+            # parsing at all -- either way checksum-verify must flag it
+            if not FaultFS.flip_bit_in_file(jp, size - 10):
+                self._torn_injected = False
+
+    def _storage_audit(self) -> dict | None:
+        """Evidence for the storage invariants (None when the plan
+        injected no storage fault): shim fired counts vs scheduler
+        fault accounting vs storage.fault events, plus the checksum
+        verify verdict and the run id the verified prefix folds to."""
+        if not self._storage_injected:
+            return None
+        from pathlib import Path
+
+        from ..loop.journal import journal_path, replay
+        from ..monitor.ledger import read_verified_prefix, verify_jsonl
+
+        sched = self._sched
+        journal = getattr(sched, "journal", None)
+        fired = sum(s.failed_writes + s.failed_fsyncs
+                    for s in self._storage_shims)
+        audit = {
+            "injected": list(self._storage_injected),
+            "torn_injected": self._torn_injected,
+            "fired": fired,
+            "faults": (self._storage_faults_base
+                       + getattr(sched, "storage_faults", 0)),
+            "durability": getattr(sched, "durability", "ok"),
+            "dropped": getattr(journal, "dropped", 0) or 0,
+            "poisoned": getattr(journal, "poisoned", 0) or 0,
+            "events": self._storage_events,
+            "verify": None,
+            "folded_run_id": None,
+        }
+        jp = Path(journal_path(self.cfg.logs_dir, sched.loop_id))
+        if jp.exists():
+            audit["verify"] = verify_jsonl(jp).to_doc()
+            records, _report = read_verified_prefix(jp)
+            audit["folded_run_id"] = replay(records).run_id
+        return audit
 
     def _gitguard_probe(self) -> None:
         """Fire the next scheduled push probe at the gitguard proxy:
@@ -772,6 +909,11 @@ class ChaosRunner:
                     # elastic controller, never an engine: the worker
                     # stays unfaulted
                     self._apply_capacity_fault(ev)
+                elif ev.kind in ("disk_full", "io_error", "fsync_fail",
+                                 "torn_record"):
+                    # storage faults hit the run journal's fd / bytes,
+                    # never an engine: the worker stays unfaulted
+                    self._apply_storage_fault(ev)
                 elif ev.kind in ("egress_silent", "egress_flood",
                                  "sentinel_kill"):
                     # stream/collector faults: they hit the SENTINEL's
@@ -852,7 +994,8 @@ class ChaosRunner:
                 kills=self.kills, sentinel=self.sentinel,
                 workerd=self._workerd_audit(),
                 shipper=self._shipper_audit(),
-                gitguard=self._gitguard_audit()))
+                gitguard=self._gitguard_audit(),
+                storage=self._storage_audit()))
         except ClawkerError as e:
             runner_error = True
             result.violations.append(f"runner-error: {e}")
@@ -1193,6 +1336,42 @@ class ChaosController:
                     "chaos", "skipped",
                     f"{ev.kind}: seed stores are workerd-resident "
                     "(use the soak runner / `clawker chaos run`)")
+                continue
+            if ev.kind in ("disk_full", "io_error", "fsync_fail"):
+                # storage faults arm the LIVE run's journal fd (the
+                # fail-loud contract under test end-to-end); the
+                # journal recovers on a fresh fd and the run degrades
+                # per settings loop.journal.on_fault
+                import errno
+
+                from ..testenv import FaultFS
+
+                journal = getattr(self.sched, "journal", None)
+                shim = (FaultFS.install(journal)
+                        if journal is not None else None)
+                if shim is None:
+                    self.sched.on_event(
+                        "chaos", "skipped",
+                        f"{ev.kind}: no healthy journal on this run")
+                    continue
+                n = max(1, int(ev.arg or 1))
+                if ev.kind == "fsync_fail":
+                    shim.fail_fsyncs(n)
+                else:
+                    shim.fail_writes(n, errno_=(
+                        errno.ENOSPC if ev.kind == "disk_full"
+                        else errno.EIO))
+                _INJECTIONS.labels(ev.kind).inc()
+                self.sched.on_event("chaos", "injected",
+                                    f"{ev.kind} n={n}")
+                continue
+            if ev.kind == "torn_record":
+                # corrupting a LIVE user journal in place would destroy
+                # real crash evidence: soak-runner only
+                self.sched.on_event(
+                    "chaos", "skipped",
+                    f"{ev.kind}: destructive to a live journal (use "
+                    "the soak runner / `clawker chaos run`)")
                 continue
             if ev.kind == "gitguard_down":
                 # kill the live run's git firewall proxy: every later

@@ -150,13 +150,37 @@ def _loopd_status(f: Factory, no_daemon: bool) -> dict | None:
     return doc
 
 
-_HEALTH_COLUMNS = ("WORKER", "STATE", "BRK", "WORKERD", "P50MS", "P95MS",
-                   "PROBES", "FAILS", "ORPHANED", "MIG-OUT", "MIG-IN",
-                   "LAST-ERROR")
+_HEALTH_COLUMNS = ("WORKER", "STATE", "BRK", "WORKERD", "STORAGE", "P50MS",
+                   "P95MS", "PROBES", "FAILS", "ORPHANED", "MIG-OUT",
+                   "MIG-IN", "LAST-ERROR")
+
+
+def _storage_verdict(doc: dict | None) -> str:
+    """Compact STORAGE cell from a loopd status doc: the worst WAL
+    durability across hosted runs (ok|degraded|failed,
+    docs/durability.md) with the disk-pressure ladder level appended
+    when the daemon is shedding (``/p1``) or GC-ing (``/p2``)."""
+    if not doc:
+        return "-"
+    worst = "ok"
+    for r in doc.get("runs") or []:
+        d = (r.get("storage") or {}).get("durability")
+        if d == "failed":
+            worst = "failed"
+            break
+        if d == "degraded":
+            worst = "degraded"
+    stor = doc.get("storage") or {}
+    wal = stor.get("capacity_wal") or {}
+    if worst == "ok" and wal and not wal.get("healthy", True):
+        worst = "degraded"
+    level = int((stor.get("pressure") or {}).get("level") or 0)
+    return f"{worst}/p{level}" if level else worst
 
 
 def _health_rows(stats: list[dict], anom: dict | None = None,
-                 workerd: dict | None = None) -> list[str]:
+                 workerd: dict | None = None,
+                 storage: str = "-") -> list[str]:
     # BRK is the registry's health_breaker_state gauge (0=closed
     # 1=half_open 2=open) -- the same value a Prometheus scrape of
     # `clawker loop --metrics-port` serves (docs/telemetry.md).
@@ -171,7 +195,7 @@ def _health_rows(stats: list[dict], anom: dict | None = None,
     for s in stats:
         row = [str(x) for x in (
             s["worker"], s["state"], s["breaker_state_gauge"],
-            (workerd or {}).get(s["worker"], "absent"),
+            (workerd or {}).get(s["worker"], "absent"), storage,
             s["probe_p50_ms"], s["probe_p95_ms"],
             s["probes"], s["probe_failures"], s["orphaned"],
             s["migrations_out"], s["migrations_in"],
@@ -234,9 +258,12 @@ def fleet_health(f: Factory, probes, watch, interval, fmt, no_daemon):
             stats = doc.get("health", [])
             anom = _sentinel_anom_by_worker(doc)
             wd = doc.get("workerd") or {}
+            storage = _storage_verdict(doc)
             if fmt == "json":
                 out = {"source": f"loopd:{doc.get('pid')}", "health": stats,
-                       "workerd": wd}
+                       "workerd": wd,
+                       "storage": {"verdict": storage,
+                                   **(doc.get("storage") or {})}}
                 if doc.get("sentinel"):
                     out["sentinel"] = doc["sentinel"]
                 click.echo(_json.dumps(out, indent=2))
@@ -244,7 +271,7 @@ def fleet_health(f: Factory, probes, watch, interval, fmt, no_daemon):
                 click.echo(f"source: loopd (pid {doc.get('pid')}, "
                            f"{len(doc.get('runs', []))} hosted run(s))",
                            err=True)
-                for line in _health_rows(stats, anom, wd):
+                for line in _health_rows(stats, anom, wd, storage):
                     click.echo(line)
             if any(s["state"] != "closed" for s in stats):
                 raise SystemExit(1)

@@ -304,7 +304,21 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
         from ..loop.journal import RunJournal, replay
 
         jpath = _resolve_journal(f, resume_run)
-        run_image = replay(RunJournal.read(jpath))
+        # checksum-verified fold: replay stops at the last verified
+        # prefix, so a bit-flipped or torn journal can never seed the
+        # resume with garbage state (docs/durability.md#verify)
+        records, integrity = RunJournal.read_verified(jpath)
+        if integrity.corrupt:
+            click.echo(
+                f"warning: {jpath.name}: {integrity.corrupt} corrupt "
+                f"record(s) from line {integrity.first_corrupt_line}; "
+                f"resuming from the last verified prefix "
+                f"({integrity.verified} records) -- inspect with "
+                f"`clawker journal verify {resume_run}`", err=True)
+        elif integrity.torn_tail:
+            click.echo(f"note: {jpath.name}: torn final record "
+                       "(crash mid-append) dropped", err=True)
+        run_image = replay(records)
         if not run_image.run_id:
             raise click.ClickException(
                 f"{jpath}: no usable run header -- the journal is too "
@@ -563,10 +577,17 @@ def _run_loops(f: Factory, parallel, iterations, placement, image, prompt,
             executors.close_all()
     if not keep:
         sched.cleanup(remove_containers=True)
+    stor = sched.storage_summary()
     if as_json:
         click.echo(json.dumps({"loop_id": sched.loop_id,
-                               "agents": sched.status()}, indent=2))
+                               "agents": sched.status(),
+                               "storage": stor}, indent=2))
     else:
+        if stor.get("durability") != "ok":
+            click.echo(f"storage: durability {stor.get('durability')} "
+                       f"({stor.get('faults', 0)} fault(s)) -- inspect "
+                       f"with `clawker journal verify {sched.loop_id}`",
+                       err=True)
         for l in loops:
             codes = ",".join(map(str, l.exit_codes)) or "-"
             click.echo(f"{l.agent}\t{l.worker.id}\t{l.status}\t"

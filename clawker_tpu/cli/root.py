@@ -141,6 +141,7 @@ def register_commands() -> None:
         cmd_harness,
         cmd_image,
         cmd_init,
+        cmd_journal,
         cmd_loop,
         cmd_loopd,
         cmd_monitor,
@@ -165,6 +166,7 @@ def register_commands() -> None:
     cmd_harness.register(cli)
     cmd_image.register(cli)
     cmd_init.register(cli)
+    cmd_journal.register(cli)
     cmd_loop.register(cli)
     cmd_loopd.register(cli)
     cmd_monitor.register(cli)

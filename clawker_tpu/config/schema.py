@@ -397,11 +397,38 @@ class LoopJournalSettings:
     nobody planned for, and its cost is one fsync-batched JSONL append
     per state transition.  ``fsync_batch_n`` / ``fsync_interval_s``
     bound how much un-synced tail a HOST crash may lose (a CLI crash
-    loses nothing -- every record is flushed to the OS on append)."""
+    loses nothing -- every record is flushed to the OS on append).
+
+    ``on_fault`` is the storage-fault policy (docs/durability.md): a
+    durable append that cannot be made durable either journals a
+    ``degraded-durability`` state and keeps the run alive (``degrade``,
+    the default -- agents keep working, resume fidelity is at risk) or
+    fail-stops the run (``fail`` -- the WAL contract is load-bearing,
+    running on without it is worse than stopping)."""
 
     enable: bool = True
     fsync_batch_n: int = 8          # records per group-commit fsync
     fsync_interval_s: float = 0.25  # max age of an un-synced tail
+    on_fault: str = "degrade"       # degrade | fail (durable-append fault)
+
+
+@dataclass
+class StoragePressureSettings:
+    """Disk-pressure degradation ladder (docs/durability.md#ladder).
+
+    A statvfs watermark monitor ticked by the scheduler and loopd: at
+    the SOFT watermark non-durable streams shed first (flight spans ->
+    shipper batches -> sentinel state), each shed counted per-stream;
+    at the HARD watermark the emergency retention GC deletes journals
+    and flight files of done runs past the newest ``retention_runs`` --
+    reclaiming space BEFORE a durable append is allowed to fail.
+    Watermarks are free-space fractions of the logs filesystem."""
+
+    enable: bool = True
+    soft_free_pct: float = 10.0     # shed non-durable streams below this
+    hard_free_pct: float = 3.0      # emergency retention GC below this
+    check_interval_s: float = 5.0   # statvfs cadence
+    retention_runs: int = 64        # newest done-run journals kept by GC
 
 
 @dataclass
@@ -496,6 +523,8 @@ class LoopSettings:
         default_factory=LoopPlacementSettings)
     failover: str = "migrate"       # migrate | wait | fail (worker death)
     journal: LoopJournalSettings = field(default_factory=LoopJournalSettings)
+    storage_pressure: StoragePressureSettings = field(
+        default_factory=StoragePressureSettings)
     warm_pool: LoopWarmPoolSettings = field(
         default_factory=LoopWarmPoolSettings)
     worktrees: LoopWorktreeSettings = field(

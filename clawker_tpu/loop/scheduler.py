@@ -104,11 +104,14 @@ from ..monitor.events import (
     CAPACITY_DECISION,
     GITGUARD_DECISION,
     PLACEMENT_DECISION,
+    STORAGE_FAULT,
     TRACE_SPAN,
     EventBus,
     GitguardDecisionEvent,
     PlacementEvent,
+    StorageFaultEvent,
 )
+from ..monitor.pressure import DiskPressureMonitor, note_shed
 from ..placement import (
     ADMISSION_REJECTED,
     AdmissionController,
@@ -155,10 +158,16 @@ from .journal import (
     REC_SEED_WORKTREE,
     REC_SHUTDOWN,
     REC_STARTED,
+    REC_STORAGE_FAULT,
+    AppendReceipt,
+    JournalFault,
+    JournalUnhealthy,
+    NO_JOURNAL_RECEIPT,
     RunImage,
     RunJournal,
     journal_path,
     replay,
+    retention_gc,
 )
 from .mergeq import MergeQueue
 from .warmpool import WarmPool
@@ -523,13 +532,41 @@ class LoopScheduler:
         # replays it and reconciles against live container state.  A
         # resume APPENDS to the dead run's journal (run_id keys the path).
         self.journal: RunJournal | None = None
+        # storage-fault state (docs/durability.md): "ok" until a durable
+        # append cannot be made durable, then "degraded" (or the run
+        # fail-stops, per loop.journal.on_fault) -- surfaced in
+        # status()/loop --json/loopd status/fleet health
+        self.durability = "ok"
+        self.storage_faults = 0
+        self._journal_on_fault = "degrade"
+        self._in_storage_fault = False  # reentrancy: the fault handler
+        #                                 journals, which can fault again
         if spec.journal:
             js = cfg.settings.loop.journal
+            self._journal_on_fault = str(
+                getattr(js, "on_fault", "degrade")) or "degrade"
             if js.enable:
                 self.journal = RunJournal(
                     journal_path(cfg.logs_dir, self.loop_id),
                     fsync_batch_n=js.fsync_batch_n,
-                    fsync_interval_s=js.fsync_interval_s)
+                    fsync_interval_s=js.fsync_interval_s,
+                    on_fault=self._on_journal_fault)
+        # disk-pressure ladder (docs/durability.md#ladder): ticked on
+        # the run thread; flight spans and shipper tees consult the
+        # shed set, the hard watermark runs the retention GC
+        self.pressure: DiskPressureMonitor | None = None
+        try:
+            sps = cfg.settings.loop.storage_pressure
+        except AttributeError:          # bare test cfgs without settings
+            sps = None
+        if sps is not None and sps.enable:
+            keep = int(sps.retention_runs)
+            self.pressure = DiskPressureMonitor(
+                cfg.logs_dir, soft_free_pct=sps.soft_free_pct,
+                hard_free_pct=sps.hard_free_pct,
+                check_interval_s=sps.check_interval_s,
+                gc=lambda: retention_gc(cfg.logs_dir, keep=keep),
+                on_event=self._on_pressure_event)
         # --- warm pool (docs/loop-warmpool.md): pre-created containers
         # this run's placements adopt instead of paying a full create.
         # Refills bill a dedicated low-weight admission tenant so the
@@ -631,21 +668,114 @@ class LoopScheduler:
 
     def _record_span(self, rec) -> None:
         if self.flight is not None:
-            self.flight.append(rec.to_json())
+            if (self.pressure is not None
+                    and self.pressure.is_shedding("flight")):
+                # soft-watermark shed (docs/durability.md#ladder): the
+                # span is post-mortem evidence, the journal is
+                # correctness evidence -- under pressure the span goes
+                self.flight.dropped += 1
+                note_shed("flight")
+            else:
+                self.flight.append(rec.to_json())
         self.events.emit(rec.agent, TRACE_SPAN, rec.detail())
+        if (self.pressure is not None
+                and self.pressure.is_shedding("shipper")
+                and self._span_sinks):
+            note_shed("shipper", len(self._span_sinks))
+            return
         for sink in self._span_sinks:
             try:
                 sink(rec)
             except Exception:   # noqa: BLE001 -- telemetry never raises
                 pass            # into the scheduler hot path
 
-    def _journal(self, kind: str, *, durable: bool = False, **fields) -> None:
-        """Append one journal record; a disabled/degraded journal no-ops
-        (journaling must never fail the run it protects).  After kill()
-        nothing lands: a SIGKILLed process writes no records, and chaos
-        replays must see exactly the journal a real crash would leave."""
-        if self.journal is not None and not self._aborted:
-            self.journal.append(kind, durable=durable, **fields)
+    def _journal(self, kind: str, *, durable: bool = False,
+                 **fields) -> AppendReceipt:
+        """Append one journal record and return its receipt.  A
+        disabled journal (or one killed by kill()) answers with the
+        no-journal receipt: there is no WAL, so there is no durability
+        contract to break.  After kill() nothing lands: a SIGKILLed
+        process writes no records, and chaos replays must see exactly
+        the journal a real crash would leave.  Storage faults surface
+        through the journal's ``on_fault`` -> :meth:`_on_journal_fault`,
+        so even receipt-ignoring bookkeeping appends degrade loudly."""
+        if self.journal is None or self._aborted:
+            return NO_JOURNAL_RECEIPT
+        return self.journal.append(kind, durable=durable, **fields)
+
+    def _durable_ok(self, receipt: AppendReceipt, what: str) -> bool:
+        """Consume a durable append's receipt: True when the record is
+        on disk.  On a broken write-ahead promise the degrade/fail-stop
+        policy has already run via ``on_fault``; this just tells the
+        call site whether to proceed (most sites log and continue
+        degraded; placement sites strand the launch instead)."""
+        if receipt.synced:
+            return True
+        log.warning("loop %s: durable journal append (%s) not durable: %s",
+                    self.loop_id, what, receipt.error or "unsynced")
+        return False
+
+    def _on_journal_fault(self, fault: JournalFault) -> None:
+        """The journal's storage-fault callback (docs/durability.md):
+        every fault -- recovered or not -- lands on the event bus as a
+        typed ``storage.fault``; an UNRECOVERED fault flips the run to
+        degraded-durability (journaled best-effort) or fail-stops it,
+        per ``loop.journal.on_fault``.  Defensive about construction
+        order: the journal can fault inside its own __init__."""
+        if getattr(self, "_in_storage_fault", True):
+            return              # a fault while handling a fault: counted
+        self._in_storage_fault = True
+        try:
+            self.storage_faults += 1
+            action = "recovered" if fault.recovered else (
+                "fail_stop" if self._journal_on_fault == "fail"
+                else "degraded")
+            try:
+                self.on_event("scheduler", STORAGE_FAULT, StorageFaultEvent(
+                    fault.op, action, fault.dropped, fault.error).detail())
+            except Exception:   # noqa: BLE001 -- surfacing must never
+                pass            # compound the fault
+            if fault.recovered:
+                return
+            if self.durability == "ok":
+                self.durability = "degraded"
+                # journaled degraded-durability state: best-effort (the
+                # journal may still be unhealthy; the record lands on a
+                # later recovery's re-ring or not at all -- the event +
+                # metric above are the guaranteed signals)
+                self._journal(REC_STORAGE_FAULT, op=fault.op,
+                              dropped=fault.dropped, error=fault.error)
+            if self._journal_on_fault == "fail":
+                self.durability = "failed"
+                log.error("loop %s: fail-stop on storage fault (%s: %s)",
+                          self.loop_id, fault.op, fault.error)
+                stop = getattr(self, "_stop", None)
+                if stop is not None:
+                    self.stop()
+        finally:
+            self._in_storage_fault = False
+
+    def _on_pressure_event(self, ev: StorageFaultEvent) -> None:
+        try:
+            self.on_event("scheduler", STORAGE_FAULT, ev.detail())
+        except Exception:       # noqa: BLE001
+            pass
+
+    def storage_summary(self) -> dict:
+        """Durability + disk-pressure state for status surfaces
+        (``loop --json``, loopd status, ``fleet health`` STORAGE)."""
+        j = self.journal
+        doc: dict = {
+            "durability": self.durability,
+            "faults": self.storage_faults,
+            "journal": (None if j is None else {
+                "healthy": j.healthy, "dropped": j.dropped,
+                "recoveries": j.recoveries, "poisoned": j.poisoned,
+                "last_error": j.last_error}),
+        }
+        if self.pressure is not None:
+            doc["pressure"] = self.pressure.summary()
+        return doc
 
     def attach_anomaly_watch(self, watch) -> None:
         """Surface fleet anomaly scores (analytics.runtime.AnomalyWatch)
@@ -970,8 +1100,11 @@ class LoopScheduler:
             with self._seed_lock:
                 if digest not in self._seeds_journaled:
                     self._seeds_journaled.add(digest)
-                    self._journal(REC_SEED_TAR, durable=True, digest=digest,
-                                  bytes=len(tar))
+                    # degraded WAL: proceed anyway -- a resume that lost
+                    # this record re-builds the seed (idempotent, slow)
+                    self._durable_ok(self._journal(
+                        REC_SEED_TAR, durable=True, digest=digest,
+                        bytes=len(tar)), "seed_tar")
         return digest, tar
 
     def _ship_seed(self, ex, worker: Worker, root: Path) -> str:
@@ -985,8 +1118,11 @@ class LoopScheduler:
         digest, tar = self._workspace_seed(root)
         if not digest or tar is None or ex is None or ex.seeded(digest):
             return digest
-        self._journal(REC_SEED_SHIP, durable=True, digest=digest,
-                      worker=worker.id)
+        # degraded WAL: still ship -- a resume that lost this record
+        # re-ships, and a content-addressed put is idempotent
+        self._durable_ok(self._journal(REC_SEED_SHIP, durable=True,
+                                       digest=digest, worker=worker.id),
+                         "seed_ship")
         ex.submit_seed(digest, tar)
         return digest
 
@@ -1098,10 +1234,12 @@ class LoopScheduler:
             ).start()
         # durable before anything acts on the cid -- same contract as
         # _create: a crash here re-finds the container by (deterministic
-        # name, journaled cid)
-        self._journal(REC_CREATED, durable=True, agent=loop.agent,
-                      worker=worker.id, epoch=epoch, cid=cid,
-                      pool=pool_hit)
+        # name, journaled cid).  The container already exists, so a
+        # broken promise here cannot be unwound -- degrade loudly
+        self._durable_ok(self._journal(
+            REC_CREATED, durable=True, agent=loop.agent,
+            worker=worker.id, epoch=epoch, cid=cid,
+            pool=pool_hit), "created")
         self.seams.fire("launch.post_create")
         with self._placement_lock:
             if loop.epoch != epoch or self._stop.is_set():
@@ -1466,8 +1604,11 @@ class LoopScheduler:
         dest = self.cfg.data_dir / "worktrees" / self.cfg.project_name() / agent
         if agent not in self._worktrees_journaled:
             self._worktrees_journaled.add(agent)
-            self._journal(REC_SEED_WORKTREE, durable=True, agent=agent,
-                          path=str(dest), branch=branch, base=wts.base)
+            # degraded WAL: setup_worktree is idempotent on resume
+            self._durable_ok(self._journal(
+                REC_SEED_WORKTREE, durable=True, agent=agent,
+                path=str(dest), branch=branch, base=wts.base),
+                "seed_worktree")
         info = gm.setup_worktree(dest, branch, base=wts.base)
         self._branches[agent] = branch
         return info.path, gm.git_dir()
@@ -1537,8 +1678,12 @@ class LoopScheduler:
             fresh = [r for r in rules if r.key() not in have]
             keys = [r.key() for r in fresh]
             if keys:
-                self._journal(REC_GITGUARD_RULES, durable=True, keys=keys,
-                              hosts=list(gs.hosts))
+                # degraded WAL risks rules outliving the run (teardown
+                # key list lost); install anyway -- refusing git egress
+                # over a disk fault would strand every push
+                self._durable_ok(self._journal(
+                    REC_GITGUARD_RULES, durable=True, keys=keys,
+                    hosts=list(gs.hosts)), "gitguard_rules")
                 if self.journal is not None:
                     self.journal.sync()
                 store.add(fresh)
@@ -1829,9 +1974,9 @@ class LoopScheduler:
         if sched._gitguard_armed():
             sched._gitguard_setup()
         sched._build_resumed_loops(image)
-        sched._journal(REC_RESUME, durable=True,
-                       generation=image.generation + 1,
-                       clean=image.clean_shutdown)
+        sched._durable_ok(sched._journal(
+            REC_RESUME, durable=True, generation=image.generation + 1,
+            clean=image.clean_shutdown), "resume")
         _RESUMES.inc()
         sched.on_event("scheduler", "resume",
                        f"run {image.run_id} generation {image.generation + 1}")
@@ -2016,9 +2161,21 @@ class LoopScheduler:
                 # journaled placement, no current container -- the crash
                 # landed between the WAL record and the create (or the
                 # container was lost with its worker): re-launch
-                self._journal(REC_PLACEMENT, durable=True, agent=loop.agent,
-                              worker=worker.id, epoch=loop.epoch,
-                              tenant=self.spec.tenant)
+                rcpt = self._journal(REC_PLACEMENT, durable=True,
+                                     agent=loop.agent, worker=worker.id,
+                                     epoch=loop.epoch,
+                                     tenant=self.spec.tenant)
+                if not self._durable_ok(rcpt, "placement"):
+                    # storage fault, not worker sickness: strand WITHOUT
+                    # breaker penalty -- the WAL-before-create contract
+                    # is never waived, the rescue pass re-places once
+                    # the journal recovers (docs/durability.md)
+                    self._strand(loop, loop.epoch,
+                                 "storage fault: placement not durable",
+                                 penalize=False)
+                    with lock:
+                        summary["orphaned"] += 1
+                    continue
                 self._submit_launch(loop, worker, loop.epoch, self._launch)
                 with lock:
                     summary["relaunched"] += 1
@@ -2333,10 +2490,13 @@ class LoopScheduler:
             if not pool_hit:
                 cid = rt.create(opts)
         # durable before anything acts on the cid: a crash here must find
-        # the container again by (deterministic name, journaled cid)
-        self._journal(REC_CREATED, durable=True, agent=loop.agent,
-                      worker=worker.id, epoch=epoch, cid=cid,
-                      pool=pool_hit)
+        # the container again by (deterministic name, journaled cid).
+        # The container already exists -- a broken promise here cannot
+        # be unwound, so the run degrades loudly instead of stranding
+        self._durable_ok(self._journal(
+            REC_CREATED, durable=True, agent=loop.agent,
+            worker=worker.id, epoch=epoch, cid=cid,
+            pool=pool_hit), "created")
         self.seams.fire("launch.post_create")
         with self._placement_lock:
             if loop.epoch != epoch:
@@ -2721,6 +2881,10 @@ class LoopScheduler:
                     # interval (docs/elastic-capacity.md); in loopd the
                     # daemon ticks one controller across hosted runs
                     self.capacity.maybe_tick()
+                if self.pressure is not None:
+                    # disk-pressure ladder rides the run thread at its
+                    # own statvfs cadence (docs/durability.md#ladder)
+                    self.pressure.tick()
                 # a loop is busy while running or orphaned (awaiting
                 # failover), or while its create/start/restart is still
                 # queued on a (possibly wedged) worker lane
@@ -3107,9 +3271,20 @@ class LoopScheduler:
             if target.id != old.id:
                 self._journal(REC_MIGRATED, agent=loop.agent,
                               src=old.id, dst=target.id)
-            self._journal(REC_PLACEMENT, durable=True, agent=loop.agent,
-                          worker=target.id, epoch=loop.epoch,
-                          tenant=self.spec.tenant)
+            rcpt = self._journal(REC_PLACEMENT, durable=True,
+                                 agent=loop.agent, worker=target.id,
+                                 epoch=loop.epoch,
+                                 tenant=self.spec.tenant)
+            if not self._durable_ok(rcpt, "placement"):
+                # storage fault: the WAL-before-create contract is never
+                # waived.  Strand WITHOUT breaker penalty (the worker is
+                # fine, the disk is not); the next rescue pass retries
+                # once the journal's lazy reopen / the pressure GC has
+                # had a chance to recover it
+                self._strand(loop, loop.epoch,
+                             "storage fault: placement not durable",
+                             penalize=False)
+                continue
             note_decision(self.policy.name, target.id)
             self.on_event(loop.agent, PLACEMENT_DECISION, PlacementEvent(
                 loop.agent, target.id, self.policy.name, self.spec.tenant,
@@ -3203,7 +3378,8 @@ class LoopScheduler:
         first Ctrl-C and its SIGTERM path both land here."""
         if not self._shutdown_journaled:
             self._shutdown_journaled = True
-            self._journal(REC_SHUTDOWN, durable=True, reason=reason)
+            self._durable_ok(self._journal(REC_SHUTDOWN, durable=True,
+                                           reason=reason), "shutdown")
         self.stop()
 
     def kill(self) -> None:

@@ -37,14 +37,17 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-from .. import telemetry
+from .. import logsetup, telemetry
 from ..engine.drivers import Worker
 from .journal import (
     REC_POOL_ADD,
     REC_POOL_ADOPT,
     REC_POOL_READY,
     REC_POOL_REMOVE,
+    receipt_synced,
 )
+
+log = logsetup.get("loop.warmpool")
 
 POOL_TENANT = "~warmpool"       # admission fairness class refills bill
 #                                 under -- low weight, so the WFQ hands
@@ -148,8 +151,16 @@ class WarmPool:
             self.hits += 1
             _HITS.labels(worker_id).inc()
             self._set_depth(pool)
-        self._journal(REC_POOL_ADOPT, durable=True, agent=entry.agent,
-                      worker=worker_id, cid=entry.cid, by=by, epoch=epoch)
+        rcpt = self._journal(REC_POOL_ADOPT, durable=True, agent=entry.agent,
+                             worker=worker_id, cid=entry.cid, by=by,
+                             epoch=epoch)
+        if not receipt_synced(rcpt):
+            # degrade loudly: the member is already popped and the
+            # container exists -- a resume sweeps it by cid even
+            # without the adopt record (scheduler handles the global
+            # degraded-durability state)
+            log.warning("pool adopt of %s not durable (storage fault)",
+                        entry.agent)
         return entry
 
     def adoption_failed(self, entry: PoolEntry, reason: str) -> None:
@@ -207,8 +218,19 @@ class WarmPool:
             self._seq += 1
             agent = f"pool-{self.run_id[:6]}-p{self._seq}"
             pool.inflight += 1
-        self._journal(REC_POOL_ADD, durable=True, agent=agent,
-                      worker=worker.id)
+        rcpt = self._journal(REC_POOL_ADD, durable=True, agent=agent,
+                             worker=worker.id)
+        if not receipt_synced(rcpt):
+            # the add record is the write-ahead for the create: if it
+            # is not durable a crash mid-fill leaks the container as an
+            # untracked ghost.  Release the reservation and skip this
+            # refill -- the pool retries next admission pass.
+            with self._lock:
+                pool = self._pool(worker)
+                pool.inflight = max(0, pool.inflight - 1)
+            log.warning("pool refill %s skipped: add record not durable "
+                        "(storage fault)", agent)
+            return None
         return agent
 
     def fill_done(self, worker: Worker, agent: str, cid: str | None,
@@ -236,8 +258,14 @@ class WarmPool:
                 self._set_depth(pool)
         if keep:
             _REFILLS.labels(worker.id).inc()
-            self._journal(REC_POOL_READY, durable=True, agent=agent,
-                          worker=worker.id, cid=cid)
+            rcpt = self._journal(REC_POOL_READY, durable=True, agent=agent,
+                                 worker=worker.id, cid=cid)
+            if not receipt_synced(rcpt):
+                # degrade loudly: the member stays adoptable this
+                # generation; without the ready record a resume sweeps
+                # the container instead of restoring it
+                log.warning("pool member %s ready record not durable "
+                            "(storage fault)", agent)
         else:
             self._journal(REC_POOL_REMOVE, agent=agent, worker=worker.id,
                           cid=cid, reason="drained")
@@ -265,8 +293,11 @@ class WarmPool:
                 agent=agent, worker=worker, cid=cid,
                 created_at=self._clock()))
             self._set_depth(pool)
-        self._journal(REC_POOL_READY, durable=True, agent=agent,
-                      worker=worker.id, cid=cid, resumed=True)
+        rcpt = self._journal(REC_POOL_READY, durable=True, agent=agent,
+                             worker=worker.id, cid=cid, resumed=True)
+        if not receipt_synced(rcpt):
+            log.warning("pool restore of %s not durable (storage fault)",
+                        agent)
         return True
 
     # ------------------------------------------------------------ lifecycle

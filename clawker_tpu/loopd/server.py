@@ -221,6 +221,8 @@ class _DaemonRun:
             "agents": sched.status() if sched is not None else [],
             "gitguard": (sched.gitguard_summary()
                          if sched is not None else {"enabled": False}),
+            "storage": (sched.storage_summary()
+                        if sched is not None else {"durability": "unknown"}),
             "subscribers": len(self.subs),
             "events_dropped": self.dropped,
             **({"ok": self.result.get("ok")} if self.done.is_set() else {}),
@@ -291,6 +293,24 @@ class LoopdServer:
                     max_bytes=tele.flight_recorder.max_bytes)
         except AttributeError:
             self.flight = None
+        # daemon-lifetime disk-pressure monitor (docs/durability.md):
+        # hosted schedulers tick their own, but the daemon must watch
+        # too -- the emergency retention GC has to fire even with zero
+        # hosted runs, BEFORE the capacity WAL's durable appends fail
+        self.pressure = None
+        try:
+            sp = cfg.settings.loop.storage_pressure
+            if sp.enable:
+                from ..loop.journal import retention_gc
+                from ..monitor.pressure import DiskPressureMonitor
+                keep = max(1, int(sp.retention_runs))
+                self.pressure = DiskPressureMonitor(
+                    Path(cfg.logs_dir), soft_free_pct=sp.soft_free_pct,
+                    hard_free_pct=sp.hard_free_pct,
+                    check_interval_s=sp.check_interval_s,
+                    gc=lambda: retention_gc(Path(cfg.logs_dir), keep=keep))
+        except AttributeError:
+            self.pressure = None
 
     # ----------------------------------------------------------- lifecycle
 
@@ -328,6 +348,9 @@ class LoopdServer:
         self._start_sentinel()
         self._start_shipper()
         self._start_capacity()
+        if self.pressure is not None:
+            threading.Thread(target=self._pressure_loop, daemon=True,
+                             name="loopd-pressure").start()
         if self._metrics_port:
             self._metrics_server = telemetry.MetricsServer(
                 self._metrics_port).start()
@@ -415,7 +438,10 @@ class LoopdServer:
             # auditable intent would break exactly the write-ahead
             # promise the controller makes
             self._capacity_journal = RunJournal(
-                journal_path(self.cfg.logs_dir, "loopd-capacity"))
+                journal_path(self.cfg.logs_dir, "loopd-capacity"),
+                on_fault=lambda f: log.warning(
+                    "loopd capacity WAL fault: op=%s recovered=%s "
+                    "dropped=%d %s", f.op, f.recovered, f.dropped, f.error))
             scaler = (make_scaler(self.driver, self.cfg,
                                   max_workers=cs.autoscale.max_workers)
                       if cs.autoscale.enable else None)
@@ -469,15 +495,19 @@ class LoopdServer:
             return sum(s._journaled_live_placements(wid)
                        for s in self._live_scheds())
 
-        def journal(kind: str, *, durable: bool = False, **fields) -> None:
+        def journal(kind: str, *, durable: bool = False, **fields):
             # the daemon WAL first (it exists even with zero hosted
             # runs), then fan out so every run's --resume can restore
-            # the controller state
+            # the controller state.  The daemon-WAL receipt is the
+            # return value: it is the one that must be durable before
+            # the scaler may act (controller consumes it)
+            rcpt = None
             if self._capacity_journal is not None:
-                self._capacity_journal.append(kind, durable=durable,
-                                              **fields)
+                rcpt = self._capacity_journal.append(kind, durable=durable,
+                                                     **fields)
             for sched in self._live_scheds():
                 sched._journal(kind, durable=durable, **fields)
+            return rcpt
 
         def emit(ev) -> None:
             from ..monitor.events import CAPACITY_DECISION
@@ -497,6 +527,15 @@ class LoopdServer:
             journal=journal,
             emit=emit,
         )
+
+    def _pressure_loop(self) -> None:
+        """Tick the daemon disk-pressure ladder at its own cadence."""
+        monitor = self.pressure
+        while not self._stop.wait(monitor.check_interval_s):
+            try:
+                monitor.tick()
+            except Exception:   # noqa: BLE001 -- pressure must never
+                log.exception("pressure tick failed")   # kill the daemon
 
     def _capacity_loop(self) -> None:
         interval = max(0.05, self.cfg.settings.capacity.interval_s)
@@ -1233,6 +1272,20 @@ class LoopdServer:
         except Exception:       # noqa: BLE001 -- a probe failure must
             return {}           # never break the status RPC
 
+    def _storage_stats(self) -> dict:
+        """Daemon-level storage health: the disk-pressure ladder plus
+        the daemon's own capacity WAL (per-run journal health rides
+        each run's ``status_doc``)."""
+        doc: dict = {"pressure": (self.pressure.summary()
+                                  if self.pressure is not None else None)}
+        j = self._capacity_journal
+        if j is not None:
+            doc["capacity_wal"] = {
+                "healthy": j.healthy, "dropped": j.dropped,
+                "recoveries": j.recoveries, "poisoned": j.poisoned,
+            }
+        return doc
+
     def _status_doc(self) -> dict:
         with self._runs_lock:
             runs = [r.status_doc() for r in self.runs.values()]
@@ -1259,6 +1312,7 @@ class LoopdServer:
             "capacity": ({"enabled": True, **self.capacity.state()}
                          if self.capacity is not None
                          else {"enabled": False}),
+            "storage": self._storage_stats(),
             "sentinel": (self.sentinel.status_doc()
                          if self.sentinel is not None
                          else {"enabled": False}),

@@ -98,6 +98,51 @@ class CapacityDecisionEvent:
 # typed so status surfaces and tests can replay what was enforced.
 GITGUARD_DECISION = "gitguard.decision"
 
+# Event name storage faults ride the bus under (docs/durability.md):
+# a durable journal append that failed or recovered through a poisoned
+# handle, an unwritable journal at open, or a disk-pressure watermark
+# transition.  The chaos no-silent-drop invariant audits this stream --
+# a dropped or poisoned write with no storage.fault event is a bug.
+STORAGE_FAULT = "storage.fault"
+
+
+@dataclass(frozen=True)
+class StorageFaultEvent:
+    """Typed payload of a ``storage.fault`` event.
+
+    ``op`` is the failed storage operation (``open`` / ``write`` /
+    ``fsync`` / ``close`` -- or ``pressure`` for a watermark
+    transition); ``action`` what the fault handler did (``recovered``,
+    ``degraded``, ``fail_stop``, ``shed``, ``gc``); ``dropped`` how
+    many records that fault lost (0 when recovery re-appended the
+    unsynced ring).  Rides as the detail string like the other typed
+    events; structured consumers round-trip with :meth:`parse`.
+    """
+
+    op: str
+    action: str
+    dropped: int = 0
+    error: str = ""
+
+    def detail(self) -> str:
+        base = f"{self.op} {self.action} dropped={self.dropped}"
+        return f"{base}: {self.error}" if self.error else base
+
+    @classmethod
+    def parse(cls, detail: str) -> "StorageFaultEvent":
+        head, _, error = detail.partition(": ")
+        parts = head.split(" ")
+        op = parts[0] if parts else ""
+        action = parts[1] if len(parts) > 1 else ""
+        dropped = 0
+        for p in parts[2:]:
+            if p.startswith("dropped="):
+                try:
+                    dropped = int(p.split("=", 1)[1])
+                except ValueError:
+                    dropped = 0
+        return cls(op, action, dropped, error)
+
 
 @dataclass(frozen=True)
 class GitguardDecisionEvent:

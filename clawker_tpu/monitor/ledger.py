@@ -22,8 +22,10 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -36,39 +38,206 @@ from .unit import MonitoringUnit
 LEDGER_FILE = "units-ledger.yaml"
 FLIGHT_DIR = "flight"           # under Config.logs_dir
 
+# --------------------------------------------------------------------------
+# record integrity (docs/durability.md): every JSONL record written by
+# this module's writers carries a CRC32 of its serialized body as a
+# reserved trailing field `"c"`.  One writer, one verifier: the run
+# journal, the flight recorder, and the capacity WAL all encode through
+# encode_record(), so a flipped bit degrades identically everywhere --
+# flagged, never silently folded into a wrong RunImage.  Checksum-less
+# legacy records (pre-checksum journals) stay first-class readable.
+# --------------------------------------------------------------------------
 
-def parse_jsonl(lines) -> list[dict]:
+CRC_FIELD = "c"                 # reserved record field: 8 hex CRC32 chars
+_CRC_RE = re.compile(r'(,?)"c":"([0-9a-f]{8})"\}$')
+
+
+def encode_record(record: dict) -> str:
+    """Serialize one record to its checksummed JSONL line (no newline).
+
+    The CRC32 covers the serialized body *without* the checksum field,
+    which is spliced on as the final member -- verifiers strip the
+    fixed-shape suffix and recompute, no re-serialization ambiguity."""
+    body = json.dumps(record, separators=(",", ":"), default=str)
+    if not body.endswith("}"):          # non-object: nothing to protect
+        return body
+    crc = zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF
+    sep = "" if body == "{}" else ","
+    return f'{body[:-1]}{sep}"{CRC_FIELD}":"{crc:08x}"}}'
+
+
+def classify_line(line: str) -> tuple[str, dict | None]:
+    """Classify one JSONL line: ``("ok", doc)`` checksum verified,
+    ``("legacy", doc)`` parseable pre-checksum record, ``("mismatch",
+    None)`` parseable but the checksum disagrees (a flipped bit),
+    ``("garbled", None)`` unparseable (a torn write -- or worse, which
+    only its position can tell), ``("blank", None)``.  The checksum
+    field is stripped from returned docs -- folds and span-loaders
+    must never see the transport framing."""
+    line = line.strip()
+    if not line:
+        return "blank", None
+    m = _CRC_RE.search(line)
+    if m is not None:
+        body = line[:m.start()] + "}"
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            return "garbled", None
+        if not isinstance(doc, dict):
+            return "garbled", None
+        want = int(m.group(2), 16)
+        if (zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF) != want:
+            return "mismatch", None
+        doc.pop(CRC_FIELD, None)
+        return "ok", doc
+    try:
+        doc = json.loads(line)
+    except ValueError:
+        return "garbled", None
+    if not isinstance(doc, dict):
+        return "garbled", None
+    return "legacy", doc
+
+
+@dataclass
+class IntegrityReport:
+    """What a verifying read saw: counts per classify_line() verdict.
+
+    ``torn_tail`` is the FINAL non-blank line failing to parse -- the
+    signature of a writer killed mid-line, tolerated everywhere.
+    ``corrupt`` is everything else: a mid-file unparseable line or any
+    checksum mismatch -- evidence of real damage, never tolerated
+    silently (``clawker journal verify`` exits 2 on it)."""
+
+    path: str = ""
+    total: int = 0              # non-blank lines seen
+    verified: int = 0           # checksum present and matched
+    legacy: int = 0             # parseable, no checksum field
+    corrupt: int = 0            # mismatch / mid-file garbage
+    torn_tail: bool = False     # final line truncated (crash tail)
+    first_corrupt_line: int = 0  # 1-based line number of first damage
+
+    @property
+    def ok(self) -> bool:
+        return self.corrupt == 0
+
+    def to_doc(self) -> dict:
+        return {"path": self.path, "total": self.total,
+                "verified": self.verified, "legacy": self.legacy,
+                "corrupt": self.corrupt, "torn_tail": self.torn_tail,
+                "first_corrupt_line": self.first_corrupt_line,
+                "ok": self.ok}
+
+
+def parse_jsonl(lines, report: IntegrityReport | None = None) -> list[dict]:
     """Every parseable JSON object in ``lines``, skipping blanks,
     corrupt lines, and non-objects.  THE tolerant parse for the
     flight-record format -- ``telemetry.load_spans`` and
     :meth:`FlightRecorder.read` both ride it, so a crashed writer's
-    truncated tail degrades identically everywhere."""
+    truncated tail degrades identically everywhere.  Checksummed
+    records are verified (a mismatch is SKIPPED like a torn line, and
+    counted when a ``report`` is passed); the checksum field never
+    reaches callers."""
     out: list[dict] = []
+    last_garbled = False
     for line in lines:
-        line = line.strip()
-        if not line:
+        status, doc = classify_line(line)
+        if status == "blank":
             continue
-        try:
-            doc = json.loads(line)
-        except ValueError:
-            continue
-        if isinstance(doc, dict):
+        last_garbled = status == "garbled"
+        if report is not None:
+            report.total += 1
+            if status == "ok":
+                report.verified += 1
+            elif status == "legacy":
+                report.legacy += 1
+            else:
+                report.corrupt += 1
+                if not report.first_corrupt_line:
+                    report.first_corrupt_line = report.total
+        if doc is not None:
             out.append(doc)
+    if report is not None and last_garbled and report.corrupt:
+        # an unparseable FINAL line is the crash-tail signature, not
+        # damage (a parseable final line with a bad checksum still is)
+        report.corrupt -= 1
+        report.torn_tail = True
+        if report.first_corrupt_line == report.total:
+            report.first_corrupt_line = 0
     return out
 
 
-def read_jsonl(path: Path) -> list[dict]:
+def read_jsonl(path: Path,
+               report: IntegrityReport | None = None) -> list[dict]:
     """Crash-tolerant JSONL *file* read: every parseable record in
     ``path``, skipping blanks, corrupt lines, and the truncated tail a
     writer that died mid-line leaves behind.  THE shared tail-reader for
     every append-only crash-evidence format (the flight recorder and the
     loop run journal both ride it), so a torn write degrades identically
-    everywhere instead of each reader inventing its own tolerance."""
+    everywhere instead of each reader inventing its own tolerance.
+    Pass a ``report`` to count checksum verdicts."""
+    if report is not None:
+        report.path = str(path)
     try:
         text = Path(path).read_text(encoding="utf-8")
     except OSError:
         return []
-    return parse_jsonl(text.splitlines())
+    return parse_jsonl(text.splitlines(), report)
+
+
+def read_verified_prefix(path: Path) -> tuple[list[dict], IntegrityReport]:
+    """The longest verified prefix of a checksummed JSONL file, for
+    folds whose CORRECTNESS rides the records (the run-journal durable
+    replay): unlike :func:`read_jsonl`, a damaged mid-file record does
+    not skip-and-continue -- the fold STOPS at the last verified record
+    before it and the report flags the damage, so ``--resume``
+    reconciles from truth rather than from records that survived a
+    corruption by accident.  A torn final line is still tolerated."""
+    report = IntegrityReport(path=str(path))
+    try:
+        text = Path(path).read_text(encoding="utf-8")
+    except OSError:
+        return [], report
+    lines = text.splitlines()
+    last_nonblank = -1
+    for i, line in enumerate(lines):
+        if line.strip():
+            last_nonblank = i
+    out: list[dict] = []
+    for i, line in enumerate(lines):
+        status, doc = classify_line(line)
+        if status == "blank":
+            continue
+        report.total += 1
+        if status == "ok":
+            report.verified += 1
+            out.append(doc)
+        elif status == "legacy":
+            report.legacy += 1
+            out.append(doc)
+        else:
+            if status == "garbled" and i == last_nonblank:
+                report.torn_tail = True
+            else:
+                report.corrupt += 1
+                report.first_corrupt_line = report.total
+            break
+    return out, report
+
+
+def verify_jsonl(path: Path) -> IntegrityReport:
+    """Full-file integrity scan (``clawker journal verify``): every
+    line classified, nothing skipped early.  A truncated final line
+    reads as ``torn_tail`` (a crash artifact, exit 0); anything else
+    unverifiable counts as ``corrupt`` (exit 2)."""
+    report = IntegrityReport(path=str(path))
+    try:
+        text = Path(path).read_text(encoding="utf-8")
+    except OSError:
+        return report
+    parse_jsonl(text.splitlines(), report)
+    return report
 
 
 @dataclass
@@ -82,6 +251,7 @@ class TailState:
     offset: int = 0
     carry: bytes = b""
     resets: int = 0
+    ino: int = -1               # st_ino of the generation being tailed
 
 
 def tail_jsonl(path: Path, state: TailState) -> list[dict]:
@@ -96,13 +266,18 @@ def tail_jsonl(path: Path, state: TailState) -> list[dict]:
     """
     path = Path(path)
     try:
-        size = path.stat().st_size
+        st = path.stat()
     except OSError:
         return []
-    if size < state.offset:         # rotated/truncated: start over
+    size = st.st_size
+    # rotated/truncated: start over.  Size alone cannot tell -- a
+    # rotation of fixed-width records can land the new generation at
+    # EXACTLY the stale offset -- so the cursor also pins the inode.
+    if size < state.offset or (state.ino >= 0 and st.st_ino != state.ino):
         state.offset = 0
         state.carry = b""
         state.resets += 1
+    state.ino = st.st_ino
     if size == state.offset:
         return []
     try:
@@ -157,11 +332,13 @@ def tail_rotated(path: Path, state: TailState) -> list[dict]:
     a second rotation between polls) loses records."""
     path = Path(path)
     try:
-        size = path.stat().st_size
+        st = path.stat()
+        size, ino = st.st_size, st.st_ino
     except OSError:
-        size = -1
+        size, ino = -1, -1
     out: list[dict] = []
-    if 0 <= size < state.offset:
+    if size >= 0 and (size < state.offset
+                      or (state.ino >= 0 and ino != state.ino)):
         try:
             with open(rotated_path(path), "rb") as f:
                 f.seek(state.offset - len(state.carry))
@@ -173,7 +350,8 @@ def tail_rotated(path: Path, state: TailState) -> list[dict]:
             pass        # double rotation / no .1: the remainder is gone
         state.offset = 0
         state.carry = b""
-        state.resets += 1
+        state.ino = -1          # adopt the new generation without a
+        state.resets += 1       # second reset inside tail_jsonl
     out.extend(tail_jsonl(path, state))
     return out
 
@@ -230,7 +408,7 @@ class FlightRecorder:
         if self._fh is None:
             self.dropped += 1
             return
-        line = json.dumps(record, separators=(",", ":"), default=str)
+        line = encode_record(record)
         with self._lock:
             if self._fh is None:
                 self.dropped += 1

@@ -376,3 +376,165 @@ class FakeBulkIndex:
             rows = list(self.docs.get(index, []))
         return [d for d in rows
                 if all(d.get(k) == v for k, v in match.items())]
+
+
+class FaultFS:
+    """Disk-fault injection shim for the WAL chain (docs/durability.md,
+    docs/chaos.md#disk-faults): a proxy wrapped around a journal's live
+    file handle that makes storage lie on command.  The chaos runner
+    installs it on a scheduler's ``RunJournal`` via :meth:`install`;
+    unit tests wrap any open file.
+
+    Fault knobs (armed counts; each triggered op decrements its arm):
+
+    - ``fail_writes(n, errno_)``: the next ``n`` writes raise (ENOSPC
+      by default -- a full disk; pass ``errno.EIO`` for a dying one);
+    - ``short_writes(n)``: the next ``n`` writes write only half the
+      payload, then raise -- a torn record on disk;
+    - ``fail_fsyncs(n)``: the next ``n`` fsyncs raise EIO *after* the
+      kernel may already have dropped the dirty pages -- the classic
+      false-success trap the journal's poisoned-handle recovery exists
+      for;
+    - ``power_cut()``: truncate the real file at the last
+      *successfully fsynced* offset -- everything after the last sync
+      vanishes, exactly like a host losing power;
+    - ``flip_bit(offset)`` / :func:`flip_bit_in_file`: corrupt one byte
+      in place (checksum-verify must flag it).
+
+    Counters (``writes``, ``failed_writes``, ``failed_fsyncs``,
+    ``synced_offset``) are the evidence the chaos *no-silent-drop*
+    audit compares against journal receipts and metrics.
+    """
+
+    def __init__(self, fh, path=None):
+        import errno as _errno
+
+        self._errno = _errno
+        self._fh = fh
+        self.path = path
+        self._lock = threading.Lock()
+        self._fail_writes = 0
+        self._fail_errno = _errno.ENOSPC
+        self._short_writes = 0
+        self._fail_fsyncs = 0
+        self.writes = 0
+        self.failed_writes = 0
+        self.short_written = 0
+        self.failed_fsyncs = 0
+        self.fsyncs = 0
+        self.synced_offset = 0      # file size at the last good fsync
+
+    # fault knobs ---------------------------------------------------------
+
+    def fail_writes(self, n: int = 1, errno_: int | None = None) -> None:
+        with self._lock:
+            self._fail_writes = int(n)
+            if errno_ is not None:
+                self._fail_errno = int(errno_)
+
+    def short_writes(self, n: int = 1) -> None:
+        with self._lock:
+            self._short_writes = int(n)
+
+    def fail_fsyncs(self, n: int = 1) -> None:
+        with self._lock:
+            self._fail_fsyncs = int(n)
+
+    def power_cut(self) -> int:
+        """Truncate the REAL file at the last fsynced offset: the
+        unsynced tail vanishes the way a power loss takes it.  Returns
+        the number of bytes cut."""
+        with self._lock:
+            offset = self.synced_offset
+        path = self.path or getattr(self._fh, "name", None)
+        if path is None:
+            return 0
+        try:
+            self._fh.flush()
+        except (OSError, ValueError):
+            pass
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb+") as f:
+                f.truncate(offset)
+            return max(0, size - offset)
+        except OSError:
+            return 0
+
+    @staticmethod
+    def flip_bit_in_file(path, offset: int, bit: int = 0) -> bool:
+        """Flip one bit of ``path`` in place (record corruption)."""
+        try:
+            with open(path, "rb+") as f:
+                f.seek(offset)
+                b = f.read(1)
+                if not b:
+                    return False
+                f.seek(offset)
+                f.write(bytes([b[0] ^ (1 << (bit & 7))]))
+            return True
+        except OSError:
+            return False
+
+    # file-handle proxy ---------------------------------------------------
+
+    def write(self, data: str) -> int:
+        with self._lock:
+            if self._fail_writes > 0:
+                self._fail_writes -= 1
+                self.failed_writes += 1
+                raise OSError(self._fail_errno,
+                              os.strerror(self._fail_errno))
+            if self._short_writes > 0:
+                self._short_writes -= 1
+                self.short_written += 1
+                half = data[:max(1, len(data) // 2)]
+                self._fh.write(half)
+                self.failed_writes += 1
+                raise OSError(self._errno.EIO, "short write")
+            self.writes += 1
+        return self._fh.write(data)
+
+    def flush(self) -> None:
+        self._fh.flush()
+
+    def fsync(self) -> None:
+        """The journal's fsync seam (``RunJournal._fsync_fh`` prefers
+        a handle-level fsync exactly so this shim can intercept)."""
+        with self._lock:
+            if self._fail_fsyncs > 0:
+                self._fail_fsyncs -= 1
+                self.failed_fsyncs += 1
+                raise OSError(self._errno.EIO, "fsync failed")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        with self._lock:
+            self.fsyncs += 1
+            try:
+                self.synced_offset = os.path.getsize(
+                    self.path or self._fh.name)
+            except (OSError, AttributeError):
+                pass
+
+    def fileno(self) -> int:
+        return self._fh.fileno()
+
+    def close(self) -> None:
+        self._fh.close()
+
+    @classmethod
+    def install(cls, journal) -> "FaultFS | None":
+        """Wrap a live ``RunJournal``'s handle in a FaultFS and return
+        it (None when the journal is disabled/unhealthy).  Subsequent
+        reopen-recoveries deliberately bypass the shim -- recovery
+        opens a FRESH fd, which is the behavior under test."""
+        fh = getattr(journal, "_fh", None)
+        if fh is None:
+            return None
+        shim = cls(fh, path=getattr(journal, "path", None))
+        try:
+            shim.synced_offset = os.path.getsize(shim.path)
+        except (OSError, TypeError):
+            pass
+        journal._fh = shim
+        return shim

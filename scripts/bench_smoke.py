@@ -64,6 +64,14 @@ Gates:
   `clawker chaos replay` repro + minimal shrunk schedule (ISSUE 8
   acceptance bar).  Includes the sentinel observe-only twin check.
   `--only chaos` runs just this gate (`make chaos-smoke`).
+- journal_checksum_overhead <= bench.JOURNAL_CHECKSUM_BUDGET_NS per
+  record: the CRC32 trailer the checksummed WAL writes on every
+  journal/flight append (docs/durability.md#verify), gated as the
+  encode delta over a bare json.dumps
+- disk_full_chaos: one seeded ENOSPC scenario against the live journal
+  must drain with ZERO invariant violations within
+  bench.DISK_FULL_CHAOS_BUDGET_S -- the degraded-durability path as a
+  standing gate, not soak draw luck (docs/chaos.md#disk-faults)
 - anomaly_flag_latency_p50 <= bench.ANOMALY_FLAG_LATENCY_BUDGET_S from
   an egress record appended to a worker stream to the typed
   anomaly.flag observable on the event bus, sentinel live over two
@@ -211,11 +219,15 @@ def main() -> int:
         WORKERD_DIRECT_RTT_MIN_RATIO,
         WORKERD_EVENT_OVERHEAD_BUDGET_MS,
         WORKERD_RTT_RATIO_BUDGET,
+        DISK_FULL_CHAOS_BUDGET_S,
+        JOURNAL_CHECKSUM_BUDGET_NS,
         bench_anomaly_flag_latency,
         bench_anomaly_fleet_score_tick,
         bench_chaos_soak,
         bench_console_repaint,
         bench_cross_process_fairness,
+        bench_disk_full_chaos,
+        bench_journal_checksum_overhead,
         bench_elastic_vs_static_p99,
         bench_engine_dials,
         bench_failover,
@@ -367,6 +379,16 @@ def main() -> int:
             elastic = retry
     flag_lat = bench_anomaly_flag_latency()
     score_tick = bench_anomaly_fleet_score_tick()
+    journal_crc = bench_journal_checksum_overhead()
+    for _ in range(2):
+        # nanosecond-scale encode cost on a shared box: a miss gets two
+        # re-measures, the best attempt is gated
+        if journal_crc["overhead_ns"] <= JOURNAL_CHECKSUM_BUDGET_NS:
+            break
+        retry = bench_journal_checksum_overhead()
+        if retry["overhead_ns"] < journal_crc["overhead_ns"]:
+            journal_crc = retry
+    disk_full = bench_disk_full_chaos()
     chaos = bench_chaos_soak()
     try:        # the parity worlds need the cryptography stack
         import cryptography  # noqa: F401
@@ -669,6 +691,20 @@ def main() -> int:
         failures.append(
             f"anomaly_fleet_score_tick {score_tick['tick_p50_s']}s > "
             f"{ANOMALY_TICK_BUDGET_S}s budget (one sharded tick)")
+    if journal_crc["overhead_ns"] > JOURNAL_CHECKSUM_BUDGET_NS:
+        failures.append(
+            f"journal_checksum_overhead {journal_crc['overhead_ns']}ns "
+            f"> {JOURNAL_CHECKSUM_BUDGET_NS}ns budget per record "
+            "(docs/durability.md#verify)")
+    if not disk_full["ok"]:
+        failures.append(
+            "disk_full_chaos: scenario violated invariant(s): "
+            + "; ".join(disk_full["violations"][:3]))
+    elif disk_full["wall_s"] > DISK_FULL_CHAOS_BUDGET_S:
+        failures.append(
+            f"disk_full_chaos {disk_full['wall_s']}s > "
+            f"{DISK_FULL_CHAOS_BUDGET_S}s budget (a full disk must "
+            "degrade the run, never wedge it)")
     _gate_chaos(chaos, failures)
     analyze = _gate_analyze(failures)
     if not parity["skipped"]:
@@ -709,6 +745,8 @@ def main() -> int:
         "elastic_vs_static_p99": elastic,
         "anomaly_flag_latency_p50": flag_lat,
         "anomaly_fleet_score_tick": score_tick,
+        "journal_checksum_overhead": journal_crc,
+        "disk_full_chaos": disk_full,
         "chaos_soak": chaos,
         "analyze": analyze,
         "parity_suite_wall": parity,

@@ -126,6 +126,87 @@ def test_wal_checker_not_disarmed_by_thread_start(tmp_path):
         f.message.split("`")[1] for f in found}
 
 
+# ----------------------------------------- durable-append checker
+
+DURABLE_BAD = {
+    "clawker_tpu/loop/warmpool.py": """
+    class P:
+        def fill(self, agent):
+            self._journal("pool_add", durable=True, agent=agent)
+            return agent
+    """,
+}
+
+DURABLE_GOOD = {
+    "clawker_tpu/loop/warmpool.py": """
+    class P:
+        def fill(self, agent):
+            rcpt = self._journal("pool_add", durable=True, agent=agent)
+            if not rcpt.synced:
+                return None
+            return agent
+
+        def wrapped(self, agent):
+            self._durable_ok(self._journal("pool_ready", durable=True),
+                             "pool_ready")
+
+        def chained(self, agent):
+            self._journal("pool_adopt", durable=True).require_durable()
+    """,
+}
+
+
+def test_durable_checker_fires_on_discarded_receipt(tmp_path):
+    found = findings_of(make_repo(tmp_path, DURABLE_BAD),
+                        "durable-append-checked")
+    assert len(found) == 1
+    assert "durable=True" in found[0].message
+    assert found[0].path == "clawker_tpu/loop/warmpool.py"
+
+
+def test_durable_checker_silent_on_consuming_twin(tmp_path):
+    assert findings_of(make_repo(tmp_path, DURABLE_GOOD),
+                       "durable-append-checked") == []
+
+
+def test_durable_checker_accepts_unhealthy_handler(tmp_path):
+    # the fail-stop policy surfaces the fault by raising: a discarded
+    # receipt under a JournalUnhealthy handler is still fail-loud
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/capacity/controller.py": """
+        from ..loop.journal import JournalUnhealthy
+
+        class C:
+            def scale(self):
+                try:
+                    self.hooks.journal("capacity_scale", durable=True)
+                except JournalUnhealthy:
+                    self._halt()
+        """,
+    })
+    assert findings_of(repo, "durable-append-checked") == []
+
+
+def test_durable_checker_ignores_passthrough_wrappers(tmp_path):
+    # durable=durable re-exports the receipt; only a literal True is a
+    # durable call site, and bare `journal(...)` with an unknown
+    # receiver is not the WAL
+    repo = make_repo(tmp_path, {
+        "clawker_tpu/loopd/server.py": """
+        class D:
+            def fanout(self, kind, durable):
+                rcpt = self._wal.append(kind, durable=durable)
+                for s in self.scheds:
+                    s._journal(kind, durable=durable)
+                return rcpt
+
+            def unrelated(self, recorder):
+                recorder.journal("note", durable=True)
+        """,
+    })
+    assert findings_of(repo, "durable-append-checked") == []
+
+
 # ------------------------------------------------- layering checker
 
 def test_layering_fires_on_sentinel_engine_import(tmp_path):

@@ -182,6 +182,30 @@ def test_tail_rotated_is_lossless_across_the_boundary(tmp_path):
     assert seen == list(range(60))
 
 
+def test_tail_detects_rotation_landing_at_equal_size(tmp_path):
+    """A rotation of fixed-width records lands the fresh generation at
+    EXACTLY the tail's stale byte offset -- size alone cannot see it,
+    so the cursor pins the inode (the regression: a poller frozen
+    forever at a boundary that happened to align)."""
+    import os
+
+    path = tmp_path / "flight.jsonl"
+
+    def write(recs):
+        path.write_text("".join(
+            json.dumps(r, separators=(",", ":")) + "\n" for r in recs),
+            encoding="utf-8")
+
+    write([{"i": 0, "pad": "aaaa"}, {"i": 1, "pad": "bbbb"}])
+    state = TailState()
+    assert [d["i"] for d in tail_rotated(path, state)] == [0, 1]
+    os.replace(path, rotated_path(path))
+    write([{"i": 2, "pad": "cccc"}, {"i": 3, "pad": "dddd"}])
+    assert path.stat().st_size == state.offset  # adversarial alignment
+    assert [d["i"] for d in tail_rotated(path, state)] == [2, 3]
+    assert state.resets == 1
+
+
 # ------------------------------------------------------------------ merge
 
 
